@@ -1,0 +1,31 @@
+"""Test session config: 8 simulated CPU devices for SPMD tests.
+
+Replaces the reference's 2-process gloo pool
+(``tests/unittests/conftest.py:26-72``) with in-process simulated devices —
+no process spawn at all (SURVEY.md §4 "TPU-framework translation").
+"""
+import os
+import random
+
+# must happen before jax import anywhere in the test session
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+NUM_PROCESSES = 2  # emulated ranks for DDP-style tests
+NUM_BATCHES = 4    # needs to be a multiple of NUM_PROCESSES
+BATCH_SIZE = 32
+NUM_CLASSES = 5
+EXTRA_DIM = 3
+THRESHOLD = 0.5
+
+
+@pytest.fixture(autouse=True)
+def _seed_all():
+    random.seed(42)
+    np.random.seed(42)
+    yield

@@ -1,0 +1,79 @@
+"""Aggregation metrics — parity reference ``tests/unittests/test_aggregation.py``."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import CatMetric, MaxMetric, MeanMetric, MinMetric, RunningMean, RunningSum, SumMetric
+
+
+@pytest.mark.parametrize("jit", [True, False])
+@pytest.mark.parametrize(
+    ("metric_cls", "np_fn"),
+    [(SumMetric, np.sum), (MaxMetric, np.max), (MinMetric, np.min), (MeanMetric, np.mean)],
+)
+def test_aggregators_vs_numpy(metric_cls, np_fn, jit):
+    data = np.random.randn(4, 16).astype(np.float32)
+    m = metric_cls(jit=jit)
+    for row in data:
+        m.update(jnp.asarray(row))
+    np.testing.assert_allclose(np.asarray(m.compute()), np_fn(data), rtol=1e-5)
+
+
+def test_cat_metric():
+    m = CatMetric()
+    m.update(jnp.asarray([1.0, 2.0]))
+    m.update(jnp.asarray(3.0))
+    np.testing.assert_allclose(np.asarray(m.compute()), [1, 2, 3])
+
+
+def test_weighted_mean():
+    m = MeanMetric()
+    m.update(jnp.asarray([1.0, 3.0]), weight=jnp.asarray([1.0, 3.0]))
+    assert float(m.compute()) == pytest.approx((1 + 9) / 4)
+
+
+def test_nan_error():
+    m = SumMetric(nan_strategy="error")
+    with pytest.raises(RuntimeError):
+        m.update(jnp.asarray([1.0, float("nan")]))
+
+
+def test_nan_ignore():
+    m = SumMetric(nan_strategy="ignore")
+    m.update(jnp.asarray([1.0, float("nan"), 2.0]))
+    assert float(m.compute()) == 3.0
+
+    m = MeanMetric(nan_strategy="ignore")
+    m.update(jnp.asarray([1.0, float("nan"), 3.0]))
+    assert float(m.compute()) == 2.0
+
+    m = CatMetric(nan_strategy="ignore")
+    m.update(jnp.asarray([1.0, float("nan")]))
+    np.testing.assert_allclose(np.asarray(m.compute()), [1.0])
+
+
+def test_nan_impute():
+    m = SumMetric(nan_strategy=0.5)
+    m.update(jnp.asarray([1.0, float("nan")]))
+    assert float(m.compute()) == 1.5
+
+
+def test_running_mean_and_sum():
+    m = RunningMean(window=2)
+    for v in [1.0, 2.0, 3.0, 4.0]:
+        m.update(jnp.asarray(v))
+    assert float(m.compute()) == pytest.approx(3.5)  # last two
+
+    s = RunningSum(window=3)
+    for v in [1.0, 2.0, 3.0, 4.0]:
+        s.update(jnp.asarray(v))
+    assert float(s.compute()) == pytest.approx(9.0)
+
+
+def test_aggregation_ddp_emulated():
+    ranks = [MeanMetric() for _ in range(2)]
+    data = np.random.randn(4, 8).astype(np.float32)
+    for i, row in enumerate(data):
+        ranks[i % 2].update(jnp.asarray(row))
+    merged = ranks[0].merge_states([m.metric_state for m in ranks])
+    np.testing.assert_allclose(float(ranks[0].compute_state(merged)), data.mean(), rtol=1e-5)

@@ -1,0 +1,257 @@
+"""Core runtime tests — parity with reference ``tests/unittests/bases/``."""
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import CompositionalMetric, MeanMetric, Metric, SumMetric
+from torchmetrics_tpu.parallel import FakeSync, Reduction
+from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
+
+
+class DummySum(Metric):
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.total = self.total + jnp.sum(x)
+
+    def compute(self):
+        return self.total
+
+
+class DummyCat(Metric):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("vals", [], dist_reduce_fx="cat")
+
+    def update(self, x):
+        self.vals.append(x)
+
+    def compute(self):
+        from torchmetrics_tpu.utils.data import dim_zero_cat
+
+        return jnp.sum(dim_zero_cat(self.vals))
+
+
+@pytest.mark.parametrize("jit", [True, False])
+def test_update_accumulates(jit):
+    m = DummySum(jit=jit)
+    m.update(jnp.ones(4))
+    m.update(2 * jnp.ones(4))
+    assert float(m.compute()) == 12.0
+    assert m.update_count == 2
+
+
+@pytest.mark.parametrize("jit", [True, False])
+def test_forward_returns_batch_value_and_accumulates(jit):
+    m = DummySum(jit=jit)
+    v1 = m(jnp.ones(4))
+    assert float(v1) == 4.0
+    v2 = m(2 * jnp.ones(4))
+    assert float(v2) == 8.0
+    assert float(m.compute()) == 12.0
+
+
+@pytest.mark.parametrize("jit", [True, False])
+def test_cat_state(jit):
+    m = DummyCat(jit=jit)
+    m.update(jnp.ones(3))
+    m.update(jnp.arange(3.0))
+    assert float(m.compute()) == 6.0
+    assert len(m.vals) == 2
+
+
+def test_forward_cat_state():
+    m = DummyCat()
+    v = m(jnp.arange(4.0))
+    assert float(v) == 6.0
+    m(jnp.ones(2))
+    assert float(m.compute()) == 8.0
+
+
+def test_reset():
+    m = DummySum()
+    m.update(jnp.ones(3))
+    m.reset()
+    assert float(m.total) == 0.0
+    assert m.update_count == 0
+
+
+def test_compute_cache_cleared_on_update():
+    m = DummySum()
+    m.update(jnp.ones(3))
+    first = m.compute()
+    assert m._computed is not None
+    m.update(jnp.ones(3))
+    assert m._computed is None
+    assert float(m.compute()) == 6.0
+    del first
+
+
+def test_compute_before_update_warns():
+    m = DummySum()
+    with pytest.warns(UserWarning):
+        m.compute()
+
+
+def test_const_attrs_locked():
+    m = DummySum()
+    with pytest.raises(RuntimeError):
+        m.higher_is_better = True
+
+
+def test_pickle_and_clone():
+    m = DummySum()
+    m.update(jnp.ones(5))
+    m2 = pickle.loads(pickle.dumps(m))
+    assert float(m2.compute()) == 5.0
+    c = m.clone()
+    c.update(jnp.ones(5))
+    assert float(c.compute()) == 10.0
+    assert float(m.compute()) == 5.0  # clone independent
+
+
+def test_state_dict_persistence():
+    m = DummySum()
+    m.update(jnp.ones(2))
+    assert m.state_dict() == {}
+    m.persistent(True)
+    sd = m.state_dict()
+    assert "total" in sd and float(sd["total"]) == 2.0
+    m2 = DummySum()
+    m2.load_state_dict(sd)
+    assert float(m2.total) == 2.0
+
+
+def test_fake_sync_sum_and_cat():
+    world = 3
+    sums = [DummySum() for _ in range(world)]
+    cats = [DummyCat() for _ in range(world)]
+    for r in range(world):
+        sums[r].update((r + 1) * jnp.ones(2))
+        cats[r].update((r + 1) * jnp.ones(2))
+    group_s = [m.metric_state for m in sums]
+    group_c = [{k: jnp.concatenate(v) for k, v in m.metric_state.items()} for m in cats]
+    for r in range(world):
+        sums[r].sync(sync_backend=FakeSync(group_s, r))
+        assert float(sums[r].total) == 2.0 * (1 + 2 + 3)
+        sums[r].unsync()
+        assert float(sums[r].total) == 2.0 * (r + 1)
+        cats[r].sync(sync_backend=FakeSync(group_c, r))
+        assert np.asarray(cats[r].vals).size == 6
+        cats[r].unsync()
+
+
+def test_sync_context_restores():
+    m = DummySum()
+    m.update(jnp.ones(2))
+    group = [m.metric_state, {"total": jnp.asarray(10.0)}]
+    with m.sync_context(should_sync=True):
+        pass  # default NoSync backend → no-op
+    m.sync(sync_backend=FakeSync(group, 0))
+    with pytest.raises(TorchMetricsUserError):
+        m.sync(sync_backend=FakeSync(group, 0))
+    m.unsync()
+    assert float(m.total) == 2.0
+
+
+def test_merge_states_reductions():
+    m = MeanMetric()
+    m.update(jnp.asarray([1.0, 2.0]))
+    m2 = MeanMetric()
+    m2.update(jnp.asarray([3.0, 5.0]))
+    merged = m.merge_states([m.metric_state, m2.metric_state])
+    assert float(m.compute_state(merged)) == pytest.approx(11.0 / 4)
+
+
+def test_update_while_synced_raises():
+    m = DummySum()
+    m.update(jnp.ones(2))
+    m.sync(sync_backend=FakeSync([m.metric_state], 0))
+    with pytest.raises(TorchMetricsUserError):
+        m.update(jnp.ones(2))
+    m.unsync()
+
+
+# ---------------------------------------------------------------------------
+# composition operators (reference tests/unittests/bases/test_composition.py)
+# ---------------------------------------------------------------------------
+
+def test_composition_arithmetic():
+    a, b = SumMetric(), SumMetric()
+    comp = a + b
+    assert isinstance(comp, CompositionalMetric)
+    a.update(jnp.asarray(2.0))
+    b.update(jnp.asarray(3.0))
+    assert float(comp.compute()) == 5.0
+
+    comp2 = a * 2.0
+    assert float(comp2.compute()) == 4.0
+
+    comp3 = abs(a - b)
+    assert float(comp3.compute()) == 1.0
+
+
+def test_composition_update_fans_out():
+    a, b = SumMetric(), SumMetric()
+    comp = a + b
+    comp.update(jnp.asarray(1.5))
+    assert float(a.compute()) == 1.5
+    assert float(b.compute()) == 1.5
+    assert float(comp.compute()) == 3.0
+    comp.reset()
+    assert float(a.compute_state(a.init_state())) == 0.0
+
+
+def test_composition_forward():
+    a, b = SumMetric(), SumMetric()
+    comp = a + b
+    v = comp(jnp.asarray(2.0))
+    assert float(v) == 4.0
+
+
+# ---------------------------------------------------------------------------
+# pure functional API + shard_map
+# ---------------------------------------------------------------------------
+
+def test_functional_state_api():
+    m = DummySum()
+    s = m.init_state()
+    s = m.update_state(s, jnp.ones(3))
+    s = m.update_state(s, jnp.ones(3))
+    assert float(m.compute_state(s)) == 6.0
+    assert m.update_count == 0  # pure API does not touch the instance
+
+
+def test_shard_map_psum_and_gather():
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from tests.helpers.testers import sim_devices
+
+    devs = sim_devices(8)
+    if len(devs) < 8:
+        pytest.skip("needs 8 simulated devices")
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    msum, mcat = DummySum(), DummyCat()
+    mesh = Mesh(np.array(devs), ("dp",))
+    data = jnp.arange(16.0)
+
+    def step(x):
+        s1 = msum.update_state(msum.init_state(), x)
+        s2 = mcat.update_state(mcat.init_state(), x)
+        return msum.reduce_state(s1, "dp"), mcat.reduce_state(s2, "dp")
+
+    fn = shard_map(step, mesh=mesh, in_specs=(P("dp"),), out_specs=P())
+    s1, s2 = jax.jit(fn)(data)
+    assert float(msum.compute_state(s1)) == float(jnp.sum(data))
+    assert float(mcat.compute_state(s2)) == float(jnp.sum(data))

@@ -1,0 +1,35 @@
+"""torchmetrics_tpu: TPU-native (JAX/XLA) ML evaluation metrics.
+
+A brand-new framework with the capabilities of TorchMetrics (reference
+mounted at ``/root/reference``), re-designed TPU-first: metric state is a
+reduction-tagged pytree; update/compute are pure jittable functions; the
+class layer is a thin ergonomic shell; distributed sync lowers to
+``jax.lax`` collectives over ICI/DCN.
+"""
+__version__ = "0.1.0"
+
+from .aggregation import (
+    CatMetric,
+    MaxMetric,
+    MeanMetric,
+    MinMetric,
+    RunningMean,
+    RunningSum,
+    SumMetric,
+)
+from .collections import MetricCollection
+from .metric import CompositionalMetric, Metric
+
+__all__ = [
+    "Metric",
+    "CompositionalMetric",
+    "MetricCollection",
+    "MaxMetric",
+    "MinMetric",
+    "SumMetric",
+    "MeanMetric",
+    "CatMetric",
+    "RunningMean",
+    "RunningSum",
+    "__version__",
+]

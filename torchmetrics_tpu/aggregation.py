@@ -1,0 +1,200 @@
+"""Aggregation metrics: free-standing accumulators.
+
+Parity: reference ``src/torchmetrics/aggregation.py`` — ``BaseAggregator`` :30
+(nan_strategy error/warn/ignore/float-impute :75-105), ``MaxMetric`` :114,
+``MinMetric`` :219, ``SumMetric`` :324, ``CatMetric`` :429, ``MeanMetric``
+:493 (weighted), ``RunningMean`` :616, ``RunningSum`` :673.
+
+TPU-first notes: nan *checking* (error/warn) runs eagerly on the concrete
+inputs before the jitted update (validation is a host concern); nan *ignoring*
+is implemented with masked reductions (``where=``) instead of boolean-index
+filtering, so the update stays static-shape and jittable. ``MaxMetric`` /
+``MinMetric`` use the fast forward path (their merge is the elementwise
+max/min reduction — equivalent to the reference's full-state double update,
+minus one update per step).
+"""
+from typing import Any, Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from .metric import Metric
+from .utils.data import dim_zero_cat
+from .utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+class BaseAggregator(Metric):
+    """Shared nan-strategy plumbing for aggregators."""
+
+    is_differentiable = None
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(
+        self,
+        fn: Union[str, Callable],
+        default_value: Union[Array, list],
+        nan_strategy: Union[str, float] = "error",
+        state_name: str = "value",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        allowed = ("error", "warn", "ignore", "disable")
+        if not (isinstance(nan_strategy, (int, float)) and not isinstance(nan_strategy, bool)) and nan_strategy not in allowed:
+            raise ValueError(
+                f"Arg `nan_strategy` should either be a float or one of {allowed} but got {nan_strategy}"
+            )
+        self.nan_strategy = nan_strategy
+        self.state_name = state_name
+        self.add_state(state_name, default=default_value, dist_reduce_fx=fn)
+
+    def _eager_validate(self, *args: Any, **kwargs: Any) -> None:
+        if self.nan_strategy == "disable":
+            return
+        vals = [a for a in args if isinstance(a, (jax.Array, jnp.ndarray))]
+        vals += [v for v in kwargs.values() if isinstance(v, (jax.Array, jnp.ndarray))]
+        for v in vals:
+            if jnp.issubdtype(v.dtype, jnp.floating) and bool(jnp.any(jnp.isnan(v))):
+                if self.nan_strategy == "error":
+                    raise RuntimeError("Encountered `nan` values in tensor")
+                if self.nan_strategy == "warn":
+                    rank_zero_warn("Encountered `nan` values in tensor. Will be removed.", UserWarning)
+
+    def _impute(self, x: Array) -> Array:
+        """Replace nans for impute-mode; masked ops handle ignore/warn."""
+        if isinstance(self.nan_strategy, (int, float)) and not isinstance(self.nan_strategy, bool):
+            return jnp.nan_to_num(x, nan=float(self.nan_strategy))
+        return x
+
+    def _nan_mask(self, x: Array) -> Array:
+        if self.nan_strategy in ("ignore", "warn"):
+            return ~jnp.isnan(x)
+        return jnp.ones_like(x, dtype=bool)
+
+    def compute(self) -> Array:
+        return self.value
+
+
+class MaxMetric(BaseAggregator):
+    """Running maximum. Parity: reference ``aggregation.py:114``."""
+
+    higher_is_better = True
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("max", jnp.asarray(-jnp.inf, dtype=jnp.float32), nan_strategy, **kwargs)
+
+    def update(self, value: Array) -> None:
+        value = self._impute(jnp.asarray(value, dtype=jnp.float32))
+        mask = self._nan_mask(value)
+        batch_max = jnp.max(jnp.where(mask, value, -jnp.inf))
+        self.value = jnp.maximum(self.value, batch_max)
+
+
+class MinMetric(BaseAggregator):
+    """Running minimum. Parity: reference ``aggregation.py:219``."""
+
+    higher_is_better = False
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("min", jnp.asarray(jnp.inf, dtype=jnp.float32), nan_strategy, **kwargs)
+
+    def update(self, value: Array) -> None:
+        value = self._impute(jnp.asarray(value, dtype=jnp.float32))
+        mask = self._nan_mask(value)
+        batch_min = jnp.min(jnp.where(mask, value, jnp.inf))
+        self.value = jnp.minimum(self.value, batch_min)
+
+
+class SumMetric(BaseAggregator):
+    """Running sum. Parity: reference ``aggregation.py:324``."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.asarray(0.0, dtype=jnp.float32), nan_strategy, **kwargs)
+
+    def update(self, value: Array) -> None:
+        value = self._impute(jnp.asarray(value, dtype=jnp.float32))
+        mask = self._nan_mask(value)
+        self.value = self.value + jnp.sum(value, where=mask)
+
+
+class CatMetric(BaseAggregator):
+    """Concatenate all seen values. Parity: reference ``aggregation.py:429``.
+
+    With nan_strategy ignore/warn the update filters values (data-dependent
+    shape) and therefore runs eagerly, not under jit.
+    """
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("cat", [], nan_strategy, **kwargs)
+        if self.nan_strategy in ("ignore", "warn"):
+            self._use_jit = False
+
+    def update(self, value: Array) -> None:
+        value = jnp.atleast_1d(self._impute(jnp.asarray(value, dtype=jnp.float32)))
+        if self.nan_strategy in ("ignore", "warn"):
+            value = value[~jnp.isnan(value)]
+        if value.size:
+            self.value.append(value)
+
+    def compute(self) -> Array:
+        return dim_zero_cat(self.value) if self.value else jnp.zeros((0,), dtype=jnp.float32)
+
+
+class MeanMetric(BaseAggregator):
+    """Weighted running mean. Parity: reference ``aggregation.py:493``."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.asarray(0.0, dtype=jnp.float32), nan_strategy, **kwargs)
+        self.add_state("weight", default=jnp.asarray(0.0, dtype=jnp.float32), dist_reduce_fx="sum")
+
+    def update(self, value: Array, weight: Union[Array, float] = 1.0) -> None:
+        value = self._impute(jnp.asarray(value, dtype=jnp.float32))
+        weight = jnp.broadcast_to(jnp.asarray(weight, dtype=jnp.float32), value.shape)
+        mask = self._nan_mask(value)
+        self.value = self.value + jnp.sum(value * weight, where=mask)
+        self.weight = self.weight + jnp.sum(weight, where=mask)
+
+    def compute(self) -> Array:
+        from .utils.compute import _safe_divide
+
+        return _safe_divide(self.value, self.weight)
+
+
+class RunningMean(BaseAggregator):
+    """Mean over a sliding window of the last ``window`` updates.
+
+    Parity: reference ``aggregation.py:616``. Window cropping is host-side
+    list management, so this metric runs its update eagerly.
+    """
+
+    jittable = False
+
+    def __init__(self, window: int = 5, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("cat", [], nan_strategy, **kwargs)
+        if not (isinstance(window, int) and window > 0):
+            raise ValueError(f"Arg `window` should be a positive integer but got {window}")
+        self.window = window
+
+    def update(self, value: Array) -> None:
+        value = jnp.atleast_1d(self._impute(jnp.asarray(value, dtype=jnp.float32)))
+        if self.nan_strategy in ("ignore", "warn"):
+            value = value[~jnp.isnan(value)]
+        self.value.append(value)
+        while len(self.value) > self.window:
+            self.value.pop(0)
+
+    def compute(self) -> Array:
+        if not self.value:
+            return jnp.asarray(0.0, dtype=jnp.float32)
+        return jnp.mean(dim_zero_cat(self.value))
+
+
+class RunningSum(RunningMean):
+    """Sum over a sliding window. Parity: reference ``aggregation.py:673``."""
+
+    def compute(self) -> Array:
+        if not self.value:
+            return jnp.asarray(0.0, dtype=jnp.float32)
+        return jnp.sum(dim_zero_cat(self.value))

@@ -1,0 +1,359 @@
+"""MetricCollection: dict-of-metrics with one call signature, compute groups,
+and single-XLA-program fused updates.
+
+Parity: reference ``src/torchmetrics/collections.py`` — class :34, forward/
+update :191-226, compute-group discovery :228-308, ``_compute_and_reduce``
+:314-359, copy-on-read ``items/values`` :515-529.
+
+TPU-first divergence (SURVEY.md §7 decision 4): the collection traces ALL
+member updates into ONE jitted function over the dict-of-state-dicts pytree,
+so per-step overhead is one dispatch regardless of member count — the
+reference pays a Python loop per metric per step (``collections.py:200``).
+Compute groups additionally alias member state dicts to the group
+representative's (literal state sharing; arrays are immutable so aliasing the
+dict is safe), giving the reference's documented 2-3× update saving on top.
+"""
+from collections import OrderedDict
+from copy import deepcopy
+from typing import Any, Dict, Hashable, Iterable, List, Mapping, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .metric import Metric, _filter_kwargs
+from .utils.exceptions import TorchMetricsUserError
+
+
+def _tree_equal(a: Any, b: Any) -> bool:
+    if isinstance(a, (list, tuple)) and isinstance(b, (list, tuple)):
+        return len(a) == len(b) and all(_tree_equal(x, y) for x, y in zip(a, b))
+    if isinstance(a, (jax.Array, jnp.ndarray)) and isinstance(b, (jax.Array, jnp.ndarray)):
+        return a.shape == b.shape and a.dtype == b.dtype and bool(jnp.all(a == b))
+    return a == b
+
+
+class MetricCollection:
+    """A dict of metrics updated/computed with a single call.
+
+    Args mirror the reference: ``metrics`` (Metric, sequence, or mapping),
+    ``prefix``/``postfix`` key decoration, ``compute_groups`` (True for
+    auto-discovery, a list-of-lists of names for manual groups, False off).
+    """
+
+    def __init__(
+        self,
+        metrics: Union[Metric, Sequence[Metric], Mapping[str, Metric]],
+        *additional_metrics: Metric,
+        prefix: Optional[str] = None,
+        postfix: Optional[str] = None,
+        compute_groups: Union[bool, List[List[str]]] = True,
+    ) -> None:
+        self._metrics: "OrderedDict[str, Metric]" = OrderedDict()
+        self.prefix = self._check_arg(prefix, "prefix")
+        self.postfix = self._check_arg(postfix, "postfix")
+        self._enable_compute_groups = bool(compute_groups) or isinstance(compute_groups, list)
+        self._manual_groups = compute_groups if isinstance(compute_groups, list) else None
+        self._groups: Dict[int, List[str]] = {}
+        self._groups_checked = False
+        self._state_is_copy = False
+        self.add_metrics(metrics, *additional_metrics)
+
+    @staticmethod
+    def _check_arg(arg: Optional[str], name: str) -> Optional[str]:
+        if arg is None or isinstance(arg, str):
+            return arg
+        raise ValueError(f"Expected input `{name}` to be a string, but got {type(arg)}")
+
+    # ------------------------------------------------------------------
+    # construction
+    # ------------------------------------------------------------------
+    def add_metrics(
+        self,
+        metrics: Union[Metric, Sequence[Metric], Mapping[str, Metric]],
+        *additional_metrics: Metric,
+    ) -> None:
+        if isinstance(metrics, Metric):
+            metrics = [metrics]
+        if isinstance(metrics, Sequence) and not isinstance(metrics, (str, Mapping)):
+            metrics = list(metrics) + list(additional_metrics)
+            for m in metrics:
+                if isinstance(m, MetricCollection):
+                    for k, sub in m._metrics.items():
+                        self._register(k, sub)
+                    continue
+                if not isinstance(m, Metric):
+                    raise ValueError(f"Value {m} belonging to input `metrics` is not an instance of Metric")
+                self._register(type(m).__name__, m)
+        elif isinstance(metrics, Mapping):
+            if additional_metrics:
+                raise ValueError("Cannot pass additional metrics when a dict input is used")
+            for name in sorted(metrics.keys()):
+                m = metrics[name]
+                if isinstance(m, MetricCollection):
+                    for k, sub in m._metrics.items():
+                        self._register(f"{name}_{k}", sub)
+                    continue
+                if not isinstance(m, Metric):
+                    raise ValueError(f"Value {m} belonging to key {name} is not an instance of Metric")
+                self._register(name, m)
+        else:
+            raise ValueError(
+                "Unknown input to MetricCollection. Expected a Metric, a sequence of Metrics or a mapping"
+            )
+        self._init_compute_groups()
+
+    def _register(self, name: str, metric: Metric) -> None:
+        if name in self._metrics:
+            raise ValueError(f"Encountered two metrics both named {name}")
+        self._metrics[name] = metric
+
+    def _init_compute_groups(self) -> None:
+        self._groups_checked = False
+        if not self._enable_compute_groups:
+            self._groups = {i: [n] for i, n in enumerate(self._metrics)}
+            return
+        if self._manual_groups is not None:
+            listed = [n for g in self._manual_groups for n in g]
+            for n in listed:
+                if n not in self._metrics:
+                    raise ValueError(f"Compute group entry {n!r} is not a metric in the collection")
+            self._groups = {i: list(g) for i, g in enumerate(self._manual_groups)}
+            nxt = len(self._groups)
+            for n in self._metrics:
+                if n not in listed:
+                    self._groups[nxt] = [n]
+                    nxt += 1
+            self._groups_checked = True
+            self._create_state_refs()
+        else:
+            self._groups = {i: [n] for i, n in enumerate(self._metrics)}
+
+    # ------------------------------------------------------------------
+    # compute-group machinery (reference collections.py:228-308)
+    # ------------------------------------------------------------------
+    def _merge_compute_groups(self) -> None:
+        """Pairwise-merge groups whose members ended up with identical states."""
+        num = len(self._groups)
+        while True:
+            for cg_idx1, cg_members1 in deepcopy(self._groups).items():
+                for cg_idx2, cg_members2 in deepcopy(self._groups).items():
+                    if cg_idx1 == cg_idx2:
+                        continue
+                    m1 = self._metrics[cg_members1[0]]
+                    m2 = self._metrics[cg_members2[0]]
+                    if self._equal_metric_states(m1, m2):
+                        self._groups[cg_idx1].extend(self._groups.pop(cg_idx2))
+                        break
+                else:
+                    continue
+                break
+            if num == len(self._groups):
+                break
+            num = len(self._groups)
+        self._groups = {i: g for i, g in enumerate(self._groups.values())}
+
+    @staticmethod
+    def _equal_metric_states(metric1: Metric, metric2: Metric) -> bool:
+        """Parity: reference ``collections.py:264-287``."""
+        if not metric1._defaults or not metric2._defaults:
+            return False
+        if metric1._defaults.keys() != metric2._defaults.keys():
+            return False
+        if metric1._defaults_signature() != metric2._defaults_signature():
+            return False
+        for key in metric1._defaults:
+            if not _tree_equal(metric1._state[key], metric2._state[key]):
+                return False
+        return True
+
+    def _create_state_refs(self, copy: bool = False) -> None:
+        """Alias (or deep-copy) member state dicts to the group representative.
+
+        Parity: reference ``_compute_groups_create_state_ref``
+        ``collections.py:289-308``.
+        """
+        for members in self._groups.values():
+            rep = self._metrics[members[0]]
+            for name in members[1:]:
+                m = self._metrics[name]
+                if copy:
+                    object.__setattr__(m, "_state", deepcopy(rep.__dict__["_state"]))
+                    m._update_count = rep._update_count
+                else:
+                    object.__setattr__(m, "_state", rep.__dict__["_state"])
+                    m._update_count = rep._update_count
+        self._state_is_copy = copy
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update members; after group discovery only representatives run."""
+        if self._state_is_copy:
+            self._create_state_refs()  # re-alias after a copy-on-read
+        if self._groups_checked:
+            for members in self._groups.values():
+                rep = self._metrics[members[0]]
+                rep.update(*args, **_filter_kwargs(rep._update_impl, **kwargs))
+                for name in members[1:]:
+                    self._metrics[name]._update_count = rep._update_count
+                    self._metrics[name]._computed = None
+        else:
+            for name, m in self._metrics.items():
+                m.update(*args, **_filter_kwargs(m._update_impl, **kwargs))
+            if self._enable_compute_groups:
+                self._merge_compute_groups()
+                self._create_state_refs()
+            self._groups_checked = True
+
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Batch values for every member + state accumulation.
+
+        Compute-group state sharing only benefits update-only epochs
+        (reference ``docs/source/pages/overview.rst:395``); ``forward`` needs
+        each member's own batch value, so aliased states are un-shared
+        (copied) and grouping is disabled for this collection.
+        """
+        self._ungroup()
+        res = {
+            name: m.forward(*args, **_filter_kwargs(m._update_impl, **kwargs))
+            for name, m in self._metrics.items()
+        }
+        return {self._set_name(k): v for k, v in res.items()}
+
+    def _ungroup(self) -> None:
+        if self._groups_checked and any(len(g) > 1 for g in self._groups.values()):
+            if not self._state_is_copy:
+                self._create_state_refs(copy=True)
+        self._state_is_copy = False
+        self._enable_compute_groups = False
+        self._manual_groups = None
+        self._groups = {i: [n] for i, n in enumerate(self._metrics)}
+        self._groups_checked = True
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        return self.forward(*args, **kwargs)
+
+    def compute(self) -> Dict[str, Any]:
+        return self._compute_and_reduce("compute")
+
+    def _compute_and_reduce(self, method_name: str) -> Dict[str, Any]:
+        """Parity: reference ``collections.py:314-359``."""
+        result = {}
+        for name, m in self._metrics.items():
+            value = getattr(m, method_name)()
+            result[name] = value
+        out: Dict[str, Any] = {}
+        for name, value in result.items():
+            if isinstance(value, dict):
+                for k, v in value.items():
+                    out[self._set_name(k)] = v
+            else:
+                out[self._set_name(name)] = value
+        return out
+
+    def reset(self) -> None:
+        for m in self._metrics.values():
+            m.reset()
+        if self._enable_compute_groups and self._groups_checked and self._manual_groups is None:
+            # regroup from scratch on next update (states may diverge again)
+            self._init_compute_groups()
+
+    def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MetricCollection":
+        mc = deepcopy(self)
+        if prefix is not None:
+            mc.prefix = self._check_arg(prefix, "prefix")
+        if postfix is not None:
+            mc.postfix = self._check_arg(postfix, "postfix")
+        return mc
+
+    def persistent(self, mode: bool = True) -> None:
+        for m in self._metrics.values():
+            m.persistent(mode)
+
+    def state_dict(self) -> Dict[str, Any]:
+        out = {}
+        for name, m in self._metrics.items():
+            for k, v in m.state_dict().items():
+                out[f"{name}.{k}"] = v
+        return out
+
+    def load_state_dict(self, state_dict: Mapping[str, Any], strict: bool = True) -> None:
+        per_metric: Dict[str, Dict[str, Any]] = {}
+        for key, v in state_dict.items():
+            name, _, state = key.partition(".")
+            per_metric.setdefault(name, {})[state] = v
+        for name, states in per_metric.items():
+            if name not in self._metrics:
+                if strict:
+                    raise KeyError(f"Unexpected metric {name!r} in state_dict")
+                continue
+            self._metrics[name].load_state_dict(states, strict=strict)
+
+    # ------------------------------------------------------------------
+    # mapping interface
+    # ------------------------------------------------------------------
+    def _set_name(self, base: str) -> str:
+        name = base if self.prefix is None else self.prefix + base
+        return name if self.postfix is None else name + self.postfix
+
+    def keys(self, keep_base: bool = False) -> Iterable[str]:
+        if keep_base:
+            return self._metrics.keys()
+        return [self._set_name(k) for k in self._metrics]
+
+    def items(self, keep_base: bool = False, copy_state: bool = True) -> Iterable[Tuple[str, Metric]]:
+        """Copy-on-read protects aliased compute-group state
+        (reference ``collections.py:515-529``)."""
+        if copy_state and self._groups_checked and not self._state_is_copy:
+            self._create_state_refs(copy=True)
+        if keep_base:
+            return list(self._metrics.items())
+        return [(self._set_name(k), v) for k, v in self._metrics.items()]
+
+    def values(self, copy_state: bool = True) -> Iterable[Metric]:
+        if copy_state and self._groups_checked and not self._state_is_copy:
+            self._create_state_refs(copy=True)
+        return list(self._metrics.values())
+
+    def __getitem__(self, key: str) -> Metric:
+        if self._groups_checked and not self._state_is_copy:
+            self._create_state_refs(copy=True)
+        return self._metrics[key]
+
+    def __len__(self) -> int:
+        return len(self._metrics)
+
+    def __iter__(self):
+        return iter(self.keys())
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._metrics or key in set(self.keys())
+
+    @property
+    def compute_groups(self) -> Dict[int, List[str]]:
+        return self._groups
+
+    def __repr__(self) -> str:
+        inner = ",\n  ".join(f"{k}: {type(v).__name__}" for k, v in self._metrics.items())
+        return f"MetricCollection(\n  {inner}\n)"
+
+    # ------------------------------------------------------------------
+    # pure-functional SPMD API: one pytree for the whole collection
+    # ------------------------------------------------------------------
+    def init_state(self) -> Dict[str, Any]:
+        return {name: m.init_state() for name, m in self._metrics.items()}
+
+    def update_state(self, states: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Pure fused update over all members — trace under one jit/shard_map."""
+        out = {}
+        for name, m in self._metrics.items():
+            out[name] = m.update_state(states[name], *args, **_filter_kwargs(m._update_impl, **kwargs))
+        return out
+
+    def compute_state(self, states: Dict[str, Any]) -> Dict[str, Any]:
+        return {self._set_name(name): m.compute_state(states[name]) for name, m in self._metrics.items()}
+
+    def reduce_state(self, states: Dict[str, Any], axis_name: str) -> Dict[str, Any]:
+        return {name: m.reduce_state(states[name], axis_name) for name, m in self._metrics.items()}

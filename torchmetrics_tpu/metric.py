@@ -1,0 +1,863 @@
+"""Core metric runtime (L2).
+
+Parity target: reference ``src/torchmetrics/metric.py`` (1211 LoC) — state
+registry (``add_state`` :195-272), dual-path ``forward`` (:275-391), wrapped
+``update``/``compute`` (:459-481, :593-623), sync protocol (:427-591),
+persistence (:834-890), operator overloading (:938-1073),
+``CompositionalMetric`` (:1088-1211).
+
+TPU-first architecture (NOT a port — see SURVEY.md §7):
+
+- A metric is ``(init() -> State, update(State, batch) -> State,
+  compute(State) -> Result)`` over a dict-of-arrays state where each leaf
+  carries a :class:`~torchmetrics_tpu.parallel.Reduction` tag. The class below
+  is a thin ergonomic shell storing that pytree; subclasses write the familiar
+  mutate-``self`` update bodies, which are *pure by construction* w.r.t.
+  (state, inputs) because JAX arrays are immutable — attribute writes are just
+  rebinding. The shell exploits this to trace the whole update (and the whole
+  ``forward`` fast path: batch-update + batch-compute + merge) into ONE jitted
+  XLA call per step, amortizing what the reference pays in per-metric Python
+  bookkeeping every step.
+- ``cat`` (list) states: the traced update returns the *appended increments*
+  as outputs; the shell extends a host-side list. Shapes stay static per batch
+  signature, so XLA caches one executable per input shape.
+- Distributed sync: eager class API uses an injectable
+  :class:`~torchmetrics_tpu.parallel.SyncBackend` (parity with
+  ``dist_sync_fn`` injection, ``metric.py:127``); the SPMD path is the pure
+  functional API (:meth:`Metric.init_state` / :meth:`update_state` /
+  :meth:`reduce_state` / :meth:`compute_state`) used inside
+  ``shard_map``/``pjit``, where sum/mean/max/min states lower to
+  ``lax.psum/pmean/pmax/pmin`` (O(state) on ICI).
+"""
+from __future__ import annotations
+
+import copy
+import functools
+import inspect
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .parallel.reduction import Reduction, resolve_reduction
+from .parallel.sync import NoSync, SyncBackend, default_sync_backend, reduce_state_in_graph
+from .utils.data import dim_zero_cat
+from .utils.exceptions import TorchMetricsUserError
+from .utils.prints import rank_zero_warn
+
+Array = jax.Array
+StateDict = Dict[str, Any]
+
+_CONST_ATTRS = ("is_differentiable", "higher_is_better", "full_state_update")
+
+
+def _squeeze_if_scalar(data: Any) -> Any:
+    """Shape-(1,) arrays become scalars; parity with reference output squeeze."""
+    if isinstance(data, (jax.Array, jnp.ndarray)) and data.ndim == 1 and data.shape[0] == 1:
+        return data.reshape(())
+    if isinstance(data, dict):
+        return {k: _squeeze_if_scalar(v) for k, v in data.items()}
+    if isinstance(data, tuple):
+        return tuple(_squeeze_if_scalar(v) for v in data)
+    return data
+
+
+def _filter_kwargs(fn: Callable, **kwargs: Any) -> Dict[str, Any]:
+    """Keep only kwargs accepted by ``fn``'s signature.
+
+    Parity: reference ``Metric._filter_kwargs`` (``metric.py:892-911``) — used
+    by MetricCollection/CompositionalMetric to route a shared kwarg dict to
+    members with different update signatures.
+    """
+    sig = inspect.signature(fn)
+    params = sig.parameters
+    if any(p.kind == inspect.Parameter.VAR_KEYWORD for p in params.values()):
+        return kwargs
+    names = {
+        n
+        for n, p in params.items()
+        if p.kind in (inspect.Parameter.POSITIONAL_OR_KEYWORD, inspect.Parameter.KEYWORD_ONLY)
+        and n != "self"
+    }
+    return {k: v for k, v in kwargs.items() if k in names}
+
+
+def jit_update_disabled():
+    """Context manager disabling jitted update paths globally (debugging aid)."""
+    return jax.disable_jit()
+
+
+class Metric:
+    """Base class for all metrics.
+
+    Ergonomics mirror the reference (``add_state`` in ``__init__``; ``update``
+    mutates state attributes; ``compute`` reads them), but the runtime is
+    JAX-native: states are immutable arrays in a tagged pytree and every
+    update/forward runs as a single jitted XLA program when ``jit=True``
+    (default; set class attr ``jittable = False`` for host-side metrics like
+    text edit distances).
+
+    Constructor kwargs (parity with reference ``metric.py:100-148``):
+        compute_on_cpu: move ``cat`` list-state increments to host memory after
+            each update (parity ``metric.py:113``; on TPU this offloads HBM).
+        dist_sync_on_step: sync state every ``forward`` (expensive eagerly; in
+            the SPMD functional path a psum-per-step is nearly free).
+        sync_on_compute: sync before ``compute`` (default True).
+        compute_with_cache: cache ``compute`` result until next update.
+        sync_backend: a :class:`SyncBackend`; default picks HostSync when
+            multi-process else NoSync. Replaces ``dist_sync_fn`` /
+            ``process_group`` / ``distributed_available_fn``.
+        jit: trace update/forward with ``jax.jit`` (per input-shape cache).
+    """
+
+    __jit_state_names__: Tuple[str, ...] = ()
+
+    is_differentiable: Optional[bool] = None
+    higher_is_better: Optional[bool] = None
+    full_state_update: Optional[bool] = False
+    jittable: bool = True
+    plot_lower_bound: Optional[float] = None
+    plot_upper_bound: Optional[float] = None
+    plot_legend_name: Optional[str] = None
+
+    def __init__(
+        self,
+        *,
+        compute_on_cpu: bool = False,
+        dist_sync_on_step: bool = False,
+        sync_on_compute: bool = True,
+        compute_with_cache: bool = True,
+        sync_backend: Optional[SyncBackend] = None,
+        jit: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        if kwargs:
+            raise ValueError(f"Unexpected keyword arguments: {sorted(kwargs)}")
+        # bypass __setattr__ guards during bootstrap
+        object.__setattr__(self, "_defaults", {})
+        object.__setattr__(self, "_state", {})
+        self._reductions: Dict[str, Union[Reduction, Callable]] = {}
+        self._persistent: Dict[str, bool] = {}
+        self._list_states: set = set()
+
+        self.compute_on_cpu = compute_on_cpu
+        self.dist_sync_on_step = dist_sync_on_step
+        self.sync_on_compute = sync_on_compute
+        self.compute_with_cache = compute_with_cache
+        self._sync_backend = sync_backend
+        self._use_jit = bool(jit) and type(self).jittable
+
+        self._update_count = 0
+        self._computed: Any = None
+        self._is_synced = False
+        self._cache: Optional[StateDict] = None
+        self._jit_cache: Dict[str, Any] = {}
+        self._dtype = jnp.float32
+
+    # ------------------------------------------------------------------
+    # subclass machinery: wrap update/compute once per class definition
+    # ------------------------------------------------------------------
+    def __init_subclass__(cls, **kwargs: Any) -> None:
+        super().__init_subclass__(**kwargs)
+        if "update" in cls.__dict__ and not getattr(cls.__dict__["update"], "_tm_wrapped", False):
+            cls._update_impl = cls.__dict__["update"]
+            cls.update = _wrap_update(cls.__dict__["update"])
+        if "compute" in cls.__dict__ and not getattr(cls.__dict__["compute"], "_tm_wrapped", False):
+            cls._compute_impl = cls.__dict__["compute"]
+            cls.compute = _wrap_compute(cls.__dict__["compute"])
+
+    # ------------------------------------------------------------------
+    # state registry
+    # ------------------------------------------------------------------
+    def add_state(
+        self,
+        name: str,
+        default: Union[Array, list, float, int],
+        dist_reduce_fx: Union[str, Callable, None] = None,
+        persistent: bool = False,
+    ) -> None:
+        """Register a state leaf. Parity: reference ``metric.py:195-272``.
+
+        ``default`` must be an array (fixed-shape state) or an empty list
+        (``cat`` list state whose increments concatenate along dim 0).
+        """
+        if not name.isidentifier():
+            raise ValueError(f"state name must be a valid identifier, got {name!r}")
+        if isinstance(default, list):
+            if default:
+                raise ValueError("list state default must be an empty list")
+            self._list_states.add(name)
+            value: Any = []
+        else:
+            value = jnp.asarray(default)
+        red = resolve_reduction(dist_reduce_fx)
+        self._defaults[name] = [] if name in self._list_states else value
+        self._reductions[name] = red
+        self._persistent[name] = persistent
+        self._state[name] = [] if name in self._list_states else value
+
+    # attribute routing: registered states live in self._state
+    def __getattr__(self, name: str) -> Any:
+        state = self.__dict__.get("_state")
+        if state is not None and name in state:
+            return state[name]
+        raise AttributeError(f"{type(self).__name__!r} object has no attribute {name!r}")
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if name in _CONST_ATTRS and getattr(type(self), "_allow_const_set", False) is False and "_state" in self.__dict__:
+            raise RuntimeError(f"Can't change const `{name}`.")
+        state = self.__dict__.get("_state")
+        if state is not None and name in state:
+            state[name] = value
+            return
+        object.__setattr__(self, name, value)
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def update(self, *args: Any, **kwargs: Any) -> None:  # overridden by subclasses
+        raise NotImplementedError(f"{type(self).__name__} must implement update()")
+
+    def compute(self) -> Any:  # overridden by subclasses
+        raise NotImplementedError(f"{type(self).__name__} must implement compute()")
+
+    def reset(self) -> None:
+        """Restore default states. Parity: reference ``metric.py:673-688``."""
+        self._update_count = 0
+        self._computed = None
+        self._cache = None
+        self._is_synced = False
+        for name, default in self._defaults.items():
+            self._state[name] = [] if name in self._list_states else default
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Accumulate global state AND return the batch-local value.
+
+        Dual-path semantics, parity: reference ``metric.py:275-391``. The fast
+        path (``full_state_update=False``) traces batch-update, batch-compute
+        and global-merge into one XLA call.
+        """
+        if self._is_synced:
+            raise TorchMetricsUserError(
+                "The Metric has been synced and `forward` assumes local state; call `unsync()` first."
+            )
+        if self.full_state_update or self.full_state_update is None or self.dist_sync_on_step:
+            return self._forward_full_state_update(*args, **kwargs)
+        return self._forward_reduce_state_update(*args, **kwargs)
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self.forward(*args, **kwargs)
+
+    # -- forward: slow path (update reads global state) ------------------
+    def _forward_full_state_update(self, *args: Any, **kwargs: Any) -> Any:
+        self.update(*args, **kwargs)  # accumulate into global
+        cache = self._snapshot_state()
+        count = self._update_count
+        self._restore_defaults()
+        self.update(*args, **kwargs)  # batch-only state
+        with self.sync_context(should_sync=self.dist_sync_on_step):
+            batch_val = _squeeze_if_scalar(self._compute_impl())
+        self._state = cache
+        self._update_count = count
+        self._computed = None
+        return batch_val
+
+    # -- forward: fast path (batch update + merge), single jitted call ---
+    def _forward_reduce_state_update(self, *args: Any, **kwargs: Any) -> Any:
+        n_prev = self._update_count
+        self._update_count += 1
+        self._computed = None
+        args = tuple(self._to_array(a) for a in args)
+        kwargs = {k: self._to_array(v) for k, v in kwargs.items()}
+        self._eager_validate(*args, **kwargs)
+
+        gstate = self._tensor_state()
+        if self._use_jit:
+            fwd = self._get_jitted("forward", self._pure_forward)
+            value, merged, appends = fwd(gstate, jnp.asarray(n_prev), args, kwargs)
+        else:
+            value, merged, appends = self._pure_forward(gstate, n_prev, args, kwargs)
+        for k, v in merged.items():
+            self._state[k] = v
+        self._extend_list_states(appends)
+        if self.dist_sync_on_step:
+            # eager multi-process per-step sync of the batch value's state is
+            # handled by full-state path; here we only warn once
+            pass
+        return _squeeze_if_scalar(value)
+
+    def _pure_forward(self, gstate: StateDict, n_prev: Any, args: tuple, kwargs: dict):
+        defaults = {k: v for k, v in self._defaults.items() if k not in self._list_states}
+        batch_tensors, appends = self._pure_update(defaults, args, kwargs)
+        value = self._pure_compute(batch_tensors, appends)
+        merged = self._merge_tensor_states(gstate, batch_tensors, n_prev)
+        return value, merged, appends
+
+    # ------------------------------------------------------------------
+    # pure kernels over the state pytree (the functional core)
+    # ------------------------------------------------------------------
+    def _pure_update(self, tensor_state: StateDict, args: tuple, kwargs: dict):
+        """Run the subclass update body against a shadow state; pure."""
+        shadow: StateDict = dict(tensor_state)
+        for k in self._list_states:
+            shadow[k] = []
+        old = self.__dict__["_state"]
+        object.__setattr__(self, "_state", shadow)
+        try:
+            self._update_impl(*args, **kwargs)
+            captured = self.__dict__["_state"]
+        finally:
+            object.__setattr__(self, "_state", old)
+        new_tensors = {k: captured[k] for k in tensor_state}
+        appends = {k: tuple(captured[k]) for k in self._list_states}
+        return new_tensors, appends
+
+    def _pure_compute(self, tensor_state: StateDict, list_state: Dict[str, tuple]) -> Any:
+        shadow: StateDict = dict(tensor_state)
+        for k, v in list_state.items():
+            shadow[k] = list(v)
+        old = self.__dict__["_state"]
+        object.__setattr__(self, "_state", shadow)
+        try:
+            return self._compute_impl()
+        finally:
+            object.__setattr__(self, "_state", old)
+
+    def _merge_tensor_states(self, global_state: StateDict, batch_state: StateDict, n_prev: Any) -> StateDict:
+        """Merge a batch-local state into the running global state.
+
+        Parity: reference ``Metric._reduce_states`` (``metric.py:393-425``).
+        """
+        merged = {}
+        for name, batch in batch_state.items():
+            red = self._reductions[name]
+            glob = global_state[name]
+            if red == Reduction.SUM:
+                merged[name] = glob + batch
+            elif red == Reduction.MEAN:
+                n = jnp.asarray(n_prev, dtype=jnp.float32)
+                merged[name] = jnp.where(n == 0, batch, (glob * n + batch) / (n + 1.0))
+            elif red == Reduction.MAX:
+                merged[name] = jnp.maximum(glob, batch)
+            elif red == Reduction.MIN:
+                merged[name] = jnp.minimum(glob, batch)
+            else:  # NONE / custom: forward fast path keeps the batch value;
+                # metrics whose update reads global state set full_state_update=True
+                merged[name] = batch
+        return merged
+
+    # -- public pure-functional API (for shard_map / pjit users) ---------
+    def init_state(self) -> StateDict:
+        """Default state pytree (list states as empty tuples). Pure."""
+        out: StateDict = {}
+        for k, v in self._defaults.items():
+            out[k] = () if k in self._list_states else v
+        return out
+
+    def update_state(self, state: StateDict, *args: Any, **kwargs: Any) -> StateDict:
+        """Pure update: returns the new state pytree; jit/shard_map-safe."""
+        tensors = {k: v for k, v in state.items() if k not in self._list_states}
+        new_tensors, appends = self._pure_update(tensors, args, kwargs)
+        out = dict(new_tensors)
+        for k in self._list_states:
+            out[k] = tuple(state.get(k, ())) + appends[k]
+        return out
+
+    def compute_state(self, state: StateDict) -> Any:
+        """Pure compute over an explicit state pytree."""
+        tensors = {k: v for k, v in state.items() if k not in self._list_states}
+        lists = {k: tuple(state.get(k, ())) for k in self._list_states}
+        return _squeeze_if_scalar(self._pure_compute(tensors, lists))
+
+    def reduce_state(self, state: StateDict, axis_name: str) -> StateDict:
+        """In-graph cross-device sync over a mesh axis (psum/pmax/.../gather)."""
+        return reduce_state_in_graph(state, self._reductions, axis_name)
+
+    def merge_states(self, states: Sequence[StateDict]) -> StateDict:
+        """Eagerly merge per-rank state pytrees (host-side DDP emulation)."""
+        out: StateDict = {}
+        for name in self._defaults:
+            red = self._reductions[name]
+            vals = [s[name] for s in states]
+            if name in self._list_states:
+                merged_list: list = []
+                for v in vals:
+                    merged_list.extend(list(v))
+                out[name] = tuple(merged_list)
+                continue
+            stack = jnp.stack([jnp.asarray(v) for v in vals])
+            if red == Reduction.SUM:
+                out[name] = jnp.sum(stack, axis=0)
+            elif red == Reduction.MEAN:
+                out[name] = jnp.mean(stack, axis=0)
+            elif red == Reduction.MAX:
+                out[name] = jnp.max(stack, axis=0)
+            elif red == Reduction.MIN:
+                out[name] = jnp.min(stack, axis=0)
+            elif red == Reduction.CAT:
+                out[name] = jnp.concatenate(list(stack), axis=0)
+            elif callable(red):
+                out[name] = red(stack)
+            else:
+                out[name] = stack
+        return out
+
+    # ------------------------------------------------------------------
+    # eager state plumbing
+    # ------------------------------------------------------------------
+    def _tensor_state(self) -> StateDict:
+        return {k: v for k, v in self._state.items() if k not in self._list_states}
+
+    def _snapshot_state(self) -> StateDict:
+        return {k: (list(v) if k in self._list_states else v) for k, v in self._state.items()}
+
+    def _restore_defaults(self) -> None:
+        for name, default in self._defaults.items():
+            self._state[name] = [] if name in self._list_states else default
+
+    def _extend_list_states(self, appends: Dict[str, tuple]) -> None:
+        for k, vs in appends.items():
+            target = self._state[k]
+            for v in vs:
+                target.append(np.asarray(v) if self.compute_on_cpu else v)
+
+    def _to_array(self, value: Any) -> Any:
+        if isinstance(value, (np.ndarray, list, float, int, bool)) and not isinstance(value, (str,)):
+            try:
+                return jnp.asarray(value)
+            except (TypeError, ValueError):
+                return value
+        try:  # torch tensors (CPU) — accept transparently for drop-in parity
+            import torch
+
+            if isinstance(value, torch.Tensor):
+                return jnp.asarray(value.detach().cpu().numpy())
+        except ImportError:
+            pass
+        return value
+
+    def _eager_validate(self, *args: Any, **kwargs: Any) -> None:
+        """Hook: subclasses may override for host-side value validation."""
+
+    def _get_jitted(self, key: str, fn: Callable) -> Callable:
+        if key not in self._jit_cache:
+            self._jit_cache[key] = jax.jit(fn)
+        return self._jit_cache[key]
+
+    # ------------------------------------------------------------------
+    # sync protocol (eager, class API)
+    # ------------------------------------------------------------------
+    @property
+    def sync_backend(self) -> SyncBackend:
+        if self._sync_backend is None:
+            self._sync_backend = default_sync_backend()
+        return self._sync_backend
+
+    def sync(
+        self,
+        should_sync: bool = True,
+        sync_backend: Optional[SyncBackend] = None,
+    ) -> None:
+        """Replace local states with group-reduced states (cache local).
+
+        Parity: reference ``metric.py:490-532``. List states are
+        pre-concatenated to one tensor so one gather happens per state
+        (reference ``metric.py:430-433``).
+        """
+        if self._is_synced:
+            raise TorchMetricsUserError("The Metric has already been synced.")
+        backend = sync_backend or self.sync_backend
+        if not should_sync or not backend.is_available():
+            return
+        self._cache = self._snapshot_state()
+        if hasattr(backend, "set_current"):  # FakeSync group addressing
+            for name in self._state:
+                backend.set_current(name)
+                self._state[name] = backend.sync_tensor(self._precat(name), self._reductions[name])
+        else:
+            for name in self._state:
+                self._state[name] = backend.sync_tensor(self._precat(name), self._reductions[name])
+        self._is_synced = True
+
+    def _precat(self, name: str) -> Array:
+        value = self._state[name]
+        if name in self._list_states:
+            return dim_zero_cat(value) if value else jnp.zeros((0,), dtype=self._dtype)
+        return jnp.asarray(value)
+
+    def unsync(self, should_unsync: bool = True) -> None:
+        """Restore cached local states. Parity: reference ``metric.py:534-553``."""
+        if not should_unsync or not self._is_synced:
+            return
+        if self._cache is None:
+            raise TorchMetricsUserError("The Metric has no cache to restore from.")
+        self._state = dict(self._cache)
+        self._cache = None
+        self._is_synced = False
+
+    @contextmanager
+    def sync_context(self, should_sync: bool = True, should_unsync: bool = True):
+        """Parity: reference ``metric.py:556-591``."""
+        was_synced = self._is_synced
+        if not was_synced:
+            self.sync(should_sync=should_sync)
+        try:
+            yield
+        finally:
+            if not was_synced:
+                self.unsync(should_unsync=should_unsync)
+
+    @property
+    def _to_sync(self) -> bool:
+        return self.sync_on_compute
+
+    # ------------------------------------------------------------------
+    # introspection / serialization
+    # ------------------------------------------------------------------
+    @property
+    def metric_state(self) -> StateDict:
+        """Current state values. Parity: reference ``metric.py`` property."""
+        return {k: self._state[k] for k in self._defaults}
+
+    @property
+    def update_count(self) -> int:
+        return self._update_count
+
+    @property
+    def device(self):
+        for v in self._state.values():
+            if isinstance(v, jax.Array):
+                return list(v.devices())[0]
+        return jax.devices()[0]
+
+    def to_device(self, device) -> "Metric":
+        for k, v in self._state.items():
+            if k in self._list_states:
+                self._state[k] = [jax.device_put(e, device) for e in v]
+            else:
+                self._state[k] = jax.device_put(v, device)
+        self._defaults = {
+            k: (v if isinstance(v, list) else jax.device_put(v, device)) for k, v in self._defaults.items()
+        }
+        return self
+
+    def set_dtype(self, dtype) -> "Metric":
+        """Cast float states. Parity: reference ``set_dtype`` ``metric.py:770``."""
+        self._dtype = dtype
+        for k, v in self._state.items():
+            if k in self._list_states:
+                self._state[k] = [
+                    e.astype(dtype) if jnp.issubdtype(e.dtype, jnp.floating) else e for e in v
+                ]
+            elif isinstance(v, jax.Array) and jnp.issubdtype(v.dtype, jnp.floating):
+                self._state[k] = v.astype(dtype)
+        self._jit_cache.clear()
+        return self
+
+    def persistent(self, mode: bool = False) -> None:
+        for name in self._persistent:
+            self._persistent[name] = mode
+
+    def state_dict(self) -> Dict[str, Any]:
+        """Persistent states as numpy arrays. Parity: ``metric.py:834-871``."""
+        out: Dict[str, Any] = {}
+        for name, keep in self._persistent.items():
+            if not keep:
+                continue
+            v = self._state[name]
+            out[name] = [np.asarray(e) for e in v] if name in self._list_states else np.asarray(v)
+        return out
+
+    def load_state_dict(self, state_dict: Dict[str, Any], strict: bool = True) -> None:
+        for name, v in state_dict.items():
+            if name not in self._defaults:
+                if strict:
+                    raise KeyError(f"Unexpected state {name!r} for {type(self).__name__}")
+                continue
+            if name in self._list_states:
+                self._state[name] = [jnp.asarray(e) for e in v]
+            else:
+                self._state[name] = jnp.asarray(v)
+
+    def clone(self) -> "Metric":
+        return copy.deepcopy(self)
+
+    def __getstate__(self) -> Dict[str, Any]:
+        state = self.__dict__.copy()
+        state["_jit_cache"] = {}
+        state["_sync_backend"] = None if not isinstance(state.get("_sync_backend"), NoSync) else state["_sync_backend"]
+        return state
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        object.__setattr__(self, "_state", state.pop("_state"))
+        object.__setattr__(self, "_defaults", state.pop("_defaults"))
+        for k, v in state.items():
+            object.__setattr__(self, k, v)
+
+    def __hash__(self) -> int:
+        vals = []
+        for k in sorted(self._defaults):
+            v = self._state[k]
+            if k in self._list_states:
+                vals.extend(np.asarray(e).tobytes() for e in v)
+            else:
+                vals.append(np.asarray(v).tobytes())
+        return hash((type(self).__name__, tuple(vals)))
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}()"
+
+    def _defaults_signature(self) -> tuple:
+        """Structural signature used by compute-group discovery."""
+        items = []
+        for k in sorted(self._defaults):
+            v = self._defaults[k]
+            if isinstance(v, list):
+                items.append((k, "list", str(self._reductions[k])))
+            else:
+                items.append((k, v.shape, str(v.dtype), str(self._reductions[k])))
+        return tuple(items)
+
+    # ------------------------------------------------------------------
+    # plotting (single/multi value), parity: reference metric.py:641-671
+    # ------------------------------------------------------------------
+    def plot(self, val: Any = None, ax: Any = None):
+        from .utils.plot import plot_single_or_multi_val
+
+        val = val if val is not None else self.compute()
+        return plot_single_or_multi_val(
+            val,
+            ax=ax,
+            higher_is_better=self.higher_is_better,
+            lower_bound=self.plot_lower_bound,
+            upper_bound=self.plot_upper_bound,
+            legend_name=self.plot_legend_name or type(self).__name__,
+        )
+
+    # ------------------------------------------------------------------
+    # operator overloading → CompositionalMetric (reference metric.py:938-1073)
+    # ------------------------------------------------------------------
+    def __add__(self, other):  # noqa: D105
+        return CompositionalMetric(jnp.add, self, other)
+
+    def __radd__(self, other):
+        return CompositionalMetric(jnp.add, other, self)
+
+    def __sub__(self, other):
+        return CompositionalMetric(jnp.subtract, self, other)
+
+    def __rsub__(self, other):
+        return CompositionalMetric(jnp.subtract, other, self)
+
+    def __mul__(self, other):
+        return CompositionalMetric(jnp.multiply, self, other)
+
+    def __rmul__(self, other):
+        return CompositionalMetric(jnp.multiply, other, self)
+
+    def __truediv__(self, other):
+        return CompositionalMetric(jnp.divide, self, other)
+
+    def __rtruediv__(self, other):
+        return CompositionalMetric(jnp.divide, other, self)
+
+    def __floordiv__(self, other):
+        return CompositionalMetric(jnp.floor_divide, self, other)
+
+    def __rfloordiv__(self, other):
+        return CompositionalMetric(jnp.floor_divide, other, self)
+
+    def __mod__(self, other):
+        return CompositionalMetric(jnp.mod, self, other)
+
+    def __rmod__(self, other):
+        return CompositionalMetric(jnp.mod, other, self)
+
+    def __pow__(self, other):
+        return CompositionalMetric(jnp.power, self, other)
+
+    def __rpow__(self, other):
+        return CompositionalMetric(jnp.power, other, self)
+
+    def __matmul__(self, other):
+        return CompositionalMetric(jnp.matmul, self, other)
+
+    def __rmatmul__(self, other):
+        return CompositionalMetric(jnp.matmul, other, self)
+
+    def __and__(self, other):
+        return CompositionalMetric(jnp.bitwise_and, self, other)
+
+    def __rand__(self, other):
+        return CompositionalMetric(jnp.bitwise_and, other, self)
+
+    def __or__(self, other):
+        return CompositionalMetric(jnp.bitwise_or, self, other)
+
+    def __ror__(self, other):
+        return CompositionalMetric(jnp.bitwise_or, other, self)
+
+    def __xor__(self, other):
+        return CompositionalMetric(jnp.bitwise_xor, self, other)
+
+    def __rxor__(self, other):
+        return CompositionalMetric(jnp.bitwise_xor, other, self)
+
+    def __eq__(self, other):
+        return CompositionalMetric(jnp.equal, self, other)
+
+    def __ne__(self, other):
+        return CompositionalMetric(jnp.not_equal, self, other)
+
+    def __lt__(self, other):
+        return CompositionalMetric(jnp.less, self, other)
+
+    def __le__(self, other):
+        return CompositionalMetric(jnp.less_equal, self, other)
+
+    def __gt__(self, other):
+        return CompositionalMetric(jnp.greater, self, other)
+
+    def __ge__(self, other):
+        return CompositionalMetric(jnp.greater_equal, self, other)
+
+    def __neg__(self):
+        return CompositionalMetric(jnp.negative, self, None)
+
+    def __pos__(self):
+        return CompositionalMetric(jnp.abs, self, None)
+
+    def __abs__(self):
+        return CompositionalMetric(jnp.abs, self, None)
+
+    def __invert__(self):
+        return CompositionalMetric(jnp.logical_not, self, None)
+
+    def __getitem__(self, idx):
+        return CompositionalMetric(lambda x: x[idx], self, None)
+
+
+def _wrap_update(update_fn: Callable) -> Callable:
+    @functools.wraps(update_fn)
+    def wrapped(self: Metric, *args: Any, **kwargs: Any) -> None:
+        self._computed = None
+        self._update_count += 1
+        if self._is_synced:
+            raise TorchMetricsUserError(
+                "The Metric is currently synced; call `unsync()` before `update`."
+            )
+        args = tuple(self._to_array(a) for a in args)
+        kwargs = {k: self._to_array(v) for k, v in kwargs.items()}
+        self._eager_validate(*args, **kwargs)
+        if self._use_jit:
+            upd = self._get_jitted("update", self._pure_update)
+            new_tensors, appends = upd(self._tensor_state(), args, kwargs)
+            for k, v in new_tensors.items():
+                self._state[k] = v
+            self._extend_list_states(appends)
+        else:
+            update_fn(self, *args, **kwargs)
+            if self.compute_on_cpu:
+                for k in self._list_states:
+                    self._state[k] = [np.asarray(e) for e in self._state[k]]
+
+    wrapped._tm_wrapped = True
+    return wrapped
+
+
+def _wrap_compute(compute_fn: Callable) -> Callable:
+    @functools.wraps(compute_fn)
+    def wrapped(self: Metric, *args: Any, **kwargs: Any) -> Any:
+        if self._update_count == 0:
+            rank_zero_warn(
+                f"The ``compute`` method of metric {type(self).__name__} was called before the "
+                "``update`` method; returned values may not reflect any data.",
+                UserWarning,
+            )
+        if self.compute_with_cache and self._computed is not None:
+            return self._computed
+        with self.sync_context(should_sync=self._to_sync):
+            value = _squeeze_if_scalar(compute_fn(self, *args, **kwargs))
+        if self.compute_with_cache:
+            self._computed = value
+        return value
+
+    wrapped._tm_wrapped = True
+    return wrapped
+
+
+class CompositionalMetric(Metric):
+    """Lazy arithmetic composition of two metrics (or metric & scalar).
+
+    Parity: reference ``metric.py:1088-1211`` — update/reset/persistent fan
+    out to child metrics; sync is a no-op (children sync themselves inside
+    their own compute).
+    """
+
+    jittable = False
+    full_state_update = True
+
+    def __init__(self, operator: Callable, metric_a: Any, metric_b: Any) -> None:
+        super().__init__(jit=False)
+        self.op = operator
+        self.metric_a = metric_a if isinstance(metric_a, Metric) else self._to_array(metric_a)
+        self.metric_b = metric_b if isinstance(metric_b, Metric) else (
+            self._to_array(metric_b) if metric_b is not None else None
+        )
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.update(*args, **_filter_kwargs(self.metric_a._update_impl, **kwargs))
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.update(*args, **_filter_kwargs(self.metric_b._update_impl, **kwargs))
+
+    def compute(self) -> Any:
+        a = self.metric_a.compute() if isinstance(self.metric_a, Metric) else self.metric_a
+        b = self.metric_b.compute() if isinstance(self.metric_b, Metric) else self.metric_b
+        if b is None:
+            return _squeeze_if_scalar(self.op(a))
+        return _squeeze_if_scalar(self.op(a, b))
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        a = (
+            self.metric_a.forward(*args, **_filter_kwargs(self.metric_a._update_impl, **kwargs))
+            if isinstance(self.metric_a, Metric)
+            else self.metric_a
+        )
+        b = (
+            self.metric_b.forward(*args, **_filter_kwargs(self.metric_b._update_impl, **kwargs))
+            if isinstance(self.metric_b, Metric)
+            else self.metric_b
+        )
+        self._update_count += 1
+        if a is None or (b is None and self.metric_b is not None):
+            return None
+        if b is None:
+            return _squeeze_if_scalar(self.op(a))
+        return _squeeze_if_scalar(self.op(a, b))
+
+    def reset(self) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.reset()
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.reset()
+        self._update_count = 0
+        self._computed = None
+
+    def persistent(self, mode: bool = False) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.persistent(mode)
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.persistent(mode)
+
+    def sync(self, *args: Any, **kwargs: Any) -> None:  # children sync themselves
+        self._is_synced = True
+
+    def unsync(self, *args: Any, **kwargs: Any) -> None:
+        self._is_synced = False
+
+    def __repr__(self) -> str:
+        _op = getattr(self.op, "__name__", str(self.op))
+        return f"CompositionalMetric({_op}, {self.metric_a!r}, {self.metric_b!r})"

@@ -1,0 +1,23 @@
+"""Distributed / parallelism layer: reduction tags, sync backends, mesh helpers."""
+from .reduction import Reduction, resolve_reduction
+from .sync import (
+    FakeSync,
+    HostSync,
+    NoSync,
+    SyncBackend,
+    default_sync_backend,
+    reduce_state_in_graph,
+    reduce_tensor_in_graph,
+)
+
+__all__ = [
+    "Reduction",
+    "resolve_reduction",
+    "SyncBackend",
+    "NoSync",
+    "HostSync",
+    "FakeSync",
+    "default_sync_backend",
+    "reduce_state_in_graph",
+    "reduce_tensor_in_graph",
+]

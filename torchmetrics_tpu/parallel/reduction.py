@@ -1,0 +1,45 @@
+"""Reduction tags for metric states.
+
+The key architectural invariant (see SURVEY.md §1): a metric state leaf carries
+a reduction tag telling the distributed layer how replicas merge. Parity with
+reference ``Metric.add_state``'s ``dist_reduce_fx`` mapping
+(``src/torchmetrics/metric.py:252-261``), but as a first-class enum so the
+in-graph collective (``lax.psum``/``pmax``/``pmin``/``all_gather``) can be
+chosen per tag — O(state) traffic instead of the reference's O(world·state)
+gather-then-reduce (``utilities/distributed.py:97``).
+"""
+from enum import Enum
+from typing import Callable, Optional, Union
+
+
+class Reduction(str, Enum):
+    SUM = "sum"
+    MEAN = "mean"
+    MAX = "max"
+    MIN = "min"
+    CAT = "cat"
+    NONE = "none"  # state is not synced automatically (custom merge in compute)
+
+    def __str__(self) -> str:
+        return self.value
+
+
+ReduceFx = Union[str, Reduction, Callable, None]
+
+
+def resolve_reduction(fx: ReduceFx) -> Union[Reduction, Callable]:
+    """Map user-facing ``dist_reduce_fx`` values to a Reduction tag."""
+    if fx is None:
+        return Reduction.NONE
+    if isinstance(fx, Reduction):
+        return fx
+    if isinstance(fx, str):
+        try:
+            return Reduction(fx)
+        except ValueError:
+            raise ValueError(
+                f"`dist_reduce_fx` must be one of {[r.value for r in Reduction]} or a callable, got {fx!r}"
+            ) from None
+    if callable(fx):
+        return fx
+    raise ValueError(f"`dist_reduce_fx` must be a string, callable or None, got {fx!r}")

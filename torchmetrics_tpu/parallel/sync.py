@@ -1,0 +1,231 @@
+"""Distributed synchronization backends.
+
+Replaces the reference's ``torch.distributed`` sync path
+(``Metric._sync_dist`` ``src/torchmetrics/metric.py:427-457`` +
+``gather_all_tensors`` ``utilities/distributed.py:97-147``) with three
+TPU-native strategies:
+
+- :func:`reduce_state_in_graph` — **in-graph** ``lax`` collectives keyed by the
+  per-state :class:`Reduction` tag, for use inside ``shard_map``/``pjit`` over a
+  mesh axis. sum/mean/max/min states cost O(state) on ICI (vs the reference's
+  O(world·state) all_gather-then-reduce); ``cat`` states use ``all_gather``
+  with ``tiled=True`` (the SPMD equivalent of the reference pad-to-max
+  protocol, which becomes unnecessary because SPMD shapes are uniform).
+- :class:`HostSync` — **eager multi-host** gather via
+  ``jax.experimental.multihost_utils.process_allgather`` over DCN, for the
+  class-API ``Metric.sync()`` path when running multi-process (parity with the
+  reference's eager NCCL collectives outside any compiled graph).
+- :class:`NoSync` — single-host no-op (reference
+  ``distributed_available_fn`` returning False).
+
+The backend is injectable per-metric via the ``sync_backend`` ctor kwarg,
+preserving the reference's ``dist_sync_fn``/``distributed_available_fn``
+injection points (``metric.py:127-133``).
+"""
+from typing import Any, Callable, Dict, Mapping, Optional, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .reduction import Reduction
+
+Array = jax.Array
+StateDict = Dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# In-graph (SPMD) collectives — the hot path on TPU
+# ---------------------------------------------------------------------------
+
+def _invariant_all_gather(value: Array, axis_name: str, stack: bool = False) -> Array:
+    """All-gather whose output is replication-*invariant* (VMA-typed).
+
+    ``lax.all_gather`` output is still typed device-varying under shard_map's
+    VMA checks, so it can't leave the region with ``out_specs=P()``. We
+    instead scatter each shard into its slot of a zeros buffer and ``psum`` —
+    one collective, invariant result. (Ring-allreduce moves ~2x the bytes of
+    an all-gather; for zero-copy epilogues prefer returning the un-gathered
+    ``cat`` shards with ``out_specs=P(axis)`` — see ``cat_out_specs``.)
+    """
+    n = lax.axis_size(axis_name)
+    i = lax.axis_index(axis_name)
+    buf = jnp.zeros((n,) + value.shape, value.dtype).at[i].set(value)
+    buf = lax.psum(buf, axis_name)
+    if stack:
+        return buf  # (world, ...) — parity with reference gather-no-reduce
+    return buf.reshape((n * value.shape[0],) + value.shape[1:]) if value.ndim else buf
+
+
+def reduce_tensor_in_graph(value: Array, reduction: Union[Reduction, Callable], axis_name: str) -> Array:
+    """Merge one per-device state leaf across a named mesh axis, in-graph."""
+    if reduction in (Reduction.SUM,):
+        return lax.psum(value, axis_name)
+    if reduction == Reduction.MEAN:
+        return lax.pmean(value, axis_name)
+    if reduction == Reduction.MAX:
+        return lax.pmax(value, axis_name)
+    if reduction == Reduction.MIN:
+        return lax.pmin(value, axis_name)
+    if reduction == Reduction.CAT:
+        return _invariant_all_gather(jnp.atleast_1d(value), axis_name)
+    if reduction == Reduction.NONE:
+        # parity with reference gather-without-reduce (metric.py:456): compute
+        # sees a (world, ...) stack and merges itself (e.g. Pearson moments)
+        return _invariant_all_gather(value, axis_name, stack=True)
+    if callable(reduction):
+        return reduction(_invariant_all_gather(value, axis_name, stack=True))
+    raise ValueError(f"Unknown reduction {reduction}")
+
+
+def reduce_state_in_graph(
+    state: StateDict,
+    reductions: Mapping[str, Union[Reduction, Callable]],
+    axis_name: str,
+) -> StateDict:
+    """Sync a whole state dict across ``axis_name``. Pure & jittable.
+
+    List (``cat``) states may be tuples of arrays: each element is gathered
+    (tiled) independently, preserving tuple structure.
+    """
+    out = {}
+    for name, value in state.items():
+        red = reductions.get(name, Reduction.NONE)
+        if isinstance(value, (list, tuple)):
+            out[name] = type(value)(reduce_tensor_in_graph(v, red, axis_name) for v in value)
+        else:
+            out[name] = reduce_tensor_in_graph(value, red, axis_name)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Eager backends for the class API
+# ---------------------------------------------------------------------------
+
+class SyncBackend:
+    """Protocol for eager (outside-jit) state synchronization."""
+
+    def is_available(self) -> bool:
+        raise NotImplementedError
+
+    def world_size(self) -> int:
+        raise NotImplementedError
+
+    def sync_tensor(self, value: Array, reduction: Union[Reduction, Callable]) -> Array:
+        raise NotImplementedError
+
+    def all_gather_object(self, obj: Any) -> list:
+        raise NotImplementedError
+
+
+class NoSync(SyncBackend):
+    """Single-process backend: everything is identity."""
+
+    def is_available(self) -> bool:
+        return False
+
+    def world_size(self) -> int:
+        return 1
+
+    def sync_tensor(self, value: Array, reduction) -> Array:
+        return value
+
+    def all_gather_object(self, obj: Any) -> list:
+        return [obj]
+
+
+class HostSync(SyncBackend):
+    """Multi-host eager sync over DCN via ``multihost_utils.process_allgather``.
+
+    Mirrors the reference's eager gather-then-reduce
+    (``metric.py:427-457``): gather a (world, ...) stack then apply the
+    per-state reduction over axis 0. Requires ``jax.distributed.initialize``.
+    """
+
+    def is_available(self) -> bool:
+        return jax.process_count() > 1
+
+    def world_size(self) -> int:
+        return jax.process_count()
+
+    def sync_tensor(self, value: Array, reduction) -> Array:
+        from jax.experimental import multihost_utils
+
+        gathered = multihost_utils.process_allgather(value)  # (world, ...)
+        if reduction == Reduction.SUM:
+            return jnp.sum(gathered, axis=0)
+        if reduction == Reduction.MEAN:
+            return jnp.mean(gathered, axis=0)
+        if reduction == Reduction.MAX:
+            return jnp.max(gathered, axis=0)
+        if reduction == Reduction.MIN:
+            return jnp.min(gathered, axis=0)
+        if reduction == Reduction.CAT:
+            return jnp.concatenate(list(gathered), axis=0)
+        if reduction == Reduction.NONE:
+            return gathered  # caller's compute merges (e.g. Pearson moment merge)
+        if callable(reduction):
+            return reduction(gathered)
+        raise ValueError(f"Unknown reduction {reduction}")
+
+    def all_gather_object(self, obj: Any) -> list:
+        raise NotImplementedError(
+            "Object gather over DCN requires a serialization transport; "
+            "use host-level orchestration for object states in multi-host runs."
+        )
+
+
+class FakeSync(SyncBackend):
+    """Test backend emulating a ``world_size``-rank group in one process.
+
+    Replaces the reference's 2-process gloo pool
+    (``tests/unittests/conftest.py:26-72``): N metric replicas register their
+    states here; ``sync_tensor`` reduces over the registered group. See
+    ``tests/helpers/testers.py``.
+    """
+
+    def __init__(self, group_states: list, rank: int):
+        self._group = group_states  # list of state dicts, one per emulated rank
+        self._rank = rank
+        self._current_name: Optional[str] = None
+
+    def is_available(self) -> bool:
+        return True
+
+    def world_size(self) -> int:
+        return len(self._group)
+
+    def set_current(self, name: str) -> None:
+        self._current_name = name
+
+    def sync_tensor(self, value: Array, reduction) -> Array:
+        peers = [jnp.asarray(s[self._current_name]) for s in self._group]
+        gathered = jnp.stack(peers, axis=0)
+        if reduction == Reduction.SUM:
+            return jnp.sum(gathered, axis=0)
+        if reduction == Reduction.MEAN:
+            return jnp.mean(gathered, axis=0)
+        if reduction == Reduction.MAX:
+            return jnp.max(gathered, axis=0)
+        if reduction == Reduction.MIN:
+            return jnp.min(gathered, axis=0)
+        if reduction == Reduction.CAT:
+            return jnp.concatenate(peers, axis=0)
+        if reduction == Reduction.NONE:
+            return gathered
+        if callable(reduction):
+            return reduction(gathered)
+        raise ValueError(f"Unknown reduction {reduction}")
+
+    def all_gather_object(self, obj: Any) -> list:
+        raise NotImplementedError
+
+
+def default_sync_backend() -> SyncBackend:
+    """Pick HostSync when running multi-process, else NoSync."""
+    try:
+        if jax.process_count() > 1:
+            return HostSync()
+    except Exception:
+        pass
+    return NoSync()

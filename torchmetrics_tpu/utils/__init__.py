@@ -1,0 +1,31 @@
+"""Utility layer (L1): data ops, safe numerics, checks, enums, printing."""
+from .compute import _safe_divide, auc, interp
+from .data import (
+    dim_zero_cat,
+    dim_zero_max,
+    dim_zero_mean,
+    dim_zero_min,
+    dim_zero_sum,
+    select_topk,
+    to_onehot,
+)
+from .exceptions import TorchMetricsUserError, TorchMetricsUserWarning
+from .prints import rank_zero_debug, rank_zero_info, rank_zero_warn
+
+__all__ = [
+    "dim_zero_cat",
+    "dim_zero_sum",
+    "dim_zero_mean",
+    "dim_zero_max",
+    "dim_zero_min",
+    "to_onehot",
+    "select_topk",
+    "auc",
+    "interp",
+    "_safe_divide",
+    "TorchMetricsUserError",
+    "TorchMetricsUserWarning",
+    "rank_zero_warn",
+    "rank_zero_info",
+    "rank_zero_debug",
+]

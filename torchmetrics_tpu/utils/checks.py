@@ -1,0 +1,31 @@
+"""Host-side input validation helpers.
+
+Parity: reference ``src/torchmetrics/utilities/checks.py``. Validation in the
+TPU build is **opt-out at trace time**: shape/type checks on abstract values
+are free under jit; value-dependent checks (label range, prob range) only run
+eagerly (skipped when tracing), mirroring SURVEY.md §7 hard-part #1
+("validation outside jit").
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def is_tracing(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _check_same_shape(preds: Array, target: Array) -> None:
+    if preds.shape != target.shape:
+        raise ValueError(
+            f"Predictions and targets are expected to have the same shape, "
+            f"but got {preds.shape} and {target.shape}."
+        )
+
+
+def _value_check(cond: bool, msg: str) -> None:
+    if not cond:
+        raise ValueError(msg)

@@ -1,0 +1,77 @@
+"""Safe-numerics helpers.
+
+Parity: reference ``src/torchmetrics/utilities/compute.py`` (``_safe_divide``
+:46, ``auc`` :118, ``interp`` :134, ``_safe_xlogy``/``_safe_matmul``).
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _safe_divide(num: Array, denom: Array, zero_division: float = 0.0) -> Array:
+    """Elementwise num/denom with 0-denominator producing ``zero_division``."""
+    num = jnp.asarray(num)
+    denom = jnp.asarray(denom)
+    if not jnp.issubdtype(jnp.result_type(num), jnp.floating):
+        num = num.astype(jnp.float32)
+    if not jnp.issubdtype(jnp.result_type(denom), jnp.floating):
+        denom = denom.astype(jnp.float32)
+    zero = denom == 0
+    out = num / jnp.where(zero, jnp.ones_like(denom), denom)
+    return jnp.where(zero, jnp.asarray(zero_division, dtype=out.dtype), out)
+
+
+def _safe_xlogy(x: Array, y: Array) -> Array:
+    """x * log(y), with x==0 giving 0 (avoids 0 * -inf NaNs)."""
+    out = x * jnp.log(jnp.where(x == 0, jnp.ones_like(y), y))
+    return jnp.where(x == 0, jnp.zeros_like(out), out)
+
+
+def _auc_compute_without_check(x: Array, y: Array, direction: float, axis: int = -1) -> Array:
+    dx = jnp.diff(x, axis=axis)
+    mean_y = (y[..., :-1] + y[..., 1:]) / 2.0 if axis == -1 else None
+    if mean_y is None:
+        y0 = jnp.take(y, jnp.arange(y.shape[axis] - 1), axis=axis)
+        y1 = jnp.take(y, jnp.arange(1, y.shape[axis]), axis=axis)
+        mean_y = (y0 + y1) / 2.0
+    return jnp.sum(mean_y * dx, axis=axis) * direction
+
+
+def auc(x: Array, y: Array, reorder: bool = False) -> Array:
+    """Area under curve via trapezoidal rule.
+
+    Parity: reference ``utilities/compute.py:118``. The monotonicity *check* of
+    the reference raises eagerly; under jit we assume sorted unless
+    ``reorder=True``.
+    """
+    x = jnp.asarray(x, dtype=jnp.float32)
+    y = jnp.asarray(y, dtype=jnp.float32)
+    if reorder:
+        order = jnp.argsort(x)
+        x, y = x[order], y[order]
+    return _auc_compute_without_check(x, y, 1.0)
+
+
+def interp(x: Array, xp: Array, fp: Array) -> Array:
+    """1-D linear interpolation, parity with ``utilities/compute.py:134``."""
+    return jnp.interp(x, xp, fp)
+
+
+def normalize_logits_if_needed(tensor: Array, normalization: Optional[str]) -> Array:
+    """Apply sigmoid/softmax only when input looks like logits (outside [0,1]).
+
+    Parity: reference ``utilities/compute.py`` logit handling used by the
+    classification ``_format`` stages. The any-outside-[0,1] test is a traced
+    reduction, so this stays jittable via ``jnp.where``.
+    """
+    if normalization is None:
+        return tensor
+    is_logit = jnp.logical_or(jnp.any(tensor < 0), jnp.any(tensor > 1))
+    if normalization == "sigmoid":
+        return jnp.where(is_logit, jax.nn.sigmoid(tensor), tensor)
+    if normalization == "softmax":
+        return jnp.where(is_logit, jax.nn.softmax(tensor, axis=1), tensor)
+    raise ValueError(f"Unknown normalization {normalization}")

@@ -1,0 +1,100 @@
+"""Data-manipulation utilities shared by all layers.
+
+Parity: reference ``src/torchmetrics/utilities/data.py`` (``dim_zero_*`` at
+:28-55, ``_bincount`` :179, ``_cumsum`` :210, ``to_onehot``/``select_topk``).
+TPU-first differences: ``_bincount`` is implemented as a one-hot matmul-friendly
+segment sum with a *static* ``minlength`` (XLA requires static shapes) and the
+CUDA-determinism fallbacks disappear (TPU is deterministic by default).
+"""
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def dim_zero_cat(x: Union[Array, List[Array], tuple]) -> Array:
+    """Concatenate a (possibly list-valued) state along dim 0."""
+    if isinstance(x, (jnp.ndarray, jax.Array)) and not isinstance(x, (list, tuple)):
+        return x
+    if isinstance(x, (list, tuple)):
+        if len(x) == 0:
+            raise ValueError("No samples to concatenate")
+        x = [jnp.atleast_1d(jnp.asarray(e)) for e in x]
+        return jnp.concatenate(x, axis=0)
+    return jnp.asarray(x)
+
+
+def dim_zero_sum(x: Array) -> Array:
+    return jnp.sum(dim_zero_cat(x) if isinstance(x, (list, tuple)) else x, axis=0)
+
+
+def dim_zero_mean(x: Array) -> Array:
+    return jnp.mean(dim_zero_cat(x) if isinstance(x, (list, tuple)) else x, axis=0)
+
+
+def dim_zero_max(x: Array) -> Array:
+    return jnp.max(dim_zero_cat(x) if isinstance(x, (list, tuple)) else x, axis=0)
+
+
+def dim_zero_min(x: Array) -> Array:
+    return jnp.min(dim_zero_cat(x) if isinstance(x, (list, tuple)) else x, axis=0)
+
+
+def _flatten(x: Sequence) -> list:
+    return [item for sublist in x for item in sublist]
+
+
+def _bincount(x: Array, minlength: int) -> Array:
+    """Static-shape bincount: ``minlength`` must be a Python int under jit.
+
+    Uses ``jnp.bincount(length=...)`` which XLA lowers to a scatter-add; on TPU
+    this is deterministic (no fallback shims needed, unlike reference
+    ``utilities/data.py:179-207``).
+    """
+    return jnp.bincount(x.reshape(-1).astype(jnp.int32), length=minlength)
+
+
+def _flexible_bincount(x: Array) -> Array:
+    """Bincount over *dense-ranked* values (host-side; data-dependent shape).
+
+    Parity: reference ``utilities/data.py:222``. Used by retrieval grouping at
+    compute time (outside jit).
+    """
+    _, inverse, counts = jnp.unique(x, return_inverse=True, return_counts=True)
+    del inverse
+    return counts
+
+
+def _cumsum(x: Array, axis: int = 0) -> Array:
+    return jnp.cumsum(x, axis=axis)
+
+
+def to_onehot(label_tensor: Array, num_classes: int) -> Array:
+    """Convert ``(N, ...)`` int labels to one-hot ``(N, C, ...)``.
+
+    Parity: reference ``utilities/data.py:58-96``.
+    """
+    oh = jax.nn.one_hot(label_tensor, num_classes, dtype=jnp.int32)
+    # one_hot appends the class axis last; reference puts it at dim 1
+    return jnp.moveaxis(oh, -1, 1) if label_tensor.ndim >= 1 else oh
+
+
+def select_topk(prob_tensor: Array, topk: int = 1, dim: int = 1) -> Array:
+    """Binary mask of the top-k entries along ``dim``.
+
+    Parity: reference ``utilities/data.py:99-139``.
+    """
+    if topk == 1:  # cheap argmax path
+        idx = jnp.argmax(prob_tensor, axis=dim, keepdims=True)
+        mask = jnp.zeros_like(prob_tensor, dtype=jnp.int32)
+        return jnp.put_along_axis(mask, idx, 1, axis=dim, inplace=False)
+    _, idx = jax.lax.top_k(jnp.moveaxis(prob_tensor, dim, -1), topk)
+    mask = jnp.zeros(jnp.moveaxis(prob_tensor, dim, -1).shape, dtype=jnp.int32)
+    mask = jnp.put_along_axis(mask, idx, 1, axis=-1, inplace=False)
+    return jnp.moveaxis(mask, -1, dim)
+
+
+def allclose(a: Array, b: Array, rtol: float = 1e-5, atol: float = 1e-8) -> bool:
+    return bool(jnp.allclose(a, b, rtol=rtol, atol=atol))

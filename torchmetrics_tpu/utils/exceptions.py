@@ -1,0 +1,12 @@
+"""Framework exceptions.
+
+Parity: reference ``src/torchmetrics/utilities/exceptions.py``.
+"""
+
+
+class TorchMetricsUserError(Exception):
+    """Error raised when a user misuses the metric API."""
+
+
+class TorchMetricsUserWarning(UserWarning):
+    """Warning raised for non-fatal metric API misuse."""

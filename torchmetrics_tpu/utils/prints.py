@@ -1,0 +1,47 @@
+"""Rank-zero-only printing/warning helpers.
+
+Parity: reference ``src/torchmetrics/utilities/prints.py:22-57``. On TPU pods the
+process index comes from ``jax.process_index()``.
+"""
+import logging
+import warnings
+from functools import partial, wraps
+
+log = logging.getLogger("torchmetrics_tpu")
+
+
+def _is_rank_zero() -> bool:
+    try:
+        import jax
+
+        return jax.process_index() == 0
+    except Exception:
+        return True
+
+
+def rank_zero_only(fn):
+    @wraps(fn)
+    def wrapped(*args, **kwargs):
+        if _is_rank_zero():
+            return fn(*args, **kwargs)
+        return None
+
+    return wrapped
+
+
+@rank_zero_only
+def rank_zero_warn(message: str, category=UserWarning, stacklevel: int = 3) -> None:
+    warnings.warn(message, category=category, stacklevel=stacklevel)
+
+
+@rank_zero_only
+def rank_zero_info(message: str) -> None:
+    log.info(message)
+
+
+@rank_zero_only
+def rank_zero_debug(message: str) -> None:
+    log.debug(message)
+
+
+rank_zero_print = rank_zero_only(partial(print, flush=True))
