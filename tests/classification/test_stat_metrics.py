@@ -1,0 +1,229 @@
+"""Stat-scores engine consumers vs sklearn oracles.
+
+Parity model: reference ``tests/unittests/classification/test_accuracy.py`` et
+al. — functional + class results compared against sklearn on single batches
+and on the accumulated union, in eager/jit/ddp-emulated/shard_map modes.
+"""
+from functools import partial
+
+import numpy as np
+import pytest
+from sklearn import metrics as skm
+
+import jax.numpy as jnp
+
+from tests.conftest import BATCH_SIZE, NUM_BATCHES, NUM_CLASSES
+from tests.helpers.testers import MetricTester
+
+from torchmetrics_tpu.classification import (
+    BinaryAccuracy,
+    BinaryConfusionMatrix,
+    BinaryF1Score,
+    BinaryMatthewsCorrCoef,
+    BinaryPrecision,
+    BinaryRecall,
+    BinarySpecificity,
+    MulticlassAccuracy,
+    MulticlassCohenKappa,
+    MulticlassConfusionMatrix,
+    MulticlassF1Score,
+    MulticlassJaccardIndex,
+    MulticlassMatthewsCorrCoef,
+    MulticlassPrecision,
+    MulticlassRecall,
+    MultilabelAccuracy,
+    MultilabelF1Score,
+    MultilabelHammingDistance,
+)
+from torchmetrics_tpu.functional.classification import (
+    binary_accuracy,
+    binary_f1_score,
+    multiclass_accuracy,
+    multiclass_f1_score,
+    multilabel_f1_score,
+)
+
+NUM_LABELS = 4
+seed = np.random.RandomState(7)
+BIN_PROBS = seed.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32)
+BIN_TARGET = seed.randint(0, 2, (NUM_BATCHES, BATCH_SIZE))
+MC_PROBS = seed.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES).astype(np.float32)
+MC_PROBS /= MC_PROBS.sum(-1, keepdims=True)
+MC_TARGET = seed.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))
+ML_PROBS = seed.rand(NUM_BATCHES, BATCH_SIZE, NUM_LABELS).astype(np.float32)
+ML_TARGET = seed.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_LABELS))
+
+
+def _sk_binary(fn):
+    return lambda p, t: fn(t, p > 0.5)
+
+
+def _sk_multiclass(fn, **kw):
+    return lambda p, t: fn(t, p.argmax(-1) if p.ndim > t.ndim else p, **kw)
+
+
+def _sk_multilabel(fn, **kw):
+    return lambda p, t: fn(t.reshape(-1, NUM_LABELS), (p > 0.5).reshape(-1, NUM_LABELS).astype(int), **kw)
+
+
+class TestBinaryFamily(MetricTester):
+    @pytest.mark.parametrize(
+        ("metric_class", "sk_fn"),
+        [
+            (BinaryAccuracy, _sk_binary(skm.accuracy_score)),
+            (BinaryPrecision, _sk_binary(partial(skm.precision_score, zero_division=0))),
+            (BinaryRecall, _sk_binary(partial(skm.recall_score, zero_division=0))),
+            (BinaryF1Score, _sk_binary(partial(skm.f1_score, zero_division=0))),
+            (BinaryMatthewsCorrCoef, _sk_binary(skm.matthews_corrcoef)),
+        ],
+    )
+    def test_binary(self, metric_class, sk_fn):
+        self.run_class_metric_test(BIN_PROBS, BIN_TARGET, metric_class, sk_fn, ddp=True)
+
+    def test_binary_specificity(self):
+        def sk_spec(p, t):
+            tn, fp, fn, tp = skm.confusion_matrix(t, p > 0.5).ravel()
+            return tn / (tn + fp)
+
+        self.run_class_metric_test(BIN_PROBS, BIN_TARGET, BinarySpecificity, sk_spec)
+
+    def test_binary_confusion_matrix(self):
+        self.run_class_metric_test(
+            BIN_PROBS, BIN_TARGET, BinaryConfusionMatrix,
+            lambda p, t: skm.confusion_matrix(t, p > 0.5), check_batch=False,
+        )
+
+    def test_binary_functional(self):
+        self.run_functional_metric_test(BIN_PROBS, BIN_TARGET, binary_accuracy, _sk_binary(skm.accuracy_score))
+        self.run_functional_metric_test(
+            BIN_PROBS, BIN_TARGET, binary_f1_score, _sk_binary(partial(skm.f1_score, zero_division=0))
+        )
+
+    def test_binary_shard_map(self):
+        self.run_shard_map_test(BIN_PROBS, BIN_TARGET, BinaryAccuracy, _sk_binary(skm.accuracy_score))
+
+
+class TestMulticlassFamily(MetricTester):
+    @pytest.mark.parametrize("average", ["micro", "macro", "weighted", None])
+    def test_accuracy_averages(self, average):
+        if average == "micro":
+            sk = _sk_multiclass(skm.accuracy_score)
+        elif average is None:
+            sk = _sk_multiclass(partial(skm.recall_score, average=None, labels=range(NUM_CLASSES), zero_division=0))
+        else:
+            sk = _sk_multiclass(partial(skm.recall_score, average=average, zero_division=0))
+        self.run_class_metric_test(
+            MC_PROBS, MC_TARGET, MulticlassAccuracy, sk,
+            metric_args={"num_classes": NUM_CLASSES, "average": average},
+            ddp=(average == "micro"),
+        )
+
+    @pytest.mark.parametrize(
+        ("metric_class", "sk_base"),
+        [
+            (MulticlassPrecision, skm.precision_score),
+            (MulticlassRecall, skm.recall_score),
+            (MulticlassF1Score, skm.f1_score),
+        ],
+    )
+    def test_prf_macro(self, metric_class, sk_base):
+        self.run_class_metric_test(
+            MC_PROBS, MC_TARGET, metric_class,
+            _sk_multiclass(partial(sk_base, average="macro", zero_division=0)),
+            metric_args={"num_classes": NUM_CLASSES, "average": "macro"},
+        )
+
+    def test_confusion_matrix(self):
+        self.run_class_metric_test(
+            MC_PROBS, MC_TARGET, MulticlassConfusionMatrix,
+            _sk_multiclass(partial(skm.confusion_matrix, labels=range(NUM_CLASSES))),
+            metric_args={"num_classes": NUM_CLASSES}, check_batch=False, ddp=True,
+        )
+
+    def test_cohen_kappa(self):
+        self.run_class_metric_test(
+            MC_PROBS, MC_TARGET, MulticlassCohenKappa, _sk_multiclass(skm.cohen_kappa_score),
+            metric_args={"num_classes": NUM_CLASSES}, check_batch=False,
+        )
+
+    def test_matthews(self):
+        self.run_class_metric_test(
+            MC_PROBS, MC_TARGET, MulticlassMatthewsCorrCoef, _sk_multiclass(skm.matthews_corrcoef),
+            metric_args={"num_classes": NUM_CLASSES}, check_batch=False,
+        )
+
+    def test_jaccard(self):
+        self.run_class_metric_test(
+            MC_PROBS, MC_TARGET, MulticlassJaccardIndex,
+            _sk_multiclass(partial(skm.jaccard_score, average="macro", zero_division=0)),
+            metric_args={"num_classes": NUM_CLASSES}, check_batch=False,
+        )
+
+    def test_top_k(self):
+        sk = lambda p, t: skm.top_k_accuracy_score(t, p, k=2, labels=range(NUM_CLASSES))
+        self.run_class_metric_test(
+            MC_PROBS, MC_TARGET, MulticlassAccuracy, sk,
+            metric_args={"num_classes": NUM_CLASSES, "average": "micro", "top_k": 2},
+        )
+
+    def test_ignore_index(self):
+        t2 = MC_TARGET.copy()
+        t2[:, :5] = -1
+
+        def sk(p, t):
+            valid = t != -1
+            return skm.accuracy_score(t[valid], p.argmax(-1)[valid])
+
+        self.run_class_metric_test(
+            MC_PROBS, t2, MulticlassAccuracy, sk,
+            metric_args={"num_classes": NUM_CLASSES, "average": "micro", "ignore_index": -1},
+        )
+
+    def test_functional(self):
+        self.run_functional_metric_test(
+            MC_PROBS, MC_TARGET, multiclass_accuracy, _sk_multiclass(skm.accuracy_score),
+            metric_args={"num_classes": NUM_CLASSES, "average": "micro"},
+        )
+        self.run_functional_metric_test(
+            MC_PROBS, MC_TARGET, multiclass_f1_score,
+            _sk_multiclass(partial(skm.f1_score, average="macro", zero_division=0)),
+            metric_args={"num_classes": NUM_CLASSES, "average": "macro"},
+        )
+
+    def test_shard_map(self):
+        self.run_shard_map_test(
+            MC_PROBS, MC_TARGET, MulticlassAccuracy, _sk_multiclass(skm.accuracy_score),
+            metric_args={"num_classes": NUM_CLASSES, "average": "micro"},
+        )
+
+    def test_samplewise(self):
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro", multidim_average="samplewise")
+        p = np.random.rand(8, NUM_CLASSES, 10).astype(np.float32)
+        t = np.random.randint(0, NUM_CLASSES, (8, 10))
+        m.update(jnp.asarray(p), jnp.asarray(t))
+        got = np.asarray(m.compute())
+        ref = np.array([skm.accuracy_score(t[i], p[i].argmax(0)) for i in range(8)])
+        np.testing.assert_allclose(got, ref, atol=1e-6)
+
+
+class TestMultilabelFamily(MetricTester):
+    @pytest.mark.parametrize("average", ["micro", "macro"])
+    def test_f1(self, average):
+        self.run_class_metric_test(
+            ML_PROBS, ML_TARGET, MultilabelF1Score,
+            _sk_multilabel(partial(skm.f1_score, average=average, zero_division=0)),
+            metric_args={"num_labels": NUM_LABELS, "average": average}, ddp=(average == "macro"),
+        )
+
+    def test_hamming(self):
+        self.run_class_metric_test(
+            ML_PROBS, ML_TARGET, MultilabelHammingDistance, _sk_multilabel(skm.hamming_loss),
+            metric_args={"num_labels": NUM_LABELS, "average": "micro"},
+        )
+
+    def test_functional(self):
+        self.run_functional_metric_test(
+            ML_PROBS, ML_TARGET, multilabel_f1_score,
+            _sk_multilabel(partial(skm.f1_score, average="macro", zero_division=0)),
+            metric_args={"num_labels": NUM_LABELS, "average": "macro"},
+        )

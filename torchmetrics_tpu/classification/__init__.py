@@ -1,0 +1,71 @@
+"""Modular classification metrics (L4)."""
+from .accuracy import Accuracy, BinaryAccuracy, MulticlassAccuracy, MultilabelAccuracy
+from .cohen_kappa import BinaryCohenKappa, CohenKappa, MulticlassCohenKappa
+from .confusion_matrix import (
+    BinaryConfusionMatrix,
+    ConfusionMatrix,
+    MulticlassConfusionMatrix,
+    MultilabelConfusionMatrix,
+)
+from .exact_match import ExactMatch, MulticlassExactMatch, MultilabelExactMatch
+from .f_beta import (
+    BinaryF1Score,
+    BinaryFBetaScore,
+    F1Score,
+    FBetaScore,
+    MulticlassF1Score,
+    MulticlassFBetaScore,
+    MultilabelF1Score,
+    MultilabelFBetaScore,
+)
+from .hamming import (
+    BinaryHammingDistance,
+    HammingDistance,
+    MulticlassHammingDistance,
+    MultilabelHammingDistance,
+)
+from .jaccard import BinaryJaccardIndex, JaccardIndex, MulticlassJaccardIndex, MultilabelJaccardIndex
+from .matthews_corrcoef import (
+    BinaryMatthewsCorrCoef,
+    MatthewsCorrCoef,
+    MulticlassMatthewsCorrCoef,
+    MultilabelMatthewsCorrCoef,
+)
+from .precision_recall import (
+    BinaryPrecision,
+    BinaryRecall,
+    MulticlassPrecision,
+    MulticlassRecall,
+    MultilabelPrecision,
+    MultilabelRecall,
+    Precision,
+    Recall,
+)
+from .specificity import (
+    BinarySpecificity,
+    MulticlassSpecificity,
+    MultilabelSpecificity,
+    Specificity,
+)
+from .stat_scores import (
+    BinaryStatScores,
+    MulticlassStatScores,
+    MultilabelStatScores,
+    StatScores,
+)
+
+__all__ = [
+    "Accuracy", "BinaryAccuracy", "MulticlassAccuracy", "MultilabelAccuracy",
+    "CohenKappa", "BinaryCohenKappa", "MulticlassCohenKappa",
+    "ConfusionMatrix", "BinaryConfusionMatrix", "MulticlassConfusionMatrix", "MultilabelConfusionMatrix",
+    "ExactMatch", "MulticlassExactMatch", "MultilabelExactMatch",
+    "FBetaScore", "BinaryFBetaScore", "MulticlassFBetaScore", "MultilabelFBetaScore",
+    "F1Score", "BinaryF1Score", "MulticlassF1Score", "MultilabelF1Score",
+    "HammingDistance", "BinaryHammingDistance", "MulticlassHammingDistance", "MultilabelHammingDistance",
+    "JaccardIndex", "BinaryJaccardIndex", "MulticlassJaccardIndex", "MultilabelJaccardIndex",
+    "MatthewsCorrCoef", "BinaryMatthewsCorrCoef", "MulticlassMatthewsCorrCoef", "MultilabelMatthewsCorrCoef",
+    "Precision", "BinaryPrecision", "MulticlassPrecision", "MultilabelPrecision",
+    "Recall", "BinaryRecall", "MulticlassRecall", "MultilabelRecall",
+    "Specificity", "BinarySpecificity", "MulticlassSpecificity", "MultilabelSpecificity",
+    "StatScores", "BinaryStatScores", "MulticlassStatScores", "MultilabelStatScores",
+]

@@ -1,0 +1,64 @@
+"""CohenKappa metric classes.
+
+Parity: reference ``src/torchmetrics/classification/cohen_kappa.py``.
+"""
+from typing import Any, Optional
+
+import jax
+
+from ..functional.classification.cohen_kappa import _cohen_kappa_reduce
+from ..metric import Metric
+from ..utils.enums import ClassificationTaskNoMultilabel
+from .base import _ClassificationTaskWrapper
+from .confusion_matrix import BinaryConfusionMatrix, MulticlassConfusionMatrix
+
+Array = jax.Array
+
+
+class BinaryCohenKappa(BinaryConfusionMatrix):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, threshold: float = 0.5, ignore_index: Optional[int] = None,
+                 weights: Optional[str] = None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(threshold, ignore_index, normalize=None, validate_args=False, **kwargs)
+        self.weights = weights
+        self.validate_args = validate_args
+
+    def compute(self) -> Array:
+        return _cohen_kappa_reduce(self.confmat, self.weights)
+
+
+class MulticlassCohenKappa(MulticlassConfusionMatrix):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, num_classes: int, ignore_index: Optional[int] = None,
+                 weights: Optional[str] = None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(num_classes, ignore_index, normalize=None, validate_args=False, **kwargs)
+        self.weights = weights
+        self.validate_args = validate_args
+
+    def compute(self) -> Array:
+        return _cohen_kappa_reduce(self.confmat, self.weights)
+
+
+class CohenKappa(_ClassificationTaskWrapper):
+    """Task facade. Parity: reference ``classification/cohen_kappa.py:236``."""
+
+    def __new__(cls, task: str, threshold: float = 0.5, num_classes: Optional[int] = None,
+                weights: Optional[str] = None, ignore_index: Optional[int] = None,
+                validate_args: bool = True, **kwargs: Any) -> Metric:
+        task = ClassificationTaskNoMultilabel.from_str(task)
+        kwargs.update({"weights": weights, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTaskNoMultilabel.BINARY:
+            return BinaryCohenKappa(threshold, **kwargs)
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+        return MulticlassCohenKappa(num_classes, **kwargs)

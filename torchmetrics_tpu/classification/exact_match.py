@@ -1,0 +1,109 @@
+"""ExactMatch metric classes.
+
+Parity: reference ``src/torchmetrics/classification/exact_match.py``.
+"""
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..functional.classification.exact_match import (
+    _exact_match_reduce,
+    _multiclass_exact_match_update,
+    _multilabel_exact_match_update,
+)
+from ..functional.classification.stat_scores import (
+    _multiclass_stat_scores_format,
+    _multilabel_stat_scores_format,
+)
+from ..metric import Metric
+from ..utils.data import dim_zero_cat
+from ..utils.enums import ClassificationTaskNoBinary
+from .base import _ClassificationTaskWrapper
+
+Array = jax.Array
+
+
+class _AbstractExactMatch(Metric):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def _create_state(self, multidim_average: str) -> None:
+        if multidim_average == "samplewise":
+            self.add_state("correct", [], dist_reduce_fx="cat")
+            self.add_state("total", [], dist_reduce_fx="cat")
+        else:
+            self.add_state("correct", jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="sum")
+            self.add_state("total", jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def _update_state(self, correct: Array, total: Array) -> None:
+        if self.multidim_average == "samplewise":
+            self.correct.append(correct)
+            self.total.append(total)
+        else:
+            self.correct = self.correct + correct
+            self.total = self.total + total
+
+    def compute(self) -> Array:
+        return _exact_match_reduce(dim_zero_cat(self.correct), dim_zero_cat(self.total))
+
+
+class MulticlassExactMatch(_AbstractExactMatch):
+    """Parity: reference ``classification/exact_match.py:44``."""
+
+    def __init__(self, num_classes: int, multidim_average: str = "global",
+                 ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.multidim_average = multidim_average
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self._create_state(multidim_average)
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds, target = _multiclass_stat_scores_format(preds, target, top_k=1)
+        correct, total = _multiclass_exact_match_update(preds, target, self.multidim_average, self.ignore_index)
+        self._update_state(correct, total)
+
+
+class MultilabelExactMatch(_AbstractExactMatch):
+    """Parity: reference ``classification/exact_match.py:173``."""
+
+    def __init__(self, num_labels: int, threshold: float = 0.5, multidim_average: str = "global",
+                 ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.num_labels = num_labels
+        self.threshold = threshold
+        self.multidim_average = multidim_average
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self._create_state(multidim_average)
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds, target, mask = _multilabel_stat_scores_format(
+            preds, target, self.num_labels, self.threshold, self.ignore_index
+        )
+        correct, total = _multilabel_exact_match_update(preds, target, mask, self.num_labels, self.multidim_average)
+        self._update_state(correct, total)
+
+
+class ExactMatch(_ClassificationTaskWrapper):
+    """Task facade. Parity: reference ``classification/exact_match.py:305``."""
+
+    def __new__(cls, task: str, threshold: float = 0.5, num_classes: Optional[int] = None,
+                num_labels: Optional[int] = None, multidim_average: str = "global",
+                ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> Metric:
+        task = ClassificationTaskNoBinary.from_str(task)
+        kwargs.update(
+            {"multidim_average": multidim_average, "ignore_index": ignore_index, "validate_args": validate_args}
+        )
+        if task == ClassificationTaskNoBinary.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+            return MulticlassExactMatch(num_classes, **kwargs)
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
+        return MultilabelExactMatch(num_labels, threshold, **kwargs)

@@ -1,0 +1,147 @@
+"""F-beta / F1 metric classes.
+
+Parity: reference ``src/torchmetrics/classification/f_beta.py`` (1158 LoC).
+"""
+from typing import Any, Optional
+
+import jax
+
+from ..functional.classification._reduce import _fbeta_reduce
+from ..utils.enums import ClassificationTask
+from .base import _ClassificationTaskWrapper
+from .stat_scores import BinaryStatScores, MulticlassStatScores, MultilabelStatScores
+from ..metric import Metric
+
+Array = jax.Array
+
+
+class BinaryFBetaScore(BinaryStatScores):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, beta: float, threshold: float = 0.5, multidim_average: str = "global",
+                 ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(threshold, multidim_average, ignore_index, validate_args=False, **kwargs)
+        if validate_args and not (isinstance(beta, float) and beta > 0):
+            raise ValueError(f"Expected argument `beta` to be a float larger than 0, but got {beta}.")
+        self.validate_args = validate_args
+        self.beta = beta
+
+    def compute(self) -> Array:
+        tp, fp, tn, fn = self._final_state()
+        return _fbeta_reduce(tp, fp, tn, fn, self.beta, average="binary", multidim_average=self.multidim_average)
+
+
+class MulticlassFBetaScore(MulticlassStatScores):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Class"
+
+    def __init__(self, beta: float, num_classes: int, top_k: int = 1, average: Optional[str] = "macro",
+                 multidim_average: str = "global", ignore_index: Optional[int] = None,
+                 validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(num_classes, top_k, average, multidim_average, ignore_index,
+                         validate_args=False, **kwargs)
+        if validate_args and not (isinstance(beta, float) and beta > 0):
+            raise ValueError(f"Expected argument `beta` to be a float larger than 0, but got {beta}.")
+        self.validate_args = validate_args
+        self.beta = beta
+
+    def compute(self) -> Array:
+        tp, fp, tn, fn = self._final_state()
+        return _fbeta_reduce(tp, fp, tn, fn, self.beta, average=self.average,
+                             multidim_average=self.multidim_average)
+
+
+class MultilabelFBetaScore(MultilabelStatScores):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Label"
+
+    def __init__(self, beta: float, num_labels: int, threshold: float = 0.5, average: Optional[str] = "macro",
+                 multidim_average: str = "global", ignore_index: Optional[int] = None,
+                 validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(num_labels, threshold, average, multidim_average, ignore_index,
+                         validate_args=False, **kwargs)
+        if validate_args and not (isinstance(beta, float) and beta > 0):
+            raise ValueError(f"Expected argument `beta` to be a float larger than 0, but got {beta}.")
+        self.validate_args = validate_args
+        self.beta = beta
+
+    def compute(self) -> Array:
+        tp, fp, tn, fn = self._final_state()
+        return _fbeta_reduce(tp, fp, tn, fn, self.beta, average=self.average,
+                             multidim_average=self.multidim_average, multilabel=True)
+
+
+class BinaryF1Score(BinaryFBetaScore):
+    def __init__(self, threshold: float = 0.5, multidim_average: str = "global",
+                 ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(1.0, threshold, multidim_average, ignore_index, validate_args, **kwargs)
+
+
+class MulticlassF1Score(MulticlassFBetaScore):
+    def __init__(self, num_classes: int, top_k: int = 1, average: Optional[str] = "macro",
+                 multidim_average: str = "global", ignore_index: Optional[int] = None,
+                 validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(1.0, num_classes, top_k, average, multidim_average, ignore_index, validate_args, **kwargs)
+
+
+class MultilabelF1Score(MultilabelFBetaScore):
+    def __init__(self, num_labels: int, threshold: float = 0.5, average: Optional[str] = "macro",
+                 multidim_average: str = "global", ignore_index: Optional[int] = None,
+                 validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(1.0, num_labels, threshold, average, multidim_average, ignore_index, validate_args, **kwargs)
+
+
+class FBetaScore(_ClassificationTaskWrapper):
+    """Task facade. Parity: reference ``classification/f_beta.py:976``."""
+
+    def __new__(cls, task: str, beta: float = 1.0, threshold: float = 0.5, num_classes: Optional[int] = None,
+                num_labels: Optional[int] = None, average: Optional[str] = "micro",
+                multidim_average: str = "global", top_k: int = 1, ignore_index: Optional[int] = None,
+                validate_args: bool = True, **kwargs: Any) -> Metric:
+        task = ClassificationTask.from_str(task)
+        kwargs.update(
+            {"multidim_average": multidim_average, "ignore_index": ignore_index, "validate_args": validate_args}
+        )
+        if task == ClassificationTask.BINARY:
+            return BinaryFBetaScore(beta, threshold, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+            return MulticlassFBetaScore(beta, num_classes, top_k, average, **kwargs)
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
+        return MultilabelFBetaScore(beta, num_labels, threshold, average, **kwargs)
+
+
+class F1Score(_ClassificationTaskWrapper):
+    """Task facade. Parity: reference ``classification/f_beta.py:1068``."""
+
+    def __new__(cls, task: str, threshold: float = 0.5, num_classes: Optional[int] = None,
+                num_labels: Optional[int] = None, average: Optional[str] = "micro",
+                multidim_average: str = "global", top_k: int = 1, ignore_index: Optional[int] = None,
+                validate_args: bool = True, **kwargs: Any) -> Metric:
+        task = ClassificationTask.from_str(task)
+        kwargs.update(
+            {"multidim_average": multidim_average, "ignore_index": ignore_index, "validate_args": validate_args}
+        )
+        if task == ClassificationTask.BINARY:
+            return BinaryF1Score(threshold, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+            return MulticlassF1Score(num_classes, top_k, average, **kwargs)
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
+        return MultilabelF1Score(num_labels, threshold, average, **kwargs)

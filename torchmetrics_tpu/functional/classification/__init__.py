@@ -1,0 +1,66 @@
+"""Functional classification kernels (L3)."""
+from .accuracy import accuracy, binary_accuracy, multiclass_accuracy, multilabel_accuracy
+from .cohen_kappa import binary_cohen_kappa, cohen_kappa, multiclass_cohen_kappa
+from .confusion_matrix import (
+    binary_confusion_matrix,
+    confusion_matrix,
+    multiclass_confusion_matrix,
+    multilabel_confusion_matrix,
+)
+from .exact_match import exact_match, multiclass_exact_match, multilabel_exact_match
+from .f_beta import (
+    binary_f1_score,
+    binary_fbeta_score,
+    f1_score,
+    fbeta_score,
+    multiclass_f1_score,
+    multiclass_fbeta_score,
+    multilabel_f1_score,
+    multilabel_fbeta_score,
+)
+from .hamming import (
+    binary_hamming_distance,
+    hamming_distance,
+    multiclass_hamming_distance,
+    multilabel_hamming_distance,
+)
+from .jaccard import binary_jaccard_index, jaccard_index, multiclass_jaccard_index, multilabel_jaccard_index
+from .matthews_corrcoef import (
+    binary_matthews_corrcoef,
+    matthews_corrcoef,
+    multiclass_matthews_corrcoef,
+    multilabel_matthews_corrcoef,
+)
+from .precision_recall import (
+    binary_precision,
+    binary_recall,
+    multiclass_precision,
+    multiclass_recall,
+    multilabel_precision,
+    multilabel_recall,
+    precision,
+    recall,
+)
+from .specificity import (
+    binary_specificity,
+    multiclass_specificity,
+    multilabel_specificity,
+    specificity,
+)
+from .stat_scores import binary_stat_scores, multiclass_stat_scores, multilabel_stat_scores, stat_scores
+
+__all__ = [
+    "accuracy", "binary_accuracy", "multiclass_accuracy", "multilabel_accuracy",
+    "cohen_kappa", "binary_cohen_kappa", "multiclass_cohen_kappa",
+    "confusion_matrix", "binary_confusion_matrix", "multiclass_confusion_matrix", "multilabel_confusion_matrix",
+    "exact_match", "multiclass_exact_match", "multilabel_exact_match",
+    "fbeta_score", "binary_fbeta_score", "multiclass_fbeta_score", "multilabel_fbeta_score",
+    "f1_score", "binary_f1_score", "multiclass_f1_score", "multilabel_f1_score",
+    "hamming_distance", "binary_hamming_distance", "multiclass_hamming_distance", "multilabel_hamming_distance",
+    "jaccard_index", "binary_jaccard_index", "multiclass_jaccard_index", "multilabel_jaccard_index",
+    "matthews_corrcoef", "binary_matthews_corrcoef", "multiclass_matthews_corrcoef", "multilabel_matthews_corrcoef",
+    "precision", "binary_precision", "multiclass_precision", "multilabel_precision",
+    "recall", "binary_recall", "multiclass_recall", "multilabel_recall",
+    "specificity", "binary_specificity", "multiclass_specificity", "multilabel_specificity",
+    "stat_scores", "binary_stat_scores", "multiclass_stat_scores", "multilabel_stat_scores",
+]

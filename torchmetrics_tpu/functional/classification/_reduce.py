@@ -1,0 +1,153 @@
+"""Shared averaging/reduction helpers for stat-score consumers.
+
+Parity: reference ``src/torchmetrics/utilities/compute.py``
+(``_adjust_weights_safe_divide``) and the per-metric ``_*_reduce`` functions in
+``functional/classification/{accuracy,precision_recall,f_beta,specificity,
+hamming}.py``. Pure jnp; fully jittable.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.compute import _safe_divide
+
+Array = jax.Array
+
+
+def _adjust_weights_safe_divide(
+    score: Array,
+    average: Optional[str],
+    multilabel: bool,
+    tp: Array,
+    fp: Array,
+    fn: Array,
+    top_k: int = 1,
+) -> Array:
+    if average is None or average == "none":
+        return score
+    if average == "weighted":
+        weights = (tp + fn).astype(jnp.float32)
+    else:
+        weights = jnp.ones_like(score, dtype=jnp.float32)
+    if not multilabel and top_k == 1:
+        # classes absent from preds AND target don't count toward macro mean
+        weights = jnp.where(tp + fp + fn == 0, 0.0, weights)
+    return jnp.sum(_safe_divide(weights * score, jnp.sum(weights, axis=-1, keepdims=True)), axis=-1)
+
+
+def _accuracy_reduce(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    average: Optional[str],
+    multidim_average: str = "global",
+    multilabel: bool = False,
+    top_k: int = 1,
+) -> Array:
+    """Parity: reference ``functional/classification/accuracy.py:24``."""
+    if average == "binary":
+        return _safe_divide(tp + tn, tp + fp + tn + fn)
+    if average == "micro":
+        axis = 0 if multidim_average == "global" else 1
+        tp, fp, tn, fn = (jnp.sum(x, axis=axis) for x in (tp, fp, tn, fn))
+        if multilabel:
+            return _safe_divide(tp + tn, tp + fp + tn + fn)
+        return _safe_divide(tp, tp + fn)
+    score = _safe_divide(tp + tn, tp + fp + tn + fn) if multilabel else _safe_divide(tp, tp + fn)
+    return _adjust_weights_safe_divide(score, average, multilabel, tp, fp, fn, top_k)
+
+
+def _precision_recall_reduce(
+    stat: str,
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    average: Optional[str],
+    multidim_average: str = "global",
+    multilabel: bool = False,
+    top_k: int = 1,
+    zero_division: float = 0.0,
+) -> Array:
+    """Parity: reference ``functional/classification/precision_recall.py:25``."""
+    different_stat = fp if stat == "precision" else fn  # denominator partner
+    if average == "binary":
+        return _safe_divide(tp, tp + different_stat, zero_division)
+    if average == "micro":
+        axis = 0 if multidim_average == "global" else 1
+        tp = jnp.sum(tp, axis=axis)
+        fn_s = jnp.sum(fn, axis=axis)
+        fp_s = jnp.sum(fp, axis=axis)
+        return _safe_divide(tp, tp + (fp_s if stat == "precision" else fn_s), zero_division)
+    score = _safe_divide(tp, tp + different_stat, zero_division)
+    return _adjust_weights_safe_divide(score, average, multilabel, tp, fp, fn, top_k)
+
+
+def _fbeta_reduce(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    beta: float,
+    average: Optional[str],
+    multidim_average: str = "global",
+    multilabel: bool = False,
+    zero_division: float = 0.0,
+    top_k: int = 1,
+) -> Array:
+    """Parity: reference ``functional/classification/f_beta.py:26``."""
+    beta2 = beta**2
+    if average == "binary":
+        return _safe_divide((1 + beta2) * tp, (1 + beta2) * tp + beta2 * fn + fp, zero_division)
+    if average == "micro":
+        axis = 0 if multidim_average == "global" else 1
+        tp, fp, tn, fn = (jnp.sum(x, axis=axis) for x in (tp, fp, tn, fn))
+        return _safe_divide((1 + beta2) * tp, (1 + beta2) * tp + beta2 * fn + fp, zero_division)
+    score = _safe_divide((1 + beta2) * tp, (1 + beta2) * tp + beta2 * fn + fp, zero_division)
+    return _adjust_weights_safe_divide(score, average, multilabel, tp, fp, fn)
+
+
+def _specificity_reduce(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    average: Optional[str],
+    multidim_average: str = "global",
+    multilabel: bool = False,
+    top_k: int = 1,
+) -> Array:
+    """Parity: reference ``functional/classification/specificity.py:23``."""
+    if average == "binary":
+        return _safe_divide(tn, tn + fp)
+    if average == "micro":
+        axis = 0 if multidim_average == "global" else 1
+        tp, fp, tn, fn = (jnp.sum(x, axis=axis) for x in (tp, fp, tn, fn))
+        return _safe_divide(tn, tn + fp)
+    score = _safe_divide(tn, tn + fp)
+    return _adjust_weights_safe_divide(score, average, multilabel, tp, fp, fn)
+
+
+def _hamming_distance_reduce(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    average: Optional[str],
+    multidim_average: str = "global",
+    multilabel: bool = False,
+    top_k: int = 1,
+) -> Array:
+    """Parity: reference ``functional/classification/hamming.py:25``."""
+    if average == "binary":
+        return 1 - _safe_divide(tp + tn, tp + fp + tn + fn)
+    if average == "micro":
+        axis = 0 if multidim_average == "global" else 1
+        tp, fp, tn, fn = (jnp.sum(x, axis=axis) for x in (tp, fp, tn, fn))
+        if multilabel:
+            return 1 - _safe_divide(tp + tn, tp + fp + tn + fn)
+        return 1 - _safe_divide(tp, tp + fn)
+    score = 1 - (_safe_divide(tp + tn, tp + fp + tn + fn) if multilabel else _safe_divide(tp, tp + fn))
+    return _adjust_weights_safe_divide(score, average, multilabel, tp, fp, fn)

@@ -1,0 +1,70 @@
+"""Cohen's kappa over the confusion-matrix engine.
+
+Parity: reference ``src/torchmetrics/functional/classification/cohen_kappa.py``.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .confusion_matrix import (
+    _binary_confusion_matrix_format,
+    _binary_confusion_matrix_update,
+    _multiclass_confusion_matrix_format,
+    _multiclass_confusion_matrix_update,
+)
+
+Array = jax.Array
+
+
+def _cohen_kappa_reduce(confmat: Array, weights: Optional[str] = None) -> Array:
+    """Parity: reference ``cohen_kappa.py:30`` (_cohen_kappa_compute core)."""
+    confmat = confmat.astype(jnp.float32)
+    n_classes = confmat.shape[-1]
+    sum0 = jnp.sum(confmat, axis=0)
+    sum1 = jnp.sum(confmat, axis=1)
+    expected = jnp.outer(sum1, sum0) / jnp.sum(sum0)
+
+    if weights is None:
+        w_mat = jnp.ones((n_classes, n_classes)) - jnp.eye(n_classes)
+    elif weights in ("linear", "quadratic"):
+        w_mat = jnp.broadcast_to(jnp.arange(n_classes)[None, :], (n_classes, n_classes))
+        diff = jnp.abs(w_mat - w_mat.T)
+        w_mat = diff if weights == "linear" else diff**2
+    else:
+        raise ValueError(f"Received invalid `weights` {weights}, expected None, 'linear' or 'quadratic'")
+    k = jnp.sum(w_mat * confmat) / jnp.sum(w_mat * expected)
+    return 1.0 - k
+
+
+def binary_cohen_kappa(
+    preds: Array, target: Array, threshold: float = 0.5, weights: Optional[str] = None,
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Array:
+    preds, target, mask = _binary_confusion_matrix_format(preds, target, threshold, ignore_index)
+    cm = _binary_confusion_matrix_update(preds, target, mask)
+    return _cohen_kappa_reduce(cm, weights)
+
+
+def multiclass_cohen_kappa(
+    preds: Array, target: Array, num_classes: int, weights: Optional[str] = None,
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Array:
+    preds, target, mask = _multiclass_confusion_matrix_format(preds, target, num_classes, ignore_index)
+    cm = _multiclass_confusion_matrix_update(preds, target, mask, num_classes)
+    return _cohen_kappa_reduce(cm, weights)
+
+
+def cohen_kappa(
+    preds: Array, target: Array, task: str, threshold: float = 0.5, num_classes: Optional[int] = None,
+    weights: Optional[str] = None, ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Array:
+    """Task dispatcher. Parity: reference ``cohen_kappa.py:244``."""
+    from ...utils.enums import ClassificationTaskNoMultilabel
+
+    task = ClassificationTaskNoMultilabel.from_str(task)
+    if task == ClassificationTaskNoMultilabel.BINARY:
+        return binary_cohen_kappa(preds, target, threshold, weights, ignore_index, validate_args)
+    if not isinstance(num_classes, int):
+        raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+    return multiclass_cohen_kappa(preds, target, num_classes, weights, ignore_index, validate_args)

@@ -1,0 +1,165 @@
+"""Confusion-matrix engine (binary / multiclass / multilabel).
+
+Parity: reference
+``src/torchmetrics/functional/classification/confusion_matrix.py`` (665 LoC):
+``_binary_confusion_matrix_update`` :149, ``_multiclass_confusion_matrix_update``
+:333 (``_bincount(num_classes * target + preds)``). Feeds ConfusionMatrix,
+CohenKappa, MatthewsCorrCoef, JaccardIndex.
+
+TPU-first: weighted static-shape scatter-add bincount; ``ignore_index`` via
+weight-0 masking.
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.checks import _check_same_shape
+from ...utils.compute import _safe_divide, normalize_logits_if_needed
+
+Array = jax.Array
+
+
+def _confusion_matrix_reduce(confmat: Array, normalize: Optional[str] = None) -> Array:
+    """Normalization over true/pred/all. Parity: reference ``confusion_matrix.py:52``."""
+    allowed = (None, "true", "pred", "all", "none")
+    if normalize not in allowed:
+        raise ValueError(f"Argument `normalize` needs to one of the following: {allowed}")
+    if normalize is None or normalize == "none":
+        return confmat
+    confmat = confmat.astype(jnp.float32)
+    if normalize == "true":
+        return _safe_divide(confmat, jnp.sum(confmat, axis=-1, keepdims=True))
+    if normalize == "pred":
+        return _safe_divide(confmat, jnp.sum(confmat, axis=-2, keepdims=True))
+    return _safe_divide(confmat, jnp.sum(confmat, axis=(-2, -1), keepdims=True))
+
+
+# -- binary -----------------------------------------------------------------
+
+def _binary_confusion_matrix_format(
+    preds: Array, target: Array, threshold: float = 0.5, ignore_index: Optional[int] = None,
+    convert_to_labels: bool = True,
+) -> Tuple[Array, Array, Array]:
+    preds = preds.reshape(-1)
+    target = target.reshape(-1)
+    if jnp.issubdtype(preds.dtype, jnp.floating):
+        preds = normalize_logits_if_needed(preds, "sigmoid")
+        if convert_to_labels:
+            preds = (preds > threshold).astype(jnp.int32)
+    if ignore_index is not None:
+        mask = (target != ignore_index).astype(jnp.float32)
+        target = jnp.clip(target, 0, 1)
+    else:
+        mask = jnp.ones(target.shape, dtype=jnp.float32)
+    return preds, target.astype(jnp.int32), mask
+
+
+def _binary_confusion_matrix_update(preds: Array, target: Array, mask: Array) -> Array:
+    idx = (target * 2 + preds).astype(jnp.int32)
+    cm = jnp.zeros((4,), jnp.float32).at[idx].add(mask)
+    return cm.reshape(2, 2).astype(jnp.int32)
+
+
+def binary_confusion_matrix(
+    preds: Array, target: Array, threshold: float = 0.5, normalize: Optional[str] = None,
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Array:
+    """Parity: reference ``confusion_matrix.py:174``."""
+    if validate_args:
+        _check_same_shape(preds, target)
+    preds, target, mask = _binary_confusion_matrix_format(preds, target, threshold, ignore_index)
+    cm = _binary_confusion_matrix_update(preds, target, mask)
+    return _confusion_matrix_reduce(cm, normalize)
+
+
+# -- multiclass -------------------------------------------------------------
+
+def _multiclass_confusion_matrix_format(
+    preds: Array, target: Array, num_classes: int, ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array]:
+    if preds.ndim == target.ndim + 1:
+        preds = jnp.argmax(preds, axis=1)
+    preds = preds.reshape(-1)
+    target = target.reshape(-1)
+    if ignore_index is not None:
+        mask = (target != ignore_index).astype(jnp.float32)
+        target = jnp.clip(target, 0, num_classes - 1)
+    else:
+        mask = jnp.ones(target.shape, dtype=jnp.float32)
+    preds = jnp.clip(preds, 0, num_classes - 1)
+    return preds.astype(jnp.int32), target.astype(jnp.int32), mask
+
+
+def _multiclass_confusion_matrix_update(preds: Array, target: Array, mask: Array, num_classes: int) -> Array:
+    idx = (num_classes * target + preds).astype(jnp.int32)
+    cm = jnp.zeros((num_classes * num_classes,), jnp.float32).at[idx].add(mask)
+    return cm.reshape(num_classes, num_classes).astype(jnp.int32)
+
+
+def multiclass_confusion_matrix(
+    preds: Array, target: Array, num_classes: int, normalize: Optional[str] = None,
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Array:
+    """Parity: reference ``confusion_matrix.py:336``."""
+    preds, target, mask = _multiclass_confusion_matrix_format(preds, target, num_classes, ignore_index)
+    cm = _multiclass_confusion_matrix_update(preds, target, mask, num_classes)
+    return _confusion_matrix_reduce(cm, normalize)
+
+
+# -- multilabel -------------------------------------------------------------
+
+def _multilabel_confusion_matrix_format(
+    preds: Array, target: Array, num_labels: int, threshold: float = 0.5, ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array]:
+    if jnp.issubdtype(preds.dtype, jnp.floating):
+        preds = normalize_logits_if_needed(preds, "sigmoid")
+        preds = (preds > threshold).astype(jnp.int32)
+    preds = preds.reshape(-1, num_labels)
+    target = target.reshape(-1, num_labels)
+    if ignore_index is not None:
+        mask = (target != ignore_index).astype(jnp.float32)
+        target = jnp.clip(target, 0, 1)
+    else:
+        mask = jnp.ones(target.shape, dtype=jnp.float32)
+    return preds.astype(jnp.int32), target.astype(jnp.int32), mask
+
+
+def _multilabel_confusion_matrix_update(preds: Array, target: Array, mask: Array, num_labels: int) -> Array:
+    # per-label 2x2: index = label*4 + target*2 + pred
+    lab = jnp.broadcast_to(jnp.arange(num_labels), target.shape)
+    idx = (lab * 4 + target * 2 + preds).astype(jnp.int32).reshape(-1)
+    cm = jnp.zeros((num_labels * 4,), jnp.float32).at[idx].add(mask.reshape(-1))
+    return cm.reshape(num_labels, 2, 2).astype(jnp.int32)
+
+
+def multilabel_confusion_matrix(
+    preds: Array, target: Array, num_labels: int, threshold: float = 0.5, normalize: Optional[str] = None,
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Array:
+    """Parity: reference ``confusion_matrix.py:498``."""
+    if validate_args:
+        _check_same_shape(preds, target)
+    preds, target, mask = _multilabel_confusion_matrix_format(preds, target, num_labels, threshold, ignore_index)
+    cm = _multilabel_confusion_matrix_update(preds, target, mask, num_labels)
+    return _confusion_matrix_reduce(cm, normalize)
+
+
+def confusion_matrix(
+    preds: Array, target: Array, task: str, threshold: float = 0.5, num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None, normalize: Optional[str] = None, ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task dispatcher. Parity: reference ``confusion_matrix.py:603``."""
+    from ...utils.enums import ClassificationTask
+
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_confusion_matrix(preds, target, threshold, normalize, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+        return multiclass_confusion_matrix(preds, target, num_classes, normalize, ignore_index, validate_args)
+    if not isinstance(num_labels, int):
+        raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
+    return multilabel_confusion_matrix(preds, target, num_labels, threshold, normalize, ignore_index, validate_args)

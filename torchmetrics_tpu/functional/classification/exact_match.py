@@ -1,0 +1,87 @@
+"""Exact match (multiclass multidim / multilabel).
+
+Parity: reference ``src/torchmetrics/functional/classification/exact_match.py``.
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.compute import _safe_divide
+from .stat_scores import (
+    _multiclass_stat_scores_format,
+    _multilabel_stat_scores_format,
+)
+
+Array = jax.Array
+
+
+def _exact_match_reduce(correct: Array, total: Array) -> Array:
+    return _safe_divide(correct, total)
+
+
+def _multiclass_exact_match_update(
+    preds: Array, target: Array, multidim_average: str = "global", ignore_index: Optional[int] = None
+) -> Tuple[Array, Array]:
+    """correct/total counts; samples where every position matches count as 1."""
+    if ignore_index is not None:
+        valid = target != ignore_index
+        match = jnp.where(valid, preds == jnp.clip(target, 0, None), True)
+    else:
+        match = preds == target
+    correct = jnp.all(match, axis=1).astype(jnp.int32)
+    if multidim_average == "global":
+        return jnp.sum(correct), jnp.asarray(target.shape[0], dtype=jnp.int32)
+    return correct, jnp.ones_like(correct)
+
+
+def multiclass_exact_match(
+    preds: Array, target: Array, num_classes: int, multidim_average: str = "global",
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Array:
+    """Parity: reference ``exact_match.py:106``."""
+    preds, target = _multiclass_stat_scores_format(preds, target, top_k=1)
+    correct, total = _multiclass_exact_match_update(preds, target, multidim_average, ignore_index)
+    return _exact_match_reduce(correct, total)
+
+
+def _multilabel_exact_match_update(
+    preds: Array, target: Array, mask: Array, num_labels: int, multidim_average: str = "global"
+) -> Tuple[Array, Array]:
+    match = jnp.where(mask == 1, preds == target, True)
+    correct = jnp.all(match, axis=1).astype(jnp.int32)  # over labels
+    if multidim_average == "global":
+        correct = jnp.sum(correct)
+        total = jnp.asarray(target.shape[0] * target.shape[2], dtype=jnp.int32)
+        return correct, total
+    return jnp.sum(correct, axis=-1) if correct.ndim > 1 else correct, jnp.full(
+        (target.shape[0],), target.shape[2], dtype=jnp.int32
+    )
+
+
+def multilabel_exact_match(
+    preds: Array, target: Array, num_labels: int, threshold: float = 0.5, multidim_average: str = "global",
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Array:
+    """Parity: reference ``exact_match.py:223``."""
+    preds, target, mask = _multilabel_stat_scores_format(preds, target, num_labels, threshold, ignore_index)
+    correct, total = _multilabel_exact_match_update(preds, target, mask, num_labels, multidim_average)
+    return _exact_match_reduce(correct, total)
+
+
+def exact_match(
+    preds: Array, target: Array, task: str, num_classes: Optional[int] = None, num_labels: Optional[int] = None,
+    threshold: float = 0.5, multidim_average: str = "global", ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task dispatcher. Parity: reference ``exact_match.py:329``."""
+    from ...utils.enums import ClassificationTaskNoBinary
+
+    task = ClassificationTaskNoBinary.from_str(task)
+    if task == ClassificationTaskNoBinary.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+        return multiclass_exact_match(preds, target, num_classes, multidim_average, ignore_index, validate_args)
+    if not isinstance(num_labels, int):
+        raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
+    return multilabel_exact_match(preds, target, num_labels, threshold, multidim_average, ignore_index, validate_args)

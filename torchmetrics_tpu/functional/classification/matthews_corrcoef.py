@@ -1,0 +1,89 @@
+"""Matthews correlation coefficient over the confusion-matrix engine.
+
+Parity: reference
+``src/torchmetrics/functional/classification/matthews_corrcoef.py``.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .confusion_matrix import (
+    _binary_confusion_matrix_format,
+    _binary_confusion_matrix_update,
+    _multiclass_confusion_matrix_format,
+    _multiclass_confusion_matrix_update,
+    _multilabel_confusion_matrix_format,
+    _multilabel_confusion_matrix_update,
+)
+
+Array = jax.Array
+
+
+def _matthews_corrcoef_reduce(confmat: Array) -> Array:
+    """Parity: reference ``matthews_corrcoef.py:26`` — generalized R_k statistic
+    with the degenerate-case handling (all-one-row/col confusion)."""
+    if confmat.ndim == 3:  # multilabel (L, 2, 2) → summed 2x2
+        confmat = jnp.sum(confmat, axis=0)
+    confmat = confmat.astype(jnp.float32)
+    tk = jnp.sum(confmat, axis=-1)
+    pk = jnp.sum(confmat, axis=-2)
+    c = jnp.trace(confmat)
+    s = jnp.sum(confmat)
+
+    cov_ytyp = c * s - jnp.sum(tk * pk)
+    cov_ypyp = s**2 - jnp.sum(pk * pk)
+    cov_ytyt = s**2 - jnp.sum(tk * tk)
+
+    denom = cov_ypyp * cov_ytyt
+    # degenerate single-row/col cases (reference handles via eps substitution)
+    num_nonzero_rows = jnp.sum((tk != 0).astype(jnp.int32))
+    num_nonzero_cols = jnp.sum((pk != 0).astype(jnp.int32))
+    degenerate = jnp.logical_or(
+        jnp.logical_and(num_nonzero_rows == 1, num_nonzero_cols == 1),
+        denom == 0,
+    )
+    mcc = jnp.where(degenerate, 0.0, cov_ytyp / jnp.sqrt(jnp.where(denom == 0, 1.0, denom)))
+    return mcc
+
+
+def binary_matthews_corrcoef(
+    preds: Array, target: Array, threshold: float = 0.5, ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    preds, target, mask = _binary_confusion_matrix_format(preds, target, threshold, ignore_index)
+    return _matthews_corrcoef_reduce(_binary_confusion_matrix_update(preds, target, mask))
+
+
+def multiclass_matthews_corrcoef(
+    preds: Array, target: Array, num_classes: int, ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Array:
+    preds, target, mask = _multiclass_confusion_matrix_format(preds, target, num_classes, ignore_index)
+    return _matthews_corrcoef_reduce(_multiclass_confusion_matrix_update(preds, target, mask, num_classes))
+
+
+def multilabel_matthews_corrcoef(
+    preds: Array, target: Array, num_labels: int, threshold: float = 0.5, ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    preds, target, mask = _multilabel_confusion_matrix_format(preds, target, num_labels, threshold, ignore_index)
+    return _matthews_corrcoef_reduce(_multilabel_confusion_matrix_update(preds, target, mask, num_labels))
+
+
+def matthews_corrcoef(
+    preds: Array, target: Array, task: str, threshold: float = 0.5, num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None, ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Array:
+    """Task dispatcher. Parity: reference ``matthews_corrcoef.py:272``."""
+    from ...utils.enums import ClassificationTask
+
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_matthews_corrcoef(preds, target, threshold, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+        return multiclass_matthews_corrcoef(preds, target, num_classes, ignore_index, validate_args)
+    if not isinstance(num_labels, int):
+        raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
+    return multilabel_matthews_corrcoef(preds, target, num_labels, threshold, ignore_index, validate_args)

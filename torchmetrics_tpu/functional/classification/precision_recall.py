@@ -1,0 +1,81 @@
+"""Precision & Recall (binary / multiclass / multilabel).
+
+Parity: reference
+``src/torchmetrics/functional/classification/precision_recall.py`` (1031 LoC;
+``_precision_recall_reduce`` :25).
+"""
+from functools import partial
+from typing import Optional
+
+import jax
+
+from ._factory import _binary_stat_metric, _multiclass_stat_metric, _multilabel_stat_metric
+from ._reduce import _precision_recall_reduce
+
+Array = jax.Array
+
+_precision = partial(_precision_recall_reduce, "precision")
+_recall = partial(_precision_recall_reduce, "recall")
+
+
+def binary_precision(preds, target, threshold=0.5, multidim_average="global", ignore_index=None, validate_args=True):
+    return _binary_stat_metric(preds, target, _precision, threshold, multidim_average, ignore_index, validate_args)
+
+
+def binary_recall(preds, target, threshold=0.5, multidim_average="global", ignore_index=None, validate_args=True):
+    return _binary_stat_metric(preds, target, _recall, threshold, multidim_average, ignore_index, validate_args)
+
+
+def multiclass_precision(preds, target, num_classes, average="macro", top_k=1, multidim_average="global",
+                         ignore_index=None, validate_args=True):
+    return _multiclass_stat_metric(preds, target, _precision, num_classes, average, top_k, multidim_average,
+                                   ignore_index, validate_args)
+
+
+def multiclass_recall(preds, target, num_classes, average="macro", top_k=1, multidim_average="global",
+                      ignore_index=None, validate_args=True):
+    return _multiclass_stat_metric(preds, target, _recall, num_classes, average, top_k, multidim_average,
+                                   ignore_index, validate_args)
+
+
+def multilabel_precision(preds, target, num_labels, threshold=0.5, average="macro", multidim_average="global",
+                         ignore_index=None, validate_args=True):
+    return _multilabel_stat_metric(preds, target, _precision, num_labels, threshold, average, multidim_average,
+                                   ignore_index, validate_args)
+
+
+def multilabel_recall(preds, target, num_labels, threshold=0.5, average="macro", multidim_average="global",
+                      ignore_index=None, validate_args=True):
+    return _multilabel_stat_metric(preds, target, _recall, num_labels, threshold, average, multidim_average,
+                                   ignore_index, validate_args)
+
+
+def _dispatch(kind, preds, target, task, threshold=0.5, num_classes=None, num_labels=None, average="micro",
+              multidim_average="global", top_k=1, ignore_index=None, validate_args=True):
+    from ...utils.enums import ClassificationTask
+
+    task = ClassificationTask.from_str(task)
+    b, mc, ml = (
+        (binary_precision, multiclass_precision, multilabel_precision)
+        if kind == "precision"
+        else (binary_recall, multiclass_recall, multilabel_recall)
+    )
+    if task == ClassificationTask.BINARY:
+        return b(preds, target, threshold, multidim_average, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+        return mc(preds, target, num_classes, average, top_k, multidim_average, ignore_index, validate_args)
+    if not isinstance(num_labels, int):
+        raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
+    return ml(preds, target, num_labels, threshold, average, multidim_average, ignore_index, validate_args)
+
+
+def precision(preds, target, task, **kwargs):
+    """Task dispatcher. Parity: reference ``precision_recall.py:830``."""
+    return _dispatch("precision", preds, target, task, **kwargs)
+
+
+def recall(preds, target, task, **kwargs):
+    """Task dispatcher. Parity: reference ``precision_recall.py:931``."""
+    return _dispatch("recall", preds, target, task, **kwargs)

@@ -1,0 +1,47 @@
+"""Specificity (binary / multiclass / multilabel).
+
+Parity: reference ``src/torchmetrics/functional/classification/specificity.py``
+(``_specificity_reduce`` :23).
+"""
+import jax
+
+from ._factory import _binary_stat_metric, _multiclass_stat_metric, _multilabel_stat_metric
+from ._reduce import _specificity_reduce
+
+Array = jax.Array
+
+
+def binary_specificity(preds, target, threshold=0.5, multidim_average="global", ignore_index=None, validate_args=True):
+    return _binary_stat_metric(preds, target, _specificity_reduce, threshold, multidim_average, ignore_index,
+                               validate_args)
+
+
+def multiclass_specificity(preds, target, num_classes, average="macro", top_k=1, multidim_average="global",
+                           ignore_index=None, validate_args=True):
+    return _multiclass_stat_metric(preds, target, _specificity_reduce, num_classes, average, top_k, multidim_average,
+                                   ignore_index, validate_args)
+
+
+def multilabel_specificity(preds, target, num_labels, threshold=0.5, average="macro", multidim_average="global",
+                           ignore_index=None, validate_args=True):
+    return _multilabel_stat_metric(preds, target, _specificity_reduce, num_labels, threshold, average,
+                                   multidim_average, ignore_index, validate_args)
+
+
+def specificity(preds, target, task, threshold=0.5, num_classes=None, num_labels=None, average="micro",
+                multidim_average="global", top_k=1, ignore_index=None, validate_args=True):
+    """Task dispatcher. Parity: reference ``specificity.py:400``."""
+    from ...utils.enums import ClassificationTask
+
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_specificity(preds, target, threshold, multidim_average, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+        return multiclass_specificity(preds, target, num_classes, average, top_k, multidim_average, ignore_index,
+                                      validate_args)
+    if not isinstance(num_labels, int):
+        raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
+    return multilabel_specificity(preds, target, num_labels, threshold, average, multidim_average, ignore_index,
+                                  validate_args)

@@ -1,0 +1,420 @@
+"""Engine A — true/false positive/negative counters for binary, multiclass
+and multilabel tasks.
+
+Parity: reference ``src/torchmetrics/functional/classification/stat_scores.py``
+(1129 LoC): binary ``_format`` :90 / ``_update`` :120 / ``_compute`` :134;
+multiclass ``_format`` :325 / ``_update`` :344; multilabel ``_format`` :647 /
+``_update`` :672.
+
+TPU-first design decisions (SURVEY.md §7 hard-part 1):
+
+- ``ignore_index`` is handled by a **weight-0 sample mask**, never boolean
+  indexing — every shape stays static under jit.
+- The multiclass confusion path is a *weighted* static-length bincount over
+  ``num_classes * target + preds`` (an XLA scatter-add feeding the MXU-free
+  path); masked entries get weight 0 and clipped indices.
+- Logit detection (``sigmoid/softmax`` if any value outside [0,1]) is a traced
+  ``jnp.where`` so the same compiled program serves probs and logits.
+- Value validation (label ranges etc.) runs only on concrete (non-traced)
+  arrays — under jit it is a no-op, matching "validation outside jit".
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.checks import _check_same_shape, is_tracing
+from ...utils.compute import normalize_logits_if_needed
+from ...utils.data import _bincount, select_topk, to_onehot
+
+Array = jax.Array
+
+
+# ---------------------------------------------------------------------------
+# shared validation helpers (host-side; skipped while tracing)
+# ---------------------------------------------------------------------------
+
+def _binary_stat_scores_arg_validation(
+    threshold: float = 0.5,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> None:
+    if not (isinstance(threshold, float) and 0 <= threshold <= 1):
+        raise ValueError(f"Expected argument `threshold` to be a float in the [0,1] range, but got {threshold}.")
+    if multidim_average not in ("global", "samplewise"):
+        raise ValueError(
+            f"Expected argument `multidim_average` to be one of ('global', 'samplewise'), but got {multidim_average}"
+        )
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _binary_stat_scores_tensor_validation(
+    preds: Array, target: Array, multidim_average: str = "global", ignore_index: Optional[int] = None
+) -> None:
+    _check_same_shape(preds, target)
+    if multidim_average != "global" and preds.ndim < 2:
+        raise ValueError("Expected input to be at least 2D when multidim_average is set to `samplewise`")
+    if is_tracing(target):
+        return
+    unique = jnp.unique(target)
+    allowed = {0, 1} if ignore_index is None else {0, 1, ignore_index}
+    if not set(jnp.asarray(unique).tolist()).issubset(allowed):
+        raise RuntimeError(
+            f"Detected the following values in `target`: {unique} but expected only the following values {sorted(allowed)}."
+        )
+    if not is_tracing(preds) and not jnp.issubdtype(preds.dtype, jnp.floating):
+        up = set(jnp.asarray(jnp.unique(preds)).tolist())
+        if not up.issubset(allowed):
+            raise RuntimeError(f"Detected the following values in `preds`: {up} but expected only 0s and 1s.")
+
+
+def _multiclass_stat_scores_arg_validation(
+    num_classes: int,
+    top_k: int = 1,
+    average: Optional[str] = "macro",
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> None:
+    if not isinstance(num_classes, int) or num_classes < 2:
+        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+    if not isinstance(top_k, int) and top_k < 1:
+        raise ValueError(f"Expected argument `top_k` to be an integer larger than or equal to 1, but got {top_k}")
+    if top_k > num_classes:
+        raise ValueError(
+            f"Expected argument `top_k` to be smaller or equal to `num_classes` but got {top_k} and {num_classes}"
+        )
+    if average not in ("micro", "macro", "weighted", "none", None):
+        raise ValueError(f"Expected argument `average` to be one of ('micro', 'macro', 'weighted', 'none', None)")
+    if multidim_average not in ("global", "samplewise"):
+        raise ValueError("Expected argument `multidim_average` to be one of ('global', 'samplewise')")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _multiclass_stat_scores_tensor_validation(
+    preds: Array, target: Array, num_classes: int, multidim_average: str = "global", ignore_index: Optional[int] = None
+) -> None:
+    if preds.ndim == target.ndim + 1:
+        if not jnp.issubdtype(preds.dtype, jnp.floating):
+            raise ValueError("If `preds` have one dimension more than `target`, `preds` should be a float tensor.")
+        if preds.shape[1] != num_classes:
+            raise ValueError("If `preds` have one dimension more than `target`, `preds.shape[1]` should be"
+                             " equal to number of classes.")
+        if preds.shape[2:] != target.shape[1:]:
+            raise ValueError("If `preds` have one dimension more than `target`, the shape of `preds` should be"
+                             " (N, C, ...), and the shape of `target` should be (N, ...).")
+    elif preds.ndim == target.ndim:
+        if preds.shape != target.shape:
+            raise ValueError("The `preds` and `target` should have the same shape.")
+        if multidim_average != "global" and preds.ndim < 2:
+            raise ValueError("when `preds` and `target` have the same shape and `multidim_average` is `samplewise`,"
+                             " they should have at least 2 dimensions.")
+    else:
+        raise ValueError("Either `preds` and `target` both should have the (same) shape (N, ...), or `target` should be"
+                         " (N, ...) and `preds` should be (N, C, ...).")
+    if is_tracing(target):
+        return
+    check_value = num_classes if ignore_index is None else max(num_classes, ignore_index + 1)
+    t_max, t_min = int(jnp.max(target)), int(jnp.min(target))
+    if t_max >= check_value or (t_min < 0 and t_min != ignore_index):
+        raise RuntimeError(f"Detected values in `target` outside the expected range [0, {num_classes}).")
+    if not jnp.issubdtype(preds.dtype, jnp.floating) and not is_tracing(preds):
+        if int(jnp.max(preds)) >= num_classes:
+            raise RuntimeError(f"Detected values in `preds` outside the expected range [0, {num_classes}).")
+
+
+def _multilabel_stat_scores_arg_validation(
+    num_labels: int,
+    threshold: float = 0.5,
+    average: Optional[str] = "macro",
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> None:
+    if not isinstance(num_labels, int) or num_labels < 2:
+        raise ValueError(f"Expected argument `num_labels` to be an integer larger than 1, but got {num_labels}")
+    _binary_stat_scores_arg_validation(threshold, multidim_average, ignore_index)
+    if average not in ("micro", "macro", "weighted", "none", None):
+        raise ValueError(f"Expected argument `average` to be one of ('micro', 'macro', 'weighted', 'none', None)")
+
+
+def _multilabel_stat_scores_tensor_validation(
+    preds: Array, target: Array, num_labels: int, multidim_average: str = "global", ignore_index: Optional[int] = None
+) -> None:
+    _check_same_shape(preds, target)
+    if preds.ndim < 2:
+        raise ValueError(f"Expected both `target` and `preds` to be at least 2D, got {preds.ndim}D")
+    if preds.shape[1] != num_labels:
+        raise ValueError(f"Expected `preds.shape[1]`={preds.shape[1]} to equal `num_labels`={num_labels}")
+    if multidim_average != "global" and preds.ndim < 3:
+        raise ValueError("Expected input to be at least 3D when multidim_average is set to `samplewise`")
+
+
+# ---------------------------------------------------------------------------
+# binary
+# ---------------------------------------------------------------------------
+
+def _binary_stat_scores_format(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array]:
+    """Sigmoid-if-logits → threshold → flatten-to-(N, -1); returns a sample
+    mask instead of dropping ignored entries (static shapes under jit)."""
+    if jnp.issubdtype(preds.dtype, jnp.floating):
+        preds = normalize_logits_if_needed(preds, "sigmoid")
+        preds = (preds > threshold).astype(jnp.int32)
+    preds = preds.reshape(preds.shape[0], -1) if preds.ndim > 1 else preds.reshape(-1, 1)
+    target_r = target.reshape(target.shape[0], -1) if target.ndim > 1 else target.reshape(-1, 1)
+    if ignore_index is not None:
+        mask = (target_r != ignore_index).astype(jnp.int32)
+        target_r = jnp.clip(target_r, 0, 1)
+    else:
+        mask = jnp.ones_like(target_r, dtype=jnp.int32)
+    return preds.astype(jnp.int32), target_r.astype(jnp.int32), mask
+
+
+def _binary_stat_scores_update(
+    preds: Array, target: Array, mask: Array, multidim_average: str = "global"
+) -> Tuple[Array, Array, Array, Array]:
+    axis = None if multidim_average == "global" else 1
+    tp = jnp.sum((preds == 1) & (target == 1) & (mask == 1), axis=axis)
+    fp = jnp.sum((preds == 1) & (target == 0) & (mask == 1), axis=axis)
+    tn = jnp.sum((preds == 0) & (target == 0) & (mask == 1), axis=axis)
+    fn = jnp.sum((preds == 0) & (target == 1) & (mask == 1), axis=axis)
+    return tp, fp, tn, fn
+
+
+def _binary_stat_scores_compute(
+    tp: Array, fp: Array, tn: Array, fn: Array, multidim_average: str = "global"
+) -> Array:
+    stats = [tp, fp, tn, fn, tp + fn]
+    if multidim_average == "global":
+        return jnp.stack([jnp.atleast_1d(s).squeeze() for s in stats], axis=0)
+    return jnp.stack(stats, axis=-1)
+
+
+def binary_stat_scores(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """One-shot binary tp/fp/tn/fn/support.
+
+    Parity: reference ``functional/classification/stat_scores.py:170``.
+    """
+    if validate_args:
+        _binary_stat_scores_arg_validation(threshold, multidim_average, ignore_index)
+        _binary_stat_scores_tensor_validation(preds, target, multidim_average, ignore_index)
+    preds, target, mask = _binary_stat_scores_format(preds, target, threshold, ignore_index)
+    tp, fp, tn, fn = _binary_stat_scores_update(preds, target, mask, multidim_average)
+    return _binary_stat_scores_compute(tp, fp, tn, fn, multidim_average)
+
+
+# ---------------------------------------------------------------------------
+# multiclass
+# ---------------------------------------------------------------------------
+
+def _multiclass_stat_scores_format(
+    preds: Array,
+    target: Array,
+    top_k: int = 1,
+) -> Tuple[Array, Array]:
+    """argmax dense predictions when top_k == 1; flatten trailing dims."""
+    if preds.ndim == target.ndim + 1 and top_k == 1:
+        preds = jnp.argmax(preds, axis=1)
+    if top_k == 1:
+        preds = preds.reshape(preds.shape[0], -1)
+        target = target.reshape(target.shape[0], -1)
+    else:  # keep (N, C, S) probs for the top-k one-hot path
+        preds = preds.reshape(preds.shape[0], preds.shape[1], -1)
+        target = target.reshape(target.shape[0], -1)
+    return preds, target
+
+
+def _multiclass_stat_scores_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    top_k: int = 1,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array, Array]:
+    """Per-class tp/fp/tn/fn of shape (C,) (global) or (N, C) (samplewise)."""
+    if ignore_index is not None:
+        mask = (target != ignore_index)
+        target = jnp.clip(target, 0, num_classes - 1)
+    else:
+        mask = jnp.ones_like(target, dtype=bool)
+
+    if top_k > 1:
+        # preds (N, C, S) probs → top-k one-hot vs target one-hot
+        pred_topk = select_topk(preds, topk=top_k, dim=1)  # (N, C, S)
+        tgt_oh = jnp.moveaxis(jax.nn.one_hot(target, num_classes, dtype=jnp.int32), -1, 1)  # (N, C, S)
+        m = mask[:, None, :].astype(jnp.int32)
+        axes = (0, 2) if multidim_average == "global" else (2,)
+        tp = jnp.sum(pred_topk * tgt_oh * m, axis=axes)
+        fp = jnp.sum(pred_topk * (1 - tgt_oh) * m, axis=axes)
+        fn = jnp.sum((1 - pred_topk) * tgt_oh * m, axis=axes)
+        tn = jnp.sum((1 - pred_topk) * (1 - tgt_oh) * m, axis=axes)
+        return tp, fp, tn, fn
+
+    preds_c = jnp.clip(preds, 0, num_classes - 1)
+    w = mask.astype(jnp.float32)
+    idx = (num_classes * target + preds_c).astype(jnp.int32)
+
+    if multidim_average == "global":
+        flat_idx = idx.reshape(-1)
+        flat_w = w.reshape(-1)
+        cm = jnp.zeros((num_classes * num_classes,), jnp.float32).at[flat_idx].add(flat_w)
+        cm = cm.reshape(num_classes, num_classes)
+        tp = jnp.diagonal(cm)
+        fn = jnp.sum(cm, axis=1) - tp
+        fp = jnp.sum(cm, axis=0) - tp
+        tn = jnp.sum(cm) - tp - fp - fn
+    else:
+        def per_sample(ix, ww):
+            cm = jnp.zeros((num_classes * num_classes,), jnp.float32).at[ix].add(ww)
+            return cm.reshape(num_classes, num_classes)
+
+        cm = jax.vmap(per_sample)(idx, w)  # (N, C, C)
+        tp = jnp.diagonal(cm, axis1=1, axis2=2)
+        fn = jnp.sum(cm, axis=2) - tp
+        fp = jnp.sum(cm, axis=1) - tp
+        tn = jnp.sum(cm, axis=(1, 2))[:, None] - tp - fp - fn
+    return tp.astype(jnp.int32), fp.astype(jnp.int32), tn.astype(jnp.int32), fn.astype(jnp.int32)
+
+
+def _multiclass_stat_scores_compute(
+    tp: Array, fp: Array, tn: Array, fn: Array, average: Optional[str], multidim_average: str = "global"
+) -> Array:
+    res = jnp.stack([tp, fp, tn, fn, tp + fn], axis=-1)
+    if average == "micro":
+        return jnp.sum(res, axis=-2)
+    return res
+
+
+def multiclass_stat_scores(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    average: Optional[str] = "macro",
+    top_k: int = 1,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """One-shot multiclass tp/fp/tn/fn/support.
+
+    Parity: reference ``functional/classification/stat_scores.py:468``.
+    """
+    if validate_args:
+        _multiclass_stat_scores_arg_validation(num_classes, top_k, average, multidim_average, ignore_index)
+        _multiclass_stat_scores_tensor_validation(preds, target, num_classes, multidim_average, ignore_index)
+    preds, target = _multiclass_stat_scores_format(preds, target, top_k)
+    tp, fp, tn, fn = _multiclass_stat_scores_update(
+        preds, target, num_classes, top_k, multidim_average, ignore_index
+    )
+    return _multiclass_stat_scores_compute(tp, fp, tn, fn, average, multidim_average)
+
+
+# ---------------------------------------------------------------------------
+# multilabel
+# ---------------------------------------------------------------------------
+
+def _multilabel_stat_scores_format(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array]:
+    if jnp.issubdtype(preds.dtype, jnp.floating):
+        preds = normalize_logits_if_needed(preds, "sigmoid")
+        preds = (preds > threshold).astype(jnp.int32)
+    preds = preds.reshape(preds.shape[0], num_labels, -1)
+    target = target.reshape(target.shape[0], num_labels, -1)
+    if ignore_index is not None:
+        mask = (target != ignore_index).astype(jnp.int32)
+        target = jnp.clip(target, 0, 1)
+    else:
+        mask = jnp.ones_like(target, dtype=jnp.int32)
+    return preds.astype(jnp.int32), target.astype(jnp.int32), mask
+
+
+def _multilabel_stat_scores_update(
+    preds: Array, target: Array, mask: Array, multidim_average: str = "global"
+) -> Tuple[Array, Array, Array, Array]:
+    axes = (0, 2) if multidim_average == "global" else (2,)
+    tp = jnp.sum((preds == 1) & (target == 1) & (mask == 1), axis=axes)
+    fp = jnp.sum((preds == 1) & (target == 0) & (mask == 1), axis=axes)
+    tn = jnp.sum((preds == 0) & (target == 0) & (mask == 1), axis=axes)
+    fn = jnp.sum((preds == 0) & (target == 1) & (mask == 1), axis=axes)
+    return tp, fp, tn, fn
+
+
+def _multilabel_stat_scores_compute(
+    tp: Array, fp: Array, tn: Array, fn: Array, average: Optional[str], multidim_average: str = "global"
+) -> Array:
+    res = jnp.stack([tp, fp, tn, fn, tp + fn], axis=-1)
+    if average == "micro":
+        return jnp.sum(res, axis=-2)
+    return res
+
+
+def multilabel_stat_scores(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    threshold: float = 0.5,
+    average: Optional[str] = "macro",
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """One-shot multilabel tp/fp/tn/fn/support.
+
+    Parity: reference ``functional/classification/stat_scores.py:820``.
+    """
+    if validate_args:
+        _multilabel_stat_scores_arg_validation(num_labels, threshold, average, multidim_average, ignore_index)
+        _multilabel_stat_scores_tensor_validation(preds, target, num_labels, multidim_average, ignore_index)
+    preds, target, mask = _multilabel_stat_scores_format(preds, target, num_labels, threshold, ignore_index)
+    tp, fp, tn, fn = _multilabel_stat_scores_update(preds, target, mask, multidim_average)
+    return _multilabel_stat_scores_compute(tp, fp, tn, fn, average, multidim_average)
+
+
+def stat_scores(
+    preds: Array,
+    target: Array,
+    task: str,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    average: Optional[str] = "micro",
+    multidim_average: str = "global",
+    top_k: int = 1,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task dispatcher. Parity: reference ``stat_scores.py:1030``."""
+    from ...utils.enums import ClassificationTask
+
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_stat_scores(preds, target, threshold, multidim_average, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+        return multiclass_stat_scores(
+            preds, target, num_classes, average, top_k, multidim_average, ignore_index, validate_args
+        )
+    if not isinstance(num_labels, int):
+        raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
+    return multilabel_stat_scores(
+        preds, target, num_labels, threshold, average, multidim_average, ignore_index, validate_args
+    )
