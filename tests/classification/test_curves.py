@@ -1,0 +1,122 @@
+"""Curve engine (PR-curve / ROC / AUROC / AP) vs sklearn.
+
+Parity model: reference ``tests/unittests/classification/test_auroc.py`` etc.
+"""
+import numpy as np
+import pytest
+from sklearn import metrics as skm
+
+import jax.numpy as jnp
+
+from tests.conftest import BATCH_SIZE, NUM_BATCHES, NUM_CLASSES
+from tests.helpers.testers import MetricTester
+
+from torchmetrics_tpu.classification import (
+    BinaryAUROC,
+    BinaryAveragePrecision,
+    BinaryPrecisionRecallCurve,
+    BinaryROC,
+    MulticlassAUROC,
+    MulticlassAveragePrecision,
+    MultilabelAUROC,
+)
+
+seed = np.random.RandomState(11)
+BIN_PROBS = seed.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32)
+BIN_TARGET = seed.randint(0, 2, (NUM_BATCHES, BATCH_SIZE))
+MC_PROBS = seed.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES).astype(np.float32)
+MC_PROBS /= MC_PROBS.sum(-1, keepdims=True)
+MC_TARGET = seed.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))
+NUM_LABELS = 4
+ML_PROBS = seed.rand(NUM_BATCHES, BATCH_SIZE, NUM_LABELS).astype(np.float32)
+ML_TARGET = seed.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_LABELS))
+
+
+class TestBinaryCurves(MetricTester):
+    def test_auroc_exact(self):
+        self.run_class_metric_test(
+            BIN_PROBS, BIN_TARGET, BinaryAUROC, lambda p, t: skm.roc_auc_score(t, p),
+            metric_args={"thresholds": None}, ddp=True, check_batch=True,
+        )
+
+    def test_auroc_binned_close(self):
+        m = BinaryAUROC(thresholds=500)
+        for i in range(NUM_BATCHES):
+            m.update(jnp.asarray(BIN_PROBS[i]), jnp.asarray(BIN_TARGET[i]))
+        ref = skm.roc_auc_score(BIN_TARGET.reshape(-1), BIN_PROBS.reshape(-1))
+        assert abs(float(m.compute()) - ref) < 5e-3
+
+    def test_auroc_binned_shard_map(self):
+        # binned state is sum-reducible → psum path
+        self.atol = 5e-3
+        self.rtol = 5e-3
+        self.run_shard_map_test(
+            BIN_PROBS, BIN_TARGET, BinaryAUROC, lambda p, t: skm.roc_auc_score(t, p),
+            metric_args={"thresholds": 500},
+        )
+        self.atol = self.rtol = 1e-5
+
+    def test_average_precision_exact(self):
+        self.run_class_metric_test(
+            BIN_PROBS, BIN_TARGET, BinaryAveragePrecision,
+            lambda p, t: skm.average_precision_score(t, p), check_batch=True,
+        )
+
+    def test_pr_curve_exact(self):
+        m = BinaryPrecisionRecallCurve()
+        for i in range(NUM_BATCHES):
+            m.update(jnp.asarray(BIN_PROBS[i]), jnp.asarray(BIN_TARGET[i]))
+        prec, rec, thr = m.compute()
+        sp, sr, st = skm.precision_recall_curve(BIN_TARGET.reshape(-1), BIN_PROBS.reshape(-1))
+        np.testing.assert_allclose(np.asarray(prec), sp, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(rec), sr, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(thr), st, atol=1e-6)
+
+    def test_roc_exact(self):
+        m = BinaryROC()
+        for i in range(NUM_BATCHES):
+            m.update(jnp.asarray(BIN_PROBS[i]), jnp.asarray(BIN_TARGET[i]))
+        fpr, tpr, _ = m.compute()
+        sf, st, _ = skm.roc_curve(BIN_TARGET.reshape(-1), BIN_PROBS.reshape(-1), drop_intermediate=False)
+        np.testing.assert_allclose(np.asarray(fpr), sf, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(tpr), st, atol=1e-6)
+
+    def test_ignore_index(self):
+        t2 = BIN_TARGET.copy()
+        t2[:, :4] = -1
+        m = BinaryAUROC(ignore_index=-1)
+        for i in range(NUM_BATCHES):
+            m.update(jnp.asarray(BIN_PROBS[i]), jnp.asarray(t2[i]))
+        valid = t2.reshape(-1) != -1
+        ref = skm.roc_auc_score(t2.reshape(-1)[valid], BIN_PROBS.reshape(-1)[valid])
+        np.testing.assert_allclose(float(m.compute()), ref, atol=1e-6)
+
+
+class TestMulticlassCurves(MetricTester):
+    def test_auroc(self):
+        self.run_class_metric_test(
+            MC_PROBS, MC_TARGET, MulticlassAUROC,
+            lambda p, t: skm.roc_auc_score(t, p, multi_class="ovr", average="macro", labels=range(NUM_CLASSES)),
+            metric_args={"num_classes": NUM_CLASSES}, check_batch=False,
+        )
+
+    def test_average_precision(self):
+        def sk(p, t):
+            oh = np.eye(NUM_CLASSES)[t]
+            return np.mean([skm.average_precision_score(oh[:, c], p[:, c]) for c in range(NUM_CLASSES)])
+
+        self.run_class_metric_test(
+            MC_PROBS, MC_TARGET, MulticlassAveragePrecision, sk,
+            metric_args={"num_classes": NUM_CLASSES}, check_batch=False,
+        )
+
+
+class TestMultilabelCurves(MetricTester):
+    def test_auroc(self):
+        def sk(p, t):
+            return skm.roc_auc_score(t.reshape(-1, NUM_LABELS), p.reshape(-1, NUM_LABELS), average="macro")
+
+        self.run_class_metric_test(
+            ML_PROBS, ML_TARGET, MultilabelAUROC, sk,
+            metric_args={"num_labels": NUM_LABELS}, check_batch=False,
+        )

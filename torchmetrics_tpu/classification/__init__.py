@@ -47,6 +47,20 @@ from .specificity import (
     MultilabelSpecificity,
     Specificity,
 )
+from .auroc import AUROC, BinaryAUROC, MulticlassAUROC, MultilabelAUROC
+from .average_precision import (
+    AveragePrecision,
+    BinaryAveragePrecision,
+    MulticlassAveragePrecision,
+    MultilabelAveragePrecision,
+)
+from .precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+    MultilabelPrecisionRecallCurve,
+    PrecisionRecallCurve,
+)
+from .roc import ROC, BinaryROC, MulticlassROC, MultilabelROC
 from .stat_scores import (
     BinaryStatScores,
     MulticlassStatScores,
@@ -55,6 +69,10 @@ from .stat_scores import (
 )
 
 __all__ = [
+    "AUROC", "BinaryAUROC", "MulticlassAUROC", "MultilabelAUROC",
+    "AveragePrecision", "BinaryAveragePrecision", "MulticlassAveragePrecision", "MultilabelAveragePrecision",
+    "PrecisionRecallCurve", "BinaryPrecisionRecallCurve", "MulticlassPrecisionRecallCurve", "MultilabelPrecisionRecallCurve",
+    "ROC", "BinaryROC", "MulticlassROC", "MultilabelROC",
     "Accuracy", "BinaryAccuracy", "MulticlassAccuracy", "MultilabelAccuracy",
     "CohenKappa", "BinaryCohenKappa", "MulticlassCohenKappa",
     "ConfusionMatrix", "BinaryConfusionMatrix", "MulticlassConfusionMatrix", "MultilabelConfusionMatrix",

@@ -1,0 +1,121 @@
+"""AUROC metric classes.
+
+Parity: reference ``src/torchmetrics/classification/auroc.py``.
+"""
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..functional.classification.auroc import (
+    _binary_auroc_compute,
+    _reduce_auroc,
+)
+from ..functional.classification.roc import _multiclass_roc_compute, _multilabel_roc_compute
+from ..metric import Metric
+from ..utils.enums import ClassificationTask
+from .base import _ClassificationTaskWrapper
+from .precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+    MultilabelPrecisionRecallCurve,
+    Thresholds,
+)
+
+Array = jax.Array
+
+
+class BinaryAUROC(BinaryPrecisionRecallCurve):
+    """Parity: reference ``classification/auroc.py:40``."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, max_fpr: Optional[float] = None, thresholds: Thresholds = None,
+                 ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(thresholds, ignore_index, validate_args, **kwargs)
+        if validate_args and max_fpr is not None and not (isinstance(max_fpr, float) and 0 < max_fpr <= 1):
+            raise ValueError(f"Argument `max_fpr` should be a float in range (0, 1], but got: {max_fpr}")
+        self.max_fpr = max_fpr
+
+    def compute(self) -> Array:
+        if self.thresholds is None:
+            return _binary_auroc_compute(self._exact_state(), None, self.max_fpr)
+        return _binary_auroc_compute(self.confmat, self.thresholds, self.max_fpr)
+
+
+class MulticlassAUROC(MulticlassPrecisionRecallCurve):
+    """Parity: reference ``classification/auroc.py:146``."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Class"
+
+    def __init__(self, num_classes: int, average: Optional[str] = "macro", thresholds: Thresholds = None,
+                 ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(num_classes, thresholds, ignore_index, validate_args, **kwargs)
+        self.average = average
+
+    def compute(self) -> Array:
+        if self.thresholds is None:
+            preds, target = self._exact_state()
+            fpr, tpr, _ = _multiclass_roc_compute((preds, target), self.num_classes, None)
+            support = jnp.sum(jax.nn.one_hot(target, self.num_classes), axis=0)
+        else:
+            fpr, tpr, _ = _multiclass_roc_compute(self.confmat, self.num_classes, self.thresholds)
+            support = (self.confmat[0, :, 1, 1] + self.confmat[0, :, 1, 0]).astype(jnp.float32)
+        return _reduce_auroc(fpr, tpr, self.average, weights=support)
+
+
+class MultilabelAUROC(MultilabelPrecisionRecallCurve):
+    """Parity: reference ``classification/auroc.py:262``."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Label"
+
+    def __init__(self, num_labels: int, average: Optional[str] = "macro", thresholds: Thresholds = None,
+                 ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(num_labels, thresholds, ignore_index, validate_args, **kwargs)
+        self.average = average
+
+    def compute(self) -> Array:
+        if self.thresholds is None:
+            preds, target = self._exact_state()
+            if self.average == "micro":
+                return _binary_auroc_compute((preds.reshape(-1), target.reshape(-1)), None, None)
+            fpr, tpr, _ = _multilabel_roc_compute((preds, target), self.num_labels, None, self.ignore_index)
+            support = jnp.sum(target == 1, axis=0).astype(jnp.float32)
+        else:
+            fpr, tpr, _ = _multilabel_roc_compute(self.confmat, self.num_labels, self.thresholds)
+            support = (self.confmat[0, :, 1, 1] + self.confmat[0, :, 1, 0]).astype(jnp.float32)
+        return _reduce_auroc(fpr, tpr, self.average, weights=support)
+
+
+class AUROC(_ClassificationTaskWrapper):
+    """Task facade. Parity: reference ``classification/auroc.py:376``."""
+
+    def __new__(cls, task: str, thresholds: Thresholds = None, num_classes: Optional[int] = None,
+                num_labels: Optional[int] = None, average: Optional[str] = "macro",
+                max_fpr: Optional[float] = None, ignore_index: Optional[int] = None,
+                validate_args: bool = True, **kwargs: Any) -> Metric:
+        task = ClassificationTask.from_str(task)
+        kwargs.update({"thresholds": thresholds, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTask.BINARY:
+            return BinaryAUROC(max_fpr, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+            return MulticlassAUROC(num_classes, average, **kwargs)
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
+        return MultilabelAUROC(num_labels, average, **kwargs)

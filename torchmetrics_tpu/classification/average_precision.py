@@ -1,0 +1,124 @@
+"""AveragePrecision metric classes.
+
+Parity: reference ``src/torchmetrics/classification/average_precision.py``.
+"""
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..functional.classification.average_precision import (
+    _binary_average_precision_compute,
+    _reduce_average_precision,
+)
+from ..functional.classification.precision_recall_curve import (
+    _multiclass_precision_recall_curve_compute,
+    _multilabel_precision_recall_curve_compute,
+)
+from ..metric import Metric
+from ..utils.enums import ClassificationTask
+from .base import _ClassificationTaskWrapper
+from .precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+    MultilabelPrecisionRecallCurve,
+    Thresholds,
+)
+
+Array = jax.Array
+
+
+class BinaryAveragePrecision(BinaryPrecisionRecallCurve):
+    """Parity: reference ``classification/average_precision.py:44``."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def compute(self) -> Array:
+        if self.thresholds is None:
+            return _binary_average_precision_compute(self._exact_state(), None)
+        return _binary_average_precision_compute(self.confmat, self.thresholds)
+
+
+class MulticlassAveragePrecision(MulticlassPrecisionRecallCurve):
+    """Parity: reference ``classification/average_precision.py:151``."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Class"
+
+    def __init__(self, num_classes: int, average: Optional[str] = "macro", thresholds: Thresholds = None,
+                 ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(num_classes, thresholds, ignore_index, validate_args, **kwargs)
+        self.average = average
+
+    def compute(self) -> Array:
+        if self.thresholds is None:
+            preds, target = self._exact_state()
+            precision, recall, _ = _multiclass_precision_recall_curve_compute(
+                (preds, target), self.num_classes, None
+            )
+            support = jnp.sum(jax.nn.one_hot(target, self.num_classes), axis=0)
+        else:
+            precision, recall, _ = _multiclass_precision_recall_curve_compute(
+                self.confmat, self.num_classes, self.thresholds
+            )
+            support = (self.confmat[0, :, 1, 1] + self.confmat[0, :, 1, 0]).astype(jnp.float32)
+        return _reduce_average_precision(precision, recall, self.average, weights=support)
+
+
+class MultilabelAveragePrecision(MultilabelPrecisionRecallCurve):
+    """Parity: reference ``classification/average_precision.py:264``."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Label"
+
+    def __init__(self, num_labels: int, average: Optional[str] = "macro", thresholds: Thresholds = None,
+                 ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(num_labels, thresholds, ignore_index, validate_args, **kwargs)
+        self.average = average
+
+    def compute(self) -> Array:
+        if self.thresholds is None:
+            preds, target = self._exact_state()
+            if self.average == "micro":
+                return _binary_average_precision_compute((preds.reshape(-1), target.reshape(-1)), None)
+            precision, recall, _ = _multilabel_precision_recall_curve_compute(
+                (preds, target), self.num_labels, None, self.ignore_index
+            )
+            support = jnp.sum(target == 1, axis=0).astype(jnp.float32)
+        else:
+            precision, recall, _ = _multilabel_precision_recall_curve_compute(
+                self.confmat, self.num_labels, self.thresholds
+            )
+            support = (self.confmat[0, :, 1, 1] + self.confmat[0, :, 1, 0]).astype(jnp.float32)
+        return _reduce_average_precision(precision, recall, self.average, weights=support)
+
+
+class AveragePrecision(_ClassificationTaskWrapper):
+    """Task facade. Parity: reference ``classification/average_precision.py:398``."""
+
+    def __new__(cls, task: str, thresholds: Thresholds = None, num_classes: Optional[int] = None,
+                num_labels: Optional[int] = None, average: Optional[str] = "macro",
+                ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> Metric:
+        task = ClassificationTask.from_str(task)
+        kwargs.update({"thresholds": thresholds, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTask.BINARY:
+            return BinaryAveragePrecision(**kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+            return MulticlassAveragePrecision(num_classes, average, **kwargs)
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
+        return MultilabelAveragePrecision(num_labels, average, **kwargs)

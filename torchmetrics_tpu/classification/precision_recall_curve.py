@@ -1,0 +1,203 @@
+"""PrecisionRecallCurve metric classes — the stateful Engine B.
+
+Parity: reference ``src/torchmetrics/classification/precision_recall_curve.py``.
+Two state modes (reference ``functional/.../precision_recall_curve.py:190``):
+``thresholds=None`` → exact (raw preds/target ``cat`` list states);
+``thresholds=int/list/array`` → binned fixed-shape confusion state with
+``"sum"`` reduction (the TPU-native default recommendation).
+"""
+from typing import Any, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..functional.classification.precision_recall_curve import (
+    Thresholds,
+    _adjust_threshold_arg,
+    _binary_precision_recall_curve_compute,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_compute,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_compute,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_update,
+)
+from ..metric import Metric
+from ..utils.data import dim_zero_cat
+from ..utils.enums import ClassificationTask
+from .base import _ClassificationTaskWrapper
+
+Array = jax.Array
+
+
+class BinaryPrecisionRecallCurve(Metric):
+    """Parity: reference ``classification/precision_recall_curve.py:40``."""
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(self, thresholds: Thresholds = None, ignore_index: Optional[int] = None,
+                 validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        thr = _adjust_threshold_arg(thresholds)
+        self.thresholds = thr
+        if thr is None:
+            self._compute_jittable = False
+            self.add_state("preds", [], dist_reduce_fx="cat")
+            self.add_state("target", [], dist_reduce_fx="cat")
+            if ignore_index is not None:
+                self.add_state("valid", [], dist_reduce_fx="cat")
+        else:
+            self.add_state("confmat", jnp.zeros((thr.shape[0], 2, 2), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        p, t, _, mask = _binary_precision_recall_curve_format(preds, target, None, self.ignore_index)
+        if self.thresholds is None:
+            self.preds.append(p)
+            self.target.append(t)
+            if self.ignore_index is not None:
+                self.valid.append(mask)
+        else:
+            self.confmat = self.confmat + _binary_precision_recall_curve_update(p, t, self.thresholds, mask)
+
+    def _exact_state(self) -> Tuple[Array, Array]:
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        if self.ignore_index is not None:
+            keep = dim_zero_cat(self.valid)
+            preds, target = preds[keep], target[keep]
+        return preds, target
+
+    def compute(self) -> Tuple[Array, Array, Array]:
+        if self.thresholds is None:
+            return _binary_precision_recall_curve_compute(self._exact_state(), None)
+        return _binary_precision_recall_curve_compute(self.confmat, self.thresholds)
+
+    def plot(self, curve=None, score=None, ax=None):
+        from ..utils.plot import plot_curve
+
+        curve = curve if curve is not None else self.compute()
+        return plot_curve((curve[1], curve[0], curve[2]), score=score, ax=ax,
+                          label_names=("Recall", "Precision"), name=type(self).__name__)
+
+
+class MulticlassPrecisionRecallCurve(Metric):
+    """Parity: reference ``classification/precision_recall_curve.py:185``."""
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(self, num_classes: int, thresholds: Thresholds = None, ignore_index: Optional[int] = None,
+                 validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        thr = _adjust_threshold_arg(thresholds)
+        self.thresholds = thr
+        if thr is None:
+            self._compute_jittable = False
+            self.add_state("preds", [], dist_reduce_fx="cat")
+            self.add_state("target", [], dist_reduce_fx="cat")
+            if ignore_index is not None:
+                self.add_state("valid", [], dist_reduce_fx="cat")
+        else:
+            self.add_state("confmat", jnp.zeros((thr.shape[0], num_classes, 2, 2), dtype=jnp.int32),
+                           dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        p, t, _, mask = _multiclass_precision_recall_curve_format(preds, target, self.num_classes, None,
+                                                                  self.ignore_index)
+        if self.thresholds is None:
+            self.preds.append(p)
+            self.target.append(t)
+            if self.ignore_index is not None:
+                self.valid.append(mask)
+        else:
+            self.confmat = self.confmat + _multiclass_precision_recall_curve_update(
+                p, t, self.num_classes, self.thresholds, mask
+            )
+
+    def _exact_state(self) -> Tuple[Array, Array]:
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        if self.ignore_index is not None:
+            keep = dim_zero_cat(self.valid)
+            preds, target = preds[keep], target[keep]
+        return preds, target
+
+    def compute(self):
+        if self.thresholds is None:
+            return _multiclass_precision_recall_curve_compute(self._exact_state(), self.num_classes, None)
+        return _multiclass_precision_recall_curve_compute(self.confmat, self.num_classes, self.thresholds)
+
+
+class MultilabelPrecisionRecallCurve(Metric):
+    """Parity: reference ``classification/precision_recall_curve.py:327``."""
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(self, num_labels: int, thresholds: Thresholds = None, ignore_index: Optional[int] = None,
+                 validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.num_labels = num_labels
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        thr = _adjust_threshold_arg(thresholds)
+        self.thresholds = thr
+        if thr is None:
+            self._compute_jittable = False
+            self.add_state("preds", [], dist_reduce_fx="cat")
+            self.add_state("target", [], dist_reduce_fx="cat")
+        else:
+            self.add_state("confmat", jnp.zeros((thr.shape[0], num_labels, 2, 2), dtype=jnp.int32),
+                           dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        p, t, _, mask = _multilabel_precision_recall_curve_format(preds, target, self.num_labels, None, None)
+        if self.thresholds is None:
+            self.preds.append(p)
+            self.target.append(jnp.asarray(target).reshape(-1, self.num_labels))
+        else:
+            if self.ignore_index is not None:
+                mask = jnp.asarray(target).reshape(-1, self.num_labels) != self.ignore_index
+            self.confmat = self.confmat + _multilabel_precision_recall_curve_update(
+                p, t, self.num_labels, self.thresholds, mask
+            )
+
+    def _exact_state(self) -> Tuple[Array, Array]:
+        return dim_zero_cat(self.preds), dim_zero_cat(self.target)
+
+    def compute(self):
+        if self.thresholds is None:
+            return _multilabel_precision_recall_curve_compute(
+                self._exact_state(), self.num_labels, None, self.ignore_index
+            )
+        return _multilabel_precision_recall_curve_compute(self.confmat, self.num_labels, self.thresholds)
+
+
+class PrecisionRecallCurve(_ClassificationTaskWrapper):
+    """Task facade. Parity: reference ``classification/precision_recall_curve.py:472``."""
+
+    def __new__(cls, task: str, thresholds: Thresholds = None, num_classes: Optional[int] = None,
+                num_labels: Optional[int] = None, ignore_index: Optional[int] = None,
+                validate_args: bool = True, **kwargs: Any) -> Metric:
+        task = ClassificationTask.from_str(task)
+        kwargs.update({"thresholds": thresholds, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTask.BINARY:
+            return BinaryPrecisionRecallCurve(**kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+            return MulticlassPrecisionRecallCurve(num_classes, **kwargs)
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
+        return MultilabelPrecisionRecallCurve(num_labels, **kwargs)

@@ -1,0 +1,70 @@
+"""ROC metric classes — curve-state subclasses with a ROC compute.
+
+Parity: reference ``src/torchmetrics/classification/roc.py``.
+"""
+from typing import Any, Optional
+
+import jax
+
+from ..functional.classification.roc import (
+    _binary_roc_compute,
+    _multiclass_roc_compute,
+    _multilabel_roc_compute,
+)
+from ..metric import Metric
+from ..utils.enums import ClassificationTask
+from .base import _ClassificationTaskWrapper
+from .precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+    MultilabelPrecisionRecallCurve,
+    Thresholds,
+)
+
+Array = jax.Array
+
+
+class BinaryROC(BinaryPrecisionRecallCurve):
+    def compute(self):
+        if self.thresholds is None:
+            return _binary_roc_compute(self._exact_state(), None)
+        return _binary_roc_compute(self.confmat, self.thresholds)
+
+    def plot(self, curve=None, score=None, ax=None):
+        from ..utils.plot import plot_curve
+
+        curve = curve if curve is not None else self.compute()
+        return plot_curve(curve, score=score, ax=ax, label_names=("FPR", "TPR"), name=type(self).__name__)
+
+
+class MulticlassROC(MulticlassPrecisionRecallCurve):
+    def compute(self):
+        if self.thresholds is None:
+            return _multiclass_roc_compute(self._exact_state(), self.num_classes, None)
+        return _multiclass_roc_compute(self.confmat, self.num_classes, self.thresholds)
+
+
+class MultilabelROC(MultilabelPrecisionRecallCurve):
+    def compute(self):
+        if self.thresholds is None:
+            return _multilabel_roc_compute(self._exact_state(), self.num_labels, None, self.ignore_index)
+        return _multilabel_roc_compute(self.confmat, self.num_labels, self.thresholds)
+
+
+class ROC(_ClassificationTaskWrapper):
+    """Task facade. Parity: reference ``classification/roc.py:411``."""
+
+    def __new__(cls, task: str, thresholds: Thresholds = None, num_classes: Optional[int] = None,
+                num_labels: Optional[int] = None, ignore_index: Optional[int] = None,
+                validate_args: bool = True, **kwargs: Any) -> Metric:
+        task = ClassificationTask.from_str(task)
+        kwargs.update({"thresholds": thresholds, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTask.BINARY:
+            return BinaryROC(**kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+            return MulticlassROC(num_classes, **kwargs)
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
+        return MultilabelROC(num_labels, **kwargs)

@@ -47,9 +47,27 @@ from .specificity import (
     multilabel_specificity,
     specificity,
 )
+from .auroc import auroc, binary_auroc, multiclass_auroc, multilabel_auroc
+from .average_precision import (
+    average_precision,
+    binary_average_precision,
+    multiclass_average_precision,
+    multilabel_average_precision,
+)
+from .precision_recall_curve import (
+    binary_precision_recall_curve,
+    multiclass_precision_recall_curve,
+    multilabel_precision_recall_curve,
+    precision_recall_curve,
+)
+from .roc import binary_roc, multiclass_roc, multilabel_roc, roc
 from .stat_scores import binary_stat_scores, multiclass_stat_scores, multilabel_stat_scores, stat_scores
 
 __all__ = [
+    "auroc", "binary_auroc", "multiclass_auroc", "multilabel_auroc",
+    "average_precision", "binary_average_precision", "multiclass_average_precision", "multilabel_average_precision",
+    "precision_recall_curve", "binary_precision_recall_curve", "multiclass_precision_recall_curve", "multilabel_precision_recall_curve",
+    "roc", "binary_roc", "multiclass_roc", "multilabel_roc",
     "accuracy", "binary_accuracy", "multiclass_accuracy", "multilabel_accuracy",
     "cohen_kappa", "binary_cohen_kappa", "multiclass_cohen_kappa",
     "confusion_matrix", "binary_confusion_matrix", "multiclass_confusion_matrix", "multilabel_confusion_matrix",

@@ -1,0 +1,150 @@
+"""Area under the ROC curve.
+
+Parity: reference ``src/torchmetrics/functional/classification/auroc.py``
+(``_binary_auroc_compute`` :82; trapezoidal ``auc`` from
+``utilities/compute.py:118``).
+"""
+from typing import List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.compute import _auc_compute_without_check, _safe_divide
+from .precision_recall_curve import (
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_update,
+    Thresholds,
+)
+from .roc import _binary_roc_compute, _multiclass_roc_compute, _multilabel_roc_compute
+
+Array = jax.Array
+
+
+def _trapz(y: Array, x: Array) -> Array:
+    dx = jnp.diff(x)
+    return jnp.sum((y[..., :-1] + y[..., 1:]) / 2.0 * dx, axis=-1)
+
+
+def _binary_auroc_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    thresholds: Optional[Array],
+    max_fpr: Optional[float] = None,
+    pos_label: int = 1,
+) -> Array:
+    """Parity: reference ``auroc.py:82`` (incl. McClish partial-AUC correction)."""
+    fpr, tpr, _ = _binary_roc_compute(state, thresholds, pos_label)
+    if max_fpr is None or max_fpr == 1.0:
+        return _trapz(tpr, fpr)
+    # partial AUC up to max_fpr with interpolation + McClish standardization
+    stop = jnp.searchsorted(fpr, max_fpr, side="right")
+    x_interp = jnp.interp(jnp.asarray(max_fpr), fpr, tpr)
+    fpr_part = jnp.concatenate([fpr[: int(stop)], jnp.asarray([max_fpr])])
+    tpr_part = jnp.concatenate([tpr[: int(stop)], jnp.atleast_1d(x_interp)])
+    partial_auc = _trapz(tpr_part, fpr_part)
+    min_area = 0.5 * max_fpr**2
+    max_area = max_fpr
+    return 0.5 * (1 + (partial_auc - min_area) / (max_area - min_area))
+
+
+def binary_auroc(
+    preds: Array, target: Array, max_fpr: Optional[float] = None, thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Array:
+    """Parity: reference ``auroc.py:134``."""
+    if validate_args and max_fpr is not None and not (isinstance(max_fpr, float) and 0 < max_fpr <= 1):
+        raise ValueError(f"Argument `max_fpr` should be a float in range (0, 1], but got: {max_fpr}")
+    preds, target, thr, mask = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    if thr is None:
+        if mask is not None:
+            preds, target = preds[mask], target[mask]
+        return _binary_auroc_compute((preds, target), None, max_fpr)
+    state = _binary_precision_recall_curve_update(preds, target, thr, mask)
+    return _binary_auroc_compute(state, thr, max_fpr)
+
+
+def _reduce_auroc(
+    fpr: Union[Array, List[Array]],
+    tpr: Union[Array, List[Array]],
+    average: Optional[str] = "macro",
+    weights: Optional[Array] = None,
+) -> Array:
+    """Parity: reference ``auroc.py:53`` (_reduce_auroc)."""
+    if isinstance(fpr, (list, tuple)):
+        scores = jnp.stack([_trapz(t, f) for f, t in zip(fpr, tpr)])
+    else:
+        scores = _trapz(tpr, fpr)
+    if average in (None, "none"):
+        return scores
+    if average == "macro":
+        return jnp.mean(scores)
+    if average == "weighted":
+        w = _safe_divide(weights, jnp.sum(weights))
+        return jnp.sum(scores * w)
+    if average == "micro":
+        raise ValueError("`micro` averaging is only supported for multilabel AUROC via flattened inputs")
+    raise ValueError(f"Received invalid `average` {average}")
+
+
+def multiclass_auroc(
+    preds: Array, target: Array, num_classes: int, average: Optional[str] = "macro",
+    thresholds: Thresholds = None, ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Array:
+    """Parity: reference ``auroc.py:235``."""
+    preds, target, thr, mask = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    if thr is None:
+        if mask is not None:
+            preds, target = preds[mask], target[mask]
+        fpr, tpr, _ = _multiclass_roc_compute((preds, target), num_classes, None)
+        onehot = jax.nn.one_hot(target, num_classes)
+        support = jnp.sum(onehot, axis=0)
+    else:
+        state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thr, mask)
+        fpr, tpr, _ = _multiclass_roc_compute(state, num_classes, thr)
+        support = state[0, :, 1, 1] + state[0, :, 1, 0]
+    return _reduce_auroc(fpr, tpr, average, weights=support.astype(jnp.float32))
+
+
+def multilabel_auroc(
+    preds: Array, target: Array, num_labels: int, average: Optional[str] = "macro",
+    thresholds: Thresholds = None, ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Array:
+    """Parity: reference ``auroc.py:336``."""
+    if average == "micro":
+        return binary_auroc(preds.reshape(-1), target.reshape(-1), None, thresholds, ignore_index, validate_args)
+    preds_f, target_f, thr, mask = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    if thr is None:
+        fpr, tpr, _ = _multilabel_roc_compute((preds_f, target_f), num_labels, None, ignore_index)
+        support = jnp.sum((target_f == 1) & ((target_f != ignore_index) if ignore_index is not None else True), axis=0)
+    else:
+        state = _multilabel_precision_recall_curve_update(preds_f, target_f, num_labels, thr, mask)
+        fpr, tpr, _ = _multilabel_roc_compute(state, num_labels, thr)
+        support = state[0, :, 1, 1] + state[0, :, 1, 0]
+    return _reduce_auroc(fpr, tpr, average, weights=jnp.asarray(support, dtype=jnp.float32))
+
+
+def auroc(
+    preds: Array, target: Array, task: str, thresholds: Thresholds = None, num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None, average: Optional[str] = "macro", max_fpr: Optional[float] = None,
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Array:
+    """Task dispatcher. Parity: reference ``auroc.py:446``."""
+    from ...utils.enums import ClassificationTask
+
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_auroc(preds, target, max_fpr, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+        return multiclass_auroc(preds, target, num_classes, average, thresholds, ignore_index, validate_args)
+    if not isinstance(num_labels, int):
+        raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
+    return multilabel_auroc(preds, target, num_labels, average, thresholds, ignore_index, validate_args)

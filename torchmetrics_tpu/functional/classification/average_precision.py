@@ -1,0 +1,132 @@
+"""Average precision (area under the PR curve, step interpolation).
+
+Parity: reference
+``src/torchmetrics/functional/classification/average_precision.py``.
+"""
+from typing import List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.compute import _safe_divide
+from .precision_recall_curve import (
+    _binary_precision_recall_curve_compute,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_compute,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_compute,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_update,
+    Thresholds,
+)
+
+Array = jax.Array
+
+
+def _ap_from_curve(precision: Array, recall: Array) -> Array:
+    # recall is decreasing toward 0 along the curve order
+    return -jnp.sum(jnp.diff(recall) * precision[:-1], axis=-1)
+
+
+def _binary_average_precision_compute(
+    state: Union[Array, Tuple[Array, Array]], thresholds: Optional[Array]
+) -> Array:
+    """Parity: reference ``average_precision.py:45``."""
+    precision, recall, _ = _binary_precision_recall_curve_compute(state, thresholds)
+    return _ap_from_curve(precision, recall)
+
+
+def binary_average_precision(
+    preds: Array, target: Array, thresholds: Thresholds = None, ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Parity: reference ``average_precision.py:77``."""
+    preds, target, thr, mask = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    if thr is None:
+        if mask is not None:
+            preds, target = preds[mask], target[mask]
+        return _binary_average_precision_compute((preds, target), None)
+    state = _binary_precision_recall_curve_update(preds, target, thr, mask)
+    return _binary_average_precision_compute(state, thr)
+
+
+def _reduce_average_precision(precision, recall, average: Optional[str] = "macro", weights=None) -> Array:
+    if isinstance(precision, (list, tuple)):
+        scores = jnp.stack([_ap_from_curve(p, r) for p, r in zip(precision, recall)])
+    else:
+        scores = _ap_from_curve(precision, recall)
+    scores = jnp.nan_to_num(scores, nan=0.0)
+    if average in (None, "none"):
+        return scores
+    if average == "macro":
+        return jnp.mean(scores)
+    if average == "weighted":
+        w = _safe_divide(weights, jnp.sum(weights))
+        return jnp.sum(scores * w)
+    raise ValueError(f"Received invalid `average` {average}")
+
+
+def multiclass_average_precision(
+    preds: Array, target: Array, num_classes: int, average: Optional[str] = "macro",
+    thresholds: Thresholds = None, ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Array:
+    """Parity: reference ``average_precision.py:178``."""
+    preds, target, thr, mask = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    if thr is None:
+        if mask is not None:
+            preds, target = preds[mask], target[mask]
+        precision, recall, _ = _multiclass_precision_recall_curve_compute((preds, target), num_classes, None)
+        support = jnp.sum(jax.nn.one_hot(target, num_classes), axis=0)
+    else:
+        state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thr, mask)
+        precision, recall, _ = _multiclass_precision_recall_curve_compute(state, num_classes, thr)
+        support = (state[0, :, 1, 1] + state[0, :, 1, 0]).astype(jnp.float32)
+    return _reduce_average_precision(precision, recall, average, weights=support)
+
+
+def multilabel_average_precision(
+    preds: Array, target: Array, num_labels: int, average: Optional[str] = "macro",
+    thresholds: Thresholds = None, ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Array:
+    """Parity: reference ``average_precision.py:275``."""
+    if average == "micro":
+        return binary_average_precision(preds.reshape(-1), target.reshape(-1), thresholds, ignore_index,
+                                        validate_args)
+    preds_f, target_f, thr, mask = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    if thr is None:
+        precision, recall, _ = _multilabel_precision_recall_curve_compute(
+            (preds_f, target_f), num_labels, None, ignore_index
+        )
+        support = jnp.sum(target_f == 1, axis=0).astype(jnp.float32)
+    else:
+        state = _multilabel_precision_recall_curve_update(preds_f, target_f, num_labels, thr, mask)
+        precision, recall, _ = _multilabel_precision_recall_curve_compute(state, num_labels, thr)
+        support = (state[0, :, 1, 1] + state[0, :, 1, 0]).astype(jnp.float32)
+    return _reduce_average_precision(precision, recall, average, weights=support)
+
+
+def average_precision(
+    preds: Array, target: Array, task: str, thresholds: Thresholds = None, num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None, average: Optional[str] = "macro", ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task dispatcher. Parity: reference ``average_precision.py:380``."""
+    from ...utils.enums import ClassificationTask
+
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_average_precision(preds, target, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+        return multiclass_average_precision(preds, target, num_classes, average, thresholds, ignore_index,
+                                            validate_args)
+    if not isinstance(num_labels, int):
+        raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
+    return multilabel_average_precision(preds, target, num_labels, average, thresholds, ignore_index, validate_args)

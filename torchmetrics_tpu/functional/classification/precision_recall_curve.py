@@ -1,0 +1,325 @@
+"""Engine B — threshold curves (precision-recall / ROC family).
+
+Parity: reference
+``src/torchmetrics/functional/classification/precision_recall_curve.py``
+(1001 LoC): exact mode via sorted cumsums (``_binary_clf_curve`` :28), binned
+mode via per-threshold (T, 2, 2) confusion states (``_update`` :190).
+
+TPU-first: the **binned mode is the native mode** — fixed-shape,
+``"sum"``-reducible, one jitted (T, N) comparison (no 50k loop crossover: XLA
+tiles it; memory is bounded by T*N bools). Exact mode (``thresholds=None``)
+stores raw preds/target (``cat`` states) and computes the sklearn-equivalent
+curve *eagerly at compute time* — dynamic output shapes never enter jit.
+"""
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.compute import _safe_divide, normalize_logits_if_needed
+
+Array = jax.Array
+Thresholds = Union[int, List[float], Array, None]
+
+
+def _adjust_threshold_arg(thresholds: Thresholds) -> Optional[Array]:
+    """int → linspace(0,1,n); list/array → array; None → exact mode."""
+    if thresholds is None:
+        return None
+    if isinstance(thresholds, int):
+        return jnp.linspace(0.0, 1.0, thresholds)
+    if isinstance(thresholds, (list, tuple)):
+        return jnp.asarray(thresholds, dtype=jnp.float32)
+    return jnp.asarray(thresholds, dtype=jnp.float32)
+
+
+def _binary_clf_curve(
+    preds: Array, target: Array, sample_weights: Optional[Array] = None
+) -> Tuple[Array, Array, Array]:
+    """Cumulative fps/tps at each distinct prediction value (descending).
+
+    Parity: reference ``precision_recall_curve.py:28`` (sklearn-equivalent).
+    Eager-only (data-dependent output length).
+    """
+    w = 1.0 if sample_weights is None else jnp.asarray(sample_weights, dtype=jnp.float32)
+    desc = jnp.argsort(preds)[::-1]
+    preds = preds[desc]
+    target = target[desc]
+    weight = w[desc] if sample_weights is not None else jnp.ones_like(preds)
+
+    distinct = jnp.nonzero(jnp.diff(preds))[0]
+    threshold_idxs = jnp.concatenate([distinct, jnp.asarray([target.shape[0] - 1])])
+
+    tps = jnp.cumsum(target * weight)[threshold_idxs]
+    if sample_weights is not None:
+        fps = jnp.cumsum((1 - target) * weight)[threshold_idxs]
+    else:
+        fps = 1 + threshold_idxs - tps
+    return fps, tps, preds[threshold_idxs]
+
+
+# ---------------------------------------------------------------------------
+# binary
+# ---------------------------------------------------------------------------
+
+def _binary_precision_recall_curve_format(
+    preds: Array,
+    target: Array,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Optional[Array], Optional[Array]]:
+    """Returns (preds, target, thresholds, mask); mask is None w/o ignore_index."""
+    preds = preds.reshape(-1)
+    target = target.reshape(-1)
+    preds = normalize_logits_if_needed(preds.astype(jnp.float32), "sigmoid")
+    mask = None
+    if ignore_index is not None:
+        mask = (target != ignore_index)
+        target = jnp.clip(target, 0, 1)
+    return preds, target.astype(jnp.int32), _adjust_threshold_arg(thresholds), mask
+
+
+def _binary_precision_recall_curve_update(
+    preds: Array, target: Array, thresholds: Optional[Array], mask: Optional[Array] = None
+) -> Array:
+    """Binned state: (T, 2, 2) confusion per threshold. Jittable."""
+    if thresholds is None:
+        raise ValueError("binned update requires thresholds")
+    len_t = thresholds.shape[0]
+    w = jnp.ones_like(target, dtype=jnp.float32) if mask is None else mask.astype(jnp.float32)
+    preds_t = (preds[None, :] >= thresholds[:, None]).astype(jnp.int32)  # (T, N)
+    tgt = target[None, :]
+    tp = jnp.sum(preds_t * tgt * w, axis=1)
+    fp = jnp.sum(preds_t * (1 - tgt) * w, axis=1)
+    fn = jnp.sum((1 - preds_t) * tgt * w, axis=1)
+    tn = jnp.sum((1 - preds_t) * (1 - tgt) * w, axis=1)
+    return jnp.stack([jnp.stack([tn, fp], -1), jnp.stack([fn, tp], -1)], -2).astype(jnp.int32)  # (T,2,2)
+
+
+def _binary_precision_recall_curve_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    thresholds: Optional[Array],
+    pos_label: int = 1,
+) -> Tuple[Array, Array, Array]:
+    """Parity: reference ``precision_recall_curve.py:247``."""
+    if isinstance(state, (tuple, list)) and thresholds is None:
+        preds, target = state
+        fps, tps, thresh = _binary_clf_curve(preds, target)
+        precision = _safe_divide(tps, tps + fps)
+        # no positives → recall 1 everywhere (modern-sklearn semantics)
+        recall = jnp.where(tps[-1] == 0, jnp.ones_like(tps), tps / jnp.where(tps[-1] == 0, 1.0, tps[-1]))
+        precision = jnp.concatenate([precision[::-1], jnp.ones(1, dtype=precision.dtype)])
+        recall = jnp.concatenate([recall[::-1], jnp.zeros(1, dtype=recall.dtype)])
+        thresh = thresh[::-1]
+        return precision, recall, thresh
+    tps = state[:, 1, 1]
+    fps = state[:, 0, 1]
+    fns = state[:, 1, 0]
+    precision = _safe_divide(tps, tps + fps)
+    recall = _safe_divide(tps, tps + fns)
+    precision = jnp.concatenate([precision, jnp.ones(1, dtype=precision.dtype)])
+    recall = jnp.concatenate([recall, jnp.zeros(1, dtype=recall.dtype)])
+    return precision, recall, thresholds
+
+
+def binary_precision_recall_curve(
+    preds: Array,
+    target: Array,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array, Array]:
+    """Parity: reference ``precision_recall_curve.py:303``."""
+    preds, target, thr, mask = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    if thr is None:
+        if mask is not None:
+            preds, target = preds[mask], target[mask]
+        return _binary_precision_recall_curve_compute((preds, target), None)
+    state = _binary_precision_recall_curve_update(preds, target, thr, mask)
+    return _binary_precision_recall_curve_compute(state, thr)
+
+
+# ---------------------------------------------------------------------------
+# multiclass (one-vs-rest)
+# ---------------------------------------------------------------------------
+
+def _multiclass_precision_recall_curve_format(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Optional[Array], Optional[Array]]:
+    preds = preds.reshape(-1, num_classes) if preds.ndim == 2 else jnp.moveaxis(
+        preds, 1, -1
+    ).reshape(-1, num_classes)
+    target = target.reshape(-1)
+    preds = normalize_logits_if_needed(preds.astype(jnp.float32), "softmax")
+    mask = None
+    if ignore_index is not None:
+        mask = (target != ignore_index)
+        target = jnp.clip(target, 0, num_classes - 1)
+    return preds, target.astype(jnp.int32), _adjust_threshold_arg(thresholds), mask
+
+
+def _multiclass_precision_recall_curve_update(
+    preds: Array, target: Array, num_classes: int, thresholds: Optional[Array], mask: Optional[Array] = None
+) -> Array:
+    """Binned state (T, C, 2, 2). Jittable."""
+    len_t = thresholds.shape[0]
+    w = jnp.ones_like(target, dtype=jnp.float32) if mask is None else mask.astype(jnp.float32)
+    preds_t = (preds[None, :, :] >= thresholds[:, None, None]).astype(jnp.float32)  # (T, N, C)
+    tgt_oh = jax.nn.one_hot(target, num_classes)  # (N, C)
+    wv = w[None, :, None]
+    tp = jnp.sum(preds_t * tgt_oh[None] * wv, axis=1)  # (T, C)
+    fp = jnp.sum(preds_t * (1 - tgt_oh)[None] * wv, axis=1)
+    fn = jnp.sum((1 - preds_t) * tgt_oh[None] * wv, axis=1)
+    tn = jnp.sum((1 - preds_t) * (1 - tgt_oh)[None] * wv, axis=1)
+    return jnp.stack([jnp.stack([tn, fp], -1), jnp.stack([fn, tp], -1)], -2).astype(jnp.int32)  # (T,C,2,2)
+
+
+def _multiclass_precision_recall_curve_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_classes: int,
+    thresholds: Optional[Array],
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    if isinstance(state, (tuple, list)) and thresholds is None:
+        preds, target = state
+        precisions, recalls, threshs = [], [], []
+        for c in range(num_classes):
+            p, r, t = _binary_precision_recall_curve_compute(
+                (preds[:, c], (target == c).astype(jnp.int32)), None
+            )
+            precisions.append(p)
+            recalls.append(r)
+            threshs.append(t)
+        return precisions, recalls, threshs
+    tps = state[:, :, 1, 1]
+    fps = state[:, :, 0, 1]
+    fns = state[:, :, 1, 0]
+    precision = _safe_divide(tps, tps + fps).T  # (C, T)
+    recall = _safe_divide(tps, tps + fns).T
+    precision = jnp.concatenate([precision, jnp.ones((num_classes, 1), precision.dtype)], axis=1)
+    recall = jnp.concatenate([recall, jnp.zeros((num_classes, 1), recall.dtype)], axis=1)
+    return precision, recall, thresholds
+
+
+def multiclass_precision_recall_curve(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Parity: reference ``precision_recall_curve.py:577``."""
+    preds, target, thr, mask = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    if thr is None:
+        if mask is not None:
+            preds, target = preds[mask], target[mask]
+        return _multiclass_precision_recall_curve_compute((preds, target), num_classes, None)
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thr, mask)
+    return _multiclass_precision_recall_curve_compute(state, num_classes, thr)
+
+
+# ---------------------------------------------------------------------------
+# multilabel
+# ---------------------------------------------------------------------------
+
+def _multilabel_precision_recall_curve_format(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Optional[Array], Optional[Array]]:
+    preds = preds.reshape(-1, num_labels)
+    target = target.reshape(-1, num_labels)
+    preds = normalize_logits_if_needed(preds.astype(jnp.float32), "sigmoid")
+    mask = None
+    if ignore_index is not None:
+        mask = (target != ignore_index)
+        target = jnp.clip(target, 0, 1)
+    return preds, target.astype(jnp.int32), _adjust_threshold_arg(thresholds), mask
+
+
+def _multilabel_precision_recall_curve_update(
+    preds: Array, target: Array, num_labels: int, thresholds: Optional[Array], mask: Optional[Array] = None
+) -> Array:
+    w = jnp.ones_like(target, dtype=jnp.float32) if mask is None else mask.astype(jnp.float32)
+    preds_t = (preds[None, :, :] >= thresholds[:, None, None]).astype(jnp.float32)  # (T, N, L)
+    tgt = target[None].astype(jnp.float32)
+    wv = w[None]
+    tp = jnp.sum(preds_t * tgt * wv, axis=1)
+    fp = jnp.sum(preds_t * (1 - tgt) * wv, axis=1)
+    fn = jnp.sum((1 - preds_t) * tgt * wv, axis=1)
+    tn = jnp.sum((1 - preds_t) * (1 - tgt) * wv, axis=1)
+    return jnp.stack([jnp.stack([tn, fp], -1), jnp.stack([fn, tp], -1)], -2).astype(jnp.int32)
+
+
+def _multilabel_precision_recall_curve_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_labels: int,
+    thresholds: Optional[Array],
+    ignore_index: Optional[int] = None,
+):
+    if isinstance(state, (tuple, list)) and thresholds is None:
+        preds, target = state
+        precisions, recalls, threshs = [], [], []
+        for l in range(num_labels):
+            p_l, t_l = preds[:, l], target[:, l]
+            if ignore_index is not None:
+                keep = t_l != ignore_index
+                p_l, t_l = p_l[keep], jnp.clip(t_l[keep], 0, 1)
+            p, r, t = _binary_precision_recall_curve_compute((p_l, t_l), None)
+            precisions.append(p)
+            recalls.append(r)
+            threshs.append(t)
+        return precisions, recalls, threshs
+    tps = state[:, :, 1, 1]
+    fps = state[:, :, 0, 1]
+    fns = state[:, :, 1, 0]
+    precision = _safe_divide(tps, tps + fps).T
+    recall = _safe_divide(tps, tps + fns).T
+    precision = jnp.concatenate([precision, jnp.ones((num_labels, 1), precision.dtype)], axis=1)
+    recall = jnp.concatenate([recall, jnp.zeros((num_labels, 1), recall.dtype)], axis=1)
+    return precision, recall, thresholds
+
+
+def multilabel_precision_recall_curve(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Parity: reference ``precision_recall_curve.py:832``."""
+    preds, target, thr, mask = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    if thr is None:
+        return _multilabel_precision_recall_curve_compute((preds, target), num_labels, None, ignore_index)
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thr, mask)
+    return _multilabel_precision_recall_curve_compute(state, num_labels, thr)
+
+
+def precision_recall_curve(
+    preds: Array, target: Array, task: str, thresholds: Thresholds = None, num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None, ignore_index: Optional[int] = None, validate_args: bool = True,
+):
+    """Task dispatcher. Parity: reference ``precision_recall_curve.py:936``."""
+    from ...utils.enums import ClassificationTask
+
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_precision_recall_curve(preds, target, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+        return multiclass_precision_recall_curve(preds, target, num_classes, thresholds, ignore_index, validate_args)
+    if not isinstance(num_labels, int):
+        raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
+    return multilabel_precision_recall_curve(preds, target, num_labels, thresholds, ignore_index, validate_args)

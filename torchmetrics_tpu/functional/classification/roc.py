@@ -1,0 +1,162 @@
+"""ROC curves over Engine B states.
+
+Parity: reference ``src/torchmetrics/functional/classification/roc.py``
+(``_binary_roc_compute`` :40).
+"""
+from typing import List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.compute import _safe_divide
+from .precision_recall_curve import (
+    _binary_clf_curve,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_update,
+    Thresholds,
+)
+
+Array = jax.Array
+
+
+def _binary_roc_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    thresholds: Optional[Array],
+    pos_label: int = 1,
+) -> Tuple[Array, Array, Array]:
+    """Parity: reference ``roc.py:40``."""
+    if isinstance(state, (tuple, list)) and thresholds is None:
+        preds, target = state
+        fps, tps, thresh = _binary_clf_curve(preds, target)
+        # prepend an extra threshold position (sklearn: threshold = inf)
+        tps = jnp.concatenate([jnp.zeros(1, tps.dtype), tps])
+        fps = jnp.concatenate([jnp.zeros(1, fps.dtype), fps])
+        thresh = jnp.concatenate([jnp.asarray([jnp.inf], thresh.dtype), thresh])
+        tpr = _safe_divide(tps, tps[-1])
+        fpr = _safe_divide(fps, fps[-1])
+        return fpr, tpr, thresh
+    tps = state[:, 1, 1]
+    fps = state[:, 0, 1]
+    fns = state[:, 1, 0]
+    tns = state[:, 0, 0]
+    tpr = jnp.flip(_safe_divide(tps, tps + fns), 0)
+    fpr = jnp.flip(_safe_divide(fps, fps + tns), 0)
+    return fpr, tpr, jnp.flip(thresholds, 0)
+
+
+def binary_roc(
+    preds: Array, target: Array, thresholds: Thresholds = None, ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array, Array]:
+    """Parity: reference ``roc.py:104``."""
+    preds, target, thr, mask = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    if thr is None:
+        if mask is not None:
+            preds, target = preds[mask], target[mask]
+        return _binary_roc_compute((preds, target), None)
+    state = _binary_precision_recall_curve_update(preds, target, thr, mask)
+    return _binary_roc_compute(state, thr)
+
+
+def _multiclass_roc_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_classes: int,
+    thresholds: Optional[Array],
+):
+    if isinstance(state, (tuple, list)) and thresholds is None:
+        preds, target = state
+        fprs, tprs, threshs = [], [], []
+        for c in range(num_classes):
+            f, t, h = _binary_roc_compute((preds[:, c], (target == c).astype(jnp.int32)), None)
+            fprs.append(f)
+            tprs.append(t)
+            threshs.append(h)
+        return fprs, tprs, threshs
+    tps = state[:, :, 1, 1]
+    fps = state[:, :, 0, 1]
+    fns = state[:, :, 1, 0]
+    tns = state[:, :, 0, 0]
+    tpr = jnp.flip(_safe_divide(tps, tps + fns).T, 1)  # (C, T)
+    fpr = jnp.flip(_safe_divide(fps, fps + tns).T, 1)
+    return fpr, tpr, jnp.flip(thresholds, 0)
+
+
+def multiclass_roc(
+    preds: Array, target: Array, num_classes: int, thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+):
+    """Parity: reference ``roc.py:204``."""
+    preds, target, thr, mask = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    if thr is None:
+        if mask is not None:
+            preds, target = preds[mask], target[mask]
+        return _multiclass_roc_compute((preds, target), num_classes, None)
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thr, mask)
+    return _multiclass_roc_compute(state, num_classes, thr)
+
+
+def _multilabel_roc_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_labels: int,
+    thresholds: Optional[Array],
+    ignore_index: Optional[int] = None,
+):
+    if isinstance(state, (tuple, list)) and thresholds is None:
+        preds, target = state
+        fprs, tprs, threshs = [], [], []
+        for l in range(num_labels):
+            p_l, t_l = preds[:, l], target[:, l]
+            if ignore_index is not None:
+                keep = t_l != ignore_index
+                p_l, t_l = p_l[keep], jnp.clip(t_l[keep], 0, 1)
+            f, t, h = _binary_roc_compute((p_l, t_l), None)
+            fprs.append(f)
+            tprs.append(t)
+            threshs.append(h)
+        return fprs, tprs, threshs
+    tps = state[:, :, 1, 1]
+    fps = state[:, :, 0, 1]
+    fns = state[:, :, 1, 0]
+    tns = state[:, :, 0, 0]
+    tpr = jnp.flip(_safe_divide(tps, tps + fns).T, 1)
+    fpr = jnp.flip(_safe_divide(fps, fps + tns).T, 1)
+    return fpr, tpr, jnp.flip(thresholds, 0)
+
+
+def multilabel_roc(
+    preds: Array, target: Array, num_labels: int, thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+):
+    """Parity: reference ``roc.py:310``."""
+    preds, target, thr, mask = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    if thr is None:
+        return _multilabel_roc_compute((preds, target), num_labels, None, ignore_index)
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thr, mask)
+    return _multilabel_roc_compute(state, num_labels, thr)
+
+
+def roc(
+    preds: Array, target: Array, task: str, thresholds: Thresholds = None, num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None, ignore_index: Optional[int] = None, validate_args: bool = True,
+):
+    """Task dispatcher. Parity: reference ``roc.py:418``."""
+    from ...utils.enums import ClassificationTask
+
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_roc(preds, target, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+        return multiclass_roc(preds, target, num_classes, thresholds, ignore_index, validate_args)
+    if not isinstance(num_labels, int):
+        raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
+    return multilabel_roc(preds, target, num_labels, thresholds, ignore_index, validate_args)

@@ -150,6 +150,7 @@ class Metric:
         self._use_jit = bool(jit) and type(self).jittable
 
         self._update_count = 0
+        self._compute_jittable = True  # False for data-dependent-shape computes (exact curves)
         self._computed: Any = None
         self._is_synced = False
         self._cache: Optional[StateDict] = None
@@ -274,7 +275,7 @@ class Metric:
         self._eager_validate(*args, **kwargs)
 
         gstate = self._tensor_state()
-        if self._use_jit:
+        if self._use_jit and self._compute_jittable:
             fwd = self._get_jitted("forward", self._pure_forward)
             value, merged, appends = fwd(gstate, jnp.asarray(n_prev), args, kwargs)
         else:
