@@ -1,0 +1,196 @@
+"""Calibration / hinge / ranking / at-fixed / dice / fairness vs oracles."""
+import numpy as np
+import pytest
+from sklearn import metrics as skm
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.classification import (
+    BinaryCalibrationError,
+    BinaryFairness,
+    BinaryGroupStatRates,
+    BinaryHingeLoss,
+    BinaryPrecisionAtFixedRecall,
+    BinaryRecallAtFixedPrecision,
+    BinarySpecificityAtSensitivity,
+    Dice,
+    MulticlassCalibrationError,
+    MulticlassHingeLoss,
+    MultilabelCoverageError,
+    MultilabelRankingAveragePrecision,
+    MultilabelRankingLoss,
+)
+from torchmetrics_tpu.functional.classification import (
+    binary_calibration_error,
+    binary_hinge_loss,
+    dice as dice_fn,
+    multiclass_hinge_loss,
+    multilabel_coverage_error,
+    multilabel_ranking_average_precision,
+    multilabel_ranking_loss,
+)
+
+rng = np.random.RandomState(5)
+N, C, L = 128, 5, 4
+BP = rng.rand(N).astype(np.float32)
+BT = rng.randint(0, 2, N)
+MCP = rng.rand(N, C).astype(np.float32)
+MCP /= MCP.sum(1, keepdims=True)
+MCT = rng.randint(0, C, N)
+MLP = rng.rand(N, L).astype(np.float32)
+MLT = rng.randint(0, 2, (N, L))
+
+
+def _np_ece(conf, acc, n_bins=15, norm="l1"):
+    idx = np.clip((conf * n_bins).astype(int), 0, n_bins - 1)
+    ce = 0.0
+    maxce = 0.0
+    for b in range(n_bins):
+        m = idx == b
+        if not m.any():
+            continue
+        gap = abs(acc[m].mean() - conf[m].mean())
+        w = m.mean()
+        if norm == "l1":
+            ce += w * gap
+        elif norm == "l2":
+            ce += w * gap**2
+        maxce = max(maxce, gap)
+    if norm == "max":
+        return maxce
+    return np.sqrt(ce) if norm == "l2" else ce
+
+
+@pytest.mark.parametrize("norm", ["l1", "l2", "max"])
+def test_binary_calibration_error(norm):
+    conf = np.where(BP > 0.5, BP, 1 - BP)
+    acc = ((BP > 0.5).astype(int) == BT).astype(float)
+    ref = _np_ece(conf, acc, norm=norm)
+    got = float(binary_calibration_error(jnp.asarray(BP), jnp.asarray(BT), norm=norm))
+    np.testing.assert_allclose(got, ref, atol=1e-6)
+    m = BinaryCalibrationError(norm=norm)
+    m.update(jnp.asarray(BP[:64]), jnp.asarray(BT[:64]))
+    m.update(jnp.asarray(BP[64:]), jnp.asarray(BT[64:]))
+    np.testing.assert_allclose(float(m.compute()), ref, atol=1e-6)
+
+
+def test_multiclass_calibration_error():
+    conf = MCP.max(1)
+    acc = (MCP.argmax(1) == MCT).astype(float)
+    ref = _np_ece(conf, acc)
+    m = MulticlassCalibrationError(num_classes=C)
+    m.update(jnp.asarray(MCP), jnp.asarray(MCT))
+    np.testing.assert_allclose(float(m.compute()), ref, atol=1e-6)
+
+
+def test_binary_hinge():
+    scores = rng.randn(N).astype(np.float32)
+    ref = skm.hinge_loss(BT, scores, labels=[0, 1])
+    got = float(binary_hinge_loss(jnp.asarray(scores), jnp.asarray(BT)))
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+    m = BinaryHingeLoss()
+    m.update(jnp.asarray(scores[:64]), jnp.asarray(BT[:64]))
+    m.update(jnp.asarray(scores[64:]), jnp.asarray(BT[64:]))
+    np.testing.assert_allclose(float(m.compute()), ref, atol=1e-5)
+
+
+def test_multiclass_hinge():
+    scores = rng.randn(N, C).astype(np.float32)
+    ref = skm.hinge_loss(MCT, scores, labels=list(range(C)))
+    got = float(multiclass_hinge_loss(jnp.asarray(scores), jnp.asarray(MCT), C))
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+    m = MulticlassHingeLoss(num_classes=C)
+    m.update(jnp.asarray(scores), jnp.asarray(MCT))
+    np.testing.assert_allclose(float(m.compute()), ref, atol=1e-5)
+
+
+def test_ranking_vs_sklearn():
+    np.testing.assert_allclose(
+        float(multilabel_coverage_error(jnp.asarray(MLP), jnp.asarray(MLT), L)),
+        skm.coverage_error(MLT, MLP), atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        float(multilabel_ranking_average_precision(jnp.asarray(MLP), jnp.asarray(MLT), L)),
+        skm.label_ranking_average_precision_score(MLT, MLP), atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        float(multilabel_ranking_loss(jnp.asarray(MLP), jnp.asarray(MLT), L)),
+        skm.label_ranking_loss(MLT, MLP), atol=1e-5,
+    )
+
+
+def test_ranking_classes_accumulate():
+    for cls, sk in [
+        (MultilabelCoverageError, skm.coverage_error),
+        (MultilabelRankingAveragePrecision, skm.label_ranking_average_precision_score),
+        (MultilabelRankingLoss, skm.label_ranking_loss),
+    ]:
+        m = cls(num_labels=L)
+        m.update(jnp.asarray(MLP[:64]), jnp.asarray(MLT[:64]))
+        m.update(jnp.asarray(MLP[64:]), jnp.asarray(MLT[64:]))
+        np.testing.assert_allclose(float(m.compute()), sk(MLT, MLP), atol=1e-5)
+
+
+def test_recall_at_fixed_precision():
+    m = BinaryRecallAtFixedPrecision(min_precision=0.5)
+    m.update(jnp.asarray(BP), jnp.asarray(BT))
+    recall, thr = m.compute()
+    prec, rec, thrs = skm.precision_recall_curve(BT, BP)
+    feasible = prec[:-1] >= 0.5
+    ref = rec[:-1][feasible].max() if feasible.any() else 0.0
+    np.testing.assert_allclose(float(recall), ref, atol=1e-6)
+    # returned threshold actually achieves the constraint
+    achieved_prec = skm.precision_score(BT, BP >= float(thr))
+    assert achieved_prec >= 0.5 - 1e-6
+
+
+def test_precision_at_fixed_recall():
+    m = BinaryPrecisionAtFixedRecall(min_recall=0.5)
+    m.update(jnp.asarray(BP), jnp.asarray(BT))
+    precision, thr = m.compute()
+    prec, rec, _ = skm.precision_recall_curve(BT, BP)
+    feasible = rec >= 0.5
+    ref = prec[feasible].max()
+    np.testing.assert_allclose(float(precision), ref, atol=1e-6)
+
+
+def test_specificity_at_sensitivity():
+    m = BinarySpecificityAtSensitivity(min_sensitivity=0.5)
+    m.update(jnp.asarray(BP), jnp.asarray(BT))
+    spec, thr = m.compute()
+    fpr, tpr, _ = skm.roc_curve(BT, BP, drop_intermediate=False)
+    feasible = tpr >= 0.5
+    ref = (1 - fpr)[feasible].max()
+    np.testing.assert_allclose(float(spec), ref, atol=1e-6)
+
+
+def test_dice_equals_f1():
+    m = Dice(num_classes=C, average="macro")
+    m.update(jnp.asarray(MCP), jnp.asarray(MCT))
+    ref = skm.f1_score(MCT, MCP.argmax(1), average="macro", zero_division=0)
+    np.testing.assert_allclose(float(m.compute()), ref, atol=1e-6)
+    got = float(dice_fn(jnp.asarray(MCP), jnp.asarray(MCT), average="micro", num_classes=C))
+    ref = skm.f1_score(MCT, MCP.argmax(1), average="micro", zero_division=0)
+    np.testing.assert_allclose(got, ref, atol=1e-6)
+
+
+def test_group_fairness():
+    groups = rng.randint(0, 2, N)
+    m = BinaryGroupStatRates(num_groups=2)
+    m.update(jnp.asarray(BP), jnp.asarray(BT), jnp.asarray(groups))
+    rates = m.compute()
+    pl = (BP > 0.5).astype(int)
+    for g in range(2):
+        sel = groups == g
+        tp = ((pl == 1) & (BT == 1) & sel).sum()
+        fp = ((pl == 1) & (BT == 0) & sel).sum()
+        tn = ((pl == 0) & (BT == 0) & sel).sum()
+        fn = ((pl == 0) & (BT == 1) & sel).sum()
+        tot = sel.sum()
+        np.testing.assert_allclose(np.asarray(rates[f"group_{g}"]), np.array([tp, fp, tn, fn]) / tot, atol=1e-6)
+
+    f = BinaryFairness(num_groups=2, task="all")
+    f.update(jnp.asarray(BP), jnp.asarray(BT), jnp.asarray(groups))
+    out = f.compute()
+    assert set(out) == {"DP", "EO"}
+    assert 0 <= float(out["DP"]) <= 1 and 0 <= float(out["EO"]) <= 1

@@ -47,6 +47,28 @@ from .specificity import (
     MultilabelSpecificity,
     Specificity,
 )
+from .calibration_error import BinaryCalibrationError, CalibrationError, MulticlassCalibrationError
+from .dice import Dice
+from .group_fairness import BinaryFairness, BinaryGroupStatRates
+from .hinge import BinaryHingeLoss, HingeLoss, MulticlassHingeLoss
+from .ranking import (
+    MultilabelCoverageError,
+    MultilabelRankingAveragePrecision,
+    MultilabelRankingLoss,
+)
+from .recall_fixed_precision import (
+    BinaryPrecisionAtFixedRecall,
+    BinaryRecallAtFixedPrecision,
+    BinarySensitivityAtSpecificity,
+    BinarySpecificityAtSensitivity,
+    MulticlassPrecisionAtFixedRecall,
+    MulticlassRecallAtFixedPrecision,
+    MultilabelRecallAtFixedPrecision,
+    PrecisionAtFixedRecall,
+    RecallAtFixedPrecision,
+    SensitivityAtSpecificity,
+    SpecificityAtSensitivity,
+)
 from .auroc import AUROC, BinaryAUROC, MulticlassAUROC, MultilabelAUROC
 from .average_precision import (
     AveragePrecision,
@@ -69,6 +91,14 @@ from .stat_scores import (
 )
 
 __all__ = [
+    "CalibrationError", "BinaryCalibrationError", "MulticlassCalibrationError",
+    "Dice", "BinaryFairness", "BinaryGroupStatRates",
+    "HingeLoss", "BinaryHingeLoss", "MulticlassHingeLoss",
+    "MultilabelCoverageError", "MultilabelRankingAveragePrecision", "MultilabelRankingLoss",
+    "RecallAtFixedPrecision", "BinaryRecallAtFixedPrecision", "MulticlassRecallAtFixedPrecision", "MultilabelRecallAtFixedPrecision",
+    "PrecisionAtFixedRecall", "BinaryPrecisionAtFixedRecall", "MulticlassPrecisionAtFixedRecall",
+    "SensitivityAtSpecificity", "BinarySensitivityAtSpecificity",
+    "SpecificityAtSensitivity", "BinarySpecificityAtSensitivity",
     "AUROC", "BinaryAUROC", "MulticlassAUROC", "MultilabelAUROC",
     "AveragePrecision", "BinaryAveragePrecision", "MulticlassAveragePrecision", "MultilabelAveragePrecision",
     "PrecisionRecallCurve", "BinaryPrecisionRecallCurve", "MulticlassPrecisionRecallCurve", "MultilabelPrecisionRecallCurve",

@@ -1,0 +1,101 @@
+"""CalibrationError metric classes.
+
+Parity: reference ``src/torchmetrics/classification/calibration_error.py``.
+"""
+from typing import Any, Optional
+
+import jax
+
+from ..functional.classification.calibration_error import (
+    _binary_calibration_error_update,
+    _ce_compute,
+    _multiclass_calibration_error_update,
+)
+from ..metric import Metric
+from ..utils.data import dim_zero_cat
+from ..utils.enums import ClassificationTaskNoMultilabel
+from .base import _ClassificationTaskWrapper
+
+Array = jax.Array
+
+
+class BinaryCalibrationError(Metric):
+    """Parity: reference ``classification/calibration_error.py:40``."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, n_bins: int = 15, norm: str = "l1", ignore_index: Optional[int] = None,
+                 validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            if not isinstance(n_bins, int) or n_bins < 1:
+                raise ValueError(f"Expected argument `n_bins` to be an integer larger than 0, but got {n_bins}")
+            if norm not in ("l1", "l2", "max"):
+                raise ValueError(f"Argument `norm` is expected to be one of 'l1', 'l2', 'max' but got {norm}")
+        self.n_bins = n_bins
+        self.norm = norm
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        if ignore_index is not None:
+            self._use_jit = False  # eager filtering keeps sklearn-equal semantics
+        self.add_state("confidences", [], dist_reduce_fx="cat")
+        self.add_state("accuracies", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        confidences, accuracies = _binary_calibration_error_update(preds, target, self.ignore_index)
+        self.confidences.append(confidences)
+        self.accuracies.append(accuracies)
+
+    def compute(self) -> Array:
+        return _ce_compute(dim_zero_cat(self.confidences), dim_zero_cat(self.accuracies), self.n_bins, self.norm)
+
+
+class MulticlassCalibrationError(Metric):
+    """Parity: reference ``classification/calibration_error.py:151``."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, num_classes: int, n_bins: int = 15, norm: str = "l1",
+                 ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.n_bins = n_bins
+        self.norm = norm
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        if ignore_index is not None:
+            self._use_jit = False
+        self.add_state("confidences", [], dist_reduce_fx="cat")
+        self.add_state("accuracies", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        confidences, accuracies = _multiclass_calibration_error_update(
+            preds, target, self.num_classes, self.ignore_index
+        )
+        self.confidences.append(confidences)
+        self.accuracies.append(accuracies)
+
+    def compute(self) -> Array:
+        return _ce_compute(dim_zero_cat(self.confidences), dim_zero_cat(self.accuracies), self.n_bins, self.norm)
+
+
+class CalibrationError(_ClassificationTaskWrapper):
+    """Task facade. Parity: reference ``classification/calibration_error.py:259``."""
+
+    def __new__(cls, task: str, n_bins: int = 15, norm: str = "l1", num_classes: Optional[int] = None,
+                ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> Metric:
+        task = ClassificationTaskNoMultilabel.from_str(task)
+        kwargs.update({"n_bins": n_bins, "norm": norm, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTaskNoMultilabel.BINARY:
+            return BinaryCalibrationError(**kwargs)
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+        return MulticlassCalibrationError(num_classes, **kwargs)

@@ -1,0 +1,83 @@
+"""Group fairness metric classes.
+
+Parity: reference ``src/torchmetrics/classification/group_fairness.py``.
+"""
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..functional.classification.group_fairness import (
+    _compute_binary_demographic_parity,
+    _compute_binary_equal_opportunity,
+    _groups_stat_scores_compute,
+    _groups_stat_update,
+)
+from ..metric import Metric
+from ..utils.compute import _safe_divide
+
+Array = jax.Array
+
+
+class BinaryGroupStatRates(Metric):
+    """tp/fp/tn/fn rates per demographic group.
+
+    Parity: reference ``classification/group_fairness.py:96``.
+    """
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(self, num_groups: int, threshold: float = 0.5, ignore_index: Optional[int] = None,
+                 validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if validate_args and (not isinstance(num_groups, int) or num_groups < 2):
+            raise ValueError(f"Expected argument `num_groups` to be an int larger than 1, but got {num_groups}")
+        self.num_groups = num_groups
+        self.threshold = threshold
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.add_state("group_stats", jnp.zeros((num_groups, 4)), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array, groups: Array) -> None:
+        self.group_stats = self.group_stats + _groups_stat_update(
+            preds, target, groups, self.num_groups, self.threshold, self.ignore_index
+        )
+
+    def compute(self) -> Dict[str, Array]:
+        return _groups_stat_scores_compute(self.group_stats)
+
+
+class BinaryFairness(BinaryGroupStatRates):
+    """Demographic parity / equal opportunity ratios.
+
+    Parity: reference ``classification/group_fairness.py:159``.
+    """
+
+    def __init__(self, num_groups: int, task: str = "all", threshold: float = 0.5,
+                 ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(num_groups, threshold, ignore_index, validate_args, **kwargs)
+        if task not in ("demographic_parity", "equal_opportunity", "all"):
+            raise ValueError(
+                "Expected argument `task` to either be 'demographic_parity', 'equal_opportunity' or 'all' "
+                f"but got {task}."
+            )
+        self.task = task
+
+    def update(self, preds: Array, target: Array, groups: Array) -> None:
+        if self.task == "demographic_parity":
+            target = jnp.zeros_like(jnp.asarray(groups))
+        self.group_stats = self.group_stats + _groups_stat_update(
+            preds, target, groups, self.num_groups, self.threshold, self.ignore_index
+        )
+
+    def compute(self) -> Dict[str, Array]:
+        out: Dict[str, Array] = {}
+        if self.task in ("demographic_parity", "all"):
+            mn, mx = _compute_binary_demographic_parity(self.group_stats)
+            out["DP"] = _safe_divide(mn, mx)
+        if self.task in ("equal_opportunity", "all"):
+            mn, mx = _compute_binary_equal_opportunity(self.group_stats)
+            out["EO"] = _safe_divide(mn, mx)
+        return out

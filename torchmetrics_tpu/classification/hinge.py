@@ -1,0 +1,109 @@
+"""HingeLoss metric classes.
+
+Parity: reference ``src/torchmetrics/classification/hinge.py``.
+"""
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..functional.classification.hinge import (
+    _binary_hinge_loss_update,
+    _multiclass_hinge_loss_update,
+)
+from ..metric import Metric
+from ..utils.enums import ClassificationTaskNoMultilabel
+from .base import _ClassificationTaskWrapper
+
+Array = jax.Array
+
+
+class BinaryHingeLoss(Metric):
+    """Parity: reference ``classification/hinge.py:38``."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, squared: bool = False, ignore_index: Optional[int] = None,
+                 validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.squared = squared
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        if ignore_index is not None:
+            self._use_jit = False
+        self.add_state("measures", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        p, t = preds, target
+        if self.ignore_index is not None:
+            keep = t.reshape(-1) != self.ignore_index
+            p = p.reshape(-1)[keep]
+            t = jnp.clip(t.reshape(-1)[keep], 0, 1)
+        measures, total = _binary_hinge_loss_update(p, t, self.squared)
+        self.measures = self.measures + measures
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return self.measures / self.total
+
+
+class MulticlassHingeLoss(Metric):
+    """Parity: reference ``classification/hinge.py:120``."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, num_classes: int, squared: bool = False, multiclass_mode: str = "crammer-singer",
+                 ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if validate_args and multiclass_mode not in ("crammer-singer", "one-vs-all"):
+            raise ValueError(
+                "Argument `multiclass_mode` is expected to be 'crammer-singer' or 'one-vs-all' "
+                f"but got {multiclass_mode}"
+            )
+        self.num_classes = num_classes
+        self.squared = squared
+        self.multiclass_mode = multiclass_mode
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        if ignore_index is not None:
+            self._use_jit = False
+        default = jnp.asarray(0.0) if multiclass_mode == "crammer-singer" else jnp.zeros((num_classes,))
+        self.add_state("measures", default, dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        p, t = preds, target
+        if self.ignore_index is not None:
+            keep = t.reshape(-1) != self.ignore_index
+            p = p.reshape(-1, self.num_classes)[keep]
+            t = jnp.clip(t.reshape(-1)[keep], 0, self.num_classes - 1)
+        measures, total = _multiclass_hinge_loss_update(p, t, self.num_classes, self.squared, self.multiclass_mode)
+        if self.multiclass_mode == "crammer-singer":
+            measures = jnp.sum(measures)
+        self.measures = self.measures + measures
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return self.measures / self.total
+
+
+class HingeLoss(_ClassificationTaskWrapper):
+    """Task facade. Parity: reference ``classification/hinge.py:222``."""
+
+    def __new__(cls, task: str, num_classes: Optional[int] = None, squared: bool = False,
+                multiclass_mode: str = "crammer-singer", ignore_index: Optional[int] = None,
+                validate_args: bool = True, **kwargs: Any) -> Metric:
+        task = ClassificationTaskNoMultilabel.from_str(task)
+        kwargs.update({"ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTaskNoMultilabel.BINARY:
+            return BinaryHingeLoss(squared, **kwargs)
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+        return MulticlassHingeLoss(num_classes, squared, multiclass_mode, **kwargs)

@@ -1,0 +1,75 @@
+"""Multilabel ranking metric classes.
+
+Parity: reference ``src/torchmetrics/classification/ranking.py``.
+"""
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..functional.classification.ranking import (
+    _format_ml,
+    _multilabel_coverage_error_update,
+    _multilabel_ranking_average_precision_update,
+    _multilabel_ranking_loss_update,
+)
+from ..metric import Metric
+
+Array = jax.Array
+
+
+class _AbstractRanking(Metric):
+    is_differentiable = False
+    full_state_update = False
+
+    def __init__(self, num_labels: int, ignore_index: Optional[int] = None,
+                 validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.num_labels = num_labels
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.add_state("measure", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def compute(self) -> Array:
+        return self.measure / self.total
+
+
+class MultilabelCoverageError(_AbstractRanking):
+    """Parity: reference ``classification/ranking.py:32``."""
+
+    higher_is_better = False
+    plot_lower_bound = 0.0
+
+    def update(self, preds: Array, target: Array) -> None:
+        p, t, mask = _format_ml(preds, target, self.num_labels, self.ignore_index)
+        measure, total = _multilabel_coverage_error_update(p, t, mask)
+        self.measure = self.measure + measure
+        self.total = self.total + total
+
+
+class MultilabelRankingAveragePrecision(_AbstractRanking):
+    """Parity: reference ``classification/ranking.py:127``."""
+
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def update(self, preds: Array, target: Array) -> None:
+        p, t, mask = _format_ml(preds, target, self.num_labels, self.ignore_index)
+        measure, total = _multilabel_ranking_average_precision_update(p, t, mask)
+        self.measure = self.measure + measure
+        self.total = self.total + total
+
+
+class MultilabelRankingLoss(_AbstractRanking):
+    """Parity: reference ``classification/ranking.py:221``."""
+
+    higher_is_better = False
+    plot_lower_bound = 0.0
+
+    def update(self, preds: Array, target: Array) -> None:
+        p, t, mask = _format_ml(preds, target, self.num_labels, self.ignore_index)
+        measure, total = _multilabel_ranking_loss_update(p, t, mask)
+        self.measure = self.measure + measure
+        self.total = self.total + total

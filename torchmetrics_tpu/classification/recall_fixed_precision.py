@@ -1,0 +1,213 @@
+"""Best-X-at-fixed-Y metric classes — curve-state subclasses.
+
+Parity: reference ``src/torchmetrics/classification/{recall_fixed_precision,
+precision_fixed_recall,sensitivity_specificity,specificity_sensitivity}.py``.
+"""
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..functional.classification.precision_recall_curve import (
+    Thresholds,
+    _binary_precision_recall_curve_compute,
+    _multiclass_precision_recall_curve_compute,
+    _multilabel_precision_recall_curve_compute,
+)
+from ..functional.classification.roc import _binary_roc_compute
+from ..functional.classification.specificity_sensitivity import _best_subject_to
+from ..metric import Metric
+from ..utils.enums import ClassificationTask
+from .base import _ClassificationTaskWrapper
+from .precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+    MultilabelPrecisionRecallCurve,
+)
+
+Array = jax.Array
+
+
+class BinaryRecallAtFixedPrecision(BinaryPrecisionRecallCurve):
+    """Parity: reference ``classification/recall_fixed_precision.py:40``."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, min_precision: float, thresholds: Thresholds = None,
+                 ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(thresholds, ignore_index, validate_args, **kwargs)
+        if validate_args and not (isinstance(min_precision, float) and 0 <= min_precision <= 1):
+            raise ValueError(
+                f"Expected argument `min_precision` to be a float in the [0,1] range, but got {min_precision}"
+            )
+        self.min_precision = min_precision
+
+    def _curve(self):
+        if self.thresholds is None:
+            return _binary_precision_recall_curve_compute(self._exact_state(), None)
+        return _binary_precision_recall_curve_compute(self.confmat, self.thresholds)
+
+    def compute(self) -> Tuple[Array, Array]:
+        precision, recall, t = self._curve()
+        return _best_subject_to(recall, precision, t, self.min_precision)
+
+
+class BinaryPrecisionAtFixedRecall(BinaryRecallAtFixedPrecision):
+    """Parity: reference ``classification/precision_fixed_recall.py:37``."""
+
+    def __init__(self, min_recall: float, thresholds: Thresholds = None,
+                 ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(min_recall, thresholds, ignore_index, validate_args, **kwargs)
+        self.min_recall = min_recall
+
+    def compute(self) -> Tuple[Array, Array]:
+        precision, recall, t = self._curve()
+        return _best_subject_to(precision, recall, t, self.min_recall)
+
+
+class BinarySensitivityAtSpecificity(BinaryRecallAtFixedPrecision):
+    """Parity: reference ``classification/sensitivity_specificity.py``."""
+
+    def __init__(self, min_specificity: float, thresholds: Thresholds = None,
+                 ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(min_specificity, thresholds, ignore_index, validate_args, **kwargs)
+        self.min_specificity = min_specificity
+
+    def compute(self) -> Tuple[Array, Array]:
+        if self.thresholds is None:
+            fpr, tpr, t = _binary_roc_compute(self._exact_state(), None)
+        else:
+            fpr, tpr, t = _binary_roc_compute(self.confmat, self.thresholds)
+        return _best_subject_to(tpr, 1 - fpr, t, self.min_specificity)
+
+
+class BinarySpecificityAtSensitivity(BinaryRecallAtFixedPrecision):
+    """Parity: reference ``classification/specificity_sensitivity.py:41``."""
+
+    def __init__(self, min_sensitivity: float, thresholds: Thresholds = None,
+                 ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(min_sensitivity, thresholds, ignore_index, validate_args, **kwargs)
+        self.min_sensitivity = min_sensitivity
+
+    def compute(self) -> Tuple[Array, Array]:
+        if self.thresholds is None:
+            fpr, tpr, t = _binary_roc_compute(self._exact_state(), None)
+        else:
+            fpr, tpr, t = _binary_roc_compute(self.confmat, self.thresholds)
+        return _best_subject_to(1 - fpr, tpr, t, self.min_sensitivity)
+
+
+class _PerClassAtFixed(MulticlassPrecisionRecallCurve):
+    """Shared multiclass scanner (objective/constraint chosen by subclass)."""
+
+    _objective_is_recall = True
+
+    def __init__(self, num_classes: int, min_value: float, thresholds: Thresholds = None,
+                 ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(num_classes, thresholds, ignore_index, validate_args, **kwargs)
+        self.min_value = min_value
+
+    def compute(self):
+        if self.thresholds is None:
+            precision, recall, t = _multiclass_precision_recall_curve_compute(
+                self._exact_state(), self.num_classes, None
+            )
+            outs = [
+                _best_subject_to(r if self._objective_is_recall else p,
+                                 p if self._objective_is_recall else r, h, self.min_value)
+                for p, r, h in zip(precision, recall, t)
+            ]
+            return jnp.stack([o[0] for o in outs]), jnp.stack([o[1] for o in outs])
+        precision, recall, t = _multiclass_precision_recall_curve_compute(
+            self.confmat, self.num_classes, self.thresholds
+        )
+        if self._objective_is_recall:
+            return _best_subject_to(recall, precision, t, self.min_value)
+        return _best_subject_to(precision, recall, t, self.min_value)
+
+
+class MulticlassRecallAtFixedPrecision(_PerClassAtFixed):
+    _objective_is_recall = True
+
+
+class MulticlassPrecisionAtFixedRecall(_PerClassAtFixed):
+    _objective_is_recall = False
+
+
+class MultilabelRecallAtFixedPrecision(MultilabelPrecisionRecallCurve):
+    def __init__(self, num_labels: int, min_precision: float, thresholds: Thresholds = None,
+                 ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(num_labels, thresholds, ignore_index, validate_args, **kwargs)
+        self.min_precision = min_precision
+
+    def compute(self):
+        if self.thresholds is None:
+            precision, recall, t = _multilabel_precision_recall_curve_compute(
+                self._exact_state(), self.num_labels, None, self.ignore_index
+            )
+            outs = [_best_subject_to(r, p, h, self.min_precision) for p, r, h in zip(precision, recall, t)]
+            return jnp.stack([o[0] for o in outs]), jnp.stack([o[1] for o in outs])
+        precision, recall, t = _multilabel_precision_recall_curve_compute(
+            self.confmat, self.num_labels, self.thresholds
+        )
+        return _best_subject_to(recall, precision, t, self.min_precision)
+
+
+class RecallAtFixedPrecision(_ClassificationTaskWrapper):
+    """Task facade. Parity: reference ``classification/recall_fixed_precision.py:320``."""
+
+    def __new__(cls, task: str, min_precision: float, thresholds: Thresholds = None,
+                num_classes: Optional[int] = None, num_labels: Optional[int] = None,
+                ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> Metric:
+        task = ClassificationTask.from_str(task)
+        kwargs.update({"thresholds": thresholds, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTask.BINARY:
+            return BinaryRecallAtFixedPrecision(min_precision, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+            return MulticlassRecallAtFixedPrecision(num_classes, min_precision, **kwargs)
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
+        return MultilabelRecallAtFixedPrecision(num_labels, min_precision, **kwargs)
+
+
+class PrecisionAtFixedRecall(_ClassificationTaskWrapper):
+    """Task facade. Parity: reference ``classification/precision_fixed_recall.py``."""
+
+    def __new__(cls, task: str, min_recall: float, thresholds: Thresholds = None,
+                num_classes: Optional[int] = None, num_labels: Optional[int] = None,
+                ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> Metric:
+        task = ClassificationTask.from_str(task)
+        kwargs.update({"thresholds": thresholds, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTask.BINARY:
+            return BinaryPrecisionAtFixedRecall(min_recall, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+            return MulticlassPrecisionAtFixedRecall(num_classes, min_recall, **kwargs)
+        raise NotImplementedError("MultilabelPrecisionAtFixedRecall: use per-label RecallAtFixedPrecision instead")
+
+
+class SensitivityAtSpecificity(_ClassificationTaskWrapper):
+    """Task facade (binary only here)."""
+
+    def __new__(cls, task: str, min_specificity: float, thresholds: Thresholds = None,
+                ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> Metric:
+        task = ClassificationTask.from_str(task)
+        if task == ClassificationTask.BINARY:
+            return BinarySensitivityAtSpecificity(min_specificity, thresholds, ignore_index, validate_args, **kwargs)
+        raise NotImplementedError("SensitivityAtSpecificity currently supports the binary task")
+
+
+class SpecificityAtSensitivity(_ClassificationTaskWrapper):
+    """Task facade (binary only here)."""
+
+    def __new__(cls, task: str, min_sensitivity: float, thresholds: Thresholds = None,
+                ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> Metric:
+        task = ClassificationTask.from_str(task)
+        if task == ClassificationTask.BINARY:
+            return BinarySpecificityAtSensitivity(min_sensitivity, thresholds, ignore_index, validate_args, **kwargs)
+        raise NotImplementedError("SpecificityAtSensitivity currently supports the binary task")

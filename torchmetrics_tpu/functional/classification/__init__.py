@@ -47,6 +47,23 @@ from .specificity import (
     multilabel_specificity,
     specificity,
 )
+from .calibration_error import binary_calibration_error, calibration_error, multiclass_calibration_error
+from .dice import dice
+from .group_fairness import binary_fairness, binary_groups_stat_rates
+from .hinge import binary_hinge_loss, hinge_loss, multiclass_hinge_loss
+from .ranking import (
+    multilabel_coverage_error,
+    multilabel_ranking_average_precision,
+    multilabel_ranking_loss,
+)
+from .specificity_sensitivity import (
+    binary_precision_at_fixed_recall,
+    binary_recall_at_fixed_precision,
+    binary_sensitivity_at_specificity,
+    binary_specificity_at_sensitivity,
+    multiclass_recall_at_fixed_precision,
+    multilabel_recall_at_fixed_precision,
+)
 from .auroc import auroc, binary_auroc, multiclass_auroc, multilabel_auroc
 from .average_precision import (
     average_precision,
@@ -64,6 +81,13 @@ from .roc import binary_roc, multiclass_roc, multilabel_roc, roc
 from .stat_scores import binary_stat_scores, multiclass_stat_scores, multilabel_stat_scores, stat_scores
 
 __all__ = [
+    "calibration_error", "binary_calibration_error", "multiclass_calibration_error",
+    "dice", "binary_fairness", "binary_groups_stat_rates",
+    "hinge_loss", "binary_hinge_loss", "multiclass_hinge_loss",
+    "multilabel_coverage_error", "multilabel_ranking_average_precision", "multilabel_ranking_loss",
+    "binary_recall_at_fixed_precision", "binary_precision_at_fixed_recall",
+    "binary_sensitivity_at_specificity", "binary_specificity_at_sensitivity",
+    "multiclass_recall_at_fixed_precision", "multilabel_recall_at_fixed_precision",
     "auroc", "binary_auroc", "multiclass_auroc", "multilabel_auroc",
     "average_precision", "binary_average_precision", "multiclass_average_precision", "multilabel_average_precision",
     "precision_recall_curve", "binary_precision_recall_curve", "multiclass_precision_recall_curve", "multilabel_precision_recall_curve",

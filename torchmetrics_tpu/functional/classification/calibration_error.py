@@ -1,0 +1,120 @@
+"""Calibration error (binned ECE, l1/l2/max norms).
+
+Parity: reference
+``src/torchmetrics/functional/classification/calibration_error.py``.
+
+TPU-first: bin assignment is a static-shape scatter-add over ``n_bins``
+(equal-width binning), fully jittable.
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.compute import _safe_divide, normalize_logits_if_needed
+
+Array = jax.Array
+
+
+def _binning_bucketize(
+    confidences: Array, accuracies: Array, bin_boundaries_count: int
+) -> Tuple[Array, Array, Array]:
+    """Mean confidence/accuracy + proportion per equal-width bin."""
+    n_bins = bin_boundaries_count
+    idx = jnp.clip((confidences * n_bins).astype(jnp.int32), 0, n_bins - 1)
+    ones = jnp.ones_like(confidences)
+    counts = jnp.zeros((n_bins,), jnp.float32).at[idx].add(ones)
+    conf_sum = jnp.zeros((n_bins,), jnp.float32).at[idx].add(confidences)
+    acc_sum = jnp.zeros((n_bins,), jnp.float32).at[idx].add(accuracies)
+    prop_bin = counts / jnp.sum(counts)
+    acc_bin = _safe_divide(acc_sum, counts)
+    conf_bin = _safe_divide(conf_sum, counts)
+    return acc_bin, conf_bin, prop_bin
+
+
+def _ce_compute(
+    confidences: Array,
+    accuracies: Array,
+    n_bins: int,
+    norm: str = "l1",
+) -> Array:
+    """Parity: reference ``calibration_error.py:47``."""
+    if norm not in ("l1", "l2", "max"):
+        raise ValueError(f"Argument `norm` is expected to be one of 'l1', 'l2', 'max' but got {norm}")
+    acc_bin, conf_bin, prop_bin = _binning_bucketize(confidences, accuracies, n_bins)
+    if norm == "l1":
+        return jnp.sum(jnp.abs(acc_bin - conf_bin) * prop_bin)
+    if norm == "max":
+        return jnp.max(jnp.abs(acc_bin - conf_bin) * (prop_bin > 0))
+    ce = jnp.sum(jnp.square(acc_bin - conf_bin) * prop_bin)
+    return jnp.sqrt(ce)
+
+
+def _binary_calibration_error_update(
+    preds: Array, target: Array, ignore_index: Optional[int] = None
+) -> Tuple[Array, Array]:
+    preds = preds.reshape(-1)
+    target = target.reshape(-1)
+    preds = normalize_logits_if_needed(preds.astype(jnp.float32), "sigmoid")
+    if ignore_index is not None:
+        keep = target != ignore_index
+        preds, target = preds[keep], jnp.clip(target[keep], 0, 1)
+    confidences = jnp.where(preds > 0.5, preds, 1 - preds)
+    accuracies = ((preds > 0.5).astype(jnp.int32) == target).astype(jnp.float32)
+    return confidences, accuracies
+
+
+def binary_calibration_error(
+    preds: Array, target: Array, n_bins: int = 15, norm: str = "l1",
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Array:
+    """Parity: reference ``calibration_error.py:129``."""
+    if validate_args:
+        if not isinstance(n_bins, int) or n_bins < 1:
+            raise ValueError(f"Expected argument `n_bins` to be an integer larger than 0, but got {n_bins}")
+        if norm not in ("l1", "l2", "max"):
+            raise ValueError(f"Argument `norm` is expected to be one of 'l1', 'l2', 'max' but got {norm}")
+    confidences, accuracies = _binary_calibration_error_update(preds, target, ignore_index)
+    return _ce_compute(confidences, accuracies, n_bins, norm)
+
+
+def _multiclass_calibration_error_update(
+    preds: Array, target: Array, num_classes: int, ignore_index: Optional[int] = None
+) -> Tuple[Array, Array]:
+    if preds.ndim == target.ndim + 1:
+        pass
+    preds = normalize_logits_if_needed(
+        jnp.moveaxis(preds, 1, -1).reshape(-1, num_classes) if preds.ndim > 2 else preds.reshape(-1, num_classes),
+        "softmax",
+    )
+    target = target.reshape(-1)
+    if ignore_index is not None:
+        keep = target != ignore_index
+        preds, target = preds[keep], jnp.clip(target[keep], 0, num_classes - 1)
+    confidences = jnp.max(preds, axis=-1)
+    accuracies = (jnp.argmax(preds, axis=-1) == target).astype(jnp.float32)
+    return confidences, accuracies
+
+
+def multiclass_calibration_error(
+    preds: Array, target: Array, num_classes: int, n_bins: int = 15, norm: str = "l1",
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Array:
+    """Parity: reference ``calibration_error.py:250``."""
+    confidences, accuracies = _multiclass_calibration_error_update(preds, target, num_classes, ignore_index)
+    return _ce_compute(confidences, accuracies, n_bins, norm)
+
+
+def calibration_error(
+    preds: Array, target: Array, task: str, n_bins: int = 15, norm: str = "l1",
+    num_classes: Optional[int] = None, ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Array:
+    """Task dispatcher. Parity: reference ``calibration_error.py:344``."""
+    from ...utils.enums import ClassificationTaskNoMultilabel
+
+    task = ClassificationTaskNoMultilabel.from_str(task)
+    if task == ClassificationTaskNoMultilabel.BINARY:
+        return binary_calibration_error(preds, target, n_bins, norm, ignore_index, validate_args)
+    if not isinstance(num_classes, int):
+        raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+    return multiclass_calibration_error(preds, target, num_classes, n_bins, norm, ignore_index, validate_args)

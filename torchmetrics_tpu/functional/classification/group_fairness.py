@@ -1,0 +1,89 @@
+"""Group fairness metrics (binary).
+
+Parity: reference
+``src/torchmetrics/functional/classification/group_fairness.py``
+(``BinaryGroupStatRates``, ``BinaryFairness`` — per-group stat scores with
+dict outputs).
+
+TPU-first: per-group counts via a (num_groups, 4) scatter-add keyed by group
+id — static shapes, jittable.
+"""
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.compute import _safe_divide
+from .stat_scores import _binary_stat_scores_format
+
+Array = jax.Array
+
+
+def _groups_stat_update(
+    preds: Array, target: Array, groups: Array, num_groups: int, threshold: float,
+    ignore_index: Optional[int] = None,
+) -> Array:
+    """(num_groups, 4) tp/fp/tn/fn counts per group."""
+    p, t, mask = _binary_stat_scores_format(preds, target, threshold, ignore_index)
+    p, t, mask = p.reshape(-1), t.reshape(-1), mask.reshape(-1)
+    g = jnp.clip(groups.reshape(-1), 0, num_groups - 1)
+    # stat index: tp=0, fp=1, tn=2, fn=3
+    stat = jnp.where((p == 1) & (t == 1), 0, jnp.where((p == 1) & (t == 0), 1,
+                     jnp.where((p == 0) & (t == 0), 2, 3)))
+    idx = g * 4 + stat
+    counts = jnp.zeros((num_groups * 4,), jnp.float32).at[idx].add(mask.astype(jnp.float32))
+    return counts.reshape(num_groups, 4)
+
+
+def _groups_stat_scores_compute(group_stats: Array) -> Dict[str, Array]:
+    total = jnp.sum(group_stats, axis=1, keepdims=True)
+    rates = _safe_divide(group_stats, total)
+    return {f"group_{i}": rates[i] for i in range(group_stats.shape[0])}
+
+
+def binary_groups_stat_rates(
+    preds: Array, target: Array, groups: Array, num_groups: int, threshold: float = 0.5,
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Dict[str, Array]:
+    """Parity: reference ``group_fairness.py:116``."""
+    stats = _groups_stat_update(preds, target, groups, num_groups, threshold, ignore_index)
+    return _groups_stat_scores_compute(stats)
+
+
+def _compute_binary_demographic_parity(group_stats: Array) -> Tuple[Array, Array]:
+    tp, fp, tn, fn = group_stats[:, 0], group_stats[:, 1], group_stats[:, 2], group_stats[:, 3]
+    pos_rates = _safe_divide(tp + fp, tp + fp + tn + fn)
+    return jnp.min(pos_rates), jnp.max(pos_rates)
+
+
+def _compute_binary_equal_opportunity(group_stats: Array) -> Tuple[Array, Array]:
+    tp, fn = group_stats[:, 0], group_stats[:, 3]
+    tprs = _safe_divide(tp, tp + fn)
+    return jnp.min(tprs), jnp.max(tprs)
+
+
+def binary_fairness(
+    preds: Array, target: Array, groups: Array, task: str = "all", num_groups: Optional[int] = None,
+    threshold: float = 0.5, ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Dict[str, Array]:
+    """Demographic parity & equal opportunity ratios.
+
+    Parity: reference ``group_fairness.py:199``.
+    """
+    if task not in ("demographic_parity", "equal_opportunity", "all"):
+        raise ValueError(
+            f"Expected argument `task` to either be 'demographic_parity', 'equal_opportunity' or 'all' but got {task}."
+        )
+    if num_groups is None:
+        num_groups = int(jnp.max(groups)) + 1
+    if task == "demographic_parity":
+        target = jnp.zeros_like(jnp.asarray(groups))
+    stats = _groups_stat_update(preds, target, groups, num_groups, threshold, ignore_index)
+    out: Dict[str, Array] = {}
+    if task in ("demographic_parity", "all"):
+        mn, mx = _compute_binary_demographic_parity(stats)
+        out["DP"] = _safe_divide(mn, mx)
+    if task in ("equal_opportunity", "all"):
+        mn, mx = _compute_binary_equal_opportunity(stats)
+        out["EO"] = _safe_divide(mn, mx)
+    return out

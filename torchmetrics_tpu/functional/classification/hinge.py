@@ -1,0 +1,86 @@
+"""Hinge loss (binary / multiclass).
+
+Parity: reference ``src/torchmetrics/functional/classification/hinge.py``.
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.compute import normalize_logits_if_needed
+
+Array = jax.Array
+
+
+def _binary_hinge_loss_update(preds: Array, target: Array, squared: bool) -> Tuple[Array, Array]:
+    preds = preds.reshape(-1).astype(jnp.float32)
+    target = target.reshape(-1)
+    target_s = target * 2 - 1  # {0,1} → {-1,1}
+    margin = 1 - target_s * preds
+    losses = jnp.maximum(margin, 0.0)
+    if squared:
+        losses = losses**2
+    return jnp.sum(losses), jnp.asarray(target.shape[0], dtype=jnp.float32)
+
+
+def binary_hinge_loss(
+    preds: Array, target: Array, squared: bool = False, ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Parity: reference ``hinge.py:76``. Expects unnormalized decision scores."""
+    if ignore_index is not None:
+        keep = target.reshape(-1) != ignore_index
+        preds = preds.reshape(-1)[keep]
+        target = jnp.clip(target.reshape(-1)[keep], 0, 1)
+    measure, total = _binary_hinge_loss_update(preds, target, squared)
+    return measure / total
+
+
+def _multiclass_hinge_loss_update(
+    preds: Array, target: Array, num_classes: int, squared: bool, multiclass_mode: str
+) -> Tuple[Array, Array]:
+    preds = preds.reshape(-1, num_classes).astype(jnp.float32)
+    target = target.reshape(-1)
+    tgt_oh = jax.nn.one_hot(target, num_classes)
+    if multiclass_mode == "crammer-singer":
+        margin = preds[jnp.arange(preds.shape[0]), target]
+        pred_max = jnp.max(jnp.where(tgt_oh == 1, -jnp.inf, preds), axis=1)
+        losses = jnp.maximum(1 - (margin - pred_max), 0.0)
+    else:  # one-vs-all
+        target_s = tgt_oh * 2 - 1
+        losses = jnp.maximum(1 - target_s * preds, 0.0)
+    if squared:
+        losses = losses**2
+    return jnp.sum(losses, axis=0), jnp.asarray(target.shape[0], dtype=jnp.float32)
+
+
+def multiclass_hinge_loss(
+    preds: Array, target: Array, num_classes: int, squared: bool = False,
+    multiclass_mode: str = "crammer-singer", ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Array:
+    """Parity: reference ``hinge.py:164``."""
+    if validate_args and multiclass_mode not in ("crammer-singer", "one-vs-all"):
+        raise ValueError(
+            f"Argument `multiclass_mode` is expected to be 'crammer-singer' or 'one-vs-all' but got {multiclass_mode}"
+        )
+    if ignore_index is not None:
+        keep = target.reshape(-1) != ignore_index
+        preds = preds.reshape(-1, num_classes)[keep]
+        target = jnp.clip(target.reshape(-1)[keep], 0, num_classes - 1)
+    measure, total = _multiclass_hinge_loss_update(preds, target, num_classes, squared, multiclass_mode)
+    return jnp.sum(measure) / total if multiclass_mode == "crammer-singer" else measure / total
+
+
+def hinge_loss(
+    preds: Array, target: Array, task: str, num_classes: Optional[int] = None, squared: bool = False,
+    multiclass_mode: str = "crammer-singer", ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Array:
+    """Task dispatcher. Parity: reference ``hinge.py:245``."""
+    from ...utils.enums import ClassificationTaskNoMultilabel
+
+    task = ClassificationTaskNoMultilabel.from_str(task)
+    if task == ClassificationTaskNoMultilabel.BINARY:
+        return binary_hinge_loss(preds, target, squared, ignore_index, validate_args)
+    if not isinstance(num_classes, int):
+        raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+    return multiclass_hinge_loss(preds, target, num_classes, squared, multiclass_mode, ignore_index, validate_args)

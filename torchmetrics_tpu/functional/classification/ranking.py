@@ -1,0 +1,113 @@
+"""Multilabel ranking metrics.
+
+Parity: reference ``src/torchmetrics/functional/classification/ranking.py``
+(399 LoC): coverage error, label ranking average precision, label ranking
+loss. All are O(N·L log L) rank transforms — sorts are cheap on TPU.
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.compute import normalize_logits_if_needed
+
+Array = jax.Array
+
+
+def _rank_data(x: Array) -> Array:
+    """1-indexed ranks along the last axis (tie-unaware; used on continuous
+    scores)."""
+    order = jnp.argsort(x, axis=-1)
+    idx = jnp.broadcast_to(jnp.arange(x.shape[-1]), x.shape)
+    ranks = jnp.put_along_axis(jnp.zeros_like(order), order, idx, axis=-1, inplace=False)
+    return ranks + 1
+
+
+def _format_ml(preds: Array, target: Array, num_labels: int, ignore_index: Optional[int]):
+    preds = normalize_logits_if_needed(preds.reshape(-1, num_labels).astype(jnp.float32), "sigmoid")
+    target = target.reshape(-1, num_labels)
+    if ignore_index is not None:
+        mask = target != ignore_index
+        target = jnp.clip(target, 0, 1)
+    else:
+        mask = jnp.ones_like(target, dtype=bool)
+    return preds, target, mask
+
+
+def _multilabel_coverage_error_update(preds: Array, target: Array, mask: Array) -> Tuple[Array, Array]:
+    """Parity: reference ``ranking.py:66`` (sklearn coverage_error)."""
+    big = jnp.where(target == 1, preds, jnp.inf)
+    min_relevant = jnp.min(jnp.where(mask, big, jnp.inf), axis=1, keepdims=True)
+    coverage_per = jnp.sum((preds >= min_relevant) & mask, axis=1).astype(jnp.float32)
+    has_rel = jnp.isfinite(min_relevant[:, 0])
+    coverage = jnp.sum(jnp.where(has_rel, coverage_per, 0.0))
+    return coverage, jnp.asarray(target.shape[0], dtype=jnp.float32)
+
+
+def multilabel_coverage_error(
+    preds: Array, target: Array, num_labels: int, ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Array:
+    """Parity: reference ``ranking.py:94``."""
+    preds, target, mask = _format_ml(preds, target, num_labels, ignore_index)
+    coverage, total = _multilabel_coverage_error_update(preds, target, mask)
+    return coverage / total
+
+
+def _multilabel_ranking_average_precision_update(
+    preds: Array, target: Array, mask: Array
+) -> Tuple[Array, Array]:
+    """Parity: reference ``ranking.py:157`` (sklearn LRAP)."""
+    n, l = preds.shape
+    neg_preds = -preds
+    order = jnp.argsort(neg_preds, axis=1)
+    ranks = jnp.put_along_axis(
+        jnp.zeros_like(order), order, jnp.broadcast_to(jnp.arange(l), (n, l)), axis=1, inplace=False
+    ) + 1  # rank of each label by decreasing score
+
+    rel = (target == 1) & mask
+    # L_ij = number of relevant labels ranked at or above label j
+    def per_sample(r, rl):
+        # for each relevant j: count of relevant k with rank_k <= rank_j, / rank_j
+        rr = jnp.where(rl, r, jnp.inf)
+        cnt = jnp.sum((rr[None, :] <= rr[:, None]) & rl[None, :], axis=1)
+        score = jnp.where(rl, cnt / r, 0.0)
+        n_rel = jnp.sum(rl)
+        return jnp.where(n_rel > 0, jnp.sum(score) / jnp.maximum(n_rel, 1), 1.0)
+
+    scores = jax.vmap(per_sample)(ranks, rel)
+    return jnp.sum(scores), jnp.asarray(n, dtype=jnp.float32)
+
+
+def multilabel_ranking_average_precision(
+    preds: Array, target: Array, num_labels: int, ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Array:
+    """Parity: reference ``ranking.py:186``."""
+    preds, target, mask = _format_ml(preds, target, num_labels, ignore_index)
+    score, total = _multilabel_ranking_average_precision_update(preds, target, mask)
+    return score / total
+
+
+def _multilabel_ranking_loss_update(preds: Array, target: Array, mask: Array) -> Tuple[Array, Array]:
+    """Parity: reference ``ranking.py:255`` (sklearn label_ranking_loss)."""
+    rel = (target == 1) & mask
+    irr = (target == 0) & mask
+
+    def per_sample(p, r, i):
+        # fraction of (relevant, irrelevant) pairs that are mis-ordered
+        n_rel = jnp.sum(r)
+        n_irr = jnp.sum(i)
+        bad = jnp.sum((p[:, None] <= p[None, :]) & r[:, None] & i[None, :])
+        denom = jnp.maximum(n_rel * n_irr, 1)
+        return jnp.where((n_rel > 0) & (n_irr > 0), bad / denom, 0.0)
+
+    losses = jax.vmap(per_sample)(preds, rel, irr)
+    return jnp.sum(losses), jnp.asarray(preds.shape[0], dtype=jnp.float32)
+
+
+def multilabel_ranking_loss(
+    preds: Array, target: Array, num_labels: int, ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Array:
+    """Parity: reference ``ranking.py:284``."""
+    preds, target, mask = _format_ml(preds, target, num_labels, ignore_index)
+    loss, total = _multilabel_ranking_loss_update(preds, target, mask)
+    return loss / total

@@ -1,0 +1,161 @@
+"""Best-X-at-fixed-Y curve scanners.
+
+Parity: reference
+``src/torchmetrics/functional/classification/{recall_fixed_precision,
+precision_fixed_recall,specificity_sensitivity,sensitivity_specificity}.py``
+— all scan the Engine B curve for the best operating point subject to a
+constraint. One generic jittable scanner serves all four.
+"""
+from typing import List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.compute import _safe_divide
+from .precision_recall_curve import (
+    Thresholds,
+    _binary_precision_recall_curve_compute,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_compute,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_compute,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_update,
+)
+from .roc import _binary_roc_compute, _multiclass_roc_compute, _multilabel_roc_compute
+
+Array = jax.Array
+
+
+def _best_subject_to(
+    objective: Array, constraint: Array, thresholds: Array, min_constraint: float
+) -> Tuple[Array, Array]:
+    """max objective where constraint >= min_constraint; returns (value, threshold).
+
+    Threshold arrays may be shorter by one than curve arrays (PR curve appends
+    an endpoint); trailing positions reuse the last threshold, matching the
+    reference's 1e6-sentinel-free behavior.
+    """
+    n = objective.shape[-1]
+    if thresholds.shape[-1] < n:
+        pad = jnp.broadcast_to(thresholds[..., -1:], thresholds.shape[:-1] + (n - thresholds.shape[-1],))
+        thresholds = jnp.concatenate([thresholds, pad], axis=-1)
+    feasible = constraint >= min_constraint
+    masked = jnp.where(feasible, objective, -1.0)
+    best_idx = jnp.argmax(masked, axis=-1)
+    best = jnp.take_along_axis(masked, best_idx[..., None], axis=-1)[..., 0]
+    thr = jnp.take_along_axis(jnp.broadcast_to(thresholds, objective.shape), best_idx[..., None], axis=-1)[..., 0]
+    any_feasible = jnp.any(feasible, axis=-1)
+    best = jnp.where(any_feasible, best, 0.0)
+    thr = jnp.where(any_feasible, thr, 1e6)
+    return best, thr
+
+
+# -- recall at fixed precision ----------------------------------------------
+
+def binary_recall_at_fixed_precision(
+    preds: Array, target: Array, min_precision: float, thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Parity: reference ``recall_fixed_precision.py:125``."""
+    preds, target, thr, mask = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    if thr is None:
+        if mask is not None:
+            preds, target = preds[mask], target[mask]
+        precision, recall, t = _binary_precision_recall_curve_compute((preds, target), None)
+    else:
+        state = _binary_precision_recall_curve_update(preds, target, thr, mask)
+        precision, recall, t = _binary_precision_recall_curve_compute(state, thr)
+    return _best_subject_to(recall, precision, t, min_precision)
+
+
+def multiclass_recall_at_fixed_precision(
+    preds: Array, target: Array, num_classes: int, min_precision: float, thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    preds, target, thr, mask = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    if thr is None:
+        if mask is not None:
+            preds, target = preds[mask], target[mask]
+        precision, recall, t = _multiclass_precision_recall_curve_compute((preds, target), num_classes, None)
+        outs = [_best_subject_to(r, p, h, min_precision) for p, r, h in zip(precision, recall, t)]
+        return jnp.stack([o[0] for o in outs]), jnp.stack([o[1] for o in outs])
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thr, mask)
+    precision, recall, t = _multiclass_precision_recall_curve_compute(state, num_classes, thr)
+    return _best_subject_to(recall, precision, t, min_precision)
+
+
+def multilabel_recall_at_fixed_precision(
+    preds: Array, target: Array, num_labels: int, min_precision: float, thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    preds, target, thr, mask = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    if thr is None:
+        precision, recall, t = _multilabel_precision_recall_curve_compute(
+            (preds, target), num_labels, None, ignore_index
+        )
+        outs = [_best_subject_to(r, p, h, min_precision) for p, r, h in zip(precision, recall, t)]
+        return jnp.stack([o[0] for o in outs]), jnp.stack([o[1] for o in outs])
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thr, mask)
+    precision, recall, t = _multilabel_precision_recall_curve_compute(state, num_labels, thr)
+    return _best_subject_to(recall, precision, t, min_precision)
+
+
+# -- precision at fixed recall ----------------------------------------------
+
+def binary_precision_at_fixed_recall(
+    preds: Array, target: Array, min_recall: float, thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Parity: reference ``precision_fixed_recall.py:84``."""
+    preds, target, thr, mask = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    if thr is None:
+        if mask is not None:
+            preds, target = preds[mask], target[mask]
+        precision, recall, t = _binary_precision_recall_curve_compute((preds, target), None)
+    else:
+        state = _binary_precision_recall_curve_update(preds, target, thr, mask)
+        precision, recall, t = _binary_precision_recall_curve_compute(state, thr)
+    return _best_subject_to(precision, recall, t, min_recall)
+
+
+# -- sensitivity (TPR) at fixed specificity (TNR) and vice versa ------------
+
+def binary_sensitivity_at_specificity(
+    preds: Array, target: Array, min_specificity: float, thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Parity: reference ``sensitivity_specificity.py``."""
+    preds, target, thr, mask = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    if thr is None:
+        if mask is not None:
+            preds, target = preds[mask], target[mask]
+        fpr, tpr, t = _binary_roc_compute((preds, target), None)
+    else:
+        state = _binary_precision_recall_curve_update(preds, target, thr, mask)
+        fpr, tpr, t = _binary_roc_compute(state, thr)
+    specificity = 1 - fpr
+    return _best_subject_to(tpr, specificity, t, min_specificity)
+
+
+def binary_specificity_at_sensitivity(
+    preds: Array, target: Array, min_sensitivity: float, thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Parity: reference ``specificity_sensitivity.py:109``."""
+    preds, target, thr, mask = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    if thr is None:
+        if mask is not None:
+            preds, target = preds[mask], target[mask]
+        fpr, tpr, t = _binary_roc_compute((preds, target), None)
+    else:
+        state = _binary_precision_recall_curve_update(preds, target, thr, mask)
+        fpr, tpr, t = _binary_roc_compute(state, thr)
+    specificity = 1 - fpr
+    return _best_subject_to(specificity, tpr, t, min_sensitivity)
