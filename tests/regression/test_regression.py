@@ -1,0 +1,211 @@
+"""Regression metrics vs sklearn/scipy oracles.
+
+Parity model: reference ``tests/unittests/regression/``.
+"""
+import numpy as np
+import pytest
+import scipy.stats
+from sklearn import metrics as skm
+
+import jax.numpy as jnp
+
+from tests.conftest import BATCH_SIZE, NUM_BATCHES
+from tests.helpers.testers import MetricTester
+
+from torchmetrics_tpu.regression import (
+    ConcordanceCorrCoef,
+    CosineSimilarity,
+    CriticalSuccessIndex,
+    ExplainedVariance,
+    KendallRankCorrCoef,
+    KLDivergence,
+    LogCoshError,
+    MeanAbsoluteError,
+    MeanAbsolutePercentageError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    MinkowskiDistance,
+    PearsonCorrCoef,
+    R2Score,
+    RelativeSquaredError,
+    SpearmanCorrCoef,
+    SymmetricMeanAbsolutePercentageError,
+    TweedieDevianceScore,
+    WeightedMeanAbsolutePercentageError,
+)
+
+rng = np.random.RandomState(13)
+PREDS = rng.randn(NUM_BATCHES, BATCH_SIZE).astype(np.float32)
+TARGET = (PREDS + 0.4 * rng.randn(NUM_BATCHES, BATCH_SIZE)).astype(np.float32)
+POS_PREDS = np.abs(PREDS) + 0.1
+POS_TARGET = np.abs(TARGET) + 0.1
+
+
+class TestBasicRegression(MetricTester):
+    atol = 1e-4
+    rtol = 1e-4
+
+    @pytest.mark.parametrize(
+        ("metric_class", "sk_fn", "positive"),
+        [
+            (MeanSquaredError, skm.mean_squared_error, False),
+            (MeanAbsoluteError, skm.mean_absolute_error, False),
+            (MeanAbsolutePercentageError, skm.mean_absolute_percentage_error, True),
+            (MeanSquaredLogError, skm.mean_squared_log_error, True),
+            (ExplainedVariance, skm.explained_variance_score, False),
+        ],
+    )
+    def test_vs_sklearn(self, metric_class, sk_fn, positive):
+        p = POS_PREDS if positive else PREDS
+        t = POS_TARGET if positive else TARGET
+        self.run_class_metric_test(p, t, metric_class, lambda pp, tt: sk_fn(tt, pp),
+                                   ddp=(metric_class is MeanSquaredError))
+
+    def test_rmse(self):
+        self.run_class_metric_test(
+            PREDS, TARGET, MeanSquaredError,
+            lambda p, t: np.sqrt(skm.mean_squared_error(t, p)), metric_args={"squared": False},
+        )
+
+    def test_r2(self):
+        self.run_class_metric_test(
+            PREDS, TARGET, R2Score, lambda p, t: skm.r2_score(t, p),
+            check_batch=False, ddp=True,
+        )
+
+    def test_smape(self):
+        def sk_smape(p, t):
+            return np.mean(2 * np.abs(p - t) / (np.abs(p) + np.abs(t)))
+
+        self.run_class_metric_test(POS_PREDS, POS_TARGET, SymmetricMeanAbsolutePercentageError, sk_smape)
+
+    def test_wmape(self):
+        def sk_wmape(p, t):
+            return np.sum(np.abs(p - t)) / np.sum(np.abs(t))
+
+        self.run_class_metric_test(POS_PREDS, POS_TARGET, WeightedMeanAbsolutePercentageError, sk_wmape)
+
+    def test_logcosh(self):
+        def ref(p, t):
+            return np.mean(np.log(np.cosh(p - t)))
+
+        self.run_class_metric_test(PREDS, TARGET, LogCoshError, ref)
+
+    def test_minkowski(self):
+        def ref(p, t):
+            return (np.sum(np.abs(p - t) ** 3)) ** (1 / 3)
+
+        m = MinkowskiDistance(p=3)
+        m.update(jnp.asarray(PREDS[0]), jnp.asarray(TARGET[0]))
+        m.update(jnp.asarray(PREDS[1]), jnp.asarray(TARGET[1]))
+        np.testing.assert_allclose(
+            float(m.compute()), ref(PREDS[:2].reshape(-1), TARGET[:2].reshape(-1)), rtol=1e-4
+        )
+
+    def test_tweedie(self):
+        for power in [0.0, 1.0, 2.0, 1.5]:
+            m = TweedieDevianceScore(power=power)
+            m.update(jnp.asarray(POS_PREDS[0]), jnp.asarray(POS_TARGET[0]))
+            ref = skm.mean_tweedie_deviance(POS_TARGET[0], POS_PREDS[0], power=power)
+            np.testing.assert_allclose(float(m.compute()), ref, rtol=1e-4)
+
+    def test_rse(self):
+        def ref(p, t):
+            return np.sum((t - p) ** 2) / np.sum((t - t.mean()) ** 2)
+
+        self.run_class_metric_test(PREDS, TARGET, RelativeSquaredError, ref, check_batch=False)
+
+    def test_csi(self):
+        m = CriticalSuccessIndex(threshold=0.0)
+        m.update(jnp.asarray(PREDS[0]), jnp.asarray(TARGET[0]))
+        p, t = PREDS[0] >= 0, TARGET[0] >= 0
+        ref = (p & t).sum() / ((p & t).sum() + (~p & t).sum() + (p & ~t).sum())
+        np.testing.assert_allclose(float(m.compute()), ref, rtol=1e-5)
+
+    def test_kl_divergence(self):
+        p = np.abs(rng.randn(32, 8).astype(np.float32)) + 0.1
+        q = np.abs(rng.randn(32, 8).astype(np.float32)) + 0.1
+        pn = p / p.sum(1, keepdims=True)
+        qn = q / q.sum(1, keepdims=True)
+        ref = np.mean(np.sum(pn * np.log(pn / qn), axis=1))
+        m = KLDivergence()
+        m.update(jnp.asarray(p), jnp.asarray(q))
+        np.testing.assert_allclose(float(m.compute()), ref, rtol=1e-4)
+
+    def test_cosine_similarity(self):
+        p = rng.randn(32, 8).astype(np.float32)
+        t = rng.randn(32, 8).astype(np.float32)
+        ref = np.mean(np.sum(p * t, 1) / (np.linalg.norm(p, axis=1) * np.linalg.norm(t, axis=1)))
+        m = CosineSimilarity(reduction="mean")
+        m.update(jnp.asarray(p[:16]), jnp.asarray(t[:16]))
+        m.update(jnp.asarray(p[16:]), jnp.asarray(t[16:]))
+        np.testing.assert_allclose(float(m.compute()), ref, rtol=1e-5)
+
+
+class TestCorrelations(MetricTester):
+    atol = 1e-4
+    rtol = 1e-4
+
+    def test_pearson_accumulate(self):
+        m = PearsonCorrCoef()
+        for i in range(NUM_BATCHES):
+            m.update(jnp.asarray(PREDS[i]), jnp.asarray(TARGET[i]))
+        ref = scipy.stats.pearsonr(PREDS.reshape(-1), TARGET.reshape(-1))[0]
+        np.testing.assert_allclose(float(m.compute()), ref, rtol=1e-4)
+
+    def test_pearson_moment_merge(self):
+        # DDP emulation: per-rank running moments merged via _final_aggregation
+        ranks = [PearsonCorrCoef() for _ in range(2)]
+        for i in range(NUM_BATCHES):
+            ranks[i % 2].update(jnp.asarray(PREDS[i]), jnp.asarray(TARGET[i]))
+        merged = ranks[0].merge_states([m.metric_state for m in ranks])  # NONE → stacked
+        got = float(ranks[0].compute_state(merged))
+        ref = scipy.stats.pearsonr(PREDS.reshape(-1), TARGET.reshape(-1))[0]
+        np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+    def test_concordance(self):
+        m = ConcordanceCorrCoef()
+        m.update(jnp.asarray(PREDS.reshape(-1)), jnp.asarray(TARGET.reshape(-1)))
+        x, y = PREDS.reshape(-1), TARGET.reshape(-1)
+        ccc = 2 * np.cov(x, y, bias=True)[0, 1] / (x.var() + y.var() + (x.mean() - y.mean()) ** 2)
+        np.testing.assert_allclose(float(m.compute()), ccc, rtol=1e-4)
+
+    def test_spearman(self):
+        m = SpearmanCorrCoef()
+        for i in range(NUM_BATCHES):
+            m.update(jnp.asarray(PREDS[i]), jnp.asarray(TARGET[i]))
+        ref = scipy.stats.spearmanr(PREDS.reshape(-1), TARGET.reshape(-1))[0]
+        np.testing.assert_allclose(float(m.compute()), ref, rtol=1e-4)
+
+    def test_spearman_with_ties(self):
+        p = rng.randint(0, 5, 64).astype(np.float32)
+        t = rng.randint(0, 5, 64).astype(np.float32)
+        m = SpearmanCorrCoef()
+        m.update(jnp.asarray(p), jnp.asarray(t))
+        ref = scipy.stats.spearmanr(p, t)[0]
+        np.testing.assert_allclose(float(m.compute()), ref, rtol=1e-4)
+
+    @pytest.mark.parametrize("variant", ["a", "b"])
+    def test_kendall(self, variant):
+        p, t = PREDS[0], TARGET[0]
+        m = KendallRankCorrCoef(variant=variant)
+        m.update(jnp.asarray(p), jnp.asarray(t))
+        if variant == "b":
+            ref = scipy.stats.kendalltau(p, t, variant="b").statistic
+        else:  # tau-a = (C - D) / (n(n-1)/2), no scipy variant for it
+            n = len(p)
+            dp = np.sign(p[:, None] - p[None, :])
+            dt = np.sign(t[:, None] - t[None, :])
+            iu = np.triu(np.ones((n, n), bool), 1)
+            ref = ((dp * dt > 0) & iu).sum() - ((dp * dt < 0) & iu).sum()
+            ref = ref / (n * (n - 1) / 2)
+        np.testing.assert_allclose(float(m.compute()), ref, rtol=1e-4)
+
+    def test_kendall_pvalue(self):
+        p, t = PREDS[0], TARGET[0]
+        m = KendallRankCorrCoef(t_test=True)
+        m.update(jnp.asarray(p), jnp.asarray(t))
+        tau, pval = m.compute()
+        ref = scipy.stats.kendalltau(p, t)
+        np.testing.assert_allclose(float(tau), ref.statistic, rtol=1e-4)
+        assert 0 <= float(pval) <= 1

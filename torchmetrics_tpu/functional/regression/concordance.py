@@ -1,0 +1,28 @@
+"""Concordance correlation coefficient (reuses Pearson moment states).
+
+Parity: reference ``src/torchmetrics/functional/regression/concordance.py``.
+"""
+import jax
+import jax.numpy as jnp
+
+from .pearson import _pearson_corrcoef_update
+
+Array = jax.Array
+
+
+def _concordance_corrcoef_compute(
+    mean_x: Array, mean_y: Array, var_x: Array, var_y: Array, corr_xy: Array, nb: Array
+) -> Array:
+    """Parity: reference ``concordance.py:24``."""
+    var_x = var_x / nb
+    var_y = var_y / nb
+    corr_xy = corr_xy / nb
+    return 2.0 * corr_xy / (var_x + var_y + (mean_x - mean_y) ** 2)
+
+
+def concordance_corrcoef(preds: Array, target: Array) -> Array:
+    """Parity: reference ``concordance.py:58``."""
+    d = preds.shape[1] if preds.ndim == 2 else 1
+    z = jnp.zeros((d,)).squeeze() if d == 1 else jnp.zeros((d,))
+    mx, my, vx, vy, cxy, n = _pearson_corrcoef_update(preds, target, z, z, z, z, z, jnp.asarray(0.0), d)
+    return _concordance_corrcoef_compute(mx, my, vx, vy, cxy, n)

@@ -1,0 +1,29 @@
+"""Cosine similarity.
+
+Parity: reference ``src/torchmetrics/functional/regression/cosine_similarity.py``.
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _cosine_similarity_compute(preds: Array, target: Array, reduction: Optional[str] = "sum") -> Array:
+    dot = jnp.sum(preds * target, axis=-1)
+    norm = jnp.linalg.norm(preds, axis=-1) * jnp.linalg.norm(target, axis=-1)
+    sim = dot / norm
+    if reduction == "mean":
+        return jnp.mean(sim)
+    if reduction == "sum":
+        return jnp.sum(sim)
+    return sim
+
+
+def cosine_similarity(preds: Array, target: Array, reduction: Optional[str] = "sum") -> Array:
+    """Parity: reference ``cosine_similarity.py:44``."""
+    _check_same_shape(preds, target)
+    return _cosine_similarity_compute(preds.astype(jnp.float32), target.astype(jnp.float32), reduction)

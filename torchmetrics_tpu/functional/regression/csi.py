@@ -1,0 +1,38 @@
+"""Critical success index.
+
+Parity: reference ``src/torchmetrics/functional/regression/csi.py``.
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.checks import _check_same_shape
+from ...utils.compute import _safe_divide
+
+Array = jax.Array
+
+
+def _critical_success_index_update(
+    preds: Array, target: Array, threshold: float, keep_sequence_dim=None
+) -> Tuple[Array, Array, Array]:
+    _check_same_shape(preds, target)
+    p = preds >= threshold
+    t = target >= threshold
+    axis = None if keep_sequence_dim is None else tuple(i for i in range(preds.ndim) if i != keep_sequence_dim)
+    hits = jnp.sum(p & t, axis=axis)
+    misses = jnp.sum(~p & t, axis=axis)
+    false_alarms = jnp.sum(p & ~t, axis=axis)
+    return hits, misses, false_alarms
+
+
+def _critical_success_index_compute(hits: Array, misses: Array, false_alarms: Array) -> Array:
+    return _safe_divide(hits, hits + misses + false_alarms)
+
+
+def critical_success_index(
+    preds: Array, target: Array, threshold: float, keep_sequence_dim=None
+) -> Array:
+    """Parity: reference ``csi.py:62``."""
+    hits, misses, false_alarms = _critical_success_index_update(preds, target, threshold, keep_sequence_dim)
+    return _critical_success_index_compute(hits, misses, false_alarms)

@@ -1,0 +1,66 @@
+"""Explained variance.
+
+Parity: reference ``src/torchmetrics/functional/regression/explained_variance.py``.
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _explained_variance_update(preds: Array, target: Array) -> Tuple[Array, Array, Array, Array, Array]:
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    n_obs = jnp.asarray(preds.shape[0], dtype=jnp.float32)
+    sum_error = jnp.sum(target - preds, axis=0)
+    diff = target - preds
+    sum_squared_error = jnp.sum(diff * diff, axis=0)
+    sum_target = jnp.sum(target, axis=0)
+    sum_squared_target = jnp.sum(target * target, axis=0)
+    return n_obs, sum_error, sum_squared_error, sum_target, sum_squared_target
+
+
+def _explained_variance_compute(
+    n_obs: Array,
+    sum_error: Array,
+    sum_squared_error: Array,
+    sum_target: Array,
+    sum_squared_target: Array,
+    multioutput: str = "uniform_average",
+) -> Array:
+    """Parity: reference ``explained_variance.py:51``."""
+    diff_avg = sum_error / n_obs
+    numerator = sum_squared_error / n_obs - diff_avg * diff_avg
+    target_avg = sum_target / n_obs
+    denominator = sum_squared_target / n_obs - target_avg * target_avg
+
+    nonzero_numerator = numerator != 0
+    nonzero_denominator = denominator != 0
+    valid = nonzero_numerator & nonzero_denominator
+    output_scores = jnp.where(
+        valid,
+        1.0 - numerator / jnp.where(valid, denominator, 1.0),
+        jnp.where(nonzero_numerator & ~nonzero_denominator, 0.0, 1.0),
+    )
+    if multioutput == "raw_values":
+        return output_scores
+    if multioutput == "uniform_average":
+        return jnp.mean(output_scores)
+    if multioutput == "variance_weighted":
+        denom_sum = jnp.sum(denominator)
+        return jnp.sum(denominator / denom_sum * output_scores)
+    raise ValueError(
+        "Argument `multioutput` must be either `raw_values`, `uniform_average` or `variance_weighted`."
+        f" Received {multioutput}."
+    )
+
+
+def explained_variance(preds: Array, target: Array, multioutput: str = "uniform_average") -> Array:
+    """Parity: reference ``explained_variance.py:102``."""
+    stats = _explained_variance_update(preds, target)
+    return _explained_variance_compute(*stats, multioutput=multioutput)

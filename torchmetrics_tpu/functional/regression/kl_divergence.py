@@ -1,0 +1,41 @@
+"""KL divergence between distributions.
+
+Parity: reference ``src/torchmetrics/functional/regression/kl_divergence.py``.
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.checks import _check_same_shape
+from ...utils.compute import _safe_xlogy
+
+Array = jax.Array
+
+
+def _kld_update(p: Array, q: Array, log_prob: bool) -> Tuple[Array, Array]:
+    _check_same_shape(p, q)
+    if p.ndim != 2 or q.ndim != 2:
+        raise ValueError(f"Expected both p and q distribution to be 2D but got {p.ndim} and {q.ndim} respectively")
+    total = jnp.asarray(p.shape[0], dtype=jnp.float32)
+    if log_prob:
+        measures = jnp.sum(jnp.exp(p) * (p - q), axis=-1)
+    else:
+        p = p / jnp.sum(p, axis=-1, keepdims=True)
+        q = q / jnp.sum(q, axis=-1, keepdims=True)
+        measures = jnp.sum(_safe_xlogy(p, p / q), axis=-1)
+    return jnp.sum(measures), total
+
+
+def _kld_compute(measures: Array, total: Array, reduction: str = "mean") -> Array:
+    if reduction == "mean":
+        return measures / total
+    if reduction == "sum":
+        return measures
+    return measures
+
+
+def kl_divergence(p: Array, q: Array, log_prob: bool = False, reduction: str = "mean") -> Array:
+    """Parity: reference ``kl_divergence.py:43``."""
+    measures, total = _kld_update(p, q, log_prob)
+    return _kld_compute(measures, total, reduction)

@@ -1,0 +1,44 @@
+"""Mean squared log error & log-cosh error.
+
+Parity: reference ``src/torchmetrics/functional/regression/{log_mse,log_cosh}.py``.
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _mean_squared_log_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    _check_same_shape(preds, target)
+    d = jnp.log1p(preds) - jnp.log1p(target)
+    return jnp.sum(d * d), jnp.asarray(target.size, dtype=jnp.float32)
+
+
+def mean_squared_log_error(preds: Array, target: Array) -> Array:
+    """Parity: reference ``log_mse.py:45``."""
+    s, n = _mean_squared_log_error_update(preds, target)
+    return s / n
+
+
+def _stable_log_cosh(x: Array) -> Array:
+    # log(cosh(x)) = |x| + log1p(exp(-2|x|)) - log(2); overflow-safe
+    ax = jnp.abs(x)
+    return ax + jnp.log1p(jnp.exp(-2 * ax)) - jnp.log(2.0)
+
+
+def _log_cosh_error_update(preds: Array, target: Array, num_outputs: int) -> Tuple[Array, Array]:
+    _check_same_shape(preds, target)
+    if num_outputs == 1:
+        preds = preds.reshape(-1)
+        target = target.reshape(-1)
+    return jnp.sum(_stable_log_cosh(preds - target), axis=0), jnp.asarray(preds.shape[0], dtype=jnp.float32)
+
+
+def log_cosh_error(preds: Array, target: Array, num_outputs: int = 1) -> Array:
+    """Parity: reference ``log_cosh.py:55``."""
+    s, n = _log_cosh_error_update(preds, target, num_outputs)
+    return s / n

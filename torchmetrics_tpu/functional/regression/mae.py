@@ -1,0 +1,31 @@
+"""Mean absolute error.
+
+Parity: reference ``src/torchmetrics/functional/regression/mae.py``.
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _mean_absolute_error_update(preds: Array, target: Array, num_outputs: int = 1) -> Tuple[Array, Array]:
+    _check_same_shape(preds, target)
+    if num_outputs == 1:
+        preds = preds.reshape(-1)
+        target = target.reshape(-1)
+    sum_abs_error = jnp.sum(jnp.abs((preds - target).astype(jnp.float32)), axis=0)
+    return sum_abs_error, jnp.asarray(preds.shape[0], dtype=jnp.float32)
+
+
+def _mean_absolute_error_compute(sum_abs_error: Array, total: Array) -> Array:
+    return sum_abs_error / total
+
+
+def mean_absolute_error(preds: Array, target: Array, num_outputs: int = 1) -> Array:
+    """Parity: reference ``mae.py:46``."""
+    sum_abs_error, total = _mean_absolute_error_update(preds, target, num_outputs)
+    return _mean_absolute_error_compute(sum_abs_error, total)

@@ -1,0 +1,57 @@
+"""Mean absolute percentage error (+ symmetric & weighted variants).
+
+Parity: reference ``src/torchmetrics/functional/regression/{mape,symmetric_mape,
+wmape}.py``.
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.checks import _check_same_shape
+
+Array = jax.Array
+_EPS = 1.17e-06  # matches reference epsilon (torch.finfo(float32).eps scale)
+
+
+def _mean_absolute_percentage_error_update(
+    preds: Array, target: Array, epsilon: float = _EPS
+) -> Tuple[Array, Array]:
+    _check_same_shape(preds, target)
+    abs_per_error = jnp.abs(preds - target) / jnp.clip(jnp.abs(target), min=epsilon)
+    return jnp.sum(abs_per_error), jnp.asarray(target.size, dtype=jnp.float32)
+
+
+def _mean_absolute_percentage_error_compute(sum_abs_per_error: Array, num_obs: Array) -> Array:
+    return sum_abs_per_error / num_obs
+
+
+def mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """Parity: reference ``mape.py:51``."""
+    s, n = _mean_absolute_percentage_error_update(preds, target)
+    return _mean_absolute_percentage_error_compute(s, n)
+
+
+def _symmetric_mean_absolute_percentage_error_update(
+    preds: Array, target: Array, epsilon: float = _EPS
+) -> Tuple[Array, Array]:
+    _check_same_shape(preds, target)
+    abs_per_error = 2 * jnp.abs(preds - target) / jnp.clip(jnp.abs(target) + jnp.abs(preds), min=epsilon)
+    return jnp.sum(abs_per_error), jnp.asarray(target.size, dtype=jnp.float32)
+
+
+def symmetric_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """Parity: reference ``symmetric_mape.py:51``."""
+    s, n = _symmetric_mean_absolute_percentage_error_update(preds, target)
+    return s / n
+
+
+def _weighted_mean_absolute_percentage_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    _check_same_shape(preds, target)
+    return jnp.sum(jnp.abs(preds - target)), jnp.sum(jnp.abs(target))
+
+
+def weighted_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """Parity: reference ``wmape.py:48``."""
+    num, denom = _weighted_mean_absolute_percentage_error_update(preds, target)
+    return num / jnp.clip(denom, min=_EPS)

@@ -1,0 +1,27 @@
+"""Minkowski distance.
+
+Parity: reference ``src/torchmetrics/functional/regression/minkowski.py``.
+"""
+import jax
+import jax.numpy as jnp
+
+from ...utils.checks import _check_same_shape
+from ...utils.exceptions import TorchMetricsUserError
+
+Array = jax.Array
+
+
+def _minkowski_distance_update(preds: Array, target: Array, p: float) -> Array:
+    _check_same_shape(preds, target)
+    return jnp.sum(jnp.abs(preds - target) ** p)
+
+
+def _minkowski_distance_compute(distance: Array, p: float) -> Array:
+    return distance ** (1.0 / p)
+
+
+def minkowski_distance(preds: Array, target: Array, p: float) -> Array:
+    """Parity: reference ``minkowski.py:43``."""
+    if not (isinstance(p, (float, int)) and p >= 1):
+        raise TorchMetricsUserError(f"Argument ``p`` must be a float or int greater than 1, but got {p}")
+    return _minkowski_distance_compute(_minkowski_distance_update(preds, target, p), p)

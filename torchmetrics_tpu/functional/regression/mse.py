@@ -1,0 +1,35 @@
+"""Mean squared error / RMSE.
+
+Parity: reference ``src/torchmetrics/functional/regression/mse.py``.
+"""
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _mean_squared_error_update(preds: Array, target: Array, num_outputs: int) -> Tuple[Array, Array]:
+    _check_same_shape(preds, target)
+    if num_outputs == 1:
+        preds = preds.reshape(-1)
+        target = target.reshape(-1)
+    diff = (preds - target).astype(jnp.float32)
+    sum_squared_error = jnp.sum(diff * diff, axis=0)
+    return sum_squared_error, jnp.asarray(preds.shape[0], dtype=jnp.float32)
+
+
+def _mean_squared_error_compute(sum_squared_error: Array, total: Array, squared: bool = True) -> Array:
+    mse = sum_squared_error / total
+    return mse if squared else jnp.sqrt(mse)
+
+
+def mean_squared_error(
+    preds: Array, target: Array, squared: bool = True, num_outputs: int = 1
+) -> Array:
+    """Parity: reference ``mse.py:53``."""
+    sum_squared_error, total = _mean_squared_error_update(preds, target, num_outputs)
+    return _mean_squared_error_compute(sum_squared_error, total, squared)

@@ -1,0 +1,106 @@
+"""Pearson correlation coefficient — streaming moments with pairwise merge.
+
+Parity: reference ``src/torchmetrics/functional/regression/pearson.py`` and
+``regression/pearson.py:28`` (``_final_aggregation`` — the numerically-stable
+pairwise moment merge that is the template for ALL device-parallel moment
+merging on TPU; SURVEY.md §2.4).
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _pearson_corrcoef_update(
+    preds: Array,
+    target: Array,
+    mean_x: Array,
+    mean_y: Array,
+    var_x: Array,
+    var_y: Array,
+    corr_xy: Array,
+    num_prior: Array,
+    num_outputs: int,
+) -> Tuple[Array, Array, Array, Array, Array, Array]:
+    """Welford-style streaming update of first/second cross moments."""
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    if num_outputs == 1 and preds.ndim > 1:
+        preds = preds.reshape(-1)
+        target = target.reshape(-1)
+    n_obs = jnp.asarray(preds.shape[0], dtype=jnp.float32)
+
+    mx_new = (num_prior * mean_x + jnp.sum(preds, axis=0)) / (num_prior + n_obs)
+    my_new = (num_prior * mean_y + jnp.sum(target, axis=0)) / (num_prior + n_obs)
+    num_obs = num_prior + n_obs
+
+    var_x = var_x + jnp.sum((preds - mx_new) * (preds - mean_x), axis=0)
+    var_y = var_y + jnp.sum((target - my_new) * (target - mean_y), axis=0)
+    corr_xy = corr_xy + jnp.sum((preds - mx_new) * (target - mean_y), axis=0)
+    return mx_new, my_new, var_x, var_y, corr_xy, num_obs
+
+
+def _final_aggregation(
+    means_x: Array,
+    means_y: Array,
+    vars_x: Array,
+    vars_y: Array,
+    corrs_xy: Array,
+    nbs: Array,
+) -> Tuple[Array, Array, Array, Array, Array, Array]:
+    """Merge per-device (world, ...) moment stacks pairwise.
+
+    Parity: reference ``regression/pearson.py:28``. Used after a NONE-reduction
+    gather (each row is one device's running moments).
+    """
+    if means_x.ndim == 0 or means_x.shape[0] == 1:
+        sq = lambda v: v[0] if v.ndim > 0 else v
+        return tuple(sq(v) for v in (means_x, means_y, vars_x, vars_y, corrs_xy, nbs))
+
+    mx1, my1, vx1, vy1, cxy1, n1 = means_x[0], means_y[0], vars_x[0], vars_y[0], corrs_xy[0], nbs[0]
+    for i in range(1, means_x.shape[0]):
+        mx2, my2, vx2, vy2, cxy2, n2 = means_x[i], means_y[i], vars_x[i], vars_y[i], corrs_xy[i], nbs[i]
+        nb = n1 + n2
+        mean_x = (n1 * mx1 + n2 * mx2) / nb
+        mean_y = (n1 * my1 + n2 * my2) / nb
+        # var_x
+        element_x1 = (n1 + 1) * mean_x - n1 * mx1
+        vx1 = vx1 + (element_x1 - mx1) * (element_x1 - mean_x) - (element_x1 - mean_x) ** 2
+        element_x2 = (n2 + 1) * mean_x - n2 * mx2
+        vx2 = vx2 + (element_x2 - mx2) * (element_x2 - mean_x) - (element_x2 - mean_x) ** 2
+        var_x = vx1 + vx2
+        # var_y
+        element_y1 = (n1 + 1) * mean_y - n1 * my1
+        vy1 = vy1 + (element_y1 - my1) * (element_y1 - mean_y) - (element_y1 - mean_y) ** 2
+        element_y2 = (n2 + 1) * mean_y - n2 * my2
+        vy2 = vy2 + (element_y2 - my2) * (element_y2 - mean_y) - (element_y2 - mean_y) ** 2
+        var_y = vy1 + vy2
+        # corr_xy
+        cxy1 = cxy1 + (element_x1 - mx1) * (element_y1 - mean_y) - (element_x1 - mean_x) * (element_y1 - mean_y)
+        cxy2 = cxy2 + (element_x2 - mx2) * (element_y2 - mean_y) - (element_x2 - mean_x) * (element_y2 - mean_y)
+        corr_xy = cxy1 + cxy2
+
+        mx1, my1, vx1, vy1, cxy1, n1 = mean_x, mean_y, var_x, var_y, corr_xy, nb
+    return mx1, my1, vx1, vy1, cxy1, n1
+
+
+def _pearson_corrcoef_compute(var_x: Array, var_y: Array, corr_xy: Array, nb: Array) -> Array:
+    """Parity: reference ``functional/regression/pearson.py:68``."""
+    var_x = var_x / (nb - 1)
+    var_y = var_y / (nb - 1)
+    corr_xy = corr_xy / (nb - 1)
+    corrcoef = jnp.clip(corr_xy / jnp.sqrt(var_x * var_y), -1.0, 1.0)
+    return corrcoef
+
+
+def pearson_corrcoef(preds: Array, target: Array) -> Array:
+    """Parity: reference ``functional/regression/pearson.py:95``."""
+    d = preds.shape[1] if preds.ndim == 2 else 1
+    z = jnp.zeros((d,)).squeeze() if d == 1 else jnp.zeros((d,))
+    mx, my, vx, vy, cxy, n = _pearson_corrcoef_update(preds, target, z, z, z, z, z, jnp.asarray(0.0), d)
+    return _pearson_corrcoef_compute(vx, vy, cxy, n)

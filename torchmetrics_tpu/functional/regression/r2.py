@@ -1,0 +1,68 @@
+"""R² score.
+
+Parity: reference ``src/torchmetrics/functional/regression/r2.py``.
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.checks import _check_same_shape
+from ...utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _r2_score_update(preds: Array, target: Array, num_outputs: int = 1) -> Tuple[Array, Array, Array, Array]:
+    _check_same_shape(preds, target)
+    if num_outputs == 1 and preds.ndim > 1:
+        preds = preds.reshape(-1)
+        target = target.reshape(-1)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    sum_obs = jnp.sum(target, axis=0)
+    sum_squared_obs = jnp.sum(target * target, axis=0)
+    residual = target - preds
+    rss = jnp.sum(residual * residual, axis=0)
+    return sum_squared_obs, sum_obs, rss, jnp.asarray(target.shape[0], dtype=jnp.float32)
+
+
+def _r2_score_compute(
+    sum_squared_obs: Array,
+    sum_obs: Array,
+    rss: Array,
+    num_obs: Array,
+    adjusted: int = 0,
+    multioutput: str = "uniform_average",
+) -> Array:
+    """Parity: reference ``r2.py:46``."""
+    mean_obs = sum_obs / num_obs
+    tss = sum_squared_obs - sum_obs * mean_obs
+    raw_scores = 1 - (rss / tss)
+    if multioutput == "raw_values":
+        r2 = raw_scores
+    elif multioutput == "uniform_average":
+        r2 = jnp.mean(raw_scores)
+    elif multioutput == "variance_weighted":
+        tss_sum = jnp.sum(tss)
+        r2 = jnp.sum(tss / tss_sum * raw_scores)
+    else:
+        raise ValueError(
+            "Argument `multioutput` must be either `raw_values`, `uniform_average` or `variance_weighted`."
+            f" Received {multioutput}."
+        )
+    if adjusted < 0 or not isinstance(adjusted, int):
+        raise ValueError("`adjusted` parameter should be an integer larger or equal to 0.")
+    if adjusted != 0:
+        return 1 - (1 - r2) * (num_obs - 1) / (num_obs - adjusted - 1)
+    return r2
+
+
+def r2_score(
+    preds: Array, target: Array, adjusted: int = 0, multioutput: str = "uniform_average", num_outputs: int = 1
+) -> Array:
+    """Parity: reference ``r2.py:115``."""
+    if num_outputs == 1 and preds.ndim == 2:
+        num_outputs = preds.shape[1]
+    sum_squared_obs, sum_obs, rss, num_obs = _r2_score_update(preds, target, num_outputs)
+    return _r2_score_compute(sum_squared_obs, sum_obs, rss, num_obs, adjusted, multioutput)

@@ -1,0 +1,26 @@
+"""Relative squared error.
+
+Parity: reference ``src/torchmetrics/functional/regression/rse.py``.
+"""
+import jax
+import jax.numpy as jnp
+
+from .r2 import _r2_score_update
+
+Array = jax.Array
+
+
+def _relative_squared_error_compute(
+    sum_squared_obs: Array, sum_obs: Array, sum_squared_error: Array, num_obs: Array, squared: bool = True
+) -> Array:
+    epsilon = 1.17e-06
+    rse = sum_squared_error / jnp.clip(sum_squared_obs - sum_obs * sum_obs / num_obs, min=epsilon)
+    if not squared:
+        rse = jnp.sqrt(rse)
+    return jnp.mean(rse)
+
+
+def relative_squared_error(preds: Array, target: Array, num_outputs: int = 1, squared: bool = True) -> Array:
+    """Parity: reference ``rse.py:42``."""
+    sum_squared_obs, sum_obs, rss, num_obs = _r2_score_update(preds, target, num_outputs)
+    return _relative_squared_error_compute(sum_squared_obs, sum_obs, rss, num_obs, squared)

@@ -1,0 +1,54 @@
+"""Spearman rank correlation.
+
+Parity: reference ``src/torchmetrics/functional/regression/spearman.py``
+(rank transform at compute; tie-averaged ranks).
+"""
+import jax
+import jax.numpy as jnp
+
+from ...utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _rank_data_average_ties(x: Array) -> Array:
+    """Tie-averaged 1-indexed ranks (scipy ``rankdata`` 'average' method).
+
+    Implemented with two sorts + segment means over equal values — static
+    shapes, jittable.
+    """
+    n = x.shape[0]
+    order = jnp.argsort(x)
+    xs = x[order]
+    base = jnp.arange(1, n + 1, dtype=jnp.float32)
+    # average rank across groups of equal values
+    is_new = jnp.concatenate([jnp.ones(1, bool), xs[1:] != xs[:-1]])
+    grp = jnp.cumsum(is_new) - 1  # group id per sorted position
+    grp_sum = jnp.zeros((n,), jnp.float32).at[grp].add(base)
+    grp_cnt = jnp.zeros((n,), jnp.float32).at[grp].add(1.0)
+    avg = grp_sum / jnp.maximum(grp_cnt, 1.0)
+    ranks_sorted = avg[grp]
+    ranks = jnp.zeros((n,), jnp.float32).at[order].set(ranks_sorted)
+    return ranks
+
+
+def _spearman_corrcoef_compute(preds: Array, target: Array, eps: float = 1.17e-06) -> Array:
+    """Parity: reference ``spearman.py:58``."""
+    if preds.ndim == 1:
+        r_p = _rank_data_average_ties(preds)
+        r_t = _rank_data_average_ties(target)
+    else:
+        r_p = jnp.stack([_rank_data_average_ties(preds[:, i]) for i in range(preds.shape[1])], axis=1)
+        r_t = jnp.stack([_rank_data_average_ties(target[:, i]) for i in range(target.shape[1])], axis=1)
+    dp = r_p - jnp.mean(r_p, axis=0)
+    dt = r_t - jnp.mean(r_t, axis=0)
+    cov = jnp.mean(dp * dt, axis=0)
+    std_p = jnp.sqrt(jnp.mean(dp * dp, axis=0))
+    std_t = jnp.sqrt(jnp.mean(dt * dt, axis=0))
+    return jnp.clip(cov / jnp.clip(std_p * std_t, min=eps), -1.0, 1.0)
+
+
+def spearman_corrcoef(preds: Array, target: Array) -> Array:
+    """Parity: reference ``spearman.py:84``."""
+    _check_same_shape(preds, target)
+    return _spearman_corrcoef_compute(preds.astype(jnp.float32), target.astype(jnp.float32))

@@ -1,0 +1,52 @@
+"""Tweedie deviance score.
+
+Parity: reference ``src/torchmetrics/functional/regression/tweedie_deviance.py``.
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _tweedie_deviance_score_update(preds: Array, target: Array, power: float = 0.0) -> Tuple[Array, Array]:
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    if power < 0:
+        dev = 2 * (
+            jnp.maximum(target, 0.0) ** (2 - power) / ((1 - power) * (2 - power))
+            - target * preds ** (1 - power) / (1 - power)
+            + preds ** (2 - power) / (2 - power)
+        )
+    elif power == 0:
+        diff = target - preds
+        dev = diff * diff
+    elif power == 1:
+        from ...utils.compute import _safe_xlogy
+
+        dev = 2 * (_safe_xlogy(target, target / preds) - target + preds)
+    elif power == 2:
+        dev = 2 * (jnp.log(preds / target) + target / preds - 1)
+    elif 1 < power < 2 or power > 2:
+        dev = 2 * (
+            target ** (2 - power) / ((1 - power) * (2 - power))
+            - target * preds ** (1 - power) / (1 - power)
+            + preds ** (2 - power) / (2 - power)
+        )
+    else:
+        raise ValueError(f"Deviance Score is not defined for power={power}.")
+    return jnp.sum(dev), jnp.asarray(target.size, dtype=jnp.float32)
+
+
+def _tweedie_deviance_score_compute(sum_deviance_score: Array, num_observations: Array) -> Array:
+    return sum_deviance_score / num_observations
+
+
+def tweedie_deviance_score(preds: Array, target: Array, power: float = 0.0) -> Array:
+    """Parity: reference ``tweedie_deviance.py:103``."""
+    s, n = _tweedie_deviance_score_update(preds, target, power)
+    return _tweedie_deviance_score_compute(s, n)

@@ -1,0 +1,54 @@
+"""MSLE & LogCosh classes.
+
+Parity: reference ``src/torchmetrics/regression/{log_mse,log_cosh}.py``.
+"""
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..functional.regression.log_mse import _log_cosh_error_update, _mean_squared_log_error_update
+from ..metric import Metric
+
+Array = jax.Array
+
+
+class MeanSquaredLogError(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_squared_log_error", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        s, n = _mean_squared_log_error_update(preds, target)
+        self.sum_squared_log_error = self.sum_squared_log_error + s
+        self.total = self.total + n
+
+    def compute(self) -> Array:
+        return self.sum_squared_log_error / self.total
+
+
+class LogCoshError(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.num_outputs = num_outputs
+        self.add_state("sum_log_cosh_error", jnp.zeros((num_outputs,)).squeeze(), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        s, n = _log_cosh_error_update(preds, target, self.num_outputs)
+        self.sum_log_cosh_error = self.sum_log_cosh_error + s
+        self.total = self.total + n
+
+    def compute(self) -> Array:
+        return self.sum_log_cosh_error / self.total

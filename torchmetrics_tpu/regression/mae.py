@@ -1,0 +1,31 @@
+"""MeanAbsoluteError class. Parity: reference ``src/torchmetrics/regression/mae.py``."""
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..functional.regression.mae import _mean_absolute_error_compute, _mean_absolute_error_update
+from ..metric import Metric
+
+Array = jax.Array
+
+
+class MeanAbsoluteError(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.num_outputs = num_outputs
+        self.add_state("sum_abs_error", jnp.zeros((num_outputs,)).squeeze(), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        sum_abs_error, num_obs = _mean_absolute_error_update(preds, target, self.num_outputs)
+        self.sum_abs_error = self.sum_abs_error + sum_abs_error
+        self.total = self.total + num_obs
+
+    def compute(self) -> Array:
+        return _mean_absolute_error_compute(self.sum_abs_error, self.total)

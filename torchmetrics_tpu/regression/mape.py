@@ -1,0 +1,65 @@
+"""MAPE / SMAPE / WMAPE classes.
+
+Parity: reference ``src/torchmetrics/regression/{mape,symmetric_mape,wmape}.py``.
+"""
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..functional.regression.mape import (
+    _EPS,
+    _mean_absolute_percentage_error_update,
+    _symmetric_mean_absolute_percentage_error_update,
+    _weighted_mean_absolute_percentage_error_update,
+)
+from ..metric import Metric
+
+Array = jax.Array
+
+
+class MeanAbsolutePercentageError(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_abs_per_error", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        s, n = _mean_absolute_percentage_error_update(preds, target)
+        self.sum_abs_per_error = self.sum_abs_per_error + s
+        self.total = self.total + n
+
+    def compute(self) -> Array:
+        return self.sum_abs_per_error / self.total
+
+
+class SymmetricMeanAbsolutePercentageError(MeanAbsolutePercentageError):
+    def update(self, preds: Array, target: Array) -> None:
+        s, n = _symmetric_mean_absolute_percentage_error_update(preds, target)
+        self.sum_abs_per_error = self.sum_abs_per_error + s
+        self.total = self.total + n
+
+
+class WeightedMeanAbsolutePercentageError(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_abs_error", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("sum_scale", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        num, denom = _weighted_mean_absolute_percentage_error_update(preds, target)
+        self.sum_abs_error = self.sum_abs_error + num
+        self.sum_scale = self.sum_scale + denom
+
+    def compute(self) -> Array:
+        return self.sum_abs_error / jnp.clip(self.sum_scale, min=_EPS)

@@ -1,0 +1,207 @@
+"""Minkowski / Tweedie / CSI / RSE / KLDivergence / CosineSimilarity classes.
+
+Parity: reference ``src/torchmetrics/regression/{minkowski,tweedie_deviance,
+csi,rse,kl_divergence,cosine_similarity}.py``.
+"""
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..functional.regression.cosine_similarity import _cosine_similarity_compute
+from ..functional.regression.csi import _critical_success_index_compute, _critical_success_index_update
+from ..functional.regression.kl_divergence import _kld_compute, _kld_update
+from ..functional.regression.minkowski import _minkowski_distance_compute, _minkowski_distance_update
+from ..functional.regression.r2 import _r2_score_update
+from ..functional.regression.rse import _relative_squared_error_compute
+from ..functional.regression.tweedie_deviance import (
+    _tweedie_deviance_score_compute,
+    _tweedie_deviance_score_update,
+)
+from ..metric import Metric
+from ..utils.data import dim_zero_cat
+from ..utils.exceptions import TorchMetricsUserError
+
+Array = jax.Array
+
+
+class MinkowskiDistance(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, p: float, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(p, (float, int)) and p >= 1):
+            raise TorchMetricsUserError(f"Argument ``p`` must be a float or int greater than 1, but got {p}")
+        self.p = p
+        self.add_state("minkowski_dist_sum", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        self.minkowski_dist_sum = self.minkowski_dist_sum + _minkowski_distance_update(preds, target, self.p)
+
+    def compute(self) -> Array:
+        return _minkowski_distance_compute(self.minkowski_dist_sum, self.p)
+
+
+class TweedieDevianceScore(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, power: float = 0.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if 0 < power < 1:
+            raise ValueError(f"Deviance Score is not defined for power={power}.")
+        self.power = power
+        self.add_state("sum_deviance_score", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("num_observations", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        s, n = _tweedie_deviance_score_update(preds, target, self.power)
+        self.sum_deviance_score = self.sum_deviance_score + s
+        self.num_observations = self.num_observations + n
+
+    def compute(self) -> Array:
+        return _tweedie_deviance_score_compute(self.sum_deviance_score, self.num_observations)
+
+
+class CriticalSuccessIndex(Metric):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, threshold: float, keep_sequence_dim: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(threshold, (int, float)):
+            raise ValueError(f"Expected argument `threshold` to be a float but got {threshold}")
+        self.threshold = float(threshold)
+        if keep_sequence_dim is None:
+            self.keep_sequence_dim = None
+            self.add_state("hits", jnp.asarray(0), dist_reduce_fx="sum")
+            self.add_state("misses", jnp.asarray(0), dist_reduce_fx="sum")
+            self.add_state("false_alarms", jnp.asarray(0), dist_reduce_fx="sum")
+        else:
+            if not (isinstance(keep_sequence_dim, int) and keep_sequence_dim >= 0):
+                raise ValueError(f"Expected argument `keep_sequence_dim` to be an int but got {keep_sequence_dim}")
+            self.keep_sequence_dim = keep_sequence_dim
+            self.add_state("hits", [], dist_reduce_fx="cat")
+            self.add_state("misses", [], dist_reduce_fx="cat")
+            self.add_state("false_alarms", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        hits, misses, false_alarms = _critical_success_index_update(
+            preds, target, self.threshold, self.keep_sequence_dim
+        )
+        if self.keep_sequence_dim is None:
+            self.hits = self.hits + hits
+            self.misses = self.misses + misses
+            self.false_alarms = self.false_alarms + false_alarms
+        else:
+            self.hits.append(hits)
+            self.misses.append(misses)
+            self.false_alarms.append(false_alarms)
+
+    def compute(self) -> Array:
+        return _critical_success_index_compute(
+            dim_zero_cat(self.hits), dim_zero_cat(self.misses), dim_zero_cat(self.false_alarms)
+        )
+
+
+class RelativeSquaredError(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, num_outputs: int = 1, squared: bool = True, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.num_outputs = num_outputs
+        self.squared = squared
+        self.add_state("sum_squared_obs", jnp.zeros((num_outputs,)).squeeze(), dist_reduce_fx="sum")
+        self.add_state("sum_obs", jnp.zeros((num_outputs,)).squeeze(), dist_reduce_fx="sum")
+        self.add_state("sum_squared_error", jnp.zeros((num_outputs,)).squeeze(), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        sum_squared_obs, sum_obs, rss, n = _r2_score_update(preds, target, self.num_outputs)
+        self.sum_squared_obs = self.sum_squared_obs + sum_squared_obs
+        self.sum_obs = self.sum_obs + sum_obs
+        self.sum_squared_error = self.sum_squared_error + rss
+        self.total = self.total + n
+
+    def compute(self) -> Array:
+        return _relative_squared_error_compute(
+            self.sum_squared_obs, self.sum_obs, self.sum_squared_error, self.total, self.squared
+        )
+
+
+class KLDivergence(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, log_prob: bool = False, reduction: str = "mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(log_prob, bool):
+            raise TypeError(f"Expected argument `log_prob` to be bool but got {log_prob}")
+        if reduction not in ("mean", "sum", "none", None):
+            raise ValueError(f"Expected argument `reduction` to be one of 'mean', 'sum', 'none' but got {reduction}")
+        self.log_prob = log_prob
+        self.reduction = reduction
+        if reduction in ("mean", "sum"):
+            self.add_state("measures", jnp.asarray(0.0), dist_reduce_fx="sum")
+        else:
+            self.add_state("measures", [], dist_reduce_fx="cat")
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, p: Array, q: Array) -> None:
+        measures, total = _kld_update(p, q, self.log_prob)
+        if self.reduction in ("none", None):
+            # per-sample measures for none-reduction
+            if self.log_prob:
+                m = jnp.sum(jnp.exp(p) * (p - q), axis=-1)
+            else:
+                from ..utils.compute import _safe_xlogy
+
+                pn = p / jnp.sum(p, axis=-1, keepdims=True)
+                qn = q / jnp.sum(q, axis=-1, keepdims=True)
+                m = jnp.sum(_safe_xlogy(pn, pn / qn), axis=-1)
+            self.measures.append(m)
+        else:
+            self.measures = self.measures + measures
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        if self.reduction in ("none", None):
+            return dim_zero_cat(self.measures)
+        return _kld_compute(self.measures, self.total, self.reduction)
+
+
+class CosineSimilarity(Metric):
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = -1.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, reduction: str = "sum", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if reduction not in ("mean", "sum", "none", None):
+            raise ValueError(f"Expected argument `reduction` to be one of 'mean', 'sum', 'none' but got {reduction}")
+        self.reduction = reduction
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Array:
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return _cosine_similarity_compute(preds, target, self.reduction)

@@ -1,0 +1,70 @@
+"""PearsonCorrCoef & ConcordanceCorrCoef classes — the moment-merge template.
+
+Parity: reference ``src/torchmetrics/regression/pearson.py:73`` — per-device
+running moments with ``dist_reduce_fx=None``; device-parallel moments merged
+in compute via ``_final_aggregation`` (``regression/pearson.py:28``).
+``full_state_update=True`` because update reads the running means.
+"""
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..functional.regression.concordance import _concordance_corrcoef_compute
+from ..functional.regression.pearson import (
+    _final_aggregation,
+    _pearson_corrcoef_compute,
+    _pearson_corrcoef_update,
+)
+from ..metric import Metric
+
+Array = jax.Array
+
+
+class PearsonCorrCoef(Metric):
+    is_differentiable = True
+    higher_is_better = None
+    full_state_update = True
+    plot_lower_bound = -1.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(num_outputs, int) and num_outputs > 0):
+            raise ValueError(f"Expected argument `num_outputs` to be an int larger than 0, but got {num_outputs}")
+        self.num_outputs = num_outputs
+        z = jnp.zeros((num_outputs,)).squeeze() if num_outputs == 1 else jnp.zeros((num_outputs,))
+        for name in ("mean_x", "mean_y", "var_x", "var_y", "corr_xy"):
+            self.add_state(name, z, dist_reduce_fx=None)
+        self.add_state("n_total", jnp.zeros_like(z), dist_reduce_fx=None)
+
+    def update(self, preds: Array, target: Array) -> None:
+        mx, my, vx, vy, cxy, n = _pearson_corrcoef_update(
+            preds, target, self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy,
+            self.n_total, self.num_outputs,
+        )
+        self.mean_x, self.mean_y = mx, my
+        self.var_x, self.var_y, self.corr_xy = vx, vy, cxy
+        self.n_total = jnp.broadcast_to(n, jnp.shape(self.mean_x)) if jnp.ndim(self.mean_x) else n
+
+    def _merged_moments(self):
+        """Merge the (world, ...) gathered stacks if synced, else pass through."""
+        mx = jnp.asarray(self.mean_x)
+        if (self.num_outputs == 1 and mx.ndim == 1) or (self.num_outputs > 1 and mx.ndim == 2):
+            return _final_aggregation(
+                mx, jnp.asarray(self.mean_y), jnp.asarray(self.var_x), jnp.asarray(self.var_y),
+                jnp.asarray(self.corr_xy), jnp.asarray(self.n_total),
+            )
+        return mx, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total
+
+    def compute(self) -> Array:
+        _, _, var_x, var_y, corr_xy, n = self._merged_moments()
+        return _pearson_corrcoef_compute(var_x, var_y, corr_xy, n)
+
+
+class ConcordanceCorrCoef(PearsonCorrCoef):
+    """Parity: reference ``src/torchmetrics/regression/concordance.py``."""
+
+    def compute(self) -> Array:
+        mean_x, mean_y, var_x, var_y, corr_xy, n = self._merged_moments()
+        return _concordance_corrcoef_compute(mean_x, mean_y, var_x, var_y, corr_xy, n)

@@ -1,0 +1,87 @@
+"""R2Score & ExplainedVariance classes.
+
+Parity: reference ``src/torchmetrics/regression/{r2,explained_variance}.py``.
+"""
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..functional.regression.explained_variance import (
+    _explained_variance_compute,
+    _explained_variance_update,
+)
+from ..functional.regression.r2 import _r2_score_compute, _r2_score_update
+from ..metric import Metric
+
+Array = jax.Array
+
+
+class R2Score(Metric):
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_upper_bound = 1.0
+
+    def __init__(self, num_outputs: int = 1, adjusted: int = 0, multioutput: str = "uniform_average",
+                 **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.num_outputs = num_outputs
+        if adjusted < 0 or not isinstance(adjusted, int):
+            raise ValueError("`adjusted` parameter should be an integer larger or equal to 0.")
+        self.adjusted = adjusted
+        allowed = ("raw_values", "uniform_average", "variance_weighted")
+        if multioutput not in allowed:
+            raise ValueError(f"Invalid input to argument `multioutput`. Choose one of the following: {allowed}")
+        self.multioutput = multioutput
+        self.add_state("sum_squared_error", jnp.zeros((num_outputs,)).squeeze(), dist_reduce_fx="sum")
+        self.add_state("sum_error", jnp.zeros((num_outputs,)).squeeze(), dist_reduce_fx="sum")
+        self.add_state("residual", jnp.zeros((num_outputs,)).squeeze(), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        sum_squared_obs, sum_obs, rss, num_obs = _r2_score_update(preds, target, self.num_outputs)
+        self.sum_squared_error = self.sum_squared_error + sum_squared_obs
+        self.sum_error = self.sum_error + sum_obs
+        self.residual = self.residual + rss
+        self.total = self.total + num_obs
+
+    def compute(self) -> Array:
+        return _r2_score_compute(
+            self.sum_squared_error, self.sum_error, self.residual, self.total, self.adjusted, self.multioutput
+        )
+
+
+class ExplainedVariance(Metric):
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_upper_bound = 1.0
+
+    def __init__(self, multioutput: str = "uniform_average", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        allowed = ("raw_values", "uniform_average", "variance_weighted")
+        if multioutput not in allowed:
+            raise ValueError(f"Invalid input to argument `multioutput`. Choose one of the following: {allowed}")
+        self.multioutput = multioutput
+        self.add_state("sum_error", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("sum_squared_error", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("sum_target", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("sum_squared_target", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("n_obs", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        n_obs, sum_error, sum_squared_error, sum_target, sum_squared_target = _explained_variance_update(
+            preds, target
+        )
+        self.n_obs = self.n_obs + n_obs
+        self.sum_error = self.sum_error + sum_error
+        self.sum_squared_error = self.sum_squared_error + sum_squared_error
+        self.sum_target = self.sum_target + sum_target
+        self.sum_squared_target = self.sum_squared_target + sum_squared_target
+
+    def compute(self) -> Array:
+        return _explained_variance_compute(
+            self.n_obs, self.sum_error, self.sum_squared_error, self.sum_target, self.sum_squared_target,
+            self.multioutput,
+        )

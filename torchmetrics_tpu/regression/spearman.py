@@ -1,0 +1,69 @@
+"""SpearmanCorrCoef & KendallRankCorrCoef classes (cat states, rank at compute).
+
+Parity: reference ``src/torchmetrics/regression/{spearman,kendall}.py``.
+"""
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..functional.regression.kendall import kendall_rank_corrcoef
+from ..functional.regression.spearman import _spearman_corrcoef_compute
+from ..metric import Metric
+from ..utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class SpearmanCorrCoef(Metric):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = -1.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.num_outputs = num_outputs
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        self.preds.append(preds.astype(jnp.float32))
+        self.target.append(target.astype(jnp.float32))
+
+    def compute(self) -> Array:
+        return _spearman_corrcoef_compute(dim_zero_cat(self.preds), dim_zero_cat(self.target))
+
+
+class KendallRankCorrCoef(Metric):
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+    plot_lower_bound = -1.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, variant: str = "b", t_test: bool = False, alternative: Optional[str] = "two-sided",
+                 num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if variant not in ("a", "b", "c"):
+            raise ValueError(f"Argument `variant` is expected to be one of 'a', 'b', 'c' but got {variant}")
+        if not isinstance(t_test, bool):
+            raise ValueError(f"Argument `t_test` is expected to be of a type `bool`, but got {t_test}.")
+        if t_test and alternative not in ("two-sided", "less", "greater"):
+            raise ValueError("Argument `alternative` is expected to be one of 'two-sided', 'less', 'greater'")
+        self.variant = variant
+        self.t_test = t_test
+        self.alternative = alternative
+        self.num_outputs = num_outputs
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        self.preds.append(preds.astype(jnp.float32))
+        self.target.append(target.astype(jnp.float32))
+
+    def compute(self):
+        return kendall_rank_corrcoef(
+            dim_zero_cat(self.preds), dim_zero_cat(self.target), self.variant, self.t_test, self.alternative
+        )
