@@ -1,0 +1,126 @@
+"""Wrapper metrics — parity reference ``tests/unittests/wrappers/``."""
+import numpy as np
+import pytest
+from sklearn import metrics as skm
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu import MeanMetric, MetricCollection, SumMetric
+from torchmetrics_tpu.classification import BinaryAccuracy, MulticlassAccuracy
+from torchmetrics_tpu.regression import MeanAbsoluteError, MeanSquaredError
+from torchmetrics_tpu.wrappers import (
+    BootStrapper,
+    ClasswiseWrapper,
+    MetricTracker,
+    MinMaxMetric,
+    MultioutputWrapper,
+    MultitaskWrapper,
+    Running,
+)
+
+rng = np.random.RandomState(17)
+
+
+def test_bootstrapper():
+    preds = rng.rand(256).astype(np.float32)
+    target = rng.randint(0, 2, 256)
+    bs = BootStrapper(BinaryAccuracy(), num_bootstraps=20, quantile=0.5, raw=True)
+    bs.update(jnp.asarray(preds), jnp.asarray(target))
+    out = bs.compute()
+    assert set(out) == {"mean", "std", "quantile", "raw"}
+    acc = skm.accuracy_score(target, preds > 0.5)
+    assert abs(float(out["mean"]) - acc) < 0.05
+    assert out["raw"].shape == (20,)
+    assert float(out["std"]) > 0
+
+
+def test_classwise_wrapper():
+    cw = ClasswiseWrapper(MulticlassAccuracy(num_classes=3, average=None), labels=["a", "b", "c"])
+    preds = rng.rand(64, 3).astype(np.float32)
+    target = rng.randint(0, 3, 64)
+    cw.update(jnp.asarray(preds), jnp.asarray(target))
+    out = cw.compute()
+    assert set(out) == {"multiclassaccuracy_a", "multiclassaccuracy_b", "multiclassaccuracy_c"}
+    ref = skm.recall_score(target, preds.argmax(1), average=None, labels=range(3), zero_division=0)
+    np.testing.assert_allclose([float(out[k]) for k in sorted(out)], ref, atol=1e-6)
+
+
+def test_minmax():
+    mm = MinMaxMetric(MeanMetric())
+    vals = [0.5, 2.0, 1.0]
+    for v in vals:
+        out = mm(jnp.asarray(v))
+    # running mean after all: .5 -> 1.25 -> ~1.1667; max of means=1.25, min=0.5
+    assert float(out["max"]) == pytest.approx(1.25)
+    assert float(out["min"]) == pytest.approx(0.5)
+    assert float(out["raw"]) == pytest.approx(np.mean(vals))
+
+
+def test_multioutput():
+    mo = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
+    p = rng.randn(32, 2).astype(np.float32)
+    t = rng.randn(32, 2).astype(np.float32)
+    mo.update(jnp.asarray(p), jnp.asarray(t))
+    out = np.asarray(mo.compute())
+    ref = [skm.mean_squared_error(t[:, i], p[:, i]) for i in range(2)]
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_multitask():
+    mt = MultitaskWrapper({
+        "cls": BinaryAccuracy(),
+        "reg": MeanAbsoluteError(),
+    })
+    p_cls = rng.rand(32).astype(np.float32)
+    t_cls = rng.randint(0, 2, 32)
+    p_reg = rng.randn(32).astype(np.float32)
+    t_reg = rng.randn(32).astype(np.float32)
+    mt.update({"cls": jnp.asarray(p_cls), "reg": jnp.asarray(p_reg)},
+              {"cls": jnp.asarray(t_cls), "reg": jnp.asarray(t_reg)})
+    out = mt.compute()
+    np.testing.assert_allclose(float(out["cls"]), skm.accuracy_score(t_cls, p_cls > 0.5), atol=1e-6)
+    np.testing.assert_allclose(float(out["reg"]), skm.mean_absolute_error(t_reg, p_reg), rtol=1e-5)
+    with pytest.raises(ValueError):
+        mt.update({"wrong": jnp.asarray(p_cls)}, {"cls": jnp.asarray(t_cls)})
+
+
+def test_running():
+    r = Running(SumMetric(), window=2)
+    for v in [1.0, 2.0, 3.0]:
+        r.update(jnp.asarray(v))
+    assert float(r.compute()) == 5.0  # last two updates
+    r2 = Running(MeanSquaredError(), window=3)
+    ps = [rng.randn(8).astype(np.float32) for _ in range(5)]
+    ts = [rng.randn(8).astype(np.float32) for _ in range(5)]
+    for p, t in zip(ps, ts):
+        r2.update(jnp.asarray(p), jnp.asarray(t))
+    ref = skm.mean_squared_error(np.concatenate(ts[2:]), np.concatenate(ps[2:]))
+    np.testing.assert_allclose(float(r2.compute()), ref, rtol=1e-5)
+
+
+def test_tracker():
+    tracker = MetricTracker(BinaryAccuracy(), maximize=True)
+    accs = []
+    for epoch in range(3):
+        tracker.increment()
+        preds = rng.rand(64).astype(np.float32)
+        target = (preds > (0.7 - 0.2 * epoch)).astype(int)  # improves over epochs
+        tracker.update(jnp.asarray(preds), jnp.asarray(target))
+        accs.append(skm.accuracy_score(target, preds > 0.5))
+    allv = np.asarray(tracker.compute_all())
+    np.testing.assert_allclose(allv, accs, atol=1e-6)
+    best, step = tracker.best_metric(return_step=True)
+    assert step == int(np.argmax(accs))
+    np.testing.assert_allclose(best, max(accs), atol=1e-6)
+    with pytest.raises(ValueError):
+        MetricTracker(BinaryAccuracy()).update(jnp.ones(2), jnp.ones(2))
+
+
+def test_tracker_with_collection():
+    tracker = MetricTracker(MetricCollection([BinaryAccuracy()]), maximize=True)
+    tracker.increment()
+    preds = rng.rand(64).astype(np.float32)
+    target = rng.randint(0, 2, 64)
+    tracker.update(jnp.asarray(preds), jnp.asarray(target))
+    out = tracker.compute_all()
+    assert "BinaryAccuracy" in out

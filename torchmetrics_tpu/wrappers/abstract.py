@@ -1,0 +1,22 @@
+"""WrapperMetric base.
+
+Parity: reference ``src/torchmetrics/wrappers/abstract.py:19`` — fixes
+``forward`` cache semantics for metrics that wrap other metrics (the wrapped
+metric handles its own batch-value computation).
+"""
+from typing import Any
+
+from ..metric import Metric
+
+
+class WrapperMetric(Metric):
+    """Base class for wrapper metrics; inner metrics own their states."""
+
+    jittable = False  # wrappers orchestrate Python objects; inner metrics jit themselves
+
+    def _wrap_compute_value(self, value: Any) -> Any:
+        return value
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Default wrapper forward: delegate to update + compute-on-inner."""
+        raise NotImplementedError

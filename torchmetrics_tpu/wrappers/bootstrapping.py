@@ -1,0 +1,101 @@
+"""BootStrapper — bootstrap confidence intervals over any metric.
+
+Parity: reference ``src/torchmetrics/wrappers/bootstrapping.py:54`` (sampler
+:31, update :125-146): keeps N copies of the base metric; each update
+resamples the batch (poisson or multinomial weights) and feeds each copy.
+"""
+from copy import deepcopy
+from typing import Any, Dict, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..metric import Metric, _squeeze_if_scalar
+from .abstract import WrapperMetric
+
+Array = jax.Array
+
+
+def _bootstrap_sampler(size: int, sampling_strategy: str, rng: np.random.RandomState) -> np.ndarray:
+    """Index sampler. Parity: reference ``bootstrapping.py:31``."""
+    if sampling_strategy == "poisson":
+        p = rng.poisson(1, size)
+        return np.repeat(np.arange(size), p)
+    if sampling_strategy == "multinomial":
+        return rng.randint(0, size, size)
+    raise ValueError("Unknown sampling strategy")
+
+
+class BootStrapper(WrapperMetric):
+    full_state_update = True
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_bootstraps: int = 10,
+        mean: bool = True,
+        std: bool = True,
+        quantile: Optional[Union[float, Sequence[float]]] = None,
+        raw: bool = False,
+        sampling_strategy: str = "poisson",
+        seed: int = 42,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected base metric to be an instance of torchmetrics_tpu.Metric but received {base_metric}"
+            )
+        self.metrics = [deepcopy(base_metric) for _ in range(num_bootstraps)]
+        self.num_bootstraps = num_bootstraps
+        self.mean = mean
+        self.std = std
+        self.quantile = quantile
+        self.raw = raw
+        allowed = ("poisson", "multinomial")
+        if sampling_strategy not in allowed:
+            raise ValueError(f"Expected argument ``sampling_strategy`` to be one of {allowed} but received {sampling_strategy}")
+        self.sampling_strategy = sampling_strategy
+        self._rng = np.random.RandomState(seed)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Resample the batch for every bootstrap copy."""
+        arrs = [a for a in args if isinstance(a, (jax.Array, jnp.ndarray, np.ndarray))]
+        size = arrs[0].shape[0] if arrs else 0
+        for idx in range(self.num_bootstraps):
+            sample_idx = _bootstrap_sampler(size, self.sampling_strategy, self._rng)
+            if len(sample_idx) == 0:
+                continue
+            new_args = tuple(
+                a[jnp.asarray(sample_idx)] if isinstance(a, (jax.Array, jnp.ndarray, np.ndarray)) else a
+                for a in args
+            )
+            new_kwargs = {
+                k: (v[jnp.asarray(sample_idx)] if isinstance(v, (jax.Array, jnp.ndarray, np.ndarray)) else v)
+                for k, v in kwargs.items()
+            }
+            self.metrics[idx].update(*new_args, **new_kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        """Parity: reference ``bootstrapping.py:148``."""
+        computed_vals = jnp.stack([jnp.asarray(m.compute()) for m in self.metrics], axis=0)
+        output: Dict[str, Array] = {}
+        if self.mean:
+            output["mean"] = jnp.mean(computed_vals, axis=0)
+        if self.std:
+            output["std"] = jnp.std(computed_vals, axis=0, ddof=1)
+        if self.quantile is not None:
+            output["quantile"] = jnp.quantile(computed_vals, jnp.asarray(self.quantile), axis=0)
+        if self.raw:
+            output["raw"] = computed_vals
+        return output
+
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Array]:
+        self.update(*args, **kwargs)
+        return self.compute()
+
+    def reset(self) -> None:
+        for m in self.metrics:
+            m.reset()
+        super().reset()

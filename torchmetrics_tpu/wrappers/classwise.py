@@ -1,0 +1,52 @@
+"""ClasswiseWrapper — split per-class output into a labeled dict.
+
+Parity: reference ``src/torchmetrics/wrappers/classwise.py:31``.
+"""
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from ..metric import Metric
+from .abstract import WrapperMetric
+
+Array = jax.Array
+
+
+class ClasswiseWrapper(WrapperMetric):
+    def __init__(
+        self,
+        metric: Metric,
+        labels: Optional[List[str]] = None,
+        prefix: Optional[str] = None,
+        postfix: Optional[str] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(metric, Metric):
+            raise ValueError(f"Expected argument `metric` to be an instance of `Metric` but got {metric}")
+        if labels is not None and not (isinstance(labels, list) and all(isinstance(lab, str) for lab in labels)):
+            raise ValueError(f"Expected argument `labels` to either be `None` or a list of strings but got {labels}")
+        self.metric = metric
+        self.labels = labels
+        self._prefix = prefix
+        self._postfix = postfix
+
+    def _convert(self, x: Array) -> Dict[str, Array]:
+        name = self._prefix or f"{type(self.metric).__name__.lower()}_"
+        postfix = self._postfix or ""
+        if self.labels is None:
+            return {f"{name}{i}{postfix}": val for i, val in enumerate(x)}
+        return {f"{name}{lab}{postfix}": val for lab, val in zip(self.labels, x)}
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self.metric.update(*args, **kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        return self._convert(self.metric.compute())
+
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Array]:
+        return self._convert(self.metric(*args, **kwargs))
+
+    def reset(self) -> None:
+        self.metric.reset()
+        super().reset()

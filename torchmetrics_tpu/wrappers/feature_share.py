@@ -1,0 +1,69 @@
+"""FeatureShare — share one feature extractor across network-based metrics.
+
+Parity: reference ``src/torchmetrics/wrappers/feature_share.py:26``
+(``NetworkCache``) and ``:45`` (``FeatureShare``): a MetricCollection subclass
+that swaps each member's feature-extractor attribute for one shared cached
+network, so the backbone runs once per batch regardless of member count.
+
+TPU-first: the cache key is the input array's object id + shape (JAX arrays
+are immutable, so id-identity is safe within a step); the shared forward is a
+single jitted call whose output feeds every member update.
+"""
+from functools import lru_cache
+from typing import Any, Optional, Sequence, Union
+
+from ..collections import MetricCollection
+from ..metric import Metric
+
+
+class NetworkCache:
+    """Wrap a feature-extractor callable with an LRU cache."""
+
+    def __init__(self, network: Any, max_size: int = 100) -> None:
+        self.max_size = max_size
+        self.network = network
+        self._cached = lru_cache(maxsize=max_size)(self._call_by_key)
+        self._store = {}
+
+    def _call_by_key(self, key):
+        args, kwargs = self._store[key]
+        return self.network(*args, **kwargs)
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        key = (tuple(id(a) for a in args), tuple(sorted((k, id(v)) for k, v in kwargs.items())))
+        self._store[key] = (args, kwargs)
+        out = self._cached(key)
+        return out
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self.__dict__["network"], name)
+
+
+class FeatureShare(MetricCollection):
+    """MetricCollection whose members share one cached feature extractor."""
+
+    def __init__(self, metrics: Union[Metric, Sequence[Metric], dict], max_cache_size: Optional[int] = None,
+                 **kwargs: Any) -> None:
+        super().__init__(metrics, compute_groups=False, **kwargs)
+        if max_cache_size is None:
+            max_cache_size = len(self._metrics)
+        if not isinstance(max_cache_size, int):
+            raise TypeError(f"max_cache_size should be an integer, but got {max_cache_size}")
+
+        first = list(self._metrics.values())[0]
+        try:
+            net_attr = first.feature_network
+            network = getattr(first, net_attr)
+        except AttributeError as err:
+            raise AttributeError(
+                "Tried to extract the network to share from the first metric, but it did not have a "
+                "`feature_network` attribute. Please make sure all metrics have this attribute."
+            ) from err
+        shared = NetworkCache(network, max_size=max_cache_size)
+        for name, m in self._metrics.items():
+            if not hasattr(m, "feature_network"):
+                raise AttributeError(
+                    "Tried to set the cached network to all metrics, but one of the metrics did not have a "
+                    "`feature_network` attribute."
+                )
+            object.__setattr__(m, m.feature_network, shared)

@@ -1,0 +1,57 @@
+"""MinMaxMetric — track the min/max of a wrapped metric over time.
+
+Parity: reference ``src/torchmetrics/wrappers/minmax.py:29``.
+"""
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ..metric import Metric
+from .abstract import WrapperMetric
+
+Array = jax.Array
+
+
+class MinMaxMetric(WrapperMetric):
+    full_state_update = True
+
+    def __init__(self, base_metric: Metric, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected base metric to be an instance of `torchmetrics_tpu.Metric` but received {base_metric}"
+            )
+        self._base_metric = base_metric
+        self.add_state("min_val", jnp.asarray(jnp.inf), dist_reduce_fx="min")
+        self.add_state("max_val", jnp.asarray(-jnp.inf), dist_reduce_fx="max")
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self._base_metric.update(*args, **kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        val = self._base_metric.compute()
+        if not self._is_suitable_val(val):
+            raise RuntimeError(f"Returned value from base metric should be a float or scalar tensor, but got {val}")
+        val = jnp.asarray(val)
+        self.max_val = jnp.where(val > self.max_val, val, self.max_val)
+        self.min_val = jnp.where(val < self.min_val, val, self.min_val)
+        return {"raw": val, "max": self.max_val, "min": self.min_val}
+
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Array]:
+        self._base_metric.update(*args, **kwargs)
+        self._update_count += 1
+        self._computed = None
+        return self.compute()
+
+    def reset(self) -> None:
+        super().reset()
+        self._base_metric.reset()
+
+    @staticmethod
+    def _is_suitable_val(val: Any) -> bool:
+        if isinstance(val, (int, float)):
+            return True
+        if isinstance(val, (jax.Array, jnp.ndarray)):
+            return jnp.size(val) == 1
+        return False

@@ -1,0 +1,97 @@
+"""MultioutputWrapper — one internal metric copy per output column.
+
+Parity: reference ``src/torchmetrics/wrappers/multioutput.py:43``.
+"""
+from copy import deepcopy
+from typing import Any, List
+
+import jax
+import jax.numpy as jnp
+
+from ..metric import Metric
+from .abstract import WrapperMetric
+
+Array = jax.Array
+
+
+class MultioutputWrapper(WrapperMetric):
+    is_differentiable = False
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_outputs: int,
+        output_dim: int = -1,
+        remove_nans: bool = True,
+        squeeze_outputs: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.metrics = [deepcopy(base_metric) for _ in range(num_outputs)]
+        self.output_dim = output_dim
+        self.remove_nans = remove_nans
+        self.squeeze_outputs = squeeze_outputs
+
+    def _get_args_kwargs_by_output(self, *args: Array, **kwargs: Array):
+        """Slice each input along ``output_dim`` per metric copy."""
+        args_kwargs_by_output = []
+        for i in range(len(self.metrics)):
+            selected_args = [
+                jnp.take(a, jnp.asarray([i]), axis=self.output_dim) if isinstance(a, (jax.Array, jnp.ndarray)) else a
+                for a in args
+            ]
+            selected_kwargs = {
+                k: (jnp.take(v, jnp.asarray([i]), axis=self.output_dim) if isinstance(v, (jax.Array, jnp.ndarray)) else v)
+                for k, v in kwargs.items()
+            }
+            if self.remove_nans:
+                arrs = [a for a in selected_args if isinstance(a, (jax.Array, jnp.ndarray))]
+                arrs += [v for v in selected_kwargs.values() if isinstance(v, (jax.Array, jnp.ndarray))]
+                if arrs:
+                    nan_idxs = jnp.zeros(arrs[0].shape[0], dtype=bool)
+                    for a in arrs:
+                        if jnp.issubdtype(a.dtype, jnp.floating):
+                            nan_idxs = nan_idxs | jnp.any(
+                                jnp.isnan(a.reshape(a.shape[0], -1)), axis=1
+                            )
+                    keep = ~nan_idxs
+                    selected_args = [
+                        a[keep] if isinstance(a, (jax.Array, jnp.ndarray)) else a for a in selected_args
+                    ]
+                    selected_kwargs = {
+                        k: (v[keep] if isinstance(v, (jax.Array, jnp.ndarray)) else v)
+                        for k, v in selected_kwargs.items()
+                    }
+            if self.squeeze_outputs:
+                selected_args = [
+                    jnp.squeeze(a, axis=self.output_dim) if isinstance(a, (jax.Array, jnp.ndarray)) else a
+                    for a in selected_args
+                ]
+                selected_kwargs = {
+                    k: (jnp.squeeze(v, axis=self.output_dim) if isinstance(v, (jax.Array, jnp.ndarray)) else v)
+                    for k, v in selected_kwargs.items()
+                }
+            args_kwargs_by_output.append((selected_args, selected_kwargs))
+        return args_kwargs_by_output
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        for (selected_args, selected_kwargs), metric in zip(
+            self._get_args_kwargs_by_output(*args, **kwargs), self.metrics
+        ):
+            metric.update(*selected_args, **selected_kwargs)
+
+    def compute(self) -> Array:
+        return jnp.stack([jnp.asarray(m.compute()) for m in self.metrics], axis=0)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Array:
+        results = []
+        for (selected_args, selected_kwargs), metric in zip(
+            self._get_args_kwargs_by_output(*args, **kwargs), self.metrics
+        ):
+            results.append(jnp.asarray(metric(*selected_args, **selected_kwargs)))
+        return jnp.stack(results, axis=0)
+
+    def reset(self) -> None:
+        for m in self.metrics:
+            m.reset()
+        super().reset()

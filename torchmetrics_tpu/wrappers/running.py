@@ -1,0 +1,60 @@
+"""Running — sliding window over the last ``window`` update states.
+
+Parity: reference ``src/torchmetrics/wrappers/running.py:27`` (update :106,
+compute :126): keeps per-update batch-state snapshots; compute merges the
+window's states and runs the base compute.
+"""
+from collections import deque
+from copy import deepcopy
+from typing import Any
+
+import jax
+
+from ..metric import Metric, _squeeze_if_scalar
+from .abstract import WrapperMetric
+
+Array = jax.Array
+
+
+class Running(WrapperMetric):
+    def __init__(self, base_metric: Metric, window: int = 5, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected argument `metric` to be an instance of `torchmetrics_tpu.Metric` but got {base_metric}"
+            )
+        if not (isinstance(window, int) and window > 0):
+            raise ValueError(f"Expected argument `window` to be a positive integer but got {window}")
+        self.base_metric = base_metric
+        self.window = window
+        if base_metric.full_state_update:
+            raise ValueError(
+                f"Expected attribute `full_state_update` set to `False` but got {base_metric.full_state_update}"
+            )
+        self._window_states: deque = deque(maxlen=window)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Compute this batch's state from defaults and push onto the window."""
+        m = self.base_metric
+        batch_state = m.update_state(m.init_state(), *args, **kwargs)
+        self._window_states.append(batch_state)
+
+    def _merged_window_state(self):
+        states = list(self._window_states)
+        if not states:
+            return self.base_metric.init_state()
+        if len(states) == 1:
+            return states[0]
+        return self.base_metric.merge_states(states)
+
+    def compute(self) -> Any:
+        return self.base_metric.compute_state(self._merged_window_state())
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        self.update(*args, **kwargs)
+        return self.base_metric.compute_state(self._window_states[-1])
+
+    def reset(self) -> None:
+        super().reset()
+        self._window_states.clear()
+        self.base_metric.reset()

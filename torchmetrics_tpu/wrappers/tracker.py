@@ -1,0 +1,115 @@
+"""MetricTracker — one clone of the base metric per ``increment()`` (epoch).
+
+Parity: reference ``src/torchmetrics/wrappers/tracker.py:31``
+(``best_metric`` :186 using ``higher_is_better``/``maximize``).
+"""
+from copy import deepcopy
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..collections import MetricCollection
+from ..metric import Metric
+from ..utils.prints import rank_zero_warn
+from .abstract import WrapperMetric
+
+Array = jax.Array
+
+
+class MetricTracker(WrapperMetric):
+    def __init__(self, metric: Union[Metric, MetricCollection], maximize: Union[bool, List[bool], None] = True,
+                 **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(metric, (Metric, MetricCollection)):
+            raise TypeError(
+                "Metric arg need to be an instance of a torchmetrics_tpu `Metric` or `MetricCollection` "
+                f"but got {metric}"
+            )
+        self._base_metric = metric
+        if maximize is None:  # infer from higher_is_better
+            if isinstance(metric, Metric):
+                if metric.higher_is_better is None:
+                    raise AttributeError("When `maximize` is not set, the metric must define `higher_is_better`")
+                maximize = bool(metric.higher_is_better)
+            else:
+                maximize = [bool(m.higher_is_better) for m in metric.values(copy_state=False)]
+        if not isinstance(maximize, (bool, list)):
+            raise ValueError("Argument `maximize` should either be a single bool or list of bool")
+        if isinstance(maximize, list) and not all(isinstance(m, bool) for m in maximize):
+            raise ValueError("Argument `maximize` should either be a single bool or list of bool")
+        self.maximize = maximize
+        self._increment_called = False
+        self._metrics: List[Union[Metric, MetricCollection]] = []
+
+    @property
+    def n_steps(self) -> int:
+        return len(self._metrics)
+
+    def increment(self) -> None:
+        """Start tracking a new version (epoch)."""
+        self._increment_called = True
+        self._metrics.append(deepcopy(self._base_metric))
+        self._metrics[-1].reset()
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self._check_for_increment("update")
+        self._metrics[-1].update(*args, **kwargs)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        self._check_for_increment("forward")
+        return self._metrics[-1](*args, **kwargs)
+
+    def compute(self) -> Any:
+        self._check_for_increment("compute")
+        return self._metrics[-1].compute()
+
+    def compute_all(self) -> Any:
+        """Stacked results from all tracked versions."""
+        self._check_for_increment("compute_all")
+        res = [m.compute() for m in self._metrics]
+        if isinstance(self._base_metric, MetricCollection):
+            keys = res[0].keys()
+            return {k: jnp.stack([jnp.asarray(r[k]) for r in res], axis=0) for k in keys}
+        return jnp.stack([jnp.asarray(r) for r in res], axis=0)
+
+    def best_metric(
+        self, return_step: bool = False
+    ):
+        """Best value (and optionally its step) across tracked versions."""
+        res = self.compute_all()
+
+        def _best(vals: Array, maximize: bool) -> Tuple[float, int]:
+            arr = np.asarray(vals)
+            idx = int(np.argmax(arr)) if maximize else int(np.argmin(arr))
+            return float(arr[idx]), idx
+
+        if isinstance(res, dict):
+            maximize = self.maximize if isinstance(self.maximize, list) else [self.maximize] * len(res)
+            values, steps = {}, {}
+            for (k, v), mx in zip(res.items(), maximize):
+                try:
+                    values[k], steps[k] = _best(v, mx)
+                except (ValueError, TypeError):
+                    values[k], steps[k] = None, None
+            return (values, steps) if return_step else values
+        try:
+            value, step = _best(res, bool(self.maximize))
+        except (ValueError, TypeError):
+            rank_zero_warn("Encountered nested structure; returning None as best metric.")
+            value, step = None, None
+        return (value, step) if return_step else value
+
+    def reset(self) -> None:
+        """Reset the current version."""
+        if self._metrics:
+            self._metrics[-1].reset()
+
+    def reset_all(self) -> None:
+        for m in self._metrics:
+            m.reset()
+
+    def _check_for_increment(self, method: str) -> None:
+        if not self._increment_called:
+            raise ValueError(f"`{method}` cannot be called before `.increment()` has been called.")
