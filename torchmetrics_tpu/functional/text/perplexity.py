@@ -1,0 +1,46 @@
+"""Perplexity from logits (sequence-shardable).
+
+Parity: reference ``src/torchmetrics/functional/text/perplexity.py``
+(``total_log_probs``/``count`` sum states over device tensors).
+
+TPU-first (SURVEY.md §2.10): update accepts **sequence-sharded** logits — the
+states are plain sums, so syncing over a sequence-parallel mesh axis is the
+same ``psum`` as over the batch axis; a v4-32 can evaluate sequences no single
+chip could hold.
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _perplexity_update(preds: Array, target: Array, ignore_index: Optional[int] = None) -> Tuple[Array, Array]:
+    """preds: (..., vocab) logits or probs; target: (...) int tokens."""
+    vocab = preds.shape[-1]
+    preds = preds.reshape(-1, vocab).astype(jnp.float32)
+    target = target.reshape(-1)
+    # treat as logits unless rows already sum to 1
+    probs_sum = jnp.sum(preds, axis=-1)
+    is_probs = jnp.all(jnp.abs(probs_sum - 1.0) < 1e-3) & jnp.all(preds >= 0)
+    log_probs = jnp.where(is_probs, jnp.log(jnp.clip(preds, min=1e-20)), jax.nn.log_softmax(preds, axis=-1))
+    if ignore_index is not None:
+        mask = (target != ignore_index).astype(jnp.float32)
+        target = jnp.clip(target, 0, vocab - 1)
+    else:
+        mask = jnp.ones_like(target, dtype=jnp.float32)
+    token_log_probs = jnp.take_along_axis(log_probs, target[:, None], axis=-1)[:, 0]
+    total = -jnp.sum(token_log_probs * mask)
+    count = jnp.sum(mask)
+    return total, count
+
+
+def _perplexity_compute(total: Array, count: Array) -> Array:
+    return jnp.exp(total / count)
+
+
+def perplexity(preds: Array, target: Array, ignore_index: Optional[int] = None) -> Array:
+    """Parity: reference ``functional/text/perplexity.py:80``."""
+    total, count = _perplexity_update(preds, target, ignore_index)
+    return _perplexity_compute(total, count)

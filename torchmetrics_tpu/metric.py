@@ -366,6 +366,50 @@ class Metric:
             out[k] = tuple(state.get(k, ())) + appends[k]
         return out
 
+    def update_state_batched(self, state: StateDict, *args: Any, **kwargs: Any) -> StateDict:
+        """Bulk update over a leading steps axis: ``args`` are (S, ...) stacks.
+
+        TPU-native alternative to a sequential ``lax.scan`` over updates:
+        per-step batch states are computed in parallel with ``vmap`` and
+        merged by reduction tag (updates are independent; merging is
+        associative). Not available for metrics with ``None``/custom
+        reductions whose update reads prior state (e.g. Pearson) — use
+        ``update_state`` in a scan for those.
+        """
+        for red in self._reductions.values():
+            if red == Reduction.NONE or callable(red):
+                raise TorchMetricsUserError(
+                    f"{type(self).__name__} has a custom/None reduction state; "
+                    "update_state_batched requires associative (sum/mean/max/min/cat) reductions."
+                )
+
+        def one_step(step_args, step_kwargs):
+            return self._pure_update(
+                {k: v for k, v in self._defaults.items() if k not in self._list_states},
+                step_args,
+                step_kwargs,
+            )
+
+        new_tensors, appends = jax.vmap(one_step)(args, kwargs)
+        out: StateDict = {}
+        for name in self._defaults:
+            red = self._reductions[name]
+            if name in self._list_states:
+                stacked = appends[name]  # tuple of (S, B, ...) arrays
+                flat = [v.reshape((-1,) + v.shape[2:]) for v in stacked]
+                out[name] = tuple(state.get(name, ())) + tuple(flat)
+                continue
+            v = new_tensors[name]  # (S, ...)
+            if red == Reduction.SUM:
+                out[name] = state[name] + jnp.sum(v, axis=0)
+            elif red == Reduction.MEAN:
+                out[name] = jnp.mean(v, axis=0)  # equal-weight steps from a fresh state
+            elif red == Reduction.MAX:
+                out[name] = jnp.maximum(state[name], jnp.max(v, axis=0))
+            elif red == Reduction.MIN:
+                out[name] = jnp.minimum(state[name], jnp.min(v, axis=0))
+        return out
+
     def compute_state(self, state: StateDict) -> Any:
         """Pure compute over an explicit state pytree."""
         tensors = {k: v for k, v in state.items() if k not in self._list_states}
