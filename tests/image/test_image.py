@@ -1,0 +1,189 @@
+"""Image metrics vs scipy-based / analytic oracles."""
+import numpy as np
+import pytest
+import scipy.ndimage
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.image import (
+    peak_signal_noise_ratio,
+    spectral_angle_mapper,
+    structural_similarity_index_measure,
+    total_variation,
+    universal_image_quality_index,
+)
+from torchmetrics_tpu.image import (
+    FrechetInceptionDistance,
+    InceptionScore,
+    KernelInceptionDistance,
+    MultiScaleStructuralSimilarityIndexMeasure,
+    PeakSignalNoiseRatio,
+    StructuralSimilarityIndexMeasure,
+    TotalVariation,
+)
+
+rng = np.random.RandomState(23)
+IMGS_A = rng.rand(2, 4, 3, 64, 64).astype(np.float32)
+IMGS_B = np.clip(IMGS_A + 0.1 * rng.randn(2, 4, 3, 64, 64), 0, 1).astype(np.float32)
+
+
+def np_gaussian_ssim(p, t, data_range=1.0, sigma=1.5, ksize=11, k1=0.01, k2=0.03):
+    """Independent SSIM oracle via scipy.ndimage (truncated gaussian window)."""
+    c1, c2 = (k1 * data_range) ** 2, (k2 * data_range) ** 2
+    trunc = ((ksize - 1) / 2) / sigma
+
+    def g(x):
+        return scipy.ndimage.gaussian_filter(x, sigma, truncate=trunc, mode="reflect")
+
+    vals = []
+    for n in range(p.shape[0]):
+        per_c = []
+        for c in range(p.shape[1]):
+            x, y = p[n, c].astype(np.float64), t[n, c].astype(np.float64)
+            mx, my = g(x), g(y)
+            vx = np.clip(g(x * x) - mx * mx, 0, None)
+            vy = np.clip(g(y * y) - my * my, 0, None)
+            cxy = g(x * y) - mx * my
+            s = ((2 * mx * my + c1) * (2 * cxy + c2)) / ((mx**2 + my**2 + c1) * (vx + vy + c2))
+            pad = (ksize - 1) // 2
+            per_c.append(s[pad:-pad, pad:-pad].mean())
+        vals.append(np.mean(per_c))
+    return np.asarray(vals)
+
+
+def test_psnr():
+    p, t = IMGS_A[0], IMGS_B[0]
+    mse = np.mean((p - t) ** 2)
+    ref = 10 * np.log10(1.0 / mse)
+    got = float(peak_signal_noise_ratio(jnp.asarray(p), jnp.asarray(t), data_range=1.0))
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+    m = PeakSignalNoiseRatio(data_range=1.0)
+    m.update(jnp.asarray(IMGS_A[0]), jnp.asarray(IMGS_B[0]))
+    m.update(jnp.asarray(IMGS_A[1]), jnp.asarray(IMGS_B[1]))
+    mse = np.mean((IMGS_A - IMGS_B) ** 2)
+    np.testing.assert_allclose(float(m.compute()), 10 * np.log10(1.0 / mse), rtol=1e-4)
+
+
+def test_ssim_vs_scipy():
+    p, t = IMGS_A[0], IMGS_B[0]
+    ref = np_gaussian_ssim(p, t).mean()
+    got = float(structural_similarity_index_measure(jnp.asarray(p), jnp.asarray(t), data_range=1.0))
+    np.testing.assert_allclose(got, ref, atol=2e-4)
+
+
+def test_ssim_class_accumulates():
+    m = StructuralSimilarityIndexMeasure(data_range=1.0)
+    m.update(jnp.asarray(IMGS_A[0]), jnp.asarray(IMGS_B[0]))
+    m.update(jnp.asarray(IMGS_A[1]), jnp.asarray(IMGS_B[1]))
+    ref = np.concatenate([np_gaussian_ssim(IMGS_A[i], IMGS_B[i]) for i in range(2)]).mean()
+    np.testing.assert_allclose(float(m.compute()), ref, atol=2e-4)
+
+
+def test_ssim_identical_is_one():
+    got = float(structural_similarity_index_measure(jnp.asarray(IMGS_A[0]), jnp.asarray(IMGS_A[0]), data_range=1.0))
+    assert got == pytest.approx(1.0, abs=1e-5)
+
+
+def test_ms_ssim_bounds():
+    big_a = rng.rand(2, 1, 192, 192).astype(np.float32)
+    big_b = np.clip(big_a + 0.05 * rng.randn(*big_a.shape), 0, 1).astype(np.float32)
+    m = MultiScaleStructuralSimilarityIndexMeasure(data_range=1.0)
+    m.update(jnp.asarray(big_a), jnp.asarray(big_b))
+    v = float(m.compute())
+    assert 0.0 < v <= 1.0
+    m2 = MultiScaleStructuralSimilarityIndexMeasure(data_range=1.0)
+    m2.update(jnp.asarray(big_a), jnp.asarray(big_a))
+    assert float(m2.compute()) == pytest.approx(1.0, abs=1e-5)
+
+
+def test_total_variation():
+    img = IMGS_A[0]
+    ref = np.abs(np.diff(img, axis=-1)).sum() + np.abs(np.diff(img, axis=-2)).sum()
+    got = float(total_variation(jnp.asarray(img)))
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
+    m = TotalVariation()
+    m.update(jnp.asarray(img))
+    np.testing.assert_allclose(float(m.compute()), ref, rtol=1e-4)
+
+
+def test_sam():
+    p, t = IMGS_A[0], IMGS_B[0]
+    dot = (p * t).sum(1)
+    ref = np.arccos(dot / (np.linalg.norm(p, axis=1) * np.linalg.norm(t, axis=1))).mean()
+    got = float(spectral_angle_mapper(jnp.asarray(p), jnp.asarray(t)))
+    np.testing.assert_allclose(got, ref, atol=1e-4)
+
+
+def test_uqi_self_is_one():
+    got = float(universal_image_quality_index(jnp.asarray(IMGS_A[0]), jnp.asarray(IMGS_A[0])))
+    assert got == pytest.approx(1.0, abs=1e-3)
+
+
+def test_fid_analytic():
+    """FID between two gaussian feature clouds ~ analytic Frechet distance."""
+    d = 16
+    extractor = lambda x: x.reshape(x.shape[0], -1)[:, :d]
+    fid = FrechetInceptionDistance(feature=extractor)
+    real = rng.randn(2000, d).astype(np.float32)
+    fake = (rng.randn(2000, d) + 1.0).astype(np.float32)  # shifted mean
+    fid.update(jnp.asarray(real), real=True)
+    fid.update(jnp.asarray(fake), real=False)
+    got = float(fid.compute())
+    # analytic: |mu1-mu2|^2 = d * 1.0 (cov identical) → ~16
+    assert abs(got - d * 1.0) < 2.0
+
+    # identical distributions → ~0
+    fid2 = FrechetInceptionDistance(feature=extractor)
+    fid2.update(jnp.asarray(real[:1000]), real=True)
+    fid2.update(jnp.asarray(real[1000:]), real=False)
+    assert float(fid2.compute()) < 0.5
+
+
+def test_fid_streaming_matches_onebatch():
+    d = 8
+    extractor = lambda x: x
+    a = rng.randn(512, d).astype(np.float32)
+    b = rng.randn(512, d).astype(np.float32)
+    f1 = FrechetInceptionDistance(feature=extractor)
+    f1.update(jnp.asarray(a), real=True)
+    f1.update(jnp.asarray(b), real=False)
+    f2 = FrechetInceptionDistance(feature=extractor)
+    for i in range(0, 512, 128):
+        f2.update(jnp.asarray(a[i : i + 128]), real=True)
+        f2.update(jnp.asarray(b[i : i + 128]), real=False)
+    np.testing.assert_allclose(float(f1.compute()), float(f2.compute()), rtol=1e-3)
+
+
+def test_kid():
+    extractor = lambda x: x
+    kid = KernelInceptionDistance(feature=extractor, subsets=10, subset_size=100)
+    real = rng.randn(300, 8).astype(np.float32)
+    fake = (rng.randn(300, 8) * 1.5).astype(np.float32)
+    kid.update(jnp.asarray(real), real=True)
+    kid.update(jnp.asarray(fake), real=False)
+    mean, std = kid.compute()
+    assert float(mean) > 0
+    kid2 = KernelInceptionDistance(feature=extractor, subsets=10, subset_size=100)
+    kid2.update(jnp.asarray(real[:150]), real=True)
+    kid2.update(jnp.asarray(real[150:]), real=False)
+    assert abs(float(kid2.compute()[0])) < float(mean)
+
+
+def test_inception_score():
+    extractor = lambda x: x  # inputs are already logits
+    m = InceptionScore(feature=extractor, splits=4)
+    # confident, diverse predictions → high IS
+    logits = np.eye(10)[rng.randint(0, 10, 400)] * 10.0
+    m.update(jnp.asarray(logits.astype(np.float32)))
+    mean, std = m.compute()
+    assert float(mean) > 5.0
+    # uniform predictions → IS ~ 1
+    m2 = InceptionScore(feature=extractor, splits=4)
+    m2.update(jnp.asarray(np.zeros((400, 10), dtype=np.float32)))
+    assert float(m2.compute()[0]) == pytest.approx(1.0, abs=1e-3)
+
+
+def test_fid_without_extractor_raises():
+    with pytest.raises(ModuleNotFoundError):
+        FrechetInceptionDistance(feature=2048)

@@ -1,0 +1,110 @@
+"""Pan-sharpening quality metrics: D_lambda, D_s, QNR.
+
+Parity: reference ``src/torchmetrics/functional/image/{d_lambda,d_s,qnr}.py``
+— spectral distortion (UQI between band pairs), spatial distortion (UQI
+between each band and the PAN image at two resolutions), and the combined
+quality-with-no-reference index.
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.checks import _check_same_shape
+from .helper import avg_pool2d
+from .uqi import _uqi_update
+
+Array = jax.Array
+
+
+def _band_uqi(a: Array, b: Array) -> Array:
+    """(N,) UQI between two single-band images (N, H, W)."""
+    return _uqi_update(a[:, None], b[:, None])
+
+
+def _spectral_distortion_index_compute(preds: Array, target: Array, p: int = 1) -> Array:
+    length = preds.shape[1]
+    total = jnp.zeros(preds.shape[0])
+    cnt = 0
+    for k in range(length):
+        for r in range(length):
+            if k == r:
+                continue
+            q_fused = _band_uqi(preds[:, k], preds[:, r])
+            q_lr = _band_uqi(target[:, k], target[:, r])
+            total = total + jnp.abs(q_fused - q_lr) ** p
+            cnt += 1
+    return (total / cnt) ** (1.0 / p)
+
+
+def spectral_distortion_index(
+    preds: Array, target: Array, p: int = 1, reduction: Optional[str] = "elementwise_mean"
+) -> Array:
+    """D_lambda. Parity: reference ``d_lambda.py:84``."""
+    _check_same_shape(preds, target)
+    if not isinstance(p, int) or p <= 0:
+        raise ValueError(f"Expected `p` to be a positive integer. Got p: {p}.")
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    scores = _spectral_distortion_index_compute(preds, target, p)
+    if reduction == "elementwise_mean":
+        return jnp.mean(scores)
+    if reduction == "sum":
+        return jnp.sum(scores)
+    return scores
+
+
+def spatial_distortion_index(
+    preds: Array, ms: Array, pan: Array, pan_lr: Optional[Array] = None,
+    norm_order: int = 1, window_size: int = 7, reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """D_s. Parity: reference ``d_s.py:95``.
+
+    preds: fused high-res multispectral (N, C, H, W); ms: low-res
+    multispectral (N, C, h, w); pan: panchromatic (N, C, H, W) or (N, 1, H, W).
+    """
+    if not isinstance(norm_order, int) or norm_order <= 0:
+        raise ValueError(f"Expected `norm_order` to be a positive integer. Got norm_order: {norm_order}.")
+    preds = preds.astype(jnp.float32)
+    ms = ms.astype(jnp.float32)
+    pan = pan.astype(jnp.float32)
+    length = preds.shape[1]
+    ratio = preds.shape[-1] // ms.shape[-1]
+    if pan_lr is None:
+        pan_lr = avg_pool2d(pan, ratio)
+    total = jnp.zeros(preds.shape[0])
+    for i in range(length):
+        pan_band = pan[:, min(i, pan.shape[1] - 1)]
+        pan_lr_band = pan_lr[:, min(i, pan_lr.shape[1] - 1)]
+        q_hr = _band_uqi(preds[:, i], pan_band)
+        q_lr = _band_uqi(ms[:, i], pan_lr_band)
+        total = total + jnp.abs(q_hr - q_lr) ** norm_order
+    scores = (total / length) ** (1.0 / norm_order)
+    if reduction == "elementwise_mean":
+        return jnp.mean(scores)
+    if reduction == "sum":
+        return jnp.sum(scores)
+    return scores
+
+
+def quality_with_no_reference(
+    preds: Array, ms: Array, pan: Array, pan_lr: Optional[Array] = None,
+    alpha: float = 1.0, beta: float = 1.0, norm_order: int = 1, window_size: int = 7,
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """QNR = (1 - D_lambda)^alpha * (1 - D_s)^beta. Parity: reference ``qnr.py:71``."""
+    d_l = spectral_distortion_index(preds, _upsample_like(ms, preds), 1, reduction="none")
+    d_s_val = spatial_distortion_index(preds, ms, pan, pan_lr, norm_order, window_size, reduction="none")
+    qnr = (1 - d_l) ** alpha * (1 - d_s_val) ** beta
+    if reduction == "elementwise_mean":
+        return jnp.mean(qnr)
+    if reduction == "sum":
+        return jnp.sum(qnr)
+    return qnr
+
+
+def _upsample_like(x: Array, ref: Array) -> Array:
+    """Nearest-neighbor upsample x to ref's spatial size."""
+    factor_h = ref.shape[-2] // x.shape[-2]
+    factor_w = ref.shape[-1] // x.shape[-1]
+    return jnp.repeat(jnp.repeat(x, factor_h, axis=-2), factor_w, axis=-1)

@@ -1,0 +1,76 @@
+"""Shared image kernels: gaussian/uniform windows, depthwise conv, padding.
+
+Parity: reference ``src/torchmetrics/functional/image/utils.py``
+(``_gaussian_kernel_2d/3d``, reflection padding).
+
+TPU-first: all filtering is ``lax.conv_general_dilated`` with
+``feature_group_count=channels`` (depthwise) in NCHW — XLA maps these onto
+the convolution units; kernels are built once per (static) config.
+"""
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Array = jax.Array
+
+
+def _gaussian_1d(kernel_size: int, sigma: float, dtype=jnp.float32) -> Array:
+    x = jnp.arange(kernel_size, dtype=dtype) - (kernel_size - 1) / 2.0
+    g = jnp.exp(-(x**2) / (2 * sigma**2))
+    return g / jnp.sum(g)
+
+def gaussian_kernel_2d(channels: int, kernel_size: Sequence[int], sigma: Sequence[float], dtype=jnp.float32) -> Array:
+    """(C, 1, kh, kw) depthwise gaussian kernel."""
+    kh = _gaussian_1d(kernel_size[0], sigma[0], dtype)
+    kw = _gaussian_1d(kernel_size[1], sigma[1], dtype)
+    k2d = jnp.outer(kh, kw)
+    return jnp.broadcast_to(k2d, (channels, 1) + k2d.shape)
+
+
+def gaussian_kernel_3d(channels: int, kernel_size: Sequence[int], sigma: Sequence[float], dtype=jnp.float32) -> Array:
+    kd = _gaussian_1d(kernel_size[2], sigma[2], dtype) if len(kernel_size) > 2 else None
+    kh = _gaussian_1d(kernel_size[0], sigma[0], dtype)
+    kw = _gaussian_1d(kernel_size[1], sigma[1], dtype)
+    k3d = jnp.einsum("i,j,k->ijk", kh, kw, kd)
+    return jnp.broadcast_to(k3d, (channels, 1) + k3d.shape)
+
+
+def uniform_kernel_2d(channels: int, kernel_size: Sequence[int], dtype=jnp.float32) -> Array:
+    k = jnp.ones(tuple(kernel_size), dtype=dtype) / (kernel_size[0] * kernel_size[1])
+    return jnp.broadcast_to(k, (channels, 1) + k.shape)
+
+
+def depthwise_conv2d(x: Array, kernel: Array) -> Array:
+    """x: (N, C, H, W); kernel: (C, 1, kh, kw); valid padding."""
+    return lax.conv_general_dilated(
+        x,
+        kernel,
+        window_strides=(1, 1),
+        padding="VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=x.shape[1],
+    )
+
+
+def depthwise_conv3d(x: Array, kernel: Array) -> Array:
+    return lax.conv_general_dilated(
+        x,
+        kernel,
+        window_strides=(1, 1, 1),
+        padding="VALID",
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=x.shape[1],
+    )
+
+
+def reflect_pad_2d(x: Array, pad_h: int, pad_w: int) -> Array:
+    return jnp.pad(x, ((0, 0), (0, 0), (pad_h, pad_h), (pad_w, pad_w)), mode="reflect")
+
+
+def avg_pool2d(x: Array, window: int = 2) -> Array:
+    """Non-overlapping average pooling (MS-SSIM downsampling)."""
+    return lax.reduce_window(
+        x, 0.0, lax.add, (1, 1, window, window), (1, 1, window, window), "VALID"
+    ) / (window * window)

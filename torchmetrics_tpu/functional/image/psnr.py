@@ -1,0 +1,71 @@
+"""Peak signal-to-noise ratio.
+
+Parity: reference ``src/torchmetrics/functional/image/psnr.py`` (154 LoC).
+"""
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _psnr_update(
+    preds: Array, target: Array, dim: Optional[Union[int, Tuple[int, ...]]] = None
+) -> Tuple[Array, Array]:
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    diff = preds - target
+    if dim is None:
+        sum_squared_error = jnp.sum(diff * diff)
+        num_obs = jnp.asarray(target.size, dtype=jnp.float32)
+    else:
+        sum_squared_error = jnp.sum(diff * diff, axis=dim)
+        num_obs = jnp.asarray(
+            jnp.prod(jnp.asarray([target.shape[d] for d in (dim if isinstance(dim, tuple) else (dim,))])),
+            dtype=jnp.float32,
+        )
+        num_obs = jnp.broadcast_to(num_obs, sum_squared_error.shape)
+    return sum_squared_error, num_obs
+
+
+def _psnr_compute(
+    sum_squared_error: Array,
+    num_obs: Array,
+    data_range: Array,
+    base: float = 10.0,
+    reduction: str = "elementwise_mean",
+) -> Array:
+    psnr_base_e = 2 * jnp.log(data_range) - jnp.log(sum_squared_error / num_obs)
+    psnr_vals = psnr_base_e * (10 / jnp.log(base))
+    if reduction == "elementwise_mean":
+        return jnp.mean(psnr_vals)
+    if reduction == "sum":
+        return jnp.sum(psnr_vals)
+    return psnr_vals
+
+
+def peak_signal_noise_ratio(
+    preds: Array,
+    target: Array,
+    data_range: Optional[Union[float, Tuple[float, float]]] = None,
+    base: float = 10.0,
+    reduction: str = "elementwise_mean",
+    dim: Optional[Union[int, Tuple[int, ...]]] = None,
+) -> Array:
+    """Parity: reference ``psnr.py:92``."""
+    if data_range is None:
+        if dim is not None:
+            raise ValueError("The `data_range` must be given when `dim` is set.")
+        data_range = jnp.max(target) - jnp.min(target)
+    elif isinstance(data_range, tuple):
+        preds = jnp.clip(preds, data_range[0], data_range[1])
+        target = jnp.clip(target, data_range[0], data_range[1])
+        data_range = jnp.asarray(data_range[1] - data_range[0])
+    else:
+        data_range = jnp.asarray(float(data_range))
+    sum_squared_error, num_obs = _psnr_update(preds, target, dim)
+    return _psnr_compute(sum_squared_error, num_obs, data_range, base, reduction)

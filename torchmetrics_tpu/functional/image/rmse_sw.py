@@ -1,0 +1,90 @@
+"""Sliding-window RMSE (+ ERGAS / RASE which build on it).
+
+Parity: reference ``src/torchmetrics/functional/image/{rmse_sw,ergas,rase}.py``.
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.checks import _check_same_shape
+from .helper import depthwise_conv2d, uniform_kernel_2d
+
+Array = jax.Array
+
+
+def _rmse_sw_update(
+    preds: Array, target: Array, window_size: int
+) -> Tuple[Array, Array, Array]:
+    """Returns (rmse_per_sample_mean, rmse_map_sum, total_windows)."""
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    channel = preds.shape[1]
+    kernel = uniform_kernel_2d(channel, (window_size, window_size))
+    diff_sq = (preds - target) ** 2
+    mse_map = depthwise_conv2d(diff_sq, kernel)  # local mean of squared error
+    rmse_map = jnp.sqrt(jnp.clip(mse_map, min=0.0))
+    n = preds.shape[0]
+    rmse_per_sample = jnp.sqrt(jnp.mean(mse_map.reshape(n, -1), axis=-1))
+    return rmse_per_sample, rmse_map, jnp.asarray(rmse_map[0].size, dtype=jnp.float32)
+
+
+def root_mean_squared_error_using_sliding_window(
+    preds: Array, target: Array, window_size: int = 8, return_rmse_map: bool = False
+):
+    """Parity: reference ``rmse_sw.py:74``."""
+    if not isinstance(window_size, int) or window_size < 1:
+        raise ValueError("Argument `window_size` is expected to be a positive integer.")
+    rmse_per_sample, rmse_map, _ = _rmse_sw_update(preds, target, window_size)
+    rmse = jnp.mean(rmse_per_sample)
+    if return_rmse_map:
+        return rmse, rmse_map
+    return rmse
+
+
+def _ergas_update(preds: Array, target: Array, ratio: float = 4.0) -> Array:
+    """Per-sample ERGAS. Parity: reference ``ergas.py:28``."""
+    _check_same_shape(preds, target)
+    if preds.ndim != 4:
+        raise ValueError(f"Expected `preds` and `target` to have BxCxHxW shape. Got preds: {preds.shape}.")
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    b, c, h, w = preds.shape
+    preds_f = preds.reshape(b, c, -1)
+    target_f = target.reshape(b, c, -1)
+    diff = preds_f - target_f
+    rmse_per_band = jnp.sqrt(jnp.mean(diff * diff, axis=-1))
+    mean_target = jnp.mean(target_f, axis=-1)
+    return 100.0 * ratio * jnp.sqrt(jnp.mean((rmse_per_band / mean_target) ** 2, axis=1))
+
+
+def error_relative_global_dimensionless_synthesis(
+    preds: Array, target: Array, ratio: float = 4.0, reduction: Optional[str] = "elementwise_mean"
+) -> Array:
+    """Parity: reference ``ergas.py:77``."""
+    scores = _ergas_update(preds, target, ratio)
+    if reduction == "elementwise_mean":
+        return jnp.mean(scores)
+    if reduction == "sum":
+        return jnp.sum(scores)
+    return scores
+
+
+def relative_average_spectral_error(preds: Array, target: Array, window_size: int = 8) -> Array:
+    """RASE. Parity: reference ``rase.py:54``."""
+    if not isinstance(window_size, int) or window_size < 1:
+        raise ValueError("Argument `window_size` is expected to be a positive integer.")
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    channel = preds.shape[1]
+    kernel = uniform_kernel_2d(channel, (window_size, window_size))
+    # per-window mean target and rmse per band
+    mean_target_map = depthwise_conv2d(target, kernel)  # (N,C,h',w')
+    mse_map = depthwise_conv2d((preds - target) ** 2, kernel)
+    rmse_map = jnp.sqrt(jnp.clip(mse_map, min=0.0))
+    # RASE = 100 / mu * sqrt(mean_over_bands(rmse^2)), averaged over windows
+    mu = jnp.mean(mean_target_map, axis=1, keepdims=True)
+    rase_map = 100.0 / mu * jnp.sqrt(jnp.mean(rmse_map**2, axis=1, keepdims=True))
+    return jnp.mean(rase_map)

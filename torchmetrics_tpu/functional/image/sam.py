@@ -1,0 +1,41 @@
+"""Spectral angle mapper.
+
+Parity: reference ``src/torchmetrics/functional/image/sam.py``.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _sam_update(preds: Array, target: Array):
+    _check_same_shape(preds, target)
+    if preds.ndim != 4:
+        raise ValueError(f"Expected `preds` and `target` to have BxCxHxW shape. Got preds: {preds.shape}.")
+    if preds.shape[1] <= 1:
+        raise ValueError("Expected channel dimension of `preds` and `target` to be larger than 1.")
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    dot_product = jnp.sum(preds * target, axis=1)
+    preds_norm = jnp.linalg.norm(preds, axis=1)
+    target_norm = jnp.linalg.norm(target, axis=1)
+    return jnp.arccos(jnp.clip(dot_product / (preds_norm * target_norm), -1.0, 1.0))
+
+
+def _sam_compute(sam_score: Array, reduction: Optional[str] = "elementwise_mean") -> Array:
+    if reduction == "elementwise_mean":
+        return jnp.mean(sam_score)
+    if reduction == "sum":
+        return jnp.sum(sam_score)
+    return sam_score
+
+
+def spectral_angle_mapper(
+    preds: Array, target: Array, reduction: Optional[str] = "elementwise_mean"
+) -> Array:
+    """Parity: reference ``sam.py:72``."""
+    return _sam_compute(_sam_update(preds, target), reduction)

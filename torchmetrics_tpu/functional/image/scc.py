@@ -1,0 +1,67 @@
+"""Spatial correlation coefficient.
+
+Parity: reference ``src/torchmetrics/functional/image/scc.py`` — high-pass
+filter (laplacian) then local window correlation.
+"""
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.checks import _check_same_shape
+from .helper import depthwise_conv2d, reflect_pad_2d
+
+Array = jax.Array
+
+_LAPLACIAN = jnp.asarray([[-1.0, -1.0, -1.0], [-1.0, 8.0, -1.0], [-1.0, -1.0, -1.0]])
+
+
+def _scc_per_channel(preds: Array, target: Array, hp_filter: Array, window_size: int) -> Array:
+    """preds/target: (N, 1, H, W) single channel."""
+    pad = (hp_filter.shape[0] - 1) // 2
+    kernel = hp_filter[None, None]
+    preds_hp = depthwise_conv2d(reflect_pad_2d(preds, pad, pad), kernel)
+    target_hp = depthwise_conv2d(reflect_pad_2d(target, pad, pad), kernel)
+
+    win = jnp.ones((1, 1, window_size, window_size))
+    n_w = window_size * window_size
+
+    def local_sum(x):
+        return depthwise_conv2d(x, win)
+
+    mu_p = local_sum(preds_hp) / n_w
+    mu_t = local_sum(target_hp) / n_w
+    var_p = local_sum(preds_hp**2) / n_w - mu_p**2
+    var_t = local_sum(target_hp**2) / n_w - mu_t**2
+    cov = local_sum(preds_hp * target_hp) / n_w - mu_p * mu_t
+    denom = var_p * var_t
+    scc = jnp.where(denom > 0, cov / jnp.sqrt(jnp.where(denom > 0, denom, 1.0)), 0.0)
+    return scc
+
+
+def spatial_correlation_coefficient(
+    preds: Array,
+    target: Array,
+    hp_filter: Optional[Array] = None,
+    window_size: int = 8,
+    reduction: Optional[str] = "mean",
+) -> Array:
+    """Parity: reference ``scc.py:135``."""
+    if hp_filter is None:
+        hp_filter = _LAPLACIAN
+    _check_same_shape(preds, target)
+    if preds.ndim == 3:
+        preds = preds[:, None]
+        target = target[:, None]
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    per_channel = [
+        _scc_per_channel(preds[:, i : i + 1], target[:, i : i + 1], hp_filter, window_size)
+        for i in range(preds.shape[1])
+    ]
+    scc = jnp.concatenate(per_channel, axis=1)
+    if reduction in ("mean", "elementwise_mean"):
+        return jnp.mean(scc)
+    if reduction == "none" or reduction is None:
+        return jnp.mean(scc, axis=(1, 2, 3))
+    raise ValueError(f"Expected reduction to be 'mean' or 'none' but got {reduction}")

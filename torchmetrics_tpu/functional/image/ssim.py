@@ -1,0 +1,199 @@
+"""Structural similarity (SSIM) & multi-scale SSIM.
+
+Parity: reference ``src/torchmetrics/functional/image/ssim.py`` (528 LoC):
+reflect-pad → depthwise gaussian/uniform conv → crop pad margins →
+per-sample mean; MS-SSIM via 2x avg-pool pyramid with standard betas.
+"""
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.checks import _check_same_shape
+from .helper import (
+    avg_pool2d,
+    depthwise_conv2d,
+    gaussian_kernel_2d,
+    reflect_pad_2d,
+    uniform_kernel_2d,
+)
+
+Array = jax.Array
+
+
+def _ssim_check_inputs(preds: Array, target: Array) -> Tuple[Array, Array]:
+    _check_same_shape(preds, target)
+    if preds.ndim != 4:
+        raise ValueError(
+            f"Expected `preds` and `target` to have BxCxHxW shape. Got preds: {preds.shape} and target: {target.shape}."
+        )
+    return preds.astype(jnp.float32), target.astype(jnp.float32)
+
+
+def _ssim_update(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    data_range: Optional[Union[float, Tuple[float, float]]] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    return_full_image: bool = False,
+    return_contrast_sensitivity: bool = False,
+):
+    """Per-sample SSIM. Parity: reference ``ssim.py:44-185``."""
+    if not isinstance(kernel_size, Sequence):
+        kernel_size = (kernel_size, kernel_size)
+    if not isinstance(sigma, Sequence):
+        sigma = (sigma, sigma)
+
+    if data_range is None:
+        data_range = jnp.max(jnp.stack([jnp.max(preds) - jnp.min(preds), jnp.max(target) - jnp.min(target)]))
+    elif isinstance(data_range, tuple):
+        preds = jnp.clip(preds, data_range[0], data_range[1])
+        target = jnp.clip(target, data_range[0], data_range[1])
+        data_range = data_range[1] - data_range[0]
+
+    c1 = (k1 * data_range) ** 2
+    c2 = (k2 * data_range) ** 2
+
+    channel = preds.shape[1]
+    pad_h = (kernel_size[0] - 1) // 2
+    pad_w = (kernel_size[1] - 1) // 2
+
+    preds_p = reflect_pad_2d(preds, pad_h, pad_w)
+    target_p = reflect_pad_2d(target, pad_h, pad_w)
+
+    if gaussian_kernel:
+        kernel = gaussian_kernel_2d(channel, kernel_size, sigma)
+    else:
+        kernel = uniform_kernel_2d(channel, kernel_size)
+
+    input_list = jnp.concatenate(
+        [preds_p, target_p, preds_p * preds_p, target_p * target_p, preds_p * target_p], axis=0
+    )
+    outputs = depthwise_conv2d(input_list, kernel)
+    n = preds.shape[0]
+    mu_pred = outputs[:n]
+    mu_target = outputs[n : 2 * n]
+    mu_pred_sq = mu_pred * mu_pred
+    mu_target_sq = mu_target * mu_target
+    mu_pred_target = mu_pred * mu_target
+
+    # no clamping: keeping the raw (possibly epsilon-negative) moment
+    # estimates preserves the exact sim==1 identity for identical inputs
+    sigma_pred_sq = outputs[2 * n : 3 * n] - mu_pred_sq
+    sigma_target_sq = outputs[3 * n : 4 * n] - mu_target_sq
+    sigma_pred_target = outputs[4 * n :] - mu_pred_target
+
+    upper = 2 * sigma_pred_target + c2
+    lower = sigma_pred_sq + sigma_target_sq + c2
+    ssim_full = ((2 * mu_pred_target + c1) * upper) / ((mu_pred_sq + mu_target_sq + c1) * lower)
+
+    ssim_idx = ssim_full[..., pad_h:-pad_h, pad_w:-pad_w] if pad_h and pad_w else ssim_full
+    per_sample = jnp.mean(ssim_idx.reshape(n, -1), axis=-1)
+
+    if return_contrast_sensitivity:
+        cs = upper / lower
+        cs = cs[..., pad_h:-pad_h, pad_w:-pad_w] if pad_h and pad_w else cs
+        return per_sample, jnp.mean(cs.reshape(n, -1), axis=-1)
+    if return_full_image:
+        return per_sample, ssim_full
+    return per_sample
+
+
+def _ssim_reduce(vals: Array, reduction: Optional[str]) -> Array:
+    if reduction == "elementwise_mean":
+        return jnp.mean(vals)
+    if reduction == "sum":
+        return jnp.sum(vals)
+    return vals
+
+
+def structural_similarity_index_measure(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    reduction: Optional[str] = "elementwise_mean",
+    data_range: Optional[Union[float, Tuple[float, float]]] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    return_full_image: bool = False,
+    return_contrast_sensitivity: bool = False,
+):
+    """Parity: reference ``ssim.py:187``."""
+    preds, target = _ssim_check_inputs(preds, target)
+    out = _ssim_update(
+        preds, target, gaussian_kernel, sigma, kernel_size, data_range, k1, k2,
+        return_full_image, return_contrast_sensitivity,
+    )
+    if isinstance(out, tuple):
+        return _ssim_reduce(out[0], reduction), out[1]
+    return _ssim_reduce(out, reduction)
+
+
+def _multiscale_ssim_update(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    data_range: Optional[Union[float, Tuple[float, float]]] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    betas: Sequence[float] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
+    normalize: Optional[str] = "relu",
+) -> Array:
+    """Per-sample MS-SSIM. Parity: reference ``ssim.py:322``."""
+    sim_list: List[Array] = []
+    cs_list: List[Array] = []
+    h, w = preds.shape[-2], preds.shape[-1]
+    k0 = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    min_size = (k0 - 1) * max(1, (len(betas) - 1)) ** 2
+    if h < min_size or w < min_size:
+        raise ValueError(
+            f"For a given number of `betas` parameters {len(betas)} and kernel size {k0}, the image height and "
+            f"width should be larger than {min_size}, but got height={h} and width={w}."
+        )
+    for i in range(len(betas)):
+        sim, cs = _ssim_update(
+            preds, target, gaussian_kernel, sigma, kernel_size, data_range, k1, k2,
+            return_contrast_sensitivity=True,
+        )
+        sim_list.append(sim)
+        cs_list.append(cs)
+        if i < len(betas) - 1:
+            preds = avg_pool2d(preds, 2)
+            target = avg_pool2d(target, 2)
+    sim_stack = jnp.stack(sim_list)  # (S, N)
+    cs_stack = jnp.stack(cs_list)
+    if normalize == "relu":
+        sim_stack = jax.nn.relu(sim_stack)
+        cs_stack = jax.nn.relu(cs_stack)
+    betas_arr = jnp.asarray(betas)[:, None]
+    mcs_and_ssim = jnp.concatenate([cs_stack[:-1], sim_stack[-1:]], axis=0)
+    return jnp.prod(mcs_and_ssim ** betas_arr, axis=0)
+
+
+def multiscale_structural_similarity_index_measure(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    reduction: Optional[str] = "elementwise_mean",
+    data_range: Optional[Union[float, Tuple[float, float]]] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    betas: Sequence[float] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
+    normalize: Optional[str] = "relu",
+) -> Array:
+    """Parity: reference ``ssim.py:533``."""
+    preds, target = _ssim_check_inputs(preds, target)
+    vals = _multiscale_ssim_update(
+        preds, target, gaussian_kernel, sigma, kernel_size, data_range, k1, k2, betas, normalize
+    )
+    return _ssim_reduce(vals, reduction)

@@ -1,0 +1,36 @@
+"""Total variation.
+
+Parity: reference ``src/torchmetrics/functional/image/tv.py``.
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _total_variation_update(img: Array) -> Tuple[Array, Array]:
+    if img.ndim != 4:
+        raise RuntimeError(f"Expected input `img` to be an 4D tensor, but got {img.shape}")
+    diff1 = img[..., 1:, :] - img[..., :-1, :]
+    diff2 = img[..., :, 1:] - img[..., :, :-1]
+    res1 = jnp.sum(jnp.abs(diff1), axis=(1, 2, 3))
+    res2 = jnp.sum(jnp.abs(diff2), axis=(1, 2, 3))
+    return res1 + res2, jnp.asarray(img.shape[0], dtype=jnp.float32)
+
+
+def _total_variation_compute(score: Array, num_elements: Array, reduction: Optional[str]) -> Array:
+    if reduction == "mean":
+        return jnp.sum(score) / num_elements
+    if reduction == "sum":
+        return jnp.sum(score)
+    if reduction is None or reduction == "none":
+        return score
+    raise ValueError("Expected argument `reduction` to either be 'sum', 'mean', 'none' or None")
+
+
+def total_variation(img: Array, reduction: Optional[str] = "sum") -> Array:
+    """Parity: reference ``tv.py:43``."""
+    score, num_elements = _total_variation_update(img)
+    return _total_variation_compute(score, num_elements, reduction)

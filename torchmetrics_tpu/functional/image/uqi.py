@@ -1,0 +1,75 @@
+"""Universal image quality index (UQI).
+
+Parity: reference ``src/torchmetrics/functional/image/uqi.py`` — SSIM with
+C1 = C2 = 0 computed with a gaussian window.
+"""
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.checks import _check_same_shape
+from .helper import depthwise_conv2d, gaussian_kernel_2d, reflect_pad_2d
+
+Array = jax.Array
+
+
+def _uqi_update(
+    preds: Array,
+    target: Array,
+    kernel_size: Sequence[int] = (11, 11),
+    sigma: Sequence[float] = (1.5, 1.5),
+) -> Array:
+    _check_same_shape(preds, target)
+    if preds.ndim != 4:
+        raise ValueError(f"Expected `preds` and `target` to have BxCxHxW shape. Got preds: {preds.shape}.")
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+
+    channel = preds.shape[1]
+    pad_h = (kernel_size[0] - 1) // 2
+    pad_w = (kernel_size[1] - 1) // 2
+    preds_p = reflect_pad_2d(preds, pad_h, pad_w)
+    target_p = reflect_pad_2d(target, pad_h, pad_w)
+    kernel = gaussian_kernel_2d(channel, kernel_size, sigma)
+
+    n = preds.shape[0]
+    input_list = jnp.concatenate(
+        [preds_p, target_p, preds_p * preds_p, target_p * target_p, preds_p * target_p], axis=0
+    )
+    outputs = depthwise_conv2d(input_list, kernel)
+    mu_pred = outputs[:n]
+    mu_target = outputs[n : 2 * n]
+    mu_pred_sq = mu_pred**2
+    mu_target_sq = mu_target**2
+    mu_pred_target = mu_pred * mu_target
+    sigma_pred_sq = outputs[2 * n : 3 * n] - mu_pred_sq
+    sigma_target_sq = outputs[3 * n : 4 * n] - mu_target_sq
+    sigma_pred_target = outputs[4 * n :] - mu_pred_target
+
+    upper = 2 * sigma_pred_target
+    lower = sigma_pred_sq + sigma_target_sq
+    eps = jnp.finfo(jnp.float32).eps
+    uqi_idx = ((2 * mu_pred_target) * upper) / ((mu_pred_sq + mu_target_sq) * lower + eps)
+    uqi_idx = uqi_idx[..., pad_h:-pad_h, pad_w:-pad_w] if pad_h and pad_w else uqi_idx
+    return jnp.mean(uqi_idx.reshape(n, -1), axis=-1)
+
+
+def _uqi_reduce(vals: Array, reduction: Optional[str]) -> Array:
+    if reduction == "elementwise_mean":
+        return jnp.mean(vals)
+    if reduction == "sum":
+        return jnp.sum(vals)
+    return vals
+
+
+def universal_image_quality_index(
+    preds: Array,
+    target: Array,
+    kernel_size: Sequence[int] = (11, 11),
+    sigma: Sequence[float] = (1.5, 1.5),
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """Parity: reference ``uqi.py:122``."""
+    vals = _uqi_update(preds, target, kernel_size, sigma)
+    return _uqi_reduce(vals, reduction)

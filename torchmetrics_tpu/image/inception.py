@@ -1,0 +1,66 @@
+"""Inception score — stored class-probability logits → marginal KL.
+
+Parity: reference ``src/torchmetrics/image/inception.py:34`` (218 LoC).
+"""
+from typing import Any, Callable, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..metric import Metric
+from ..utils.data import dim_zero_cat
+from .fid import _resolve_feature_extractor
+
+Array = jax.Array
+
+
+class InceptionScore(Metric):
+    higher_is_better = True
+    is_differentiable = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+    feature_network = "inception"
+    jittable = False
+
+    def __init__(
+        self,
+        feature: Union[str, int, Callable] = "logits_unbiased",
+        splits: int = 10,
+        normalize: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if isinstance(feature, (str, int)):
+            raise ModuleNotFoundError(
+                "InceptionScore with the pretrained InceptionV3 requires downloaded weights, which are not "
+                "available in this offline environment. Pass a callable mapping images to class logits."
+            )
+        self.inception = _resolve_feature_extractor(feature, "InceptionScore")
+        if not (isinstance(splits, int) and splits > 0):
+            raise ValueError("Integer input to argument `splits` must be larger than 0")
+        self.splits = splits
+        self.normalize = normalize
+        self.add_state("features", [], dist_reduce_fx="cat")
+
+    def update(self, imgs: Array) -> None:
+        features = jnp.asarray(self.inception(imgs)).astype(jnp.float32)
+        self.features.append(features)
+
+    def compute(self) -> Tuple[Array, Array]:
+        """Parity: reference ``inception.py:158``."""
+        features = dim_zero_cat(self.features)
+        # random permutation then split (reference shuffles with fixed generator)
+        idx = jnp.asarray(np.random.RandomState(42).permutation(features.shape[0]))
+        features = features[idx]
+        prob = jax.nn.softmax(features, axis=1)
+        log_prob = jax.nn.log_softmax(features, axis=1)
+
+        n = (features.shape[0] // self.splits) * self.splits
+        prob_s = prob[:n].reshape(self.splits, -1, prob.shape[-1])
+        log_prob_s = log_prob[:n].reshape(self.splits, -1, log_prob.shape[-1])
+
+        mean_prob = jnp.mean(prob_s, axis=1, keepdims=True)
+        kl = prob_s * (log_prob_s - jnp.log(jnp.clip(mean_prob, min=1e-20)))
+        kl = jnp.exp(jnp.mean(jnp.sum(kl, axis=2), axis=1))
+        return jnp.mean(kl), jnp.std(kl, ddof=1)

@@ -1,0 +1,75 @@
+"""Learned perceptual image patch similarity (LPIPS).
+
+Parity: reference ``src/torchmetrics/image/lpip.py`` (188 LoC) +
+``functional/image/lpips.py:258`` (vendored AlexNet/VGG16/Squeeze backbones +
+NetLinLayer heads shipped in-repo as ``.pth``).
+
+Offline-TPU note: the backbone weights (torchvision pretrained) cannot be
+downloaded here. The metric accepts ``net_type`` as a *callable*
+``(img1, img2) -> (N,) distances`` (e.g. a Flax LPIPS network with converted
+weights — see ``torchmetrics_tpu.models.lpips`` for the architecture and the
+weight-conversion utility); the string presets raise with guidance.
+"""
+from typing import Any, Callable, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..metric import Metric
+from ..utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class LearnedPerceptualImagePatchSimilarity(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    feature_network = "net"
+    jittable = False
+
+    def __init__(
+        self,
+        net_type: Union[str, Callable] = "alex",
+        reduction: str = "mean",
+        normalize: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if isinstance(net_type, str):
+            valid_net_type = ("vgg", "alex", "squeeze")
+            if net_type not in valid_net_type:
+                raise ValueError(f"Argument `net_type` must be one of {valid_net_type}, but got {net_type}.")
+            raise ModuleNotFoundError(
+                f"LPIPS with the pretrained `{net_type}` backbone requires torchvision weights that cannot be "
+                "downloaded in this offline environment. Pass a callable `(img1, img2) -> distances` instead "
+                "(see torchmetrics_tpu.models.lpips for the network definition and weight conversion)."
+            )
+        if not callable(net_type):
+            raise ValueError("Argument `net_type` must be a string preset or a callable")
+        self.net = net_type
+        valid_reduction = ("mean", "sum")
+        if reduction not in valid_reduction:
+            raise ValueError(f"Argument `reduction` must be one of {valid_reduction}, but got {reduction}")
+        self.reduction = reduction
+        if not isinstance(normalize, bool):
+            raise ValueError(f"Argument `normalize` should be a bool but got {normalize}")
+        self.normalize = normalize
+        self.add_state("sum_scores", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, img1: Array, img2: Array) -> None:
+        """Parity: reference ``lpip.py:154``."""
+        if self.normalize:  # [0,1] → [-1,1]
+            img1 = 2 * img1 - 1
+            img2 = 2 * img2 - 1
+        loss = jnp.asarray(self.net(img1, img2)).reshape(-1)
+        self.sum_scores = self.sum_scores + jnp.sum(loss)
+        self.total = self.total + loss.shape[0]
+
+    def compute(self) -> Array:
+        if self.reduction == "mean":
+            return self.sum_scores / self.total
+        return self.sum_scores

@@ -1,0 +1,77 @@
+"""PSNR class. Parity: reference ``src/torchmetrics/image/psnr.py`` (201 LoC)."""
+from typing import Any, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..functional.image.psnr import _psnr_compute, _psnr_update
+from ..metric import Metric
+from ..utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class PeakSignalNoiseRatio(Metric):
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(
+        self,
+        data_range: Optional[Union[float, Tuple[float, float]]] = None,
+        base: float = 10.0,
+        reduction: str = "elementwise_mean",
+        dim: Optional[Union[int, Tuple[int, ...]]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if dim is None and reduction != "elementwise_mean":
+            from ..utils.prints import rank_zero_warn
+
+            rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")
+        if dim is None:
+            self.add_state("sum_squared_error", jnp.asarray(0.0), dist_reduce_fx="sum")
+            self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+        else:
+            self.add_state("sum_squared_error", [], dist_reduce_fx="cat")
+            self.add_state("total", [], dist_reduce_fx="cat")
+        if data_range is None:
+            if dim is not None:
+                raise ValueError("The `data_range` must be given when `dim` is set.")
+            self.data_range = None
+            self.add_state("min_target", jnp.asarray(jnp.inf), dist_reduce_fx="min")
+            self.add_state("max_target", jnp.asarray(-jnp.inf), dist_reduce_fx="max")
+            self._clamp_range = None
+        elif isinstance(data_range, tuple):
+            self.data_range = jnp.asarray(data_range[1] - data_range[0])
+            self._clamp_range = data_range
+        else:
+            self.data_range = jnp.asarray(float(data_range))
+            self._clamp_range = None
+        self.base = base
+        self.reduction = reduction
+        self.dim = tuple(dim) if isinstance(dim, (list, tuple)) else dim
+
+    def update(self, preds: Array, target: Array) -> None:
+        if self._clamp_range is not None:
+            preds = jnp.clip(preds, *self._clamp_range)
+            target = jnp.clip(target, *self._clamp_range)
+        sum_squared_error, num_obs = _psnr_update(preds, target, self.dim)
+        if self.dim is None:
+            if self.data_range is None:
+                self.min_target = jnp.minimum(jnp.min(target), self.min_target)
+                self.max_target = jnp.maximum(jnp.max(target), self.max_target)
+            self.sum_squared_error = self.sum_squared_error + sum_squared_error
+            self.total = self.total + num_obs
+        else:
+            self.sum_squared_error.append(jnp.atleast_1d(sum_squared_error))
+            self.total.append(jnp.atleast_1d(num_obs))
+
+    def compute(self) -> Array:
+        data_range = self.data_range if self.data_range is not None else (self.max_target - self.min_target)
+        if self.dim is None:
+            return _psnr_compute(self.sum_squared_error, self.total, data_range, self.base, self.reduction)
+        return _psnr_compute(
+            dim_zero_cat(self.sum_squared_error), dim_zero_cat(self.total), data_range, self.base, self.reduction
+        )

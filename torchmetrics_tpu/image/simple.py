@@ -1,0 +1,295 @@
+"""TV / UQI / SAM / ERGAS / RASE / RMSE-SW / SCC / VIF / D-lambda / D-s / QNR classes.
+
+Parity: reference ``src/torchmetrics/image/{tv,uqi,sam,ergas,rase,rmse_sw,
+scc,vif,d_lambda,d_s,qnr}.py`` — each a thin shell over the functional kernel
+with per-sample cat states or running sums.
+"""
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..functional.image.d_lambda import (
+    spatial_distortion_index as _d_s_fn,
+    spectral_distortion_index as _d_lambda_fn,
+    quality_with_no_reference as _qnr_fn,
+)
+from ..functional.image.rmse_sw import (
+    _ergas_update,
+    _rmse_sw_update,
+    relative_average_spectral_error as _rase_fn,
+)
+from ..functional.image.sam import _sam_compute, _sam_update
+from ..functional.image.scc import spatial_correlation_coefficient as _scc_fn
+from ..functional.image.tv import _total_variation_compute, _total_variation_update
+from ..functional.image.uqi import _uqi_reduce, _uqi_update
+from ..functional.image.vif import visual_information_fidelity as _vif_fn
+from ..metric import Metric
+from ..utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class TotalVariation(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, reduction: Optional[str] = "sum", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if reduction is not None and reduction not in ("sum", "mean", "none"):
+            raise ValueError("Expected argument `reduction` to either be 'sum', 'mean', 'none' or None")
+        self.reduction = reduction
+        if self.reduction is None or self.reduction == "none":
+            self.add_state("score_list", [], dist_reduce_fx="cat")
+        else:
+            self.add_state("score", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("num_elements", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, img: Array) -> None:
+        score, num_elements = _total_variation_update(img)
+        if self.reduction is None or self.reduction == "none":
+            self.score_list.append(score)
+        else:
+            self.score = self.score + jnp.sum(score)
+        self.num_elements = self.num_elements + num_elements
+
+    def compute(self) -> Array:
+        if self.reduction is None or self.reduction == "none":
+            return dim_zero_cat(self.score_list)
+        return _total_variation_compute(self.score, self.num_elements, self.reduction)
+
+
+class UniversalImageQualityIndex(Metric):
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, kernel_size: Sequence[int] = (11, 11), sigma: Sequence[float] = (1.5, 1.5),
+                 reduction: Optional[str] = "elementwise_mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.kernel_size = kernel_size
+        self.sigma = sigma
+        self.reduction = reduction
+        self.add_state("vals", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        self.vals.append(_uqi_update(preds, target, self.kernel_size, self.sigma))
+
+    def compute(self) -> Array:
+        return _uqi_reduce(dim_zero_cat(self.vals), self.reduction)
+
+
+class SpectralAngleMapper(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, reduction: Optional[str] = "elementwise_mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.reduction = reduction
+        self.add_state("preds_sum", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("vals", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        score = _sam_update(preds, target)
+        self.vals.append(score.reshape(score.shape[0], -1))
+
+    def compute(self) -> Array:
+        return _sam_compute(dim_zero_cat(self.vals), self.reduction)
+
+
+class ErrorRelativeGlobalDimensionlessSynthesis(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, ratio: float = 4.0, reduction: Optional[str] = "elementwise_mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.ratio = ratio
+        self.reduction = reduction
+        self.add_state("vals", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        self.vals.append(_ergas_update(preds, target, self.ratio))
+
+    def compute(self) -> Array:
+        vals = dim_zero_cat(self.vals)
+        if self.reduction == "elementwise_mean":
+            return jnp.mean(vals)
+        if self.reduction == "sum":
+            return jnp.sum(vals)
+        return vals
+
+
+class RelativeAverageSpectralError(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, window_size: int = 8, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(window_size, int) or window_size < 1:
+            raise ValueError(f"Argument `window_size` is expected to be a positive integer, but got {window_size}")
+        self.window_size = window_size
+        self.add_state("vals", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        self.vals.append(jnp.atleast_1d(_rase_fn(preds, target, self.window_size)))
+
+    def compute(self) -> Array:
+        return jnp.mean(dim_zero_cat(self.vals))
+
+
+class RootMeanSquaredErrorUsingSlidingWindow(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, window_size: int = 8, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(window_size, int) or window_size < 1:
+            raise ValueError("Argument `window_size` is expected to be a positive integer.")
+        self.window_size = window_size
+        self.add_state("vals", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        rmse_per_sample, _, _ = _rmse_sw_update(preds, target, self.window_size)
+        self.vals.append(rmse_per_sample)
+
+    def compute(self) -> Array:
+        return jnp.mean(dim_zero_cat(self.vals))
+
+
+class SpatialCorrelationCoefficient(Metric):
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, hp_filter: Optional[Array] = None, window_size: int = 8, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.hp_filter = hp_filter
+        self.window_size = window_size
+        self.add_state("vals", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        self.vals.append(_scc_fn(preds, target, self.hp_filter, self.window_size, reduction="none"))
+
+    def compute(self) -> Array:
+        return jnp.mean(dim_zero_cat(self.vals))
+
+
+class VisualInformationFidelity(Metric):
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, sigma_n_sq: float = 2.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(sigma_n_sq, (float, int)) or sigma_n_sq < 0:
+            raise ValueError(f"Argument `sigma_n_sq` is expected to be a positive float or int, but got {sigma_n_sq}")
+        self.sigma_n_sq = float(sigma_n_sq)
+        self.add_state("vif_score", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        self.vif_score = self.vif_score + _vif_fn(preds, target, self.sigma_n_sq) * preds.shape[0]
+        self.total = self.total + preds.shape[0]
+
+    def compute(self) -> Array:
+        return self.vif_score / self.total
+
+
+class SpectralDistortionIndex(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, p: int = 1, reduction: str = "elementwise_mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.p = p
+        self.reduction = reduction
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Array:
+        return _d_lambda_fn(dim_zero_cat(self.preds), dim_zero_cat(self.target), self.p, self.reduction)
+
+
+class SpatialDistortionIndex(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, norm_order: int = 1, window_size: int = 7,
+                 reduction: str = "elementwise_mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.norm_order = norm_order
+        self.window_size = window_size
+        self.reduction = reduction
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("ms", [], dist_reduce_fx="cat")
+        self.add_state("pan", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: dict) -> None:
+        if not isinstance(target, dict) or "ms" not in target or "pan" not in target:
+            raise ValueError("Expected `target` to be a dict with keys 'ms' and 'pan'.")
+        self.preds.append(preds)
+        self.ms.append(target["ms"])
+        self.pan.append(target["pan"])
+
+    def compute(self) -> Array:
+        return _d_s_fn(
+            dim_zero_cat(self.preds), dim_zero_cat(self.ms), dim_zero_cat(self.pan), None,
+            self.norm_order, self.window_size, self.reduction,
+        )
+
+
+class QualityWithNoReference(Metric):
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, alpha: float = 1.0, beta: float = 1.0, norm_order: int = 1, window_size: int = 7,
+                 reduction: str = "elementwise_mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.alpha = alpha
+        self.beta = beta
+        self.norm_order = norm_order
+        self.window_size = window_size
+        self.reduction = reduction
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("ms", [], dist_reduce_fx="cat")
+        self.add_state("pan", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: dict) -> None:
+        if not isinstance(target, dict) or "ms" not in target or "pan" not in target:
+            raise ValueError("Expected `target` to be a dict with keys 'ms' and 'pan'.")
+        self.preds.append(preds)
+        self.ms.append(target["ms"])
+        self.pan.append(target["pan"])
+
+    def compute(self) -> Array:
+        return _qnr_fn(
+            dim_zero_cat(self.preds), dim_zero_cat(self.ms), dim_zero_cat(self.pan), None,
+            self.alpha, self.beta, self.norm_order, self.window_size, self.reduction,
+        )
