@@ -1,0 +1,197 @@
+"""Audio metrics vs hand-numpy / reference oracles.
+
+Parity model: reference ``tests/unittests/audio/``. SDR oracle values were
+computed with the reference implementation (``functional/audio/sdr.py``,
+torch CPU, filter_length=128) on the same seeded inputs.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.audio import (
+    PermutationInvariantTraining,
+    ScaleInvariantSignalDistortionRatio,
+    ScaleInvariantSignalNoiseRatio,
+    SignalDistortionRatio,
+    SignalNoiseRatio,
+    SourceAggregatedSignalDistortionRatio,
+)
+from torchmetrics_tpu.functional.audio import (
+    complex_scale_invariant_signal_noise_ratio,
+    permutation_invariant_training,
+    pit_permutate,
+    scale_invariant_signal_distortion_ratio,
+    scale_invariant_signal_noise_ratio,
+    signal_distortion_ratio,
+    signal_noise_ratio,
+    source_aggregated_signal_distortion_ratio,
+)
+
+rng = np.random.RandomState(42)
+TARGET = rng.randn(3, 1000).astype(np.float32)
+PREDS = (TARGET + 0.3 * rng.randn(3, 1000)).astype(np.float32)
+
+REF_SDR = [10.59004, 10.98473, 10.69772]
+REF_SDR_ZM = [10.59214, 10.98505, 10.70876]
+
+
+def np_snr(preds, target, zero_mean=False):
+    if zero_mean:
+        target = target - target.mean(-1, keepdims=True)
+        preds = preds - preds.mean(-1, keepdims=True)
+    return 10 * np.log10((target**2).sum(-1) / ((target - preds) ** 2).sum(-1))
+
+
+def np_si_sdr(preds, target, zero_mean=False):
+    if zero_mean:
+        target = target - target.mean(-1, keepdims=True)
+        preds = preds - preds.mean(-1, keepdims=True)
+    alpha = (preds * target).sum(-1, keepdims=True) / (target**2).sum(-1, keepdims=True)
+    t = alpha * target
+    return 10 * np.log10((t**2).sum(-1) / ((t - preds) ** 2).sum(-1))
+
+
+def test_snr():
+    res = np.asarray(signal_noise_ratio(jnp.asarray(PREDS), jnp.asarray(TARGET)))
+    np.testing.assert_allclose(res, np_snr(PREDS, TARGET), rtol=1e-4)
+    res_zm = np.asarray(signal_noise_ratio(jnp.asarray(PREDS), jnp.asarray(TARGET), zero_mean=True))
+    np.testing.assert_allclose(res_zm, np_snr(PREDS, TARGET, True), rtol=1e-4)
+
+
+def test_si_snr_si_sdr():
+    res = np.asarray(scale_invariant_signal_noise_ratio(jnp.asarray(PREDS), jnp.asarray(TARGET)))
+    np.testing.assert_allclose(res, np_si_sdr(PREDS, TARGET, zero_mean=True), rtol=1e-4)
+    res2 = np.asarray(scale_invariant_signal_distortion_ratio(jnp.asarray(PREDS), jnp.asarray(TARGET)))
+    np.testing.assert_allclose(res2, np_si_sdr(PREDS, TARGET), rtol=1e-4)
+
+
+def test_sdr_vs_reference():
+    res = np.asarray(signal_distortion_ratio(jnp.asarray(PREDS), jnp.asarray(TARGET), filter_length=128))
+    np.testing.assert_allclose(res, REF_SDR, atol=5e-3)
+    res_zm = np.asarray(
+        signal_distortion_ratio(
+            jnp.asarray(PREDS), jnp.asarray(TARGET), filter_length=128, zero_mean=True, load_diag=1e-6
+        )
+    )
+    np.testing.assert_allclose(res_zm, REF_SDR_ZM, atol=5e-3)
+
+
+def test_sa_sdr():
+    preds = PREDS.reshape(1, 3, 1000)
+    target = TARGET.reshape(1, 3, 1000)
+    res = float(source_aggregated_signal_distortion_ratio(jnp.asarray(preds), jnp.asarray(target))[0])
+    # oracle: common alpha over speakers
+    alpha = (preds * target).sum() / (target**2).sum()
+    t = alpha * target
+    ref = 10 * np.log10((t**2).sum() / ((t - preds) ** 2).sum())
+    np.testing.assert_allclose(res, ref, rtol=1e-4)
+
+
+def test_c_si_snr():
+    spec_t = rng.randn(2, 64, 20, 2).astype(np.float32)
+    spec_p = (spec_t + 0.2 * rng.randn(2, 64, 20, 2)).astype(np.float32)
+    res = np.asarray(complex_scale_invariant_signal_noise_ratio(jnp.asarray(spec_p), jnp.asarray(spec_t)))
+    ref = np_si_sdr(spec_p.reshape(2, -1), spec_t.reshape(2, -1))
+    np.testing.assert_allclose(res, ref, rtol=1e-4)
+
+
+@pytest.mark.parametrize("spk", [2, 3, 4])
+def test_pit(spk):
+    t = rng.randn(4, spk, 200).astype(np.float32)
+    perm = rng.permutation(spk)
+    p = (t[:, perm, :] + 0.1 * rng.randn(4, spk, 200)).astype(np.float32)
+    best, best_perm = permutation_invariant_training(
+        jnp.asarray(p), jnp.asarray(t), scale_invariant_signal_noise_ratio
+    )
+    # the recovered permutation must map preds back onto targets
+    restored = pit_permutate(jnp.asarray(p), best_perm)
+    # oracle: brute force
+    from itertools import permutations
+
+    for b in range(4):
+        vals = []
+        for pm in permutations(range(spk)):
+            v = np_si_sdr(p[b, list(pm)], t[b], zero_mean=True).mean()
+            vals.append(v)
+        np.testing.assert_allclose(float(best[b]), max(vals), rtol=1e-3)
+    assert restored.shape == p.shape
+
+
+def test_pit_permutation_wise_and_min():
+    t = rng.randn(4, 2, 100).astype(np.float32)
+    p = (t + 0.5 * rng.randn(4, 2, 100)).astype(np.float32)
+
+    def neg_mse(preds, target):
+        return ((preds - target) ** 2).mean(axis=(-1, -2))
+
+    best, _ = permutation_invariant_training(
+        jnp.asarray(p), jnp.asarray(t), neg_mse, mode="permutation-wise", eval_func="min"
+    )
+    from itertools import permutations
+
+    for b in range(4):
+        vals = [((p[b] - t[b][list(pm)]) ** 2).mean() for pm in permutations(range(2))]
+        np.testing.assert_allclose(float(best[b]), min(vals), rtol=1e-4)
+
+
+CLASS_CASES = [
+    (SignalNoiseRatio, {}, lambda p, t: np_snr(p, t).mean()),
+    (ScaleInvariantSignalNoiseRatio, {}, lambda p, t: np_si_sdr(p, t, True).mean()),
+    (ScaleInvariantSignalDistortionRatio, {}, lambda p, t: np_si_sdr(p, t).mean()),
+]
+
+
+@pytest.mark.parametrize(("cls", "kwargs", "oracle"), CLASS_CASES)
+def test_class_accumulate(cls, kwargs, oracle):
+    metric = cls(**kwargs)
+    metric.update(jnp.asarray(PREDS[:2]), jnp.asarray(TARGET[:2]))
+    metric.update(jnp.asarray(PREDS[2:]), jnp.asarray(TARGET[2:]))
+    np.testing.assert_allclose(float(metric.compute()), oracle(PREDS, TARGET), rtol=1e-4)
+
+
+def test_sdr_class():
+    metric = SignalDistortionRatio(filter_length=128)
+    metric.update(jnp.asarray(PREDS), jnp.asarray(TARGET))
+    np.testing.assert_allclose(float(metric.compute()), np.mean(REF_SDR), atol=5e-3)
+
+
+def test_sa_sdr_class():
+    metric = SourceAggregatedSignalDistortionRatio()
+    metric.update(jnp.asarray(PREDS.reshape(1, 3, -1)), jnp.asarray(TARGET.reshape(1, 3, -1)))
+    assert np.isfinite(float(metric.compute()))
+
+
+def test_pit_class():
+    t = rng.randn(4, 2, 100).astype(np.float32)
+    p = (t[:, ::-1, :] + 0.1 * rng.randn(4, 2, 100)).astype(np.float32)
+    metric = PermutationInvariantTraining(scale_invariant_signal_noise_ratio)
+    metric.update(jnp.asarray(p), jnp.asarray(t))
+    best, _ = permutation_invariant_training(jnp.asarray(p), jnp.asarray(t), scale_invariant_signal_noise_ratio)
+    np.testing.assert_allclose(float(metric.compute()), float(jnp.mean(best)), rtol=1e-5)
+
+
+def test_gated_metrics_raise():
+    from torchmetrics_tpu.functional.audio.gated import _PESQ_AVAILABLE, _PYSTOI_AVAILABLE
+
+    if not _PESQ_AVAILABLE:
+        from torchmetrics_tpu.audio import PerceptualEvaluationSpeechQuality
+
+        with pytest.raises(ModuleNotFoundError, match="PESQ"):
+            PerceptualEvaluationSpeechQuality(16000, "wb")
+    if not _PYSTOI_AVAILABLE:
+        from torchmetrics_tpu.audio import ShortTimeObjectiveIntelligibility
+
+        with pytest.raises(ModuleNotFoundError, match="STOI"):
+            ShortTimeObjectiveIntelligibility(16000)
+
+
+def test_ddp_merge_states_audio():
+    full = SignalNoiseRatio()
+    full.update(jnp.asarray(PREDS), jnp.asarray(TARGET))
+    ref = float(full.compute())
+    r0, r1 = SignalNoiseRatio(), SignalNoiseRatio()
+    r0.update(jnp.asarray(PREDS[:2]), jnp.asarray(TARGET[:2]))
+    r1.update(jnp.asarray(PREDS[2:]), jnp.asarray(TARGET[2:]))
+    merged = r0.merge_states([r0.metric_state, r1.metric_state])
+    np.testing.assert_allclose(float(r0.compute_state(merged)), ref, atol=1e-5)

@@ -1,0 +1,159 @@
+"""Clustering metrics vs sklearn oracles.
+
+Parity model: reference ``tests/unittests/clustering/``.
+"""
+import numpy as np
+import pytest
+from sklearn import metrics as skm
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.clustering import (
+    AdjustedMutualInfoScore,
+    AdjustedRandScore,
+    CalinskiHarabaszScore,
+    CompletenessScore,
+    DaviesBouldinScore,
+    DunnIndex,
+    FowlkesMallowsIndex,
+    HomogeneityScore,
+    MutualInfoScore,
+    NormalizedMutualInfoScore,
+    RandScore,
+    VMeasureScore,
+)
+from torchmetrics_tpu.functional.clustering import (
+    adjusted_mutual_info_score,
+    adjusted_rand_score,
+    calinski_harabasz_score,
+    davies_bouldin_score,
+    dunn_index,
+    fowlkes_mallows_index,
+    mutual_info_score,
+    normalized_mutual_info_score,
+    rand_score,
+    v_measure_score,
+)
+
+rng = np.random.RandomState(3)
+N = 200
+PREDS = rng.randint(0, 6, size=N)
+TARGET = rng.randint(0, 4, size=N)
+DATA = rng.randn(N, 5).astype(np.float32) + PREDS[:, None].astype(np.float32) * 1.5
+
+
+LABEL_CASES = [
+    (mutual_info_score, lambda t, p: skm.mutual_info_score(t, p)),
+    (adjusted_mutual_info_score, lambda t, p: skm.adjusted_mutual_info_score(t, p)),
+    (normalized_mutual_info_score, lambda t, p: skm.normalized_mutual_info_score(t, p)),
+    (rand_score, lambda t, p: skm.rand_score(t, p)),
+    (adjusted_rand_score, lambda t, p: skm.adjusted_rand_score(t, p)),
+    (fowlkes_mallows_index, lambda t, p: skm.fowlkes_mallows_score(t, p)),
+    (v_measure_score, lambda t, p: skm.v_measure_score(t, p)),
+]
+
+
+@pytest.mark.parametrize(("fn", "sk_fn"), LABEL_CASES)
+def test_functional_label_metrics(fn, sk_fn):
+    res = float(fn(jnp.asarray(PREDS), jnp.asarray(TARGET)))
+    ref = float(sk_fn(TARGET, PREDS))
+    np.testing.assert_allclose(res, ref, atol=1e-4, rtol=1e-4, err_msg=fn.__name__)
+
+
+@pytest.mark.parametrize("method", ["min", "geometric", "arithmetic", "max"])
+def test_ami_nmi_average_methods(method):
+    res = float(adjusted_mutual_info_score(jnp.asarray(PREDS), jnp.asarray(TARGET), method))
+    ref = float(skm.adjusted_mutual_info_score(TARGET, PREDS, average_method=method))
+    np.testing.assert_allclose(res, ref, atol=1e-4, rtol=1e-4)
+    res = float(normalized_mutual_info_score(jnp.asarray(PREDS), jnp.asarray(TARGET), method))
+    ref = float(skm.normalized_mutual_info_score(TARGET, PREDS, average_method=method))
+    np.testing.assert_allclose(res, ref, atol=1e-4, rtol=1e-4)
+
+
+def test_homogeneity_completeness():
+    from torchmetrics_tpu.functional.clustering import completeness_score, homogeneity_score
+
+    np.testing.assert_allclose(
+        float(homogeneity_score(jnp.asarray(PREDS), jnp.asarray(TARGET))),
+        float(skm.homogeneity_score(TARGET, PREDS)), atol=1e-4)
+    np.testing.assert_allclose(
+        float(completeness_score(jnp.asarray(PREDS), jnp.asarray(TARGET))),
+        float(skm.completeness_score(TARGET, PREDS)), atol=1e-4)
+
+
+def test_functional_intrinsic():
+    np.testing.assert_allclose(
+        float(calinski_harabasz_score(jnp.asarray(DATA), jnp.asarray(PREDS))),
+        float(skm.calinski_harabasz_score(DATA, PREDS)), rtol=1e-4)
+    np.testing.assert_allclose(
+        float(davies_bouldin_score(jnp.asarray(DATA), jnp.asarray(PREDS))),
+        float(skm.davies_bouldin_score(DATA, PREDS)), rtol=1e-4)
+    # dunn index: hand-computed oracle (centroid distances / max dist to centroid)
+    centroids = np.stack([DATA[PREDS == c].mean(0) for c in np.unique(PREDS)])
+    inter = min(
+        np.linalg.norm(a - b)
+        for i, a in enumerate(centroids)
+        for j, b in enumerate(centroids)
+        if i != j
+    )
+    intra = max(
+        np.linalg.norm(DATA[PREDS == c] - centroids[i], axis=1).max()
+        for i, c in enumerate(np.unique(PREDS))
+    )
+    np.testing.assert_allclose(
+        float(dunn_index(jnp.asarray(DATA), jnp.asarray(PREDS))), inter / intra, rtol=1e-4)
+
+
+CLASS_CASES = [
+    (MutualInfoScore, lambda t, p: skm.mutual_info_score(t, p)),
+    (AdjustedMutualInfoScore, lambda t, p: skm.adjusted_mutual_info_score(t, p)),
+    (NormalizedMutualInfoScore, lambda t, p: skm.normalized_mutual_info_score(t, p)),
+    (RandScore, lambda t, p: skm.rand_score(t, p)),
+    (AdjustedRandScore, lambda t, p: skm.adjusted_rand_score(t, p)),
+    (FowlkesMallowsIndex, lambda t, p: skm.fowlkes_mallows_score(t, p)),
+    (HomogeneityScore, lambda t, p: skm.homogeneity_score(t, p)),
+    (CompletenessScore, lambda t, p: skm.completeness_score(t, p)),
+    (VMeasureScore, lambda t, p: skm.v_measure_score(t, p)),
+]
+
+
+@pytest.mark.parametrize(("cls", "sk_fn"), CLASS_CASES)
+def test_class_accumulate(cls, sk_fn):
+    metric = cls()
+    for i in range(4):
+        sl = slice(i * (N // 4), (i + 1) * (N // 4))
+        metric.update(jnp.asarray(PREDS[sl]), jnp.asarray(TARGET[sl]))
+    np.testing.assert_allclose(float(metric.compute()), float(sk_fn(TARGET, PREDS)),
+                               atol=1e-4, rtol=1e-4, err_msg=cls.__name__)
+
+
+@pytest.mark.parametrize(
+    ("cls", "sk_fn"),
+    [
+        (CalinskiHarabaszScore, skm.calinski_harabasz_score),
+        (DaviesBouldinScore, skm.davies_bouldin_score),
+    ],
+)
+def test_class_embedding(cls, sk_fn):
+    metric = cls()
+    for i in range(2):
+        sl = slice(i * (N // 2), (i + 1) * (N // 2))
+        metric.update(jnp.asarray(DATA[sl]), jnp.asarray(PREDS[sl]))
+    np.testing.assert_allclose(float(metric.compute()), float(sk_fn(DATA, PREDS)), rtol=1e-4)
+
+
+def test_dunn_index_class():
+    metric = DunnIndex()
+    metric.update(jnp.asarray(DATA), jnp.asarray(PREDS))
+    assert float(metric.compute()) > 0
+
+
+def test_ddp_merge_states():
+    full = RandScore()
+    full.update(jnp.asarray(PREDS), jnp.asarray(TARGET))
+    ref = float(full.compute())
+    r0, r1 = RandScore(), RandScore()
+    r0.update(jnp.asarray(PREDS[: N // 2]), jnp.asarray(TARGET[: N // 2]))
+    r1.update(jnp.asarray(PREDS[N // 2 :]), jnp.asarray(TARGET[N // 2 :]))
+    merged = r0.merge_states([r0.metric_state, r1.metric_state])
+    np.testing.assert_allclose(float(r0.compute_state(merged)), ref, atol=1e-6)

@@ -7,8 +7,10 @@ no process spawn at all (SURVEY.md §4 "TPU-framework translation").
 import os
 import random
 
-# must happen before jax import anywhere in the test session
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# must happen before jax import anywhere in the test session; force CPU even
+# when the environment preset JAX_PLATFORMS (e.g. an attached TPU via axon) —
+# tests are numerics-parity checks and must run fp32, not bf16 matmuls
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

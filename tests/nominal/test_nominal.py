@@ -1,0 +1,211 @@
+"""Nominal association metrics vs hand-numpy/scipy oracles.
+
+Parity model: reference ``tests/unittests/nominal/`` (which compares against
+``dython`` / ``pandas`` implementations; here the oracles are direct numpy
+transcriptions of the published formulas).
+"""
+import numpy as np
+import pytest
+import scipy.stats
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.nominal import (
+    cramers_v,
+    cramers_v_matrix,
+    fleiss_kappa,
+    pearsons_contingency_coefficient,
+    theils_u,
+    tschuprows_t,
+)
+from torchmetrics_tpu.nominal import (
+    CramersV,
+    FleissKappa,
+    PearsonsContingencyCoefficient,
+    TheilsU,
+    TschuprowsT,
+)
+
+rng = np.random.RandomState(11)
+N = 400
+NUM_CLASSES = 4
+PREDS = rng.randint(0, NUM_CLASSES, size=N)
+TARGET = np.where(rng.rand(N) < 0.6, PREDS, rng.randint(0, NUM_CLASSES, size=N))
+
+
+def np_confmat(p, t, c):
+    m = np.zeros((c, c))
+    for a, b in zip(p, t):
+        m[a, b] += 1
+    return m
+
+
+def np_chi2(confmat, bias_correction):
+    rows, cols = confmat.sum(1), confmat.sum(0)
+    n = confmat.sum()
+    expected = np.outer(rows, cols) / n
+    r, c = confmat.shape
+    df = r * c - r - c + 1
+    if df == 0:
+        return 0.0
+    if df == 1 and bias_correction:
+        diff = expected - confmat
+        confmat = confmat + np.sign(diff) * np.minimum(0.5, np.abs(diff))
+    return float(((confmat - expected) ** 2 / expected).sum())
+
+
+def np_cramers_v(p, t, bias_correction=True):
+    m = np_confmat(p, t, NUM_CLASSES)
+    m = m[m.sum(1) != 0][:, m.sum(0) != 0]
+    n = m.sum()
+    phi2 = np_chi2(m, bias_correction) / n
+    r, c = m.shape
+    if bias_correction:
+        phi2c = max(0.0, phi2 - (r - 1) * (c - 1) / (n - 1))
+        rc = r - (r - 1) ** 2 / (n - 1)
+        cc = c - (c - 1) ** 2 / (n - 1)
+        return np.clip(np.sqrt(phi2c / min(rc - 1, cc - 1)), 0, 1)
+    return np.clip(np.sqrt(phi2 / min(r - 1, c - 1)), 0, 1)
+
+
+def np_tschuprows_t(p, t, bias_correction=True):
+    m = np_confmat(p, t, NUM_CLASSES)
+    m = m[m.sum(1) != 0][:, m.sum(0) != 0]
+    n = m.sum()
+    phi2 = np_chi2(m, bias_correction) / n
+    r, c = m.shape
+    if bias_correction:
+        phi2c = max(0.0, phi2 - (r - 1) * (c - 1) / (n - 1))
+        rc = r - (r - 1) ** 2 / (n - 1)
+        cc = c - (c - 1) ** 2 / (n - 1)
+        return np.clip(np.sqrt(phi2c / np.sqrt((rc - 1) * (cc - 1))), 0, 1)
+    return np.clip(np.sqrt(phi2 / np.sqrt((r - 1) * (c - 1))), 0, 1)
+
+
+def np_pearson_cc(p, t):
+    m = np_confmat(p, t, NUM_CLASSES)
+    m = m[m.sum(1) != 0][:, m.sum(0) != 0]
+    phi2 = np_chi2(m, False) / m.sum()
+    return np.clip(np.sqrt(phi2 / (1 + phi2)), 0, 1)
+
+
+def np_theils_u(p, t):
+    # U(X|Y): fraction of entropy of X (target) explained by Y (preds)
+    def entropy(labels):
+        _, counts = np.unique(labels, return_counts=True)
+        pr = counts / counts.sum()
+        return -np.sum(pr * np.log(pr))
+
+    s_x = entropy(t)
+    if s_x == 0:
+        return 0.0
+    # conditional entropy H(X|Y)
+    s_xy = 0.0
+    for y in np.unique(p):
+        sel = p == y
+        w = sel.mean()
+        s_xy += w * entropy(t[sel])
+    return (s_x - s_xy) / s_x
+
+
+def np_fleiss(counts):
+    total = counts.shape[0]
+    num_raters = counts.sum(1).max()
+    p_i = counts.sum(0) / (total * num_raters)
+    p_j = ((counts**2).sum(1) - num_raters) / (num_raters * (num_raters - 1))
+    return (p_j.mean() - (p_i**2).sum()) / (1 - (p_i**2).sum() + 1e-5)
+
+
+@pytest.mark.parametrize("bias_correction", [True, False])
+def test_cramers_v(bias_correction):
+    res = float(cramers_v(jnp.asarray(PREDS), jnp.asarray(TARGET), bias_correction))
+    np.testing.assert_allclose(res, np_cramers_v(PREDS, TARGET, bias_correction), atol=1e-4)
+
+
+@pytest.mark.parametrize("bias_correction", [True, False])
+def test_tschuprows_t(bias_correction):
+    res = float(tschuprows_t(jnp.asarray(PREDS), jnp.asarray(TARGET), bias_correction))
+    np.testing.assert_allclose(res, np_tschuprows_t(PREDS, TARGET, bias_correction), atol=1e-4)
+
+
+def test_pearsons_contingency_coefficient():
+    res = float(pearsons_contingency_coefficient(jnp.asarray(PREDS), jnp.asarray(TARGET)))
+    np.testing.assert_allclose(res, np_pearson_cc(PREDS, TARGET), atol=1e-4)
+    # cross-check chi2 against scipy on the same table
+    m = np_confmat(PREDS, TARGET, NUM_CLASSES)
+    chi2 = scipy.stats.chi2_contingency(m, correction=False)[0]
+    np.testing.assert_allclose(np_chi2(m, False), chi2, rtol=1e-6)
+
+
+def test_theils_u():
+    res = float(theils_u(jnp.asarray(PREDS), jnp.asarray(TARGET)))
+    np.testing.assert_allclose(res, np_theils_u(PREDS, TARGET), atol=1e-4)
+
+
+def test_fleiss_kappa_counts_and_probs():
+    counts = rng.multinomial(10, [0.3, 0.4, 0.3], size=50)
+    res = float(fleiss_kappa(jnp.asarray(counts)))
+    np.testing.assert_allclose(res, np_fleiss(counts.astype(float)), atol=1e-4)
+
+    probs = rng.rand(20, 4, 6).astype(np.float32)
+    res_p = float(fleiss_kappa(jnp.asarray(probs), mode="probs"))
+    chosen = probs.argmax(1)
+    counts_p = np.stack([(chosen == c).sum(1) for c in range(4)], axis=1)
+    np.testing.assert_allclose(res_p, np_fleiss(counts_p.astype(float)), atol=1e-4)
+
+
+def test_nan_handling():
+    p = PREDS.astype(np.float32).copy()
+    p[::17] = np.nan
+    res_rep = float(cramers_v(jnp.asarray(p), jnp.asarray(TARGET.astype(np.float32)), True, "replace", 0.0))
+    p_rep = np.nan_to_num(p, nan=0.0).astype(int)
+    np.testing.assert_allclose(res_rep, np_cramers_v(p_rep, TARGET), atol=1e-4)
+
+    res_drop = float(cramers_v(jnp.asarray(p), jnp.asarray(TARGET.astype(np.float32)), True, "drop"))
+    keep = ~np.isnan(p)
+    np.testing.assert_allclose(res_drop, np_cramers_v(p[keep].astype(int), TARGET[keep]), atol=1e-4)
+
+
+def test_matrix_form():
+    mat = np.stack([PREDS, TARGET, rng.randint(0, 3, N)], axis=1)
+    out = np.asarray(cramers_v_matrix(jnp.asarray(mat)))
+    assert out.shape == (3, 3)
+    np.testing.assert_allclose(np.diag(out), 1.0)
+    np.testing.assert_allclose(out[0, 1], np_cramers_v(PREDS, TARGET), atol=1e-4)
+
+
+CLASS_CASES = [
+    (CramersV, np_cramers_v, {"num_classes": NUM_CLASSES}),
+    (TschuprowsT, np_tschuprows_t, {"num_classes": NUM_CLASSES}),
+    (PearsonsContingencyCoefficient, np_pearson_cc, {"num_classes": NUM_CLASSES}),
+    (TheilsU, np_theils_u, {"num_classes": NUM_CLASSES}),
+]
+
+
+@pytest.mark.parametrize(("cls", "oracle", "kwargs"), CLASS_CASES)
+def test_class_accumulate(cls, oracle, kwargs):
+    metric = cls(**kwargs)
+    for i in range(4):
+        sl = slice(i * (N // 4), (i + 1) * (N // 4))
+        metric.update(jnp.asarray(PREDS[sl]), jnp.asarray(TARGET[sl]))
+    np.testing.assert_allclose(float(metric.compute()), oracle(PREDS, TARGET),
+                               atol=1e-4, err_msg=cls.__name__)
+
+
+def test_fleiss_class():
+    counts = rng.multinomial(10, [0.25, 0.25, 0.5], size=60)
+    metric = FleissKappa()
+    metric.update(jnp.asarray(counts[:30]))
+    metric.update(jnp.asarray(counts[30:]))
+    np.testing.assert_allclose(float(metric.compute()), np_fleiss(counts.astype(float)), atol=1e-4)
+
+
+def test_ddp_merge_states():
+    full = CramersV(num_classes=NUM_CLASSES)
+    full.update(jnp.asarray(PREDS), jnp.asarray(TARGET))
+    ref = float(full.compute())
+    r0, r1 = CramersV(num_classes=NUM_CLASSES), CramersV(num_classes=NUM_CLASSES)
+    r0.update(jnp.asarray(PREDS[: N // 2]), jnp.asarray(TARGET[: N // 2]))
+    r1.update(jnp.asarray(PREDS[N // 2 :]), jnp.asarray(TARGET[N // 2 :]))
+    merged = r0.merge_states([r0.metric_state, r1.metric_state])
+    np.testing.assert_allclose(float(r0.compute_state(merged)), ref, atol=1e-6)

@@ -1,0 +1,312 @@
+"""Retrieval metrics vs per-query numpy/sklearn oracles.
+
+Parity model: reference ``tests/unittests/retrieval/`` — every metric is the
+aggregation over query groups of a single-query reference function.
+"""
+import numpy as np
+import pytest
+from sklearn.metrics import average_precision_score, ndcg_score, roc_auc_score
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.retrieval import (
+    retrieval_auroc,
+    retrieval_average_precision,
+    retrieval_fall_out,
+    retrieval_hit_rate,
+    retrieval_normalized_dcg,
+    retrieval_precision,
+    retrieval_precision_recall_curve,
+    retrieval_r_precision,
+    retrieval_recall,
+    retrieval_reciprocal_rank,
+)
+from torchmetrics_tpu.retrieval import (
+    RetrievalAUROC,
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalPrecisionRecallCurve,
+    RetrievalRecall,
+    RetrievalRecallAtFixedPrecision,
+    RetrievalRPrecision,
+)
+
+rng = np.random.RandomState(7)
+N = 256
+INDEXES = rng.randint(0, 20, size=N)
+PREDS = rng.rand(N).astype(np.float32)
+TARGET = (rng.rand(N) > 0.6).astype(np.int64)
+GRADED = rng.randint(0, 4, size=N)
+
+
+# ---------------- single-query numpy oracles ----------------
+def np_ap(preds, target, top_k=None):
+    k = top_k or len(preds)
+    order = np.argsort(-preds, kind="stable")[:k]
+    t = target[order]
+    if t.sum() == 0:
+        return 0.0
+    prec = np.cumsum(t) / np.arange(1, len(t) + 1)
+    return float((prec * t).sum() / t.sum())
+
+
+def np_mrr(preds, target, top_k=None):
+    k = top_k or len(preds)
+    t = target[np.argsort(-preds, kind="stable")[:k]]
+    pos = np.nonzero(t)[0]
+    return float(1.0 / (pos[0] + 1)) if len(pos) else 0.0
+
+
+def np_precision(preds, target, top_k=None, adaptive_k=False):
+    n = len(preds)
+    k = top_k or n
+    if adaptive_k or top_k is None:
+        k_eff = min(k, n)
+    else:
+        k_eff = k
+    t = target[np.argsort(-preds, kind="stable")[: min(k, n)]]
+    return float(t.sum() / k_eff)
+
+
+def np_recall(preds, target, top_k=None):
+    k = top_k or len(preds)
+    if target.sum() == 0:
+        return 0.0
+    t = target[np.argsort(-preds, kind="stable")[:k]]
+    return float(t.sum() / target.sum())
+
+
+def np_fall_out(preds, target, top_k=None):
+    k = top_k or len(preds)
+    neg = 1 - target
+    if neg.sum() == 0:
+        return 0.0
+    t = neg[np.argsort(-preds, kind="stable")[:k]]
+    return float(t.sum() / neg.sum())
+
+
+def np_hit_rate(preds, target, top_k=None):
+    k = top_k or len(preds)
+    t = target[np.argsort(-preds, kind="stable")[:k]]
+    return float(t.sum() > 0)
+
+
+def np_r_precision(preds, target):
+    r = int(target.sum())
+    if r == 0:
+        return 0.0
+    t = target[np.argsort(-preds, kind="stable")[:r]]
+    return float(t.sum() / r)
+
+
+def np_ndcg(preds, target, top_k=None):
+    k = top_k or len(preds)
+    if target.sum() == 0:
+        return 0.0
+    return float(ndcg_score(target[None].astype(float), preds[None].astype(float), k=k))
+
+
+def np_auroc(preds, target, top_k=None, max_fpr=None):
+    k = top_k or len(preds)
+    order = np.argsort(-preds, kind="stable")[:k]
+    t, p = target[order], preds[order]
+    if len(np.unique(t)) < 2:
+        return 0.0
+    return float(roc_auc_score(t, p, max_fpr=max_fpr))
+
+
+FUNCTIONAL_CASES = [
+    (retrieval_average_precision, np_ap, {}),
+    (retrieval_average_precision, np_ap, {"top_k": 3}),
+    (retrieval_reciprocal_rank, np_mrr, {}),
+    (retrieval_reciprocal_rank, np_mrr, {"top_k": 2}),
+    (retrieval_precision, np_precision, {}),
+    (retrieval_precision, np_precision, {"top_k": 4}),
+    (retrieval_precision, np_precision, {"top_k": 100, "adaptive_k": True}),
+    (retrieval_recall, np_recall, {}),
+    (retrieval_recall, np_recall, {"top_k": 3}),
+    (retrieval_fall_out, np_fall_out, {"top_k": 3}),
+    (retrieval_hit_rate, np_hit_rate, {"top_k": 2}),
+    (retrieval_r_precision, np_r_precision, {}),
+    (retrieval_auroc, np_auroc, {}),
+    (retrieval_auroc, np_auroc, {"top_k": 8}),
+    (retrieval_auroc, np_auroc, {"max_fpr": 0.5}),
+]
+
+
+@pytest.mark.parametrize(("fn", "oracle", "kwargs"), FUNCTIONAL_CASES)
+def test_functional_single_query(fn, oracle, kwargs):
+    for q in range(12):
+        sl = INDEXES == q
+        p, t = PREDS[sl], TARGET[sl]
+        if len(p) == 0:
+            continue
+        res = float(fn(jnp.asarray(p), jnp.asarray(t), **kwargs))
+        ref = oracle(p, t, **kwargs)
+        np.testing.assert_allclose(res, ref, atol=1e-5, err_msg=f"{fn.__name__} {kwargs}")
+
+
+def test_functional_ndcg_binary_and_graded():
+    for tgt in (TARGET, GRADED):
+        for q in range(10):
+            sl = INDEXES == q
+            p, t = PREDS[sl], tgt[sl]
+            if len(p) < 2 or t.sum() == 0:
+                continue
+            res = float(retrieval_normalized_dcg(jnp.asarray(p), jnp.asarray(t)))
+            np.testing.assert_allclose(res, np_ndcg(p, t), atol=1e-4)
+            res_k = float(retrieval_normalized_dcg(jnp.asarray(p), jnp.asarray(t), top_k=3))
+            np.testing.assert_allclose(res_k, np_ndcg(p, t, top_k=3), atol=1e-4)
+
+
+def test_functional_precision_recall_curve():
+    p, t = PREDS[:16], TARGET[:16]
+    prec, rec, ks = retrieval_precision_recall_curve(jnp.asarray(p), jnp.asarray(t), max_k=5)
+    assert prec.shape == (5,) and rec.shape == (5,) and list(np.asarray(ks)) == [1, 2, 3, 4, 5]
+    for k in range(1, 6):
+        np.testing.assert_allclose(float(prec[k - 1]), np_precision(p, t, top_k=k), atol=1e-5)
+        np.testing.assert_allclose(float(rec[k - 1]), np_recall(p, t, top_k=k), atol=1e-5)
+
+
+CLASS_CASES = [
+    (RetrievalMAP, np_ap, {}),
+    (RetrievalMRR, np_mrr, {}),
+    (RetrievalPrecision, np_precision, {"top_k": 3}),
+    (RetrievalRecall, np_recall, {"top_k": 3}),
+    (RetrievalHitRate, np_hit_rate, {"top_k": 2}),
+    (RetrievalRPrecision, np_r_precision, {}),
+    (RetrievalNormalizedDCG, np_ndcg, {}),
+    (RetrievalAUROC, np_auroc, {}),
+]
+
+
+def _class_oracle(oracle, empty_action="neg", agg="mean", inverted_empty=False, **kwargs):
+    scores = []
+    for q in np.unique(INDEXES):
+        sl = INDEXES == q
+        p, t = PREDS[sl], TARGET[sl]
+        empty = (1 - t).sum() == 0 if inverted_empty else t.sum() == 0
+        if empty:
+            if empty_action == "neg":
+                scores.append(0.0)
+            elif empty_action == "pos":
+                scores.append(1.0)
+            continue
+        scores.append(oracle(p, t, **kwargs))
+    if not scores:
+        return 0.0
+    if agg == "mean":
+        return float(np.mean(scores))
+    if agg == "median":
+        return float(np.median(scores))
+    if agg == "max":
+        return float(np.max(scores))
+    return float(np.min(scores))
+
+
+@pytest.mark.parametrize(("cls", "oracle", "kwargs"), CLASS_CASES)
+def test_class_accumulate(cls, oracle, kwargs):
+    metric = cls(**kwargs)
+    for i in range(4):
+        sl = slice(i * (N // 4), (i + 1) * (N // 4))
+        metric.update(jnp.asarray(PREDS[sl]), jnp.asarray(TARGET[sl]), jnp.asarray(INDEXES[sl]))
+    res = float(metric.compute())
+    ref = _class_oracle(oracle, **kwargs)
+    np.testing.assert_allclose(res, ref, atol=1e-5, err_msg=cls.__name__)
+
+
+def test_class_fall_out():
+    metric = RetrievalFallOut(top_k=3)
+    metric.update(jnp.asarray(PREDS), jnp.asarray(TARGET), jnp.asarray(INDEXES))
+    res = float(metric.compute())
+    ref = _class_oracle(np_fall_out, empty_action="pos", inverted_empty=True, top_k=3)
+    np.testing.assert_allclose(res, ref, atol=1e-5)
+
+
+@pytest.mark.parametrize("agg", ["mean", "median", "min", "max"])
+def test_aggregation_modes(agg):
+    metric = RetrievalMAP(aggregation=agg)
+    metric.update(jnp.asarray(PREDS), jnp.asarray(TARGET), jnp.asarray(INDEXES))
+    res = float(metric.compute())
+    ref = _class_oracle(np_ap, agg=agg)
+    np.testing.assert_allclose(res, ref, atol=1e-5)
+
+
+@pytest.mark.parametrize("action", ["neg", "pos", "skip"])
+def test_empty_target_actions(action):
+    idx = np.array([0, 0, 1, 1])
+    preds = np.array([0.3, 0.6, 0.2, 0.1], dtype=np.float32)
+    tgt = np.array([1, 0, 0, 0])  # query 1 has no positives
+    metric = RetrievalMAP(empty_target_action=action)
+    metric.update(jnp.asarray(preds), jnp.asarray(tgt), jnp.asarray(idx))
+    res = float(metric.compute())
+    q0 = np_ap(preds[:2], tgt[:2])
+    expected = {"neg": (q0 + 0.0) / 2, "pos": (q0 + 1.0) / 2, "skip": q0}[action]
+    np.testing.assert_allclose(res, expected, atol=1e-5)
+
+
+def test_empty_target_error():
+    metric = RetrievalMAP(empty_target_action="error")
+    metric.update(jnp.asarray([0.3, 0.6]), jnp.asarray([0, 0]), jnp.asarray([0, 0]))
+    with pytest.raises(ValueError, match="no positive target"):
+        metric.compute()
+
+
+def test_ignore_index():
+    idx = np.array([0, 0, 0, 0])
+    preds = np.array([0.9, 0.6, 0.3, 0.1], dtype=np.float32)
+    tgt = np.array([1, -1, 0, 1])
+    metric = RetrievalMAP(ignore_index=-1)
+    metric.update(jnp.asarray(preds), jnp.asarray(tgt), jnp.asarray(idx))
+    keep = tgt != -1
+    ref = np_ap(preds[keep], tgt[keep])
+    np.testing.assert_allclose(float(metric.compute()), ref, atol=1e-5)
+
+
+def test_pr_curve_class_and_recall_at_fixed_precision():
+    m = RetrievalPrecisionRecallCurve(max_k=4)
+    m.update(jnp.asarray(PREDS), jnp.asarray(TARGET), jnp.asarray(INDEXES))
+    prec, rec, ks = m.compute()
+    assert prec.shape == (4,) and rec.shape == (4,)
+    # oracle: average per-query precision/recall at each k
+    for k in range(1, 5):
+        ref_p = _class_oracle(np_precision, top_k=k)
+        ref_r = _class_oracle(np_recall, top_k=k)
+        np.testing.assert_allclose(float(prec[k - 1]), ref_p, atol=1e-5)
+        np.testing.assert_allclose(float(rec[k - 1]), ref_r, atol=1e-5)
+
+    r = RetrievalRecallAtFixedPrecision(min_precision=0.3, max_k=4)
+    r.update(jnp.asarray(PREDS), jnp.asarray(TARGET), jnp.asarray(INDEXES))
+    max_recall, best_k = r.compute()
+    precs = [float(prec[i]) for i in range(4)]
+    recs = [float(rec[i]) for i in range(4)]
+    valid = [(rc, k + 1) for k, (pc, rc) in enumerate(zip(precs, recs)) if pc >= 0.3]
+    if valid:
+        ref_recall, ref_k = max(valid)
+        np.testing.assert_allclose(float(max_recall), ref_recall, atol=1e-5)
+        assert int(best_k) == ref_k
+
+
+def test_forward_and_reset():
+    metric = RetrievalMAP()
+    val = metric(jnp.asarray(PREDS[:32]), jnp.asarray(TARGET[:32]), jnp.asarray(INDEXES[:32]))
+    assert np.isfinite(float(val))
+    metric.reset()
+    assert metric.metric_state["preds"] == []
+
+
+def test_ddp_merge_states():
+    full = RetrievalMAP()
+    full.update(jnp.asarray(PREDS), jnp.asarray(TARGET), jnp.asarray(INDEXES))
+    ref = float(full.compute())
+
+    r0, r1 = RetrievalMAP(), RetrievalMAP()
+    r0.update(jnp.asarray(PREDS[: N // 2]), jnp.asarray(TARGET[: N // 2]), jnp.asarray(INDEXES[: N // 2]))
+    r1.update(jnp.asarray(PREDS[N // 2 :]), jnp.asarray(TARGET[N // 2 :]), jnp.asarray(INDEXES[N // 2 :]))
+    merged = r0.merge_states([r0.metric_state, r1.metric_state])
+    res = float(r0.compute_state(merged))
+    np.testing.assert_allclose(res, ref, atol=1e-5)

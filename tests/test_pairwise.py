@@ -1,0 +1,63 @@
+"""Pairwise functional metrics vs sklearn oracles.
+
+Parity model: reference ``tests/unittests/pairwise/``.
+"""
+import numpy as np
+import pytest
+from sklearn.metrics import pairwise as skp
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.pairwise import (
+    pairwise_cosine_similarity,
+    pairwise_euclidean_distance,
+    pairwise_linear_similarity,
+    pairwise_manhattan_distance,
+    pairwise_minkowski_distance,
+)
+
+rng = np.random.RandomState(5)
+X = rng.randn(12, 6).astype(np.float32)
+Y = rng.randn(8, 6).astype(np.float32)
+
+CASES = [
+    (pairwise_cosine_similarity, skp.cosine_similarity, {}),
+    (pairwise_euclidean_distance, skp.euclidean_distances, {}),
+    (pairwise_linear_similarity, skp.linear_kernel, {}),
+    (pairwise_manhattan_distance, skp.manhattan_distances, {}),
+    (pairwise_minkowski_distance, lambda x, y: skp.pairwise_distances(x, y, metric="minkowski", p=3),
+     {"exponent": 3}),
+]
+
+
+@pytest.mark.parametrize(("fn", "sk_fn", "kwargs"), CASES)
+def test_two_input(fn, sk_fn, kwargs):
+    res = np.asarray(fn(jnp.asarray(X), jnp.asarray(Y), **kwargs))
+    np.testing.assert_allclose(res, sk_fn(X, Y), atol=1e-4, rtol=1e-4)
+
+
+@pytest.mark.parametrize(("fn", "sk_fn", "kwargs"), CASES)
+def test_single_input_zero_diagonal(fn, sk_fn, kwargs):
+    res = np.asarray(fn(jnp.asarray(X), **kwargs))
+    ref = sk_fn(X, X)
+    np.fill_diagonal(ref, 0.0)
+    np.testing.assert_allclose(res, ref, atol=1e-4, rtol=1e-4)
+
+
+@pytest.mark.parametrize("reduction", ["mean", "sum"])
+def test_reductions(reduction):
+    res = np.asarray(pairwise_euclidean_distance(jnp.asarray(X), jnp.asarray(Y), reduction=reduction))
+    ref = skp.euclidean_distances(X, Y)
+    ref = ref.mean(-1) if reduction == "mean" else ref.sum(-1)
+    np.testing.assert_allclose(res, ref, atol=1e-4, rtol=1e-4)
+
+
+def test_input_validation():
+    with pytest.raises(ValueError, match="Expected argument `x`"):
+        pairwise_cosine_similarity(jnp.zeros((3,)))
+    with pytest.raises(ValueError, match="Expected argument `y`"):
+        pairwise_cosine_similarity(jnp.zeros((3, 2)), jnp.zeros((3, 4)))
+    with pytest.raises(ValueError, match="Expected reduction"):
+        pairwise_cosine_similarity(jnp.zeros((3, 2)), reduction="bad")
+    with pytest.raises(ValueError, match="exponent"):
+        pairwise_minkowski_distance(jnp.zeros((3, 2)), exponent=0.5)

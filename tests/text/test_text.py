@@ -1,0 +1,328 @@
+"""Text metrics vs sacrebleu / nltk / rouge_score / hand oracles.
+
+Parity model: reference ``tests/unittests/text/``.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.text import (
+    bleu_score,
+    char_error_rate,
+    chrf_score,
+    edit_distance,
+    extended_edit_distance,
+    match_error_rate,
+    rouge_score,
+    sacre_bleu_score,
+    squad,
+    translation_edit_rate,
+    word_error_rate,
+    word_information_lost,
+    word_information_preserved,
+)
+from torchmetrics_tpu.text import (
+    BLEUScore,
+    CharErrorRate,
+    CHRFScore,
+    EditDistance,
+    ExtendedEditDistance,
+    MatchErrorRate,
+    ROUGEScore,
+    SacreBLEUScore,
+    SQuAD,
+    TranslationEditRate,
+    WordErrorRate,
+    WordInfoLost,
+    WordInfoPreserved,
+)
+
+# all sentences >= 4 words: nltk clamps empty n-gram denominators to 1
+# (Fraction(x, max(1, d))) while the reference accumulates raw zero counts,
+# so degenerate short sentences would diverge by design
+PREDS = [
+    "the cat is on the mat",
+    "a quick brown fox jumps over the lazy dog",
+    "hello world how are you",
+    "the weather is nice today in the city",
+]
+TARGETS_SINGLE = [
+    "there is a cat on the mat",
+    "the quick brown fox jumped over the lazy dog",
+    "hello beautiful world how are you",
+    "the weather today is nice in town",
+]
+TARGETS_MULTI = [[t, t.upper().lower() + " indeed"] for t in TARGETS_SINGLE]
+
+
+def _lev(a, b):
+    dp = np.zeros((len(a) + 1, len(b) + 1), dtype=int)
+    dp[:, 0] = np.arange(len(a) + 1)
+    dp[0, :] = np.arange(len(b) + 1)
+    for i in range(1, len(a) + 1):
+        for j in range(1, len(b) + 1):
+            dp[i, j] = min(dp[i - 1, j] + 1, dp[i, j - 1] + 1, dp[i - 1, j - 1] + (a[i - 1] != b[j - 1]))
+    return dp[-1, -1]
+
+
+def test_wer_cer_mer_wil_wip():
+    errs = sum(_lev(p.split(), t.split()) for p, t in zip(PREDS, TARGETS_SINGLE))
+    tot_t = sum(len(t.split()) for t in TARGETS_SINGLE)
+    tot_p = sum(len(p.split()) for p in PREDS)
+    tot_max = sum(max(len(p.split()), len(t.split())) for p, t in zip(PREDS, TARGETS_SINGLE))
+    np.testing.assert_allclose(float(word_error_rate(PREDS, TARGETS_SINGLE)), errs / tot_t, atol=1e-6)
+    np.testing.assert_allclose(float(match_error_rate(PREDS, TARGETS_SINGLE)), errs / tot_max, atol=1e-6)
+    cerrs = sum(_lev(list(p), list(t)) for p, t in zip(PREDS, TARGETS_SINGLE))
+    ctot = sum(len(t) for t in TARGETS_SINGLE)
+    np.testing.assert_allclose(float(char_error_rate(PREDS, TARGETS_SINGLE)), cerrs / ctot, atol=1e-6)
+    e = errs - tot_max
+    wip = (e / tot_t) * (e / tot_p)
+    np.testing.assert_allclose(float(word_information_preserved(PREDS, TARGETS_SINGLE)), wip, atol=1e-6)
+    np.testing.assert_allclose(float(word_information_lost(PREDS, TARGETS_SINGLE)), 1 - wip, atol=1e-6)
+
+
+@pytest.mark.parametrize(
+    ("cls", "fn"),
+    [
+        (WordErrorRate, word_error_rate),
+        (CharErrorRate, char_error_rate),
+        (MatchErrorRate, match_error_rate),
+        (WordInfoLost, word_information_lost),
+        (WordInfoPreserved, word_information_preserved),
+    ],
+)
+def test_asr_class_accumulate(cls, fn):
+    metric = cls()
+    metric.update(PREDS[:2], TARGETS_SINGLE[:2])
+    metric.update(PREDS[2:], TARGETS_SINGLE[2:])
+    np.testing.assert_allclose(float(metric.compute()), float(fn(PREDS, TARGETS_SINGLE)), atol=1e-6)
+
+
+@pytest.mark.parametrize("n_gram", [2, 4])
+@pytest.mark.parametrize("smooth", [False, True])
+def test_bleu_vs_nltk(n_gram, smooth):
+    from nltk.translate.bleu_score import SmoothingFunction, corpus_bleu
+
+    weights = tuple([1.0 / n_gram] * n_gram)
+    refs = [[t.split() for t in refs] for refs in TARGETS_MULTI]
+    hyps = [p.split() for p in PREDS]
+    sf = SmoothingFunction().method2 if smooth else SmoothingFunction().method0
+    ref_score = corpus_bleu(refs, hyps, weights=weights, smoothing_function=sf)
+    res = float(bleu_score(PREDS, TARGETS_MULTI, n_gram=n_gram, smooth=smooth))
+    np.testing.assert_allclose(res, ref_score, atol=1e-5)
+
+
+def test_bleu_class():
+    metric = BLEUScore(n_gram=3)
+    metric.update(PREDS[:2], TARGETS_MULTI[:2])
+    metric.update(PREDS[2:], TARGETS_MULTI[2:])
+    ref = float(bleu_score(PREDS, TARGETS_MULTI, n_gram=3))
+    np.testing.assert_allclose(float(metric.compute()), ref, atol=1e-6)
+
+
+@pytest.mark.parametrize("tokenize", ["13a", "char", "intl", "none"])
+def test_sacre_bleu_vs_sacrebleu(tokenize):
+    import sacrebleu
+
+    # sacrebleu wants refs transposed: list over references of list over samples
+    refs_t = [[refs[i] for refs in TARGETS_MULTI] for i in range(2)]
+    ref_score = sacrebleu.corpus_bleu(
+        PREDS, refs_t, tokenize=tokenize, lowercase=False, use_effective_order=False
+    ).score / 100.0
+    res = float(sacre_bleu_score(PREDS, TARGETS_MULTI, tokenize=tokenize))
+    np.testing.assert_allclose(res, ref_score, atol=1e-4)
+
+
+def test_sacre_bleu_class():
+    metric = SacreBLEUScore()
+    metric.update(PREDS[:2], TARGETS_MULTI[:2])
+    metric.update(PREDS[2:], TARGETS_MULTI[2:])
+    ref = float(sacre_bleu_score(PREDS, TARGETS_MULTI))
+    np.testing.assert_allclose(float(metric.compute()), ref, atol=1e-6)
+
+
+@pytest.mark.parametrize("n_word_order", [0, 2])
+def test_chrf_vs_sacrebleu(n_word_order):
+    import sacrebleu
+
+    chrf = sacrebleu.CHRF(word_order=n_word_order)
+    refs_t = [[refs[i] for refs in TARGETS_MULTI] for i in range(2)]
+    ref_score = chrf.corpus_score(PREDS, refs_t).score / 100.0
+    res = float(chrf_score(PREDS, TARGETS_MULTI, n_word_order=n_word_order))
+    np.testing.assert_allclose(res, ref_score, atol=5e-3)
+
+
+def test_chrf_class():
+    metric = CHRFScore()
+    metric.update(PREDS[:2], TARGETS_MULTI[:2])
+    metric.update(PREDS[2:], TARGETS_MULTI[2:])
+    ref = float(chrf_score(PREDS, TARGETS_MULTI))
+    np.testing.assert_allclose(float(metric.compute()), ref, atol=1e-6)
+
+
+def test_ter_vs_sacrebleu():
+    import sacrebleu
+
+    ter = sacrebleu.TER()
+    refs_t = [[refs[i] for refs in TARGETS_MULTI] for i in range(2)]
+    ref_score = ter.corpus_score(PREDS, refs_t).score / 100.0
+    res = float(translation_edit_rate(PREDS, TARGETS_MULTI))
+    np.testing.assert_allclose(res, ref_score, atol=1e-3)
+
+
+def test_ter_class():
+    metric = TranslationEditRate()
+    metric.update(PREDS[:2], TARGETS_MULTI[:2])
+    metric.update(PREDS[2:], TARGETS_MULTI[2:])
+    ref = float(translation_edit_rate(PREDS, TARGETS_MULTI))
+    np.testing.assert_allclose(float(metric.compute()), ref, atol=1e-6)
+
+
+@pytest.mark.parametrize("accumulate", ["best", "avg"])
+def test_rouge_vs_rouge_score(accumulate):
+    from rouge_score.rouge_scorer import RougeScorer
+
+    keys = ("rouge1", "rouge2", "rougeL")
+    scorer = RougeScorer(list(keys), use_stemmer=False)
+    agg = {k: [] for k in keys}
+    for p, refs in zip(PREDS, TARGETS_MULTI):
+        per_ref = [scorer.score(r, p) for r in refs]
+        for k in keys:
+            triplets = [(s[k].precision, s[k].recall, s[k].fmeasure) for s in per_ref]
+            if accumulate == "best":
+                agg[k].append(max(triplets, key=lambda x: x[2]))
+            else:
+                agg[k].append(tuple(np.mean(triplets, axis=0)))
+    res = rouge_score(PREDS, TARGETS_MULTI, accumulate=accumulate, rouge_keys=keys)
+    for k in keys:
+        arr = np.asarray(agg[k])
+        np.testing.assert_allclose(float(res[f"{k}_precision"]), arr[:, 0].mean(), atol=1e-5)
+        np.testing.assert_allclose(float(res[f"{k}_recall"]), arr[:, 1].mean(), atol=1e-5)
+        np.testing.assert_allclose(float(res[f"{k}_fmeasure"]), arr[:, 2].mean(), atol=1e-5)
+
+
+def test_rouge_class():
+    keys = ("rouge1", "rougeL")
+    metric = ROUGEScore(rouge_keys=keys)
+    metric.update(PREDS[:2], TARGETS_MULTI[:2])
+    metric.update(PREDS[2:], TARGETS_MULTI[2:])
+    res = metric.compute()
+    ref = rouge_score(PREDS, TARGETS_MULTI, rouge_keys=keys)
+    for k in res:
+        np.testing.assert_allclose(float(res[k]), float(ref[k]), atol=1e-6)
+
+
+def test_edit_distance():
+    np.testing.assert_allclose(float(edit_distance("kitten", "sitting")), 3.0)
+    np.testing.assert_allclose(float(edit_distance(["ab", "cd"], ["ab", "ef"], reduction="sum")), 2.0)
+    metric = EditDistance(reduction="mean")
+    metric.update(["kitten"], ["sitting"])
+    metric.update(["flaw"], ["lawn"])
+    np.testing.assert_allclose(float(metric.compute()), (3 + 2) / 2)
+
+
+def test_squad():
+    preds = [{"prediction_text": "1976", "id": "56e10a3be3433e1400422b22"}]
+    target = [{"answers": {"answer_start": [97], "text": ["1976"]}, "id": "56e10a3be3433e1400422b22"}]
+    res = squad(preds, target)
+    np.testing.assert_allclose(float(res["exact_match"]), 100.0)
+    np.testing.assert_allclose(float(res["f1"]), 100.0)
+    metric = SQuAD()
+    metric.update(preds, target)
+    metric.update(
+        [{"prediction_text": "the cat", "id": "a"}],
+        [{"answers": {"answer_start": [0], "text": ["a cat sat"]}, "id": "a"}],
+    )
+    res2 = metric.compute()
+    assert 0 < float(res2["exact_match"]) < 100.0
+    assert 0 < float(res2["f1"]) < 100.0
+
+
+def test_eed_properties():
+    # oracle values computed with the reference implementation
+    # (functional/text/eed.py) on the same inputs
+    np.testing.assert_allclose(
+        float(extended_edit_distance(["hello world"], [["hello world"]])), 0.0225564, atol=1e-5)
+    np.testing.assert_allclose(
+        float(extended_edit_distance(["aaa bbb"], [["xyz qrs tuv"]])), 0.8342541, atol=1e-5)
+    np.testing.assert_allclose(
+        float(extended_edit_distance(
+            ["the cat is on the mat", "hello world"],
+            [["there is a cat on the mat"], ["hello beautiful world"]])),
+        0.3768179, atol=1e-5)
+    score, sent = extended_edit_distance(PREDS, TARGETS_MULTI, return_sentence_level_score=True)
+    assert sent.shape == (len(PREDS),)
+    np.testing.assert_allclose(float(score), float(np.mean(np.asarray(sent))), atol=1e-6)
+    metric = ExtendedEditDistance()
+    metric.update(PREDS[:2], TARGETS_MULTI[:2])
+    metric.update(PREDS[2:], TARGETS_MULTI[2:])
+    np.testing.assert_allclose(
+        float(metric.compute()), float(extended_edit_distance(PREDS, TARGETS_MULTI)), atol=1e-6)
+
+
+def test_bert_score_stub_model():
+    """Greedy-matching math vs a hand-computed oracle on a stub encoder."""
+    from torchmetrics_tpu.functional.text.bert import bert_score
+
+    rng = np.random.RandomState(0)
+    vocab_emb = rng.randn(100, 8).astype(np.float32)
+
+    def tokenizer(texts, max_length):
+        ids = np.zeros((len(texts), 5), dtype=np.int32)
+        mask = np.zeros((len(texts), 5), dtype=np.int32)
+        for i, t in enumerate(texts):
+            toks = [hash(w) % 100 for w in t.split()][:5]
+            ids[i, : len(toks)] = toks
+            mask[i, : len(toks)] = 1
+        return {"input_ids": jnp.asarray(ids), "attention_mask": jnp.asarray(mask)}
+
+    def forward(ids, mask):
+        return jnp.asarray(vocab_emb)[ids]
+
+    res = bert_score(PREDS[:2], TARGETS_SINGLE[:2], user_tokenizer=tokenizer, user_forward_fn=forward)
+    # oracle
+    for i in range(2):
+        p_toks = [hash(w) % 100 for w in PREDS[i].split()][:5]
+        t_toks = [hash(w) % 100 for w in TARGETS_SINGLE[i].split()][:5]
+        pe = vocab_emb[p_toks]
+        te = vocab_emb[t_toks]
+        pe = pe / np.linalg.norm(pe, axis=-1, keepdims=True)
+        te = te / np.linalg.norm(te, axis=-1, keepdims=True)
+        sim = pe @ te.T
+        prec = sim.max(1).mean()
+        rec = sim.max(0).mean()
+        f1 = 2 * prec * rec / (prec + rec)
+        np.testing.assert_allclose(float(res["precision"][i]), prec, atol=1e-5)
+        np.testing.assert_allclose(float(res["recall"][i]), rec, atol=1e-5)
+        np.testing.assert_allclose(float(res["f1"][i]), f1, atol=1e-5)
+
+
+def test_infolm_measures():
+    from torchmetrics_tpu.functional.text.infolm import _InformationMeasure
+
+    rng = np.random.RandomState(1)
+    p = rng.rand(4, 16); p /= p.sum(-1, keepdims=True)
+    q = rng.rand(4, 16); q /= q.sum(-1, keepdims=True)
+    p_j, q_j = jnp.asarray(p), jnp.asarray(q)
+    kl = _InformationMeasure("kl_divergence")(p_j, q_j)
+    ref_kl = (p * (np.log(p) - np.log(q))).sum(-1)
+    np.testing.assert_allclose(np.asarray(kl), ref_kl, atol=1e-4)
+    l1 = _InformationMeasure("l1_distance")(p_j, q_j)
+    np.testing.assert_allclose(np.asarray(l1), np.abs(p - q).sum(-1), atol=1e-5)
+    fr = _InformationMeasure("fisher_rao_distance")(p_j, q_j)
+    np.testing.assert_allclose(np.asarray(fr), 2 * np.arccos(np.clip((np.sqrt(p * q)).sum(-1), 0, 1)), atol=1e-4)
+    a = _InformationMeasure("alpha_divergence", alpha=0.5)(p_j, q_j)
+    ref_a = (1 - (q**0.5 * p**0.5).sum(-1)) / (0.5 * (0.5 - 1))
+    np.testing.assert_allclose(np.asarray(a), ref_a, atol=1e-4)
+
+
+def test_ddp_merge_states_text():
+    full = WordErrorRate()
+    full.update(PREDS, TARGETS_SINGLE)
+    ref = float(full.compute())
+    r0, r1 = WordErrorRate(), WordErrorRate()
+    r0.update(PREDS[:2], TARGETS_SINGLE[:2])
+    r1.update(PREDS[2:], TARGETS_SINGLE[2:])
+    merged = r0.merge_states([r0.metric_state, r1.metric_state])
+    np.testing.assert_allclose(float(r0.compute_state(merged)), ref, atol=1e-6)

@@ -1,0 +1,249 @@
+"""Modular audio metrics — mean-of-values sum states.
+
+Parity targets: reference ``audio/{snr,sdr,pit,pesq,stoi,srmr}.py`` — every
+class keeps ``sum_<metric>`` + ``total`` sum states (mean at compute), the
+exact state design of the reference's audio domain.
+"""
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from ..functional.audio.gated import (
+    perceptual_evaluation_speech_quality,
+    short_time_objective_intelligibility,
+    speech_reverberation_modulation_energy_ratio,
+)
+from ..functional.audio.pit import permutation_invariant_training
+from ..functional.audio.sdr import (
+    signal_distortion_ratio,
+    source_aggregated_signal_distortion_ratio,
+)
+from ..functional.audio.snr import (
+    complex_scale_invariant_signal_noise_ratio,
+    scale_invariant_signal_distortion_ratio,
+    scale_invariant_signal_noise_ratio,
+    signal_noise_ratio,
+)
+from ..metric import Metric
+
+Array = jax.Array
+
+
+class _MeanAudioMetric(Metric):
+    """Accumulate sum + count of per-sample values."""
+
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_value", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def _values(self, *args: Any, **kwargs: Any) -> Array:
+        raise NotImplementedError
+
+    def update(self, preds: Array, target: Array) -> None:
+        values = self._values(preds, target)
+        self.sum_value = self.sum_value + jnp.sum(values)
+        self.total = self.total + values.size
+
+    def compute(self) -> Array:
+        return self.sum_value / self.total
+
+
+class SignalNoiseRatio(_MeanAudioMetric):
+    """Parity: reference ``audio/snr.py:SignalNoiseRatio``."""
+
+    is_differentiable = True
+    higher_is_better = True
+
+    def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.zero_mean = zero_mean
+
+    def _values(self, preds: Array, target: Array) -> Array:
+        return signal_noise_ratio(preds, target, self.zero_mean)
+
+
+class ScaleInvariantSignalNoiseRatio(_MeanAudioMetric):
+    """Parity: reference ``audio/snr.py:ScaleInvariantSignalNoiseRatio``."""
+
+    is_differentiable = True
+    higher_is_better = True
+
+    def _values(self, preds: Array, target: Array) -> Array:
+        return scale_invariant_signal_noise_ratio(preds, target)
+
+
+class ComplexScaleInvariantSignalNoiseRatio(_MeanAudioMetric):
+    """Parity: reference ``audio/snr.py:ComplexScaleInvariantSignalNoiseRatio``."""
+
+    is_differentiable = True
+    higher_is_better = True
+
+    def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(zero_mean, bool):
+            raise ValueError(f"Expected argument `zero_mean` to be a bool, but got {zero_mean}")
+        self.zero_mean = zero_mean
+
+    def _values(self, preds: Array, target: Array) -> Array:
+        return complex_scale_invariant_signal_noise_ratio(preds, target, self.zero_mean)
+
+
+class SignalDistortionRatio(_MeanAudioMetric):
+    """Parity: reference ``audio/sdr.py:SignalDistortionRatio``."""
+
+    is_differentiable = True
+    higher_is_better = True
+
+    def __init__(self, use_cg_iter: Any = None, filter_length: int = 512, zero_mean: bool = False,
+                 load_diag: Any = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.use_cg_iter = use_cg_iter
+        self.filter_length = filter_length
+        self.zero_mean = zero_mean
+        self.load_diag = load_diag
+
+    def _values(self, preds: Array, target: Array) -> Array:
+        return signal_distortion_ratio(
+            preds, target, self.use_cg_iter, self.filter_length, self.zero_mean, self.load_diag
+        )
+
+
+class ScaleInvariantSignalDistortionRatio(_MeanAudioMetric):
+    """Parity: reference ``audio/sdr.py:ScaleInvariantSignalDistortionRatio``."""
+
+    is_differentiable = True
+    higher_is_better = True
+
+    def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.zero_mean = zero_mean
+
+    def _values(self, preds: Array, target: Array) -> Array:
+        return scale_invariant_signal_distortion_ratio(preds, target, self.zero_mean)
+
+
+class SourceAggregatedSignalDistortionRatio(_MeanAudioMetric):
+    """Parity: reference ``audio/sdr.py:SourceAggregatedSignalDistortionRatio``."""
+
+    is_differentiable = True
+    higher_is_better = True
+
+    def __init__(self, scale_invariant: bool = True, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(scale_invariant, bool):
+            raise ValueError(f"Expected argument `scale_invariant` to be a bool, but got {scale_invariant}")
+        if not isinstance(zero_mean, bool):
+            raise ValueError(f"Expected argument `zero_mean` to be a bool, but got {zero_mean}")
+        self.scale_invariant = scale_invariant
+        self.zero_mean = zero_mean
+
+    def _values(self, preds: Array, target: Array) -> Array:
+        return source_aggregated_signal_distortion_ratio(preds, target, self.scale_invariant, self.zero_mean)
+
+
+class PermutationInvariantTraining(_MeanAudioMetric):
+    """Parity: reference ``audio/pit.py:PermutationInvariantTraining`` (164 LoC)."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, metric_func: Callable, mode: str = "speaker-wise", eval_func: str = "max",
+                 **kwargs: Any) -> None:
+        base_kwargs = {
+            k: kwargs.pop(k)
+            for k in list(kwargs)
+            if k in ("compute_on_cpu", "dist_sync_on_step", "sync_on_compute", "compute_with_cache",
+                     "sync_backend", "jit")
+        }
+        super().__init__(**base_kwargs)
+        self.metric_func = metric_func
+        self.mode = mode
+        self.eval_func = eval_func
+        self.metric_kwargs = kwargs  # remaining kwargs forwarded to metric_func
+
+    def _values(self, preds: Array, target: Array) -> Array:
+        best_metric, _ = permutation_invariant_training(
+            preds, target, self.metric_func, self.mode, self.eval_func, **self.metric_kwargs
+        )
+        return best_metric
+
+
+class PerceptualEvaluationSpeechQuality(_MeanAudioMetric):
+    """Parity: reference ``audio/pesq.py`` (gated host C backend)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    jittable = False
+    plot_lower_bound = -0.5
+    plot_upper_bound = 4.5
+
+    def __init__(self, fs: int, mode: str, n_processes: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        from ..functional.audio.gated import _PESQ_AVAILABLE
+
+        if not _PESQ_AVAILABLE:
+            raise ModuleNotFoundError(
+                "PESQ metric requires that `pesq` is installed. Install as `pip install pesq`."
+            )
+        self.fs = fs
+        self.mode = mode
+        self.n_processes = n_processes
+
+    def _values(self, preds: Array, target: Array) -> Array:
+        return perceptual_evaluation_speech_quality(preds, target, self.fs, self.mode,
+                                                    n_processes=self.n_processes)
+
+
+class ShortTimeObjectiveIntelligibility(_MeanAudioMetric):
+    """Parity: reference ``audio/stoi.py`` (gated pystoi backend)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    jittable = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, fs: int, extended: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        from ..functional.audio.gated import _PYSTOI_AVAILABLE
+
+        if not _PYSTOI_AVAILABLE:
+            raise ModuleNotFoundError(
+                "STOI metric requires that `pystoi` is installed. Install as `pip install pystoi`."
+            )
+        self.fs = fs
+        self.extended = extended
+
+    def _values(self, preds: Array, target: Array) -> Array:
+        return short_time_objective_intelligibility(preds, target, self.fs, self.extended)
+
+
+class SpeechReverberationModulationEnergyRatio(_MeanAudioMetric):
+    """Parity: reference ``audio/srmr.py`` (gated gammatone backend)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    jittable = False
+
+    def __init__(self, fs: int, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        from ..functional.audio.gated import _GAMMATONE_AVAILABLE, _TORCHAUDIO_AVAILABLE
+
+        if not (_GAMMATONE_AVAILABLE and _TORCHAUDIO_AVAILABLE):
+            raise ModuleNotFoundError(
+                "SRMR metric requires that `gammatone` and `torchaudio` are installed."
+            )
+        self.fs = fs
+
+    def update(self, preds: Array) -> None:  # SRMR is reference-free
+        values = speech_reverberation_modulation_energy_ratio(preds, self.fs)
+        self.sum_value = self.sum_value + jnp.sum(values)
+        self.total = self.total + values.size
+
+    def _values(self, preds: Array, target: Array) -> Array:  # pragma: no cover
+        raise NotImplementedError

@@ -1,0 +1,224 @@
+"""Modular clustering metrics — cat list states of raw labels/embeddings.
+
+Parity targets: reference ``clustering/*.py`` (all store raw label or data
+lists with ``"cat"`` reduction and evaluate once at ``compute``). The
+label-pair metrics need the full epoch's labels (cluster ids are only
+comparable within one labeling), so raw storage is the correct state design
+in both frameworks; the evaluation itself is one vectorized XLA call.
+"""
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..functional.clustering import (
+    adjusted_mutual_info_score,
+    adjusted_rand_score,
+    calinski_harabasz_score,
+    completeness_score,
+    davies_bouldin_score,
+    dunn_index,
+    fowlkes_mallows_index,
+    homogeneity_score,
+    mutual_info_score,
+    normalized_mutual_info_score,
+    rand_score,
+    v_measure_score,
+)
+from ..metric import Metric
+from ..utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class _LabelClusteringMetric(Metric):
+    """Base for metrics over (preds, target) label vectors."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = True
+    jittable = False  # label spaces are data-dependent; compute is eager
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self._compute_jittable = False
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        self.preds.append(jnp.asarray(preds).reshape(-1))
+        self.target.append(jnp.asarray(target).reshape(-1))
+
+    def _evaluate(self, preds: Array, target: Array) -> Array:
+        raise NotImplementedError
+
+    def compute(self) -> Array:
+        return self._evaluate(dim_zero_cat(self.preds), dim_zero_cat(self.target))
+
+
+class MutualInfoScore(_LabelClusteringMetric):
+    """Parity: reference ``clustering/mutual_info_score.py``."""
+
+    plot_lower_bound = 0.0
+
+    def _evaluate(self, preds: Array, target: Array) -> Array:
+        return mutual_info_score(preds, target)
+
+
+class AdjustedMutualInfoScore(_LabelClusteringMetric):
+    """Parity: reference ``clustering/adjusted_mutual_info_score.py``."""
+
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, average_method: str = "arithmetic", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if average_method not in ("min", "geometric", "arithmetic", "max"):
+            raise ValueError(
+                "Expected argument `average_method` to be one of `min`, `geometric`, `arithmetic`, `max`,"
+                f"but got {average_method}"
+            )
+        self.average_method = average_method
+
+    def _evaluate(self, preds: Array, target: Array) -> Array:
+        return adjusted_mutual_info_score(preds, target, self.average_method)
+
+
+class NormalizedMutualInfoScore(_LabelClusteringMetric):
+    """Parity: reference ``clustering/normalized_mutual_info_score.py``."""
+
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, average_method: str = "arithmetic", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if average_method not in ("min", "geometric", "arithmetic", "max"):
+            raise ValueError(
+                "Expected argument `average_method` to be one of `min`, `geometric`, `arithmetic`, `max`,"
+                f"but got {average_method}"
+            )
+        self.average_method = average_method
+
+    def _evaluate(self, preds: Array, target: Array) -> Array:
+        return normalized_mutual_info_score(preds, target, self.average_method)
+
+
+class RandScore(_LabelClusteringMetric):
+    """Parity: reference ``clustering/rand_score.py``."""
+
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def _evaluate(self, preds: Array, target: Array) -> Array:
+        return rand_score(preds, target)
+
+
+class AdjustedRandScore(_LabelClusteringMetric):
+    """Parity: reference ``clustering/adjusted_rand_score.py``."""
+
+    plot_lower_bound = -0.5
+    plot_upper_bound = 1.0
+
+    def _evaluate(self, preds: Array, target: Array) -> Array:
+        return adjusted_rand_score(preds, target)
+
+
+class FowlkesMallowsIndex(_LabelClusteringMetric):
+    """Parity: reference ``clustering/fowlkes_mallows_index.py``."""
+
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def _evaluate(self, preds: Array, target: Array) -> Array:
+        return fowlkes_mallows_index(preds, target)
+
+
+class HomogeneityScore(_LabelClusteringMetric):
+    """Parity: reference ``clustering/homogeneity_completeness_v_measure.py``."""
+
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def _evaluate(self, preds: Array, target: Array) -> Array:
+        return homogeneity_score(preds, target)
+
+
+class CompletenessScore(_LabelClusteringMetric):
+    """Parity: reference ``clustering/homogeneity_completeness_v_measure.py``."""
+
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def _evaluate(self, preds: Array, target: Array) -> Array:
+        return completeness_score(preds, target)
+
+
+class VMeasureScore(_LabelClusteringMetric):
+    """Parity: reference ``clustering/homogeneity_completeness_v_measure.py``."""
+
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, beta: float = 1.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(beta, (int, float)) and beta > 0):
+            raise ValueError(f"Argument `beta` should be a positive float. Got {beta}.")
+        self.beta = float(beta)
+
+    def _evaluate(self, preds: Array, target: Array) -> Array:
+        return v_measure_score(preds, target, self.beta)
+
+
+class _EmbeddingClusteringMetric(Metric):
+    """Base for metrics over (data, labels) — stores raw embeddings."""
+
+    is_differentiable = True
+    full_state_update = True
+    jittable = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self._compute_jittable = False
+        self.add_state("data", [], dist_reduce_fx="cat")
+        self.add_state("labels", [], dist_reduce_fx="cat")
+
+    def update(self, data: Array, labels: Array) -> None:
+        self.data.append(jnp.asarray(data))
+        self.labels.append(jnp.asarray(labels).reshape(-1))
+
+
+class CalinskiHarabaszScore(_EmbeddingClusteringMetric):
+    """Parity: reference ``clustering/calinski_harabasz_score.py``."""
+
+    higher_is_better = True
+    plot_lower_bound = 0.0
+
+    def compute(self) -> Array:
+        return calinski_harabasz_score(dim_zero_cat(self.data), dim_zero_cat(self.labels))
+
+
+class DaviesBouldinScore(_EmbeddingClusteringMetric):
+    """Parity: reference ``clustering/davies_bouldin_score.py``."""
+
+    higher_is_better = False
+    plot_lower_bound = 0.0
+
+    def compute(self) -> Array:
+        return davies_bouldin_score(dim_zero_cat(self.data), dim_zero_cat(self.labels))
+
+
+class DunnIndex(_EmbeddingClusteringMetric):
+    """Parity: reference ``clustering/dunn_index.py``."""
+
+    higher_is_better = True
+    plot_lower_bound = 0.0
+
+    def __init__(self, p: float = 2.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.p = p
+
+    def update(self, data: Array, labels: Array) -> None:  # arg name parity
+        super().update(data, labels)
+
+    def compute(self) -> Array:
+        return dunn_index(dim_zero_cat(self.data), dim_zero_cat(self.labels), self.p)

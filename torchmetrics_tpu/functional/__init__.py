@@ -1,0 +1,30 @@
+"""Functional kernel layer (L3). Parity: reference ``functional/__init__.py``
+(~97 re-exports). Domain namespaces are importable as
+``torchmetrics_tpu.functional.<domain>``; the pairwise family is re-exported
+flat (it has no modular classes, reference §2.8).
+"""
+from . import audio, classification, clustering, image, nominal, pairwise, regression, retrieval, text
+from .pairwise import (
+    pairwise_cosine_similarity,
+    pairwise_euclidean_distance,
+    pairwise_linear_similarity,
+    pairwise_manhattan_distance,
+    pairwise_minkowski_distance,
+)
+
+__all__ = [
+    "audio",
+    "classification",
+    "clustering",
+    "image",
+    "nominal",
+    "pairwise",
+    "pairwise_cosine_similarity",
+    "pairwise_euclidean_distance",
+    "pairwise_linear_similarity",
+    "pairwise_manhattan_distance",
+    "pairwise_minkowski_distance",
+    "regression",
+    "retrieval",
+    "text",
+]

@@ -1,0 +1,102 @@
+"""Gated audio metrics: PESQ / STOI / SRMR.
+
+Parity targets: reference ``functional/audio/{pesq,stoi,srmr}.py`` — all
+three wrap host-side third-party backends (ITU P.862 C library, pystoi
+numpy, gammatone filterbank). The same gating pattern is kept: the
+functions import their backend lazily and raise a ``ModuleNotFoundError``
+with an install hint when absent (reference ``utilities/imports.py``
+RequirementCache behavior, SURVEY.md §2.11).
+"""
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def _module_available(name: str) -> bool:
+    import importlib.util
+
+    return importlib.util.find_spec(name) is not None
+
+
+_PESQ_AVAILABLE = _module_available("pesq")
+_PYSTOI_AVAILABLE = _module_available("pystoi")
+_GAMMATONE_AVAILABLE = _module_available("gammatone")
+_TORCHAUDIO_AVAILABLE = _module_available("torchaudio")
+
+
+def perceptual_evaluation_speech_quality(
+    preds: Array,
+    target: Array,
+    fs: int,
+    mode: str,
+    keep_same_device: bool = False,
+    n_processes: int = 1,
+) -> Array:
+    """PESQ (ITU P.862) via the host C backend. Parity: ``pesq.py``."""
+    if not _PESQ_AVAILABLE:
+        raise ModuleNotFoundError(
+            "PESQ metric requires that `pesq` is installed. Install as `pip install torchmetrics[audio]` "
+            "or `pip install pesq`."
+        )
+    import pesq as pesq_backend
+
+    if fs not in (8000, 16000):
+        raise ValueError(f"Expected argument `fs` to either be 8000 or 16000 but got {fs}")
+    if mode not in ("wb", "nb"):
+        raise ValueError(f"Expected argument `mode` to either be 'wb' or 'nb' but got {mode}")
+    p = np.asarray(preds, dtype=np.float32)
+    t = np.asarray(target, dtype=np.float32)
+    if p.ndim == 1:
+        return jnp.asarray(pesq_backend.pesq(fs, t, p, mode))
+    flat_p = p.reshape(-1, p.shape[-1])
+    flat_t = t.reshape(-1, t.shape[-1])
+    if n_processes > 1:
+        scores = pesq_backend.pesq_batch(fs, list(flat_t), list(flat_p), mode, n_processor=n_processes)
+    else:
+        scores = [pesq_backend.pesq(fs, ti, pi, mode) for ti, pi in zip(flat_t, flat_p)]
+    return jnp.asarray(np.asarray(scores, dtype=np.float32).reshape(p.shape[:-1]))
+
+
+def short_time_objective_intelligibility(
+    preds: Array, target: Array, fs: int, extended: bool = False, keep_same_device: bool = False
+) -> Array:
+    """STOI via the host pystoi backend. Parity: ``stoi.py``."""
+    if not _PYSTOI_AVAILABLE:
+        raise ModuleNotFoundError(
+            "STOI metric requires that `pystoi` is installed. Install as `pip install torchmetrics[audio]` "
+            "or `pip install pystoi`."
+        )
+    from pystoi import stoi as stoi_backend
+
+    p = np.asarray(preds, dtype=np.float64)
+    t = np.asarray(target, dtype=np.float64)
+    if p.ndim == 1:
+        return jnp.asarray(stoi_backend(t, p, fs, extended))
+    flat_p = p.reshape(-1, p.shape[-1])
+    flat_t = t.reshape(-1, t.shape[-1])
+    scores = [stoi_backend(ti, pi, fs, extended) for ti, pi in zip(flat_t, flat_p)]
+    return jnp.asarray(np.asarray(scores, dtype=np.float32).reshape(p.shape[:-1]))
+
+
+def speech_reverberation_modulation_energy_ratio(
+    preds: Array,
+    fs: int,
+    n_cochlear_filters: int = 23,
+    low_freq: float = 125.0,
+    min_cf: float = 4.0,
+    max_cf: float = 128.0,
+    norm: bool = False,
+    fast: bool = False,
+    **kwargs: Any,
+) -> Array:
+    """SRMR via the gammatone/torchaudio backend. Parity: ``srmr.py``."""
+    if not (_GAMMATONE_AVAILABLE and _TORCHAUDIO_AVAILABLE):
+        raise ModuleNotFoundError(
+            "SRMR metric requires that `gammatone` and `torchaudio` are installed. "
+            "Install as `pip install torchmetrics[audio]`."
+        )
+    raise NotImplementedError("SRMR backend integration pending (gammatone present but unported).")

@@ -1,0 +1,92 @@
+"""SDR (BSS-eval style) + SA-SDR.
+
+Parity targets: reference ``functional/audio/sdr.py:28-200`` (FFT
+autocorrelation → symmetric Toeplitz system → solve for the optimal
+distortion filter → coherence → dB) and ``:242``
+(source-aggregated SI-SDR).
+
+TPU note: the Toeplitz solve is a batched (filter_length x filter_length)
+dense ``jnp.linalg.solve`` — static shape, maps to the MXU; the FFTs are
+power-of-two rffts.
+"""
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .snr import _EPS, _check_same_shape
+
+Array = jax.Array
+
+
+def _symmetric_toeplitz(vector: Array) -> Array:
+    """Symmetric Toeplitz matrix from its first row, batched. Parity: ``sdr.py:28``."""
+    v_len = vector.shape[-1]
+    idx = jnp.abs(jnp.arange(v_len)[:, None] - jnp.arange(v_len)[None, :])
+    return vector[..., idx]
+
+
+def _compute_autocorr_crosscorr(target: Array, preds: Array, corr_len: int):
+    """FFT-based autocorrelation of target + crosscorrelation with preds.
+
+    Parity: ``sdr.py:57-86``.
+    """
+    n_fft = 2 ** math.ceil(math.log2(preds.shape[-1] + target.shape[-1] - 1))
+    t_fft = jnp.fft.rfft(target, n=n_fft, axis=-1)
+    r_0 = jnp.fft.irfft(t_fft.real**2 + t_fft.imag**2, n=n_fft)[..., :corr_len]
+    p_fft = jnp.fft.rfft(preds, n=n_fft, axis=-1)
+    b = jnp.fft.irfft(jnp.conj(t_fft) * p_fft, n=n_fft, axis=-1)[..., :corr_len]
+    return r_0, b
+
+
+def signal_distortion_ratio(
+    preds: Array,
+    target: Array,
+    use_cg_iter: Optional[int] = None,
+    filter_length: int = 512,
+    zero_mean: bool = False,
+    load_diag: Optional[float] = None,
+) -> Array:
+    """SDR with optimal length-L distortion filter. Parity: ``sdr.py:105``.
+
+    ``use_cg_iter`` is accepted for API parity; the dense Toeplitz solve is
+    always used (XLA batches it onto the MXU, so CG offers no win here).
+    """
+    _check_same_shape(preds, target)
+    if zero_mean:
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+    target = target / jnp.maximum(jnp.linalg.norm(target, axis=-1, keepdims=True), 1e-6)
+    preds = preds / jnp.maximum(jnp.linalg.norm(preds, axis=-1, keepdims=True), 1e-6)
+
+    r_0, b = _compute_autocorr_crosscorr(target, preds, corr_len=filter_length)
+    if load_diag is not None:
+        r_0 = r_0.at[..., 0].add(load_diag)
+    r = _symmetric_toeplitz(r_0)
+    sol = jnp.linalg.solve(r, b[..., None])[..., 0]
+    coh = jnp.sum(b * sol, axis=-1)
+    ratio = coh / jnp.maximum(1.0 - coh, 1e-12)
+    return 10.0 * jnp.log10(jnp.maximum(ratio, 1e-12))
+
+
+def source_aggregated_signal_distortion_ratio(
+    preds: Array, target: Array, scale_invariant: bool = True, zero_mean: bool = False
+) -> Array:
+    """SA-SDR over (..., spk, time). Parity: ``sdr.py:242``."""
+    _check_same_shape(preds, target)
+    if preds.ndim < 2:
+        raise RuntimeError(f"The preds and target should have the shape (..., spk, time), but {preds.shape} found")
+    if zero_mean:
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+    if scale_invariant:
+        alpha = (jnp.sum(jnp.sum(preds * target, axis=-1, keepdims=True), axis=-2, keepdims=True) + _EPS) / (
+            jnp.sum(jnp.sum(target**2, axis=-1, keepdims=True), axis=-2, keepdims=True) + _EPS
+        )
+        target = alpha * target
+    distortion = target - preds
+    val = (jnp.sum(jnp.sum(target**2, axis=-1), axis=-1) + _EPS) / (
+        jnp.sum(jnp.sum(distortion**2, axis=-1), axis=-1) + _EPS
+    )
+    return 10.0 * jnp.log10(val)

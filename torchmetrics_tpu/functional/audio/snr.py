@@ -1,0 +1,64 @@
+"""SNR family: SNR, SI-SNR, C-SI-SNR.
+
+Parity targets: reference ``functional/audio/snr.py`` (SNR :22, SI-SNR :60,
+complex C-SI-SNR :90) — pure projection algebra, batched over leading dims.
+"""
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+_EPS = 1.1920929e-07  # float32 eps, matching torch.finfo(float32).eps
+
+
+def _check_same_shape(preds: Array, target: Array) -> None:
+    if preds.shape != target.shape:
+        raise RuntimeError(
+            f"Predictions and targets are expected to have the same shape, but got {preds.shape} and {target.shape}."
+        )
+
+
+def signal_noise_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
+    """SNR = 10 log10(|target|² / |target - preds|²). Parity: ``snr.py:22``."""
+    _check_same_shape(preds, target)
+    if zero_mean:
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+    noise = target - preds
+    val = (jnp.sum(target**2, axis=-1) + _EPS) / (jnp.sum(noise**2, axis=-1) + _EPS)
+    return 10.0 * jnp.log10(val)
+
+
+def scale_invariant_signal_noise_ratio(preds: Array, target: Array) -> Array:
+    """SI-SNR (zero-mean projection). Parity: ``snr.py:60``."""
+    return scale_invariant_signal_distortion_ratio(preds, target, zero_mean=True)
+
+
+def scale_invariant_signal_distortion_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
+    """SI-SDR via optimal scaling projection. Parity: ``sdr.py:201``."""
+    _check_same_shape(preds, target)
+    if zero_mean:
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+    alpha = (jnp.sum(preds * target, axis=-1, keepdims=True) + _EPS) / (
+        jnp.sum(target**2, axis=-1, keepdims=True) + _EPS
+    )
+    target_scaled = alpha * target
+    noise = target_scaled - preds
+    val = (jnp.sum(target_scaled**2, axis=-1) + _EPS) / (jnp.sum(noise**2, axis=-1) + _EPS)
+    return 10.0 * jnp.log10(val)
+
+
+def complex_scale_invariant_signal_noise_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
+    """C-SI-SNR over (..., F, T, 2) real-imag spectra. Parity: ``snr.py:90``."""
+    if jnp.iscomplexobj(preds):
+        preds = jnp.stack([preds.real, preds.imag], axis=-1)
+    if jnp.iscomplexobj(target):
+        target = jnp.stack([target.real, target.imag], axis=-1)
+    if preds.ndim < 3 or preds.shape[-1] != 2 or target.ndim < 3 or target.shape[-1] != 2:
+        raise RuntimeError(
+            "Predictions and targets are expected to have the shape (..., frequency, time, 2),"
+            f" but got {preds.shape} and {target.shape}."
+        )
+    preds = preds.reshape(preds.shape[:-3] + (-1,))
+    target = target.reshape(target.shape[:-3] + (-1,))
+    return scale_invariant_signal_distortion_ratio(preds, target, zero_mean=zero_mean)

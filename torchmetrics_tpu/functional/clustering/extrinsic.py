@@ -1,0 +1,137 @@
+"""Label-comparison (extrinsic) clustering metrics.
+
+Parity targets: reference ``functional/clustering/{mutual_info_score,
+adjusted_mutual_info_score,normalized_mutual_info_score,rand_score,
+adjusted_rand_score,fowlkes_mallows_index,
+homogeneity_completeness_v_measure}.py``. Convention (as in the reference):
+``preds`` = predicted cluster labels, ``target`` = ground-truth labels,
+matching ``sklearn.metrics.*(labels_true=target, labels_pred=preds)``.
+"""
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from .utils import (
+    calculate_contingency_matrix,
+    calculate_entropy,
+    calculate_generalized_mean,
+    check_cluster_labels,
+    expected_mutual_info,
+    mutual_info_from_contingency,
+    pair_counts,
+    relabel_dense,
+)
+
+Array = jax.Array
+
+
+def _contingency(preds: Array, target: Array) -> Array:
+    check_cluster_labels(preds, target)
+    p, num_p = relabel_dense(preds)
+    t, num_t = relabel_dense(target)
+    return calculate_contingency_matrix(p, t, num_p, num_t)
+
+
+def mutual_info_score(preds: Array, target: Array) -> Array:
+    """MI between two clusterings (nats). Parity: ``mutual_info_score.py``."""
+    return mutual_info_from_contingency(_contingency(preds, target)).astype(jnp.float32)
+
+
+def normalized_mutual_info_score(
+    preds: Array, target: Array, average_method: str = "arithmetic"
+) -> Array:
+    """NMI with selectable normalizer mean. Parity: ``normalized_mutual_info_score.py``."""
+    if average_method not in ("min", "geometric", "arithmetic", "max"):
+        raise ValueError(
+            "Expected argument `average_method` to be one of `min`, `geometric`, `arithmetic`, `max`,"
+            f"but got {average_method}"
+        )
+    m = _contingency(preds, target)
+    mi = mutual_info_from_contingency(m)
+    h_pred = calculate_entropy(jnp.sum(m, axis=1))
+    h_tgt = calculate_entropy(jnp.sum(m, axis=0))
+    norm = calculate_generalized_mean(jnp.stack([h_pred, h_tgt]), average_method)
+    return jnp.where(jnp.abs(mi) < 1e-15, 0.0, mi / jnp.maximum(norm, 1e-15)).astype(jnp.float32)
+
+
+def adjusted_mutual_info_score(
+    preds: Array, target: Array, average_method: str = "arithmetic"
+) -> Array:
+    """AMI (chance-adjusted MI). Parity: ``adjusted_mutual_info_score.py``."""
+    if average_method not in ("min", "geometric", "arithmetic", "max"):
+        raise ValueError(
+            "Expected argument `average_method` to be one of `min`, `geometric`, `arithmetic`, `max`,"
+            f"but got {average_method}"
+        )
+    m = _contingency(preds, target)
+    mi = mutual_info_from_contingency(m)
+    emi = expected_mutual_info(m)
+    h_pred = calculate_entropy(jnp.sum(m, axis=1))
+    h_tgt = calculate_entropy(jnp.sum(m, axis=0))
+    norm = calculate_generalized_mean(jnp.stack([h_pred, h_tgt]), average_method)
+    denom = norm - emi
+    # sklearn: if denominator is ~0, AMI := 1 when numerator also ~0 (identical trivial splits)
+    num = mi - emi
+    denom = jnp.where(
+        jnp.abs(denom) < jnp.finfo(jnp.float64).eps,
+        jnp.where(denom >= 0, jnp.finfo(jnp.float64).eps, -jnp.finfo(jnp.float64).eps),
+        denom,
+    )
+    return (num / denom).astype(jnp.float32)
+
+
+def rand_score(preds: Array, target: Array) -> Array:
+    """Rand index = pair-agreement fraction. Parity: ``rand_score.py``."""
+    m = _contingency(preds, target)
+    s_cells, s_rows, s_cols, total = pair_counts(m)
+    agree = total + 2.0 * s_cells - s_rows - s_cols
+    return jnp.where(total > 0, agree / jnp.maximum(total, 1.0), 1.0).astype(jnp.float32)
+
+
+def adjusted_rand_score(preds: Array, target: Array) -> Array:
+    """ARI (chance-adjusted Rand). Parity: ``adjusted_rand_score.py``."""
+    m = _contingency(preds, target)
+    s_cells, s_rows, s_cols, total = pair_counts(m)
+    expected = s_rows * s_cols / jnp.maximum(total, 1.0)
+    max_index = 0.5 * (s_rows + s_cols)
+    denom = max_index - expected
+    return jnp.where(jnp.abs(denom) < 1e-15, 1.0, (s_cells - expected) / denom).astype(jnp.float32)
+
+
+def fowlkes_mallows_index(preds: Array, target: Array) -> Array:
+    """FMI = TP / sqrt((TP+FP)(TP+FN)) over pairs. Parity: ``fowlkes_mallows_index.py``."""
+    m = _contingency(preds, target)
+    s_cells, s_rows, s_cols, _ = pair_counts(m)
+    denom = jnp.sqrt(jnp.maximum(s_rows * s_cols, 1e-30))
+    return jnp.where(s_rows * s_cols > 0, s_cells / denom, 0.0).astype(jnp.float32)
+
+
+def homogeneity_completeness_v_measure(
+    preds: Array, target: Array, beta: float = 1.0
+) -> Tuple[Array, Array, Array]:
+    """(homogeneity, completeness, v-measure). Parity: ``homogeneity_completeness_v_measure.py``."""
+    m = _contingency(preds, target)
+    mi = mutual_info_from_contingency(m)
+    h_pred = calculate_entropy(jnp.sum(m, axis=1))
+    h_tgt = calculate_entropy(jnp.sum(m, axis=0))
+    homogeneity = jnp.where(h_tgt > 0, mi / jnp.maximum(h_tgt, 1e-30), 1.0)
+    completeness = jnp.where(h_pred > 0, mi / jnp.maximum(h_pred, 1e-30), 1.0)
+    denom = beta * homogeneity + completeness
+    v = jnp.where(denom > 0, (1.0 + beta) * homogeneity * completeness / jnp.maximum(denom, 1e-30), 0.0)
+    return homogeneity.astype(jnp.float32), completeness.astype(jnp.float32), v.astype(jnp.float32)
+
+
+def homogeneity_score(preds: Array, target: Array) -> Array:
+    """Each predicted cluster contains only members of one class."""
+    return homogeneity_completeness_v_measure(preds, target)[0]
+
+
+def completeness_score(preds: Array, target: Array) -> Array:
+    """All members of a class land in the same predicted cluster."""
+    return homogeneity_completeness_v_measure(preds, target)[1]
+
+
+def v_measure_score(preds: Array, target: Array, beta: float = 1.0) -> Array:
+    """Weighted harmonic mean of homogeneity and completeness."""
+    return homogeneity_completeness_v_measure(preds, target, beta)[2]

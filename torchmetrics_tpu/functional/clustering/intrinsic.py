@@ -1,0 +1,83 @@
+"""Embedding-based (intrinsic) clustering metrics.
+
+Parity targets: reference ``functional/clustering/{calinski_harabasz_score,
+davies_bouldin_score,dunn_index}.py``. All three are one-shot dense linear
+algebra over (N, D) data — segment sums for per-cluster moments (maps to
+``jax.ops.segment_sum``, SURVEY.md §7 stage 5) and a pairwise distance
+matrix for the Dunn index.
+"""
+import jax
+import jax.numpy as jnp
+
+from .utils import relabel_dense
+
+Array = jax.Array
+
+
+def _validate_intrinsic(data: Array, labels: Array) -> None:
+    if data.ndim != 2:
+        raise ValueError(f"Expected 2D data tensor but got {data.ndim}D")
+    if labels.ndim != 1 or labels.shape[0] != data.shape[0]:
+        raise ValueError("Expected 1D labels with one entry per data row")
+
+
+def calinski_harabasz_score(data: Array, labels: Array) -> Array:
+    """Between/within dispersion ratio. Parity: ``calinski_harabasz_score.py``."""
+    _validate_intrinsic(data, labels)
+    lbl, k = relabel_dense(labels)
+    n, _ = data.shape
+    data = data.astype(jnp.float32)
+    counts = jax.ops.segment_sum(jnp.ones((n,)), lbl, num_segments=k)
+    sums = jax.ops.segment_sum(data, lbl, num_segments=k)
+    means = sums / jnp.maximum(counts[:, None], 1.0)
+    overall = jnp.mean(data, axis=0)
+    # between-group dispersion
+    bgss = jnp.sum(counts * jnp.sum((means - overall[None]) ** 2, axis=-1))
+    # within-group dispersion
+    diffs = data - means[lbl]
+    wgss = jnp.sum(diffs**2)
+    return jnp.where(
+        (k > 1) & (wgss > 0), (bgss / jnp.maximum(wgss, 1e-30)) * (n - k) / jnp.maximum(k - 1, 1), 0.0
+    )
+
+
+def davies_bouldin_score(data: Array, labels: Array) -> Array:
+    """Mean worst-pair similarity of cluster scatter vs separation.
+
+    Parity: ``davies_bouldin_score.py`` (sklearn semantics).
+    """
+    _validate_intrinsic(data, labels)
+    lbl, k = relabel_dense(labels)
+    n, _ = data.shape
+    data = data.astype(jnp.float32)
+    counts = jax.ops.segment_sum(jnp.ones((n,)), lbl, num_segments=k)
+    sums = jax.ops.segment_sum(data, lbl, num_segments=k)
+    means = sums / jnp.maximum(counts[:, None], 1.0)
+    # intra-cluster mean distance to centroid (S_i)
+    dist_to_centroid = jnp.linalg.norm(data - means[lbl], axis=-1)
+    s = jax.ops.segment_sum(dist_to_centroid, lbl, num_segments=k) / jnp.maximum(counts, 1.0)
+    # centroid separations (M_ij)
+    m = jnp.linalg.norm(means[:, None, :] - means[None, :, :], axis=-1)
+    ratio = (s[:, None] + s[None, :]) / jnp.where(m > 0, m, jnp.inf)
+    ratio = jnp.where(jnp.eye(k, dtype=bool), -jnp.inf, ratio)
+    return jnp.where(k > 1, jnp.mean(jnp.max(ratio, axis=-1)), 0.0)
+
+
+def dunn_index(data: Array, labels: Array, p: float = 2.0) -> Array:
+    """Min inter-cluster centroid distance / max intra-cluster diameter.
+
+    Parity: reference ``dunn_index.py`` — distances between cluster
+    *centroids* over the maximum mean-distance-to-centroid diameter.
+    """
+    _validate_intrinsic(data, labels)
+    lbl, k = relabel_dense(labels)
+    n, _ = data.shape
+    data = data.astype(jnp.float32)
+    counts = jax.ops.segment_sum(jnp.ones((n,)), lbl, num_segments=k)
+    sums = jax.ops.segment_sum(data, lbl, num_segments=k)
+    means = sums / jnp.maximum(counts[:, None], 1.0)
+    inter = jnp.linalg.norm(means[:, None, :] - means[None, :, :], ord=p, axis=-1)
+    inter = jnp.where(jnp.eye(k, dtype=bool), jnp.inf, inter)
+    intra_dist = jnp.linalg.norm(data - means[lbl], ord=p, axis=-1)
+    max_intra = jax.ops.segment_max(intra_dist, lbl, num_segments=k)
+    return jnp.min(inter) / jnp.maximum(jnp.max(max_intra), 1e-30)

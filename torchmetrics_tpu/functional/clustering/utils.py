@@ -1,0 +1,138 @@
+"""Shared clustering machinery: contingency matrix, entropy, pair counts, EMI.
+
+Parity target: reference ``functional/clustering/utils.py`` (contingency +
+pair counting at :282). TPU-native notes: the contingency matrix is built as
+ONE flattened bincount (``R*C`` bins — same trick the classification
+confusion-matrix engine uses), and the AMI expected-mutual-information sum
+(sklearn does this in Cython) is a fully vectorized (R, C, n_max) tensor
+contraction using ``gammaln`` — no scalar loops.
+"""
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def check_cluster_labels(preds: Array, target: Array) -> None:
+    """Both inputs must be 1-D integer label vectors of equal length."""
+    if preds.shape != target.shape or preds.ndim != 1:
+        raise ValueError(
+            f"Expected 1d integer label tensors of equal shape, got {preds.shape} and {target.shape}"
+        )
+    for name, x in (("preds", preds), ("target", target)):
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            raise ValueError(f"Expected integer cluster labels for `{name}`, got {x.dtype}")
+
+
+def calculate_contingency_matrix(
+    preds: Array, target: Array, num_preds: int, num_target: int, eps: Optional[float] = None
+) -> Array:
+    """Dense (num_preds, num_target) contingency via one flattened bincount."""
+    joint = preds.astype(jnp.int32) * num_target + target.astype(jnp.int32)
+    mat = jnp.bincount(joint, length=num_preds * num_target).reshape(num_preds, num_target)
+    if eps is not None:
+        mat = mat.astype(jnp.float32) + eps
+    return mat
+
+
+def _label_counts(contingency: Array) -> Tuple[Array, Array, Array]:
+    a = jnp.sum(contingency, axis=1)  # preds marginal
+    b = jnp.sum(contingency, axis=0)  # target marginal
+    n = jnp.sum(a)
+    return a.astype(jnp.float64), b.astype(jnp.float64), n.astype(jnp.float64)
+
+
+def calculate_entropy(counts: Array) -> Array:
+    """Entropy (nats) of a label distribution given bin counts."""
+    n = jnp.sum(counts)
+    p = counts / jnp.maximum(n, 1.0)
+    return -jnp.sum(jnp.where(counts > 0, p * (jnp.log(jnp.maximum(counts, 1.0)) - jnp.log(jnp.maximum(n, 1.0))), 0.0))
+
+
+def calculate_generalized_mean(x: Array, p: Union[int, str]) -> Array:
+    """Power mean. Parity: reference ``utils.py calculate_generalized_mean``."""
+    if isinstance(p, str):
+        if p == "min":
+            return jnp.min(x)
+        if p == "geometric":
+            return jnp.exp(jnp.mean(jnp.log(jnp.maximum(x, 1e-30))))
+        if p == "arithmetic":
+            return jnp.mean(x)
+        if p == "max":
+            return jnp.max(x)
+        raise ValueError("'method' must be 'min', 'geometric', 'arithmetic', or 'max'")
+    return jnp.mean(jnp.power(x, p)) ** (1.0 / p)
+
+
+def mutual_info_from_contingency(contingency: Array) -> Array:
+    """MI (nats) between the two labelings of a contingency matrix."""
+    a, b, n = _label_counts(contingency)
+    c = contingency.astype(jnp.float64)
+    outer = a[:, None] * b[None, :]
+    valid = c > 0
+    logterm = jnp.log(jnp.maximum(c, 1.0)) + jnp.log(jnp.maximum(n, 1.0)) - jnp.log(jnp.maximum(outer, 1.0))
+    return jnp.sum(jnp.where(valid, (c / jnp.maximum(n, 1.0)) * logterm, 0.0))
+
+
+def pair_counts(contingency: Array) -> Tuple[Array, Array, Array, Array]:
+    """(sum_comb_cells, sum_comb_rows, sum_comb_cols, comb_total) — #same-cluster pairs."""
+
+    def comb2(x):
+        return x * (x - 1.0) / 2.0
+
+    a, b, n = _label_counts(contingency)
+    c = contingency.astype(jnp.float64)
+    return jnp.sum(comb2(c)), jnp.sum(comb2(a)), jnp.sum(comb2(b)), comb2(n)
+
+
+def expected_mutual_info(contingency: Array) -> Array:
+    """Expected MI under the permutation model (sklearn ``expected_mutual_information``).
+
+    Vectorized over an (R, C, n_max) grid: for each cell the hypergeometric
+    probability of each feasible co-occurrence count ``nij`` times its MI
+    contribution, summed with a feasibility mask. Runs on HOST in numpy
+    float64 — the gammaln difference chains cancel catastrophically in
+    float32 (JAX x64 is typically disabled), and this is an eager
+    once-per-epoch computation.
+    """
+    import numpy as np
+    from scipy.special import gammaln as np_gammaln
+
+    cont = np.asarray(contingency, dtype=np.float64)
+    a = cont.sum(axis=1)
+    b = cont.sum(axis=0)
+    n = cont.sum()
+    n_max = int(n)
+    nij = np.arange(1, n_max + 1, dtype=np.float64)
+    ai = a[:, None, None]
+    bj = b[None, :, None]
+    nijg = nij[None, None, :]
+    lo = np.maximum(ai + bj - n, 1.0)
+    hi = np.minimum(ai, bj)
+    feasible = (nijg >= lo) & (nijg <= hi)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        term_mi = (nijg / n) * (np.log(n) + np.log(nijg) - np.log(np.maximum(ai * bj, 1.0)))
+        log_p = (
+            np_gammaln(ai + 1.0)
+            + np_gammaln(bj + 1.0)
+            + np_gammaln(n - ai + 1.0)
+            + np_gammaln(n - bj + 1.0)
+            - np_gammaln(n + 1.0)
+            - np_gammaln(nijg + 1.0)
+            - np_gammaln(np.maximum(ai - nijg + 1.0, 1.0))
+            - np_gammaln(np.maximum(bj - nijg + 1.0, 1.0))
+            - np_gammaln(np.maximum(n - ai - bj + nijg + 1.0, 1.0))
+        )
+        contrib = np.where(feasible, term_mi * np.exp(log_p), 0.0)
+    return jnp.asarray(contrib.sum())
+
+
+def relabel_dense(labels: Array) -> Tuple[Array, int]:
+    """Map arbitrary integer labels to 0..K-1 (host-side, eager only)."""
+    import numpy as np
+
+    arr = np.asarray(labels)
+    uniq, inv = np.unique(arr, return_inverse=True)
+    return jnp.asarray(inv), len(uniq)

@@ -1,0 +1,228 @@
+"""Nominal association metrics: Cramer's V, Tschuprow's T, Pearson's
+contingency coefficient, Theil's U, Fleiss kappa (+ pairwise matrix forms).
+
+Parity targets: reference ``functional/nominal/{cramers,tschuprows,pearson,
+theils_u,fleiss_kappa}.py``.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .utils import (
+    _bias_corrected_values,
+    _compute_chi_squared,
+    _confmat_update,
+    _drop_empty_rows_and_cols,
+    _handle_nan_in_data,
+    _nominal_input_validation,
+    _unable_to_use_bias_correction_warning,
+)
+
+Array = jax.Array
+
+
+def _as_labels(x: Array) -> Array:
+    """2-D score inputs become argmax labels (reference ``cramers.py:52``)."""
+    x = jnp.asarray(x)
+    return jnp.argmax(x, axis=1) if x.ndim == 2 else x
+
+
+def _num_classes(*arrays: Array) -> int:
+    return int(max(int(jnp.max(a)) for a in arrays)) + 1
+
+
+def _nominal_confmat(
+    preds: Array, target: Array, nan_strategy: str, nan_replace_value: Optional[float]
+) -> np.ndarray:
+    preds, target = _as_labels(preds), _as_labels(target)
+    preds, target = _handle_nan_in_data(preds, target, nan_strategy, nan_replace_value)
+    preds = preds.astype(jnp.int32)
+    target = target.astype(jnp.int32)
+    nc = _num_classes(preds, target)
+    return np.asarray(_confmat_update(preds, target, nc))
+
+
+def _cramers_v_compute(confmat: np.ndarray, bias_correction: bool) -> Array:
+    confmat = jnp.asarray(_drop_empty_rows_and_cols(confmat))
+    n = jnp.sum(confmat)
+    chi2 = _compute_chi_squared(confmat, bias_correction)
+    phi2 = chi2 / jnp.maximum(n, 1.0)
+    r, c = confmat.shape
+    if bias_correction:
+        phi2c, rc, cc = _bias_corrected_values(phi2, r, c, n)
+        if float(jnp.minimum(rc, cc)) == 1.0:
+            _unable_to_use_bias_correction_warning("Cramer's V")
+            return jnp.asarray(jnp.nan)
+        v = jnp.sqrt(phi2c / jnp.minimum(rc - 1.0, cc - 1.0))
+    else:
+        v = jnp.sqrt(phi2 / max(min(r - 1, c - 1), 1))
+    return jnp.clip(v, 0.0, 1.0)
+
+
+def cramers_v(
+    preds: Array,
+    target: Array,
+    bias_correction: bool = True,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Cramer's V association in [0, 1]. Parity: ``cramers.py:88``."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    return _cramers_v_compute(_nominal_confmat(preds, target, nan_strategy, nan_replace_value), bias_correction)
+
+
+def _tschuprows_t_compute(confmat: np.ndarray, bias_correction: bool) -> Array:
+    confmat = jnp.asarray(_drop_empty_rows_and_cols(confmat))
+    n = jnp.sum(confmat)
+    chi2 = _compute_chi_squared(confmat, bias_correction)
+    phi2 = chi2 / jnp.maximum(n, 1.0)
+    r, c = confmat.shape
+    if bias_correction:
+        phi2c, rc, cc = _bias_corrected_values(phi2, r, c, n)
+        if float(jnp.minimum(rc, cc)) == 1.0:
+            _unable_to_use_bias_correction_warning("Tschuprow's T")
+            return jnp.asarray(jnp.nan)
+        t = jnp.sqrt(phi2c / jnp.sqrt((rc - 1.0) * (cc - 1.0)))
+    else:
+        t = jnp.sqrt(phi2 / jnp.sqrt(float(max(r - 1, 1)) * float(max(c - 1, 1))))
+    return jnp.clip(t, 0.0, 1.0)
+
+
+def tschuprows_t(
+    preds: Array,
+    target: Array,
+    bias_correction: bool = True,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Tschuprow's T association in [0, 1]. Parity: ``tschuprows.py``."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    return _tschuprows_t_compute(_nominal_confmat(preds, target, nan_strategy, nan_replace_value), bias_correction)
+
+
+def _pearsons_contingency_coefficient_compute(confmat: np.ndarray) -> Array:
+    confmat = jnp.asarray(_drop_empty_rows_and_cols(confmat))
+    n = jnp.sum(confmat)
+    chi2 = _compute_chi_squared(confmat, bias_correction=False)
+    phi2 = chi2 / jnp.maximum(n, 1.0)
+    return jnp.clip(jnp.sqrt(phi2 / (1.0 + phi2)), 0.0, 1.0)
+
+
+def pearsons_contingency_coefficient(
+    preds: Array,
+    target: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Pearson's contingency coefficient in [0, 1]. Parity: ``pearson.py``."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    return _pearsons_contingency_coefficient_compute(
+        _nominal_confmat(preds, target, nan_strategy, nan_replace_value)
+    )
+
+
+def _conditional_entropy(confmat: Array) -> Array:
+    """H(X|Y) where rows index Y (preds) and columns index X (target)."""
+    n = jnp.sum(confmat)
+    p_xy = confmat / jnp.maximum(n, 1.0)
+    p_y = jnp.sum(confmat, axis=1) / jnp.maximum(n, 1.0)
+    ratio = p_y[:, None] / jnp.where(p_xy > 0, p_xy, 1.0)
+    return jnp.sum(jnp.where(p_xy > 0, p_xy * jnp.log(ratio), 0.0))
+
+
+def _theils_u_compute(confmat: np.ndarray) -> Array:
+    confmat = jnp.asarray(_drop_empty_rows_and_cols(confmat))
+    s_xy = _conditional_entropy(confmat)
+    n = jnp.sum(confmat)
+    p_x = jnp.sum(confmat, axis=0) / jnp.maximum(n, 1.0)
+    s_x = -jnp.sum(jnp.where(p_x > 0, p_x * jnp.log(jnp.where(p_x > 0, p_x, 1.0)), 0.0))
+    return jnp.where(s_x == 0, 0.0, (s_x - s_xy) / jnp.maximum(s_x, 1e-12))
+
+
+def theils_u(
+    preds: Array,
+    target: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Theil's U (uncertainty coefficient) in [0, 1]. Parity: ``theils_u.py``."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    return _theils_u_compute(_nominal_confmat(preds, target, nan_strategy, nan_replace_value))
+
+
+def _fleiss_kappa_update(ratings: Array, mode: str = "counts") -> Array:
+    if mode == "probs":
+        if ratings.ndim != 3 or not jnp.issubdtype(ratings.dtype, jnp.floating):
+            raise ValueError(
+                "If argument ``mode`` is 'probs', ratings must have 3 dimensions with the format"
+                " [n_samples, n_categories, n_raters] and be floating point."
+            )
+        chosen = jnp.argmax(ratings, axis=1)  # (n_samples, n_raters)
+        num_cat = ratings.shape[1]
+        return jax.nn.one_hot(chosen, num_cat, dtype=jnp.int32).sum(axis=1)
+    if ratings.ndim != 2 or jnp.issubdtype(ratings.dtype, jnp.floating):
+        raise ValueError(
+            "If argument ``mode`` is `counts`, ratings must have 2 dimensions with the format"
+            " [n_samples, n_categories] and be none floating point."
+        )
+    return ratings
+
+
+def _fleiss_kappa_compute(counts: Array) -> Array:
+    counts = counts.astype(jnp.float32)
+    total = counts.shape[0]
+    num_raters = jnp.max(jnp.sum(counts, axis=1))
+    p_i = jnp.sum(counts, axis=0) / (total * num_raters)
+    p_j = (jnp.sum(counts**2, axis=1) - num_raters) / (num_raters * (num_raters - 1.0))
+    p_bar = jnp.mean(p_j)
+    pe_bar = jnp.sum(p_i**2)
+    return (p_bar - pe_bar) / (1.0 - pe_bar + 1e-5)
+
+
+def fleiss_kappa(ratings: Array, mode: str = "counts") -> Array:
+    """Inter-rater agreement kappa. Parity: ``fleiss_kappa.py:61``."""
+    if mode not in ("counts", "probs"):
+        raise ValueError("Argument ``mode`` must be one of ['counts', 'probs'].")
+    return _fleiss_kappa_compute(_fleiss_kappa_update(jnp.asarray(ratings), mode))
+
+
+def _pairwise_matrix(single_fn, matrix: Array, **kwargs) -> Array:
+    """Symmetric association matrix over columns of a (N, num_vars) table."""
+    matrix = jnp.asarray(matrix)
+    num_vars = matrix.shape[1]
+    out = np.ones((num_vars, num_vars), dtype=np.float32)
+    for i in range(num_vars):
+        for j in range(i + 1, num_vars):
+            val = float(single_fn(matrix[:, i], matrix[:, j], **kwargs))
+            out[i, j] = out[j, i] = val
+    return jnp.asarray(out)
+
+
+def cramers_v_matrix(matrix: Array, bias_correction: bool = True,
+                     nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0) -> Array:
+    """Pairwise Cramer's V over table columns. Parity: ``cramers.py:141``."""
+    return _pairwise_matrix(cramers_v, matrix, bias_correction=bias_correction,
+                            nan_strategy=nan_strategy, nan_replace_value=nan_replace_value)
+
+
+def tschuprows_t_matrix(matrix: Array, bias_correction: bool = True,
+                        nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0) -> Array:
+    """Pairwise Tschuprow's T over table columns."""
+    return _pairwise_matrix(tschuprows_t, matrix, bias_correction=bias_correction,
+                            nan_strategy=nan_strategy, nan_replace_value=nan_replace_value)
+
+
+def pearsons_contingency_coefficient_matrix(matrix: Array, nan_strategy: str = "replace",
+                                            nan_replace_value: Optional[float] = 0.0) -> Array:
+    """Pairwise Pearson contingency coefficients over table columns."""
+    return _pairwise_matrix(pearsons_contingency_coefficient, matrix,
+                            nan_strategy=nan_strategy, nan_replace_value=nan_replace_value)
+
+
+def theils_u_matrix(matrix: Array, nan_strategy: str = "replace",
+                    nan_replace_value: Optional[float] = 0.0) -> Array:
+    """Pairwise Theil's U over table columns (asymmetric in general)."""
+    return _pairwise_matrix(theils_u, matrix, nan_strategy=nan_strategy,
+                            nan_replace_value=nan_replace_value)

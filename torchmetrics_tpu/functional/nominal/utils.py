@@ -1,0 +1,88 @@
+"""Shared nominal-association machinery (chi-squared, bias correction, NaN policy).
+
+Parity target: reference ``functional/nominal/utils.py`` — expected
+frequencies, chi-squared with Yates correction at df=1, bias-corrected
+phi-squared/row/col counts, empty row/col dropping, NaN handling.
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _nominal_input_validation(nan_strategy: str, nan_replace_value: Optional[float]) -> None:
+    if nan_strategy not in ("replace", "drop"):
+        raise ValueError(
+            f"Argument `nan_strategy` is expected to be one of `['replace', 'drop']`, but got {nan_strategy}"
+        )
+    if nan_strategy == "replace" and not isinstance(nan_replace_value, (float, int)):
+        raise ValueError(
+            "Argument `nan_replace` is expected to be of a type `int` or `float` when `nan_strategy = 'replace`, "
+            f"but got {nan_replace_value}"
+        )
+
+
+def _handle_nan_in_data(
+    preds: Array,
+    target: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Tuple[Array, Array]:
+    """Replace NaNs with a category value, or drop rows with any NaN."""
+    if not (jnp.issubdtype(preds.dtype, jnp.floating) or jnp.issubdtype(target.dtype, jnp.floating)):
+        return preds, target
+    p = preds.astype(jnp.float32)
+    t = target.astype(jnp.float32)
+    if nan_strategy == "replace":
+        return jnp.nan_to_num(p, nan=nan_replace_value), jnp.nan_to_num(t, nan=nan_replace_value)
+    keep = ~(jnp.isnan(p) | jnp.isnan(t))
+    return p[keep], t[keep]
+
+
+def _confmat_update(preds: Array, target: Array, num_classes: int) -> Array:
+    """(num_classes, num_classes) co-occurrence counts via one flat bincount."""
+    p = preds.reshape(-1).astype(jnp.int32)
+    t = target.reshape(-1).astype(jnp.int32)
+    joint = p * num_classes + t
+    return jnp.bincount(joint, length=num_classes * num_classes).reshape(num_classes, num_classes).astype(jnp.float32)
+
+
+def _drop_empty_rows_and_cols(confmat: np.ndarray) -> np.ndarray:
+    """Remove all-zero rows/cols (host-side, data-dependent shape)."""
+    confmat = confmat[confmat.sum(1) != 0]
+    return confmat[:, confmat.sum(0) != 0]
+
+
+def _compute_chi_squared(confmat: Array, bias_correction: bool) -> Array:
+    """Chi-squared independence statistic with Yates correction at df=1."""
+    confmat = confmat.astype(jnp.float32)
+    rows = jnp.sum(confmat, axis=1)
+    cols = jnp.sum(confmat, axis=0)
+    n = jnp.sum(confmat)
+    expected = jnp.outer(rows, cols) / jnp.maximum(n, 1.0)
+    r, c = confmat.shape
+    df = r * c - r - c + 1
+    if df == 0:
+        return jnp.asarray(0.0)
+    if df == 1 and bias_correction:
+        diff = expected - confmat
+        confmat = confmat + jnp.sign(diff) * jnp.minimum(0.5, jnp.abs(diff))
+    return jnp.sum((confmat - expected) ** 2 / jnp.maximum(expected, 1e-12))
+
+
+def _bias_corrected_values(phi_squared: Array, num_rows: int, num_cols: int, n: Array):
+    phi2c = jnp.maximum(0.0, phi_squared - ((num_rows - 1) * (num_cols - 1)) / jnp.maximum(n - 1.0, 1.0))
+    rows_c = num_rows - (num_rows - 1) ** 2 / jnp.maximum(n - 1.0, 1.0)
+    cols_c = num_cols - (num_cols - 1) ** 2 / jnp.maximum(n - 1.0, 1.0)
+    return phi2c, rows_c, cols_c
+
+
+def _unable_to_use_bias_correction_warning(metric_name: str) -> None:
+    rank_zero_warn(
+        f"Unable to compute {metric_name} using bias correction. Please consider to set `bias_correction=False`."
+    )

@@ -1,0 +1,116 @@
+"""Pairwise distance/similarity matrices (functional-only domain).
+
+Parity targets: reference ``functional/pairwise/{cosine,euclidean,linear,
+manhattan,minkowski}.py`` + ``helpers.py``. All are single dense XLA
+programs; the euclidean/linear forms are expressed via one matmul so the
+MXU does the work.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _mm(a, b):
+    """fp32-exact matmul even on TPU (metrics must not silently bf16)."""
+    return jnp.matmul(a, b, precision=lax.Precision.HIGHEST)
+
+Array = jax.Array
+
+
+def _check_input(x: Array, y: Optional[Array], zero_diagonal: Optional[bool]):
+    """Parity: reference ``functional/pairwise/helpers.py:_check_input``."""
+    x = jnp.asarray(x)
+    if x.ndim != 2:
+        raise ValueError(f"Expected argument `x` to be a 2D tensor of shape `[N, d]` but got {x.shape}")
+    if y is not None:
+        y = jnp.asarray(y)
+        if y.ndim != 2 or y.shape[1] != x.shape[1]:
+            raise ValueError(
+                "Expected argument `y` to be a 2D tensor of shape `[M, d]` where"
+                f" `d` should be same as the last dimension of `x`, but got {y.shape}"
+            )
+        zero_diagonal = False if zero_diagonal is None else zero_diagonal
+    else:
+        y = x
+        zero_diagonal = True if zero_diagonal is None else zero_diagonal
+    return x.astype(jnp.float32), y.astype(jnp.float32), zero_diagonal
+
+
+def _reduce(matrix: Array, reduction: Optional[str]) -> Array:
+    """Parity: reference ``helpers.py:_reduce_distance_matrix``."""
+    if reduction == "mean":
+        return jnp.mean(matrix, axis=-1)
+    if reduction == "sum":
+        return jnp.sum(matrix, axis=-1)
+    if reduction in (None, "none"):
+        return matrix
+    raise ValueError(f"Expected reduction to be one of `['mean', 'sum', None]` but got {reduction}")
+
+
+def _zero_diag(matrix: Array, zero_diagonal: bool) -> Array:
+    if zero_diagonal:
+        n = min(matrix.shape)
+        matrix = matrix.at[jnp.arange(n), jnp.arange(n)].set(0.0)
+    return matrix
+
+
+def pairwise_cosine_similarity(
+    x: Array, y: Optional[Array] = None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """Cosine similarity matrix x·yᵀ/(|x||y|). Parity: ``pairwise/cosine.py``."""
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    xn = x / jnp.maximum(jnp.linalg.norm(x, axis=-1, keepdims=True), 1e-12)
+    yn = y / jnp.maximum(jnp.linalg.norm(y, axis=-1, keepdims=True), 1e-12)
+    return _reduce(_zero_diag(_mm(xn, yn.T), zero_diagonal), reduction)
+
+
+def pairwise_euclidean_distance(
+    x: Array, y: Optional[Array] = None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """Euclidean distance matrix via the |x|²+|y|²-2x·y matmul expansion."""
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    x_sq = jnp.sum(x * x, axis=-1, keepdims=True)
+    y_sq = jnp.sum(y * y, axis=-1, keepdims=True)
+    d2 = x_sq + y_sq.T - 2.0 * _mm(x, y.T)
+    matrix = jnp.sqrt(jnp.maximum(d2, 0.0))
+    return _reduce(_zero_diag(matrix, zero_diagonal), reduction)
+
+
+def pairwise_linear_similarity(
+    x: Array, y: Optional[Array] = None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """Inner-product similarity matrix x·yᵀ. Parity: ``pairwise/linear.py``."""
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    return _reduce(_zero_diag(_mm(x, y.T), zero_diagonal), reduction)
+
+
+def pairwise_manhattan_distance(
+    x: Array, y: Optional[Array] = None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """L1 distance matrix. Parity: ``pairwise/manhattan.py``."""
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    matrix = jnp.sum(jnp.abs(x[:, None, :] - y[None, :, :]), axis=-1)
+    return _reduce(_zero_diag(matrix, zero_diagonal), reduction)
+
+
+def pairwise_minkowski_distance(
+    x: Array, y: Optional[Array] = None, exponent: float = 2.0,
+    reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """Lp distance matrix. Parity: ``pairwise/minkowski.py``."""
+    if not (isinstance(exponent, (int, float)) and exponent >= 1):
+        raise ValueError(f"Argument `exponent` must be a float larger than 1, but got {exponent}")
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    matrix = jnp.sum(jnp.abs(x[:, None, :] - y[None, :, :]) ** exponent, axis=-1) ** (1.0 / exponent)
+    return _reduce(_zero_diag(matrix, zero_diagonal), reduction)
+
+
+__all__ = [
+    "pairwise_cosine_similarity",
+    "pairwise_euclidean_distance",
+    "pairwise_linear_similarity",
+    "pairwise_manhattan_distance",
+    "pairwise_minkowski_distance",
+]

@@ -1,0 +1,124 @@
+"""Functional retrieval kernels (L3).
+
+Single-query public API with reference-parity signatures
+(``functional/retrieval/__init__.py``); all maths delegate to the batched
+padded kernels in ``_ops.py``.
+"""
+from typing import Optional, Tuple
+
+import jax
+
+from ._ops import (
+    _single,
+    batched_auroc,
+    batched_average_precision,
+    batched_fall_out,
+    batched_hit_rate,
+    batched_ndcg,
+    batched_precision,
+    batched_precision_recall_curve,
+    batched_r_precision,
+    batched_recall,
+    batched_reciprocal_rank,
+    _check_retrieval_functional_inputs,
+)
+
+Array = jax.Array
+
+
+def _check_top_k(top_k: Optional[int]) -> None:
+    if top_k is not None and not (isinstance(top_k, int) and top_k > 0):
+        raise ValueError("`top_k` has to be a positive integer or None")
+
+
+def retrieval_average_precision(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """Parity: reference ``functional/retrieval/average_precision.py:22``."""
+    _check_top_k(top_k)
+    return _single(batched_average_precision, preds, target, top_k=top_k)
+
+
+def retrieval_reciprocal_rank(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """Parity: reference ``functional/retrieval/reciprocal_rank.py:22``."""
+    _check_top_k(top_k)
+    return _single(batched_reciprocal_rank, preds, target, top_k=top_k)
+
+
+def retrieval_precision(
+    preds: Array, target: Array, top_k: Optional[int] = None, adaptive_k: bool = False
+) -> Array:
+    """Parity: reference ``functional/retrieval/precision.py:21``."""
+    _check_top_k(top_k)
+    if not isinstance(adaptive_k, bool):
+        raise ValueError("`adaptive_k` has to be a boolean")
+    return _single(batched_precision, preds, target, top_k=top_k, adaptive_k=adaptive_k)
+
+
+def retrieval_recall(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """Parity: reference ``functional/retrieval/recall.py:22``."""
+    _check_top_k(top_k)
+    return _single(batched_recall, preds, target, top_k=top_k)
+
+
+def retrieval_fall_out(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """Parity: reference ``functional/retrieval/fall_out.py:22``."""
+    _check_top_k(top_k)
+    return _single(batched_fall_out, preds, target, top_k=top_k)
+
+
+def retrieval_hit_rate(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """Parity: reference ``functional/retrieval/hit_rate.py:22``."""
+    _check_top_k(top_k)
+    return _single(batched_hit_rate, preds, target, top_k=top_k)
+
+
+def retrieval_r_precision(preds: Array, target: Array) -> Array:
+    """Parity: reference ``functional/retrieval/r_precision.py:20``."""
+    return _single(batched_r_precision, preds, target)
+
+
+def retrieval_normalized_dcg(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """Parity: reference ``functional/retrieval/ndcg.py:71`` (ignore-ties)."""
+    _check_top_k(top_k)
+    return _single(batched_ndcg, preds, target, allow_non_binary_target=True, top_k=top_k)
+
+
+def retrieval_auroc(
+    preds: Array, target: Array, top_k: Optional[int] = None, max_fpr: Optional[float] = None
+) -> Array:
+    """Parity: reference ``functional/retrieval/auroc.py:22``."""
+    _check_top_k(top_k)
+    if max_fpr is not None and not (isinstance(max_fpr, float) and 0 < max_fpr <= 1):
+        raise ValueError(f"Argument `max_fpr` should be a float in range (0, 1], but got: {max_fpr}")
+    return _single(batched_auroc, preds, target, top_k=top_k, max_fpr=max_fpr)
+
+
+def retrieval_precision_recall_curve(
+    preds: Array, target: Array, max_k: Optional[int] = None, adaptive_k: bool = False
+) -> Tuple[Array, Array, Array]:
+    """Parity: reference ``functional/retrieval/precision_recall_curve.py:24``."""
+    if not isinstance(adaptive_k, bool):
+        raise ValueError("`adaptive_k` has to be a boolean")
+    p, t = _check_retrieval_functional_inputs(preds, target)
+    if max_k is None:
+        max_k = p.shape[-1]
+    if not (isinstance(max_k, int) and max_k > 0):
+        raise ValueError("`max_k` has to be a positive integer or None")
+    import jax.numpy as jnp
+
+    mask = jnp.ones_like(p, dtype=bool)
+    prec, rec, ks = batched_precision_recall_curve(p[None], t[None], mask[None], max_k, adaptive_k)
+    return prec[0], rec[0], ks
+
+
+__all__ = [
+    "retrieval_auroc",
+    "retrieval_average_precision",
+    "retrieval_fall_out",
+    "retrieval_hit_rate",
+    "retrieval_normalized_dcg",
+    "retrieval_precision",
+    "retrieval_precision_recall_curve",
+    "retrieval_r_precision",
+    "retrieval_recall",
+    "retrieval_reciprocal_rank",
+]

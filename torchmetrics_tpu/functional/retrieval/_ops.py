@@ -1,0 +1,215 @@
+"""Batched padded-query retrieval kernels — the TPU-native core.
+
+The reference computes every retrieval metric one query at a time with a
+Python loop over ``torch.split`` groups (``retrieval/base.py:146-183``).
+On TPU that shape-varying loop is poison for XLA; instead every kernel here
+operates on a dense padded batch ``(Q, L)`` (queries x max-docs) with a
+validity ``mask``, so an epoch's worth of per-query scores is ONE fused XLA
+program (sort + cumsum + reductions on the VPU, no host round-trips).
+
+Single-query functional wrappers (``retrieval_average_precision`` et al.)
+reshape to ``(1, L)`` and index out the scalar — same kernels, same numerics.
+
+Parity targets: reference ``functional/retrieval/*.py`` (average_precision.py:22,
+reciprocal_rank.py:22, precision.py:21, recall.py:22, fall_out.py:22,
+hit_rate.py:22, ndcg.py:71, r_precision.py:20, auroc.py:22,
+precision_recall_curve.py:24).
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def sort_by_preds(preds: Array, target: Array, mask: Array) -> Tuple[Array, Array, Array]:
+    """Per-row sort by descending prediction; padded entries go last.
+
+    Returns (preds_sorted, target_sorted, mask_sorted), each (Q, L).
+    """
+    key = jnp.where(mask, -preds, jnp.inf)
+    order = jnp.argsort(key, axis=-1, stable=True)
+    p = jnp.take_along_axis(preds, order, axis=-1)
+    t = jnp.take_along_axis(target, order, axis=-1)
+    m = jnp.take_along_axis(mask, order, axis=-1)
+    return p, t, m
+
+
+def _ranks(mask_sorted: Array) -> Array:
+    """1-based rank positions, (Q, L) broadcast."""
+    length = mask_sorted.shape[-1]
+    return jnp.arange(1, length + 1, dtype=jnp.float32)[None, :]
+
+
+def _within_k(mask_sorted: Array, top_k: Optional[int]) -> Array:
+    """Boolean (Q, L): doc is valid and ranked within top_k."""
+    ranks = _ranks(mask_sorted)
+    sel = mask_sorted
+    if top_k is not None:
+        sel = sel & (ranks <= float(top_k))
+    return sel
+
+
+def batched_average_precision(preds: Array, target: Array, mask: Array, top_k: Optional[int] = None) -> Array:
+    """AP per query, (Q,). Mean over hit positions of (#hits so far / rank)."""
+    _, t, m = sort_by_preds(preds, target, mask)
+    t = t.astype(jnp.float32) * m
+    sel = _within_k(m, top_k)
+    hits = t * sel
+    prec = jnp.cumsum(hits, axis=-1) / _ranks(m)
+    n_hits = jnp.sum(hits, axis=-1)
+    ap = jnp.sum(prec * hits, axis=-1) / jnp.maximum(n_hits, 1.0)
+    return jnp.where(n_hits > 0, ap, 0.0)
+
+
+def batched_reciprocal_rank(preds: Array, target: Array, mask: Array, top_k: Optional[int] = None) -> Array:
+    """1/rank of the first relevant doc within top_k; 0 if none. (Q,)."""
+    _, t, m = sort_by_preds(preds, target, mask)
+    sel = _within_k(m, top_k)
+    hits = t.astype(jnp.float32) * sel
+    return jnp.max(hits / _ranks(m), axis=-1)
+
+
+def batched_precision(
+    preds: Array, target: Array, mask: Array, top_k: Optional[int] = None, adaptive_k: bool = False
+) -> Array:
+    """Fraction of top-k docs that are relevant. (Q,)."""
+    _, t, m = sort_by_preds(preds, target, mask)
+    n_docs = jnp.sum(m.astype(jnp.float32), axis=-1)
+    k = jnp.full_like(n_docs, float(top_k)) if top_k is not None else n_docs
+    if adaptive_k or top_k is None:
+        k = jnp.minimum(k, n_docs)
+    sel = m & (_ranks(m) <= k[:, None])
+    hits = jnp.sum(t.astype(jnp.float32) * sel, axis=-1)
+    return hits / jnp.maximum(k, 1.0)
+
+
+def batched_recall(preds: Array, target: Array, mask: Array, top_k: Optional[int] = None) -> Array:
+    """Fraction of all relevant docs retrieved in the top-k. (Q,)."""
+    _, t, m = sort_by_preds(preds, target, mask)
+    t = t.astype(jnp.float32) * m
+    sel = _within_k(m, top_k)
+    n_pos = jnp.sum(t, axis=-1)
+    hits = jnp.sum(t * sel, axis=-1)
+    return jnp.where(n_pos > 0, hits / jnp.maximum(n_pos, 1.0), 0.0)
+
+
+def batched_fall_out(preds: Array, target: Array, mask: Array, top_k: Optional[int] = None) -> Array:
+    """Fraction of all NON-relevant docs retrieved in the top-k. (Q,)."""
+    _, t, m = sort_by_preds(preds, target, mask)
+    neg = (1.0 - t.astype(jnp.float32)) * m
+    sel = _within_k(m, top_k)
+    n_neg = jnp.sum(neg, axis=-1)
+    hits = jnp.sum(neg * sel, axis=-1)
+    return jnp.where(n_neg > 0, hits / jnp.maximum(n_neg, 1.0), 0.0)
+
+
+def batched_hit_rate(preds: Array, target: Array, mask: Array, top_k: Optional[int] = None) -> Array:
+    """1.0 if any relevant doc in the top-k else 0.0. (Q,)."""
+    _, t, m = sort_by_preds(preds, target, mask)
+    sel = _within_k(m, top_k)
+    return (jnp.sum(t.astype(jnp.float32) * sel, axis=-1) > 0).astype(jnp.float32)
+
+
+def batched_r_precision(preds: Array, target: Array, mask: Array) -> Array:
+    """Precision at rank R where R = #relevant docs of the query. (Q,)."""
+    _, t, m = sort_by_preds(preds, target, mask)
+    t = t.astype(jnp.float32) * m
+    n_pos = jnp.sum(t, axis=-1)
+    sel = m & (_ranks(m) <= n_pos[:, None])
+    hits = jnp.sum(t * sel, axis=-1)
+    return jnp.where(n_pos > 0, hits / jnp.maximum(n_pos, 1.0), 0.0)
+
+
+def batched_ndcg(preds: Array, target: Array, mask: Array, top_k: Optional[int] = None) -> Array:
+    """Normalized DCG with linear gain and log2 discount (sklearn-style,
+    ignore-ties variant of reference ``functional/retrieval/ndcg.py:45``). (Q,).
+    Supports graded (non-binary, non-negative) relevance."""
+    _, g, m = sort_by_preds(preds, target, mask)
+    g = g.astype(jnp.float32) * m
+    ranks = _ranks(m)
+    disc = 1.0 / jnp.log2(ranks + 1.0)
+    sel = _within_k(m, top_k)
+    dcg = jnp.sum(g * disc * sel, axis=-1)
+    # ideal ordering: sort gains descending within the valid docs
+    ideal = jnp.sort(jnp.where(mask, target.astype(jnp.float32), -jnp.inf), axis=-1)[:, ::-1]
+    ideal = jnp.where(jnp.isfinite(ideal), ideal, 0.0)
+    idcg = jnp.sum(ideal * disc * sel, axis=-1)
+    return jnp.where(idcg > 0, dcg / jnp.maximum(idcg, 1e-12), 0.0)
+
+
+def batched_auroc(
+    preds: Array, target: Array, mask: Array, top_k: Optional[int] = None, max_fpr: Optional[float] = None
+) -> Array:
+    """Per-query binary AUROC over the top-k docs (trapezoidal over the exact
+    ROC; McClish-standardized partial AUC when ``max_fpr``). (Q,)."""
+    _, t, m = sort_by_preds(preds, target, mask)
+    sel = _within_k(m, top_k)
+    t = t.astype(jnp.float32)
+    pos = t * sel
+    neg = (1.0 - t) * sel
+    n_pos = jnp.sum(pos, axis=-1, keepdims=True)
+    n_neg = jnp.sum(neg, axis=-1, keepdims=True)
+    tpr = jnp.cumsum(pos, axis=-1) / jnp.maximum(n_pos, 1.0)
+    fpr = jnp.cumsum(neg, axis=-1) / jnp.maximum(n_neg, 1.0)
+    tpr0 = jnp.concatenate([jnp.zeros_like(tpr[:, :1]), tpr], axis=-1)
+    fpr0 = jnp.concatenate([jnp.zeros_like(fpr[:, :1]), fpr], axis=-1)
+    if max_fpr is None:
+        auc = jnp.sum((fpr0[:, 1:] - fpr0[:, :-1]) * (tpr0[:, 1:] + tpr0[:, :-1]) * 0.5, axis=-1)
+    else:
+        # clip each trapezoid segment at fpr = max_fpr (linear interpolation)
+        x0, x1 = fpr0[:, :-1], fpr0[:, 1:]
+        y0, y1 = tpr0[:, :-1], tpr0[:, 1:]
+        cx1 = jnp.minimum(x1, max_fpr)
+        frac = jnp.where(x1 > x0, (cx1 - x0) / jnp.maximum(x1 - x0, 1e-12), 0.0)
+        cy1 = y0 + frac * (y1 - y0)
+        seg = jnp.where(x0 < max_fpr, (cx1 - x0) * (y0 + cy1) * 0.5, 0.0)
+        pauc = jnp.sum(seg, axis=-1)
+        min_area = 0.5 * max_fpr * max_fpr
+        max_area = max_fpr
+        auc = 0.5 * (1.0 + (pauc - min_area) / (max_area - min_area))
+    valid = (n_pos[:, 0] > 0) & (n_neg[:, 0] > 0)
+    return jnp.where(valid, auc, 0.0)
+
+
+def batched_precision_recall_curve(
+    preds: Array, target: Array, mask: Array, max_k: int, adaptive_k: bool = False
+) -> Tuple[Array, Array, Array]:
+    """Per-query precision@k / recall@k for k = 1..max_k.
+
+    Returns (precision (Q, max_k), recall (Q, max_k), top_k (max_k,)).
+    With ``adaptive_k`` the denominator of precision@k is min(k, n_docs).
+    """
+    _, t, m = sort_by_preds(preds, target, mask)
+    t = t.astype(jnp.float32) * m
+    length = t.shape[-1]
+    n_pos = jnp.sum(t, axis=-1, keepdims=True)
+    rel_cum = jnp.cumsum(t, axis=-1)  # (Q, L)
+    ks = jnp.arange(1, max_k + 1, dtype=jnp.int32)
+    idx = jnp.minimum(ks - 1, length - 1)
+    rel_at_k = rel_cum[:, idx]  # (Q, max_k)
+    denom = ks.astype(jnp.float32)[None, :]
+    if adaptive_k:
+        n_docs = jnp.sum(m.astype(jnp.float32), axis=-1, keepdims=True)
+        denom = jnp.minimum(denom, jnp.maximum(n_docs, 1.0))
+    precision = rel_at_k / denom
+    recall = jnp.where(n_pos > 0, rel_at_k / jnp.maximum(n_pos, 1.0), 0.0)
+    return precision, recall, ks
+
+
+def _check_retrieval_functional_inputs(preds: Array, target: Array, allow_non_binary_target: bool = False):
+    """Parity: reference ``utilities/checks.py`` retrieval functional checks."""
+    if preds.shape != target.shape:
+        raise ValueError("`preds` and `target` must be of the same shape")
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise ValueError("`preds` must be a tensor of floats")
+    if jnp.issubdtype(target.dtype, jnp.floating) and not allow_non_binary_target:
+        raise ValueError("`target` must be a tensor of booleans or integers")
+    return preds.reshape(-1).astype(jnp.float32), target.reshape(-1)
+
+
+def _single(fn, preds: Array, target: Array, allow_non_binary_target: bool = False, **kwargs) -> Array:
+    p, t = _check_retrieval_functional_inputs(preds, target, allow_non_binary_target)
+    mask = jnp.ones_like(p, dtype=bool)
+    return fn(p[None, :], t[None, :], mask[None, :], **kwargs)[0]

@@ -1,0 +1,90 @@
+"""Speech-transcription error rates: WER / CER / MER / WIL / WIP.
+
+Parity targets: reference ``functional/text/{wer,cer,mer,wil,wip}.py`` —
+host-side Levenshtein on word/char tokens, sum states, ratio computes.
+"""
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from .helper import _as_list, edit_distance_fast
+
+Array = jax.Array
+
+
+def _wer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
+    errors, total = 0, 0
+    for pred, tgt in zip(_as_list(preds), _as_list(target)):
+        errors += edit_distance_fast(pred.split(), tgt.split())
+        total += len(tgt.split())
+    return jnp.asarray(float(errors)), jnp.asarray(float(total))
+
+
+def _wer_compute(errors: Array, total: Array) -> Array:
+    return errors / total
+
+
+def word_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """WER = word edits / reference words. Parity: ``wer.py:66``."""
+    return _wer_compute(*_wer_update(preds, target))
+
+
+def _cer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
+    errors, total = 0, 0
+    for pred, tgt in zip(_as_list(preds), _as_list(target)):
+        errors += edit_distance_fast(list(pred), list(tgt))
+        total += len(tgt)
+    return jnp.asarray(float(errors)), jnp.asarray(float(total))
+
+
+def char_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """CER = char edits / reference chars. Parity: ``cer.py:66``."""
+    errors, total = _cer_update(preds, target)
+    return errors / total
+
+
+def _mer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
+    errors, total = 0, 0
+    for pred, tgt in zip(_as_list(preds), _as_list(target)):
+        p, t = pred.split(), tgt.split()
+        errors += edit_distance_fast(p, t)
+        total += max(len(p), len(t))
+    return jnp.asarray(float(errors)), jnp.asarray(float(total))
+
+
+def match_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """MER = edits / max-length alignment. Parity: ``mer.py:67``."""
+    errors, total = _mer_update(preds, target)
+    return errors / total
+
+
+def _wil_wip_update(
+    preds: Union[str, List[str]], target: Union[str, List[str]]
+) -> Tuple[Array, Array, Array]:
+    """Returns (errors - total, target_words, pred_words); the first term's
+    square ratio gives WIP (reference ``wil.py:22-55`` convention)."""
+    errors, total, t_total, p_total = 0, 0, 0, 0
+    for pred, tgt in zip(_as_list(preds), _as_list(target)):
+        p, t = pred.split(), tgt.split()
+        errors += edit_distance_fast(p, t)
+        t_total += len(t)
+        p_total += len(p)
+        total += max(len(p), len(t))
+    return (
+        jnp.asarray(float(errors - total)),
+        jnp.asarray(float(t_total)),
+        jnp.asarray(float(p_total)),
+    )
+
+
+def word_information_lost(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """WIL = 1 - WIP. Parity: ``wil.py:72``."""
+    errors, t_total, p_total = _wil_wip_update(preds, target)
+    return 1.0 - (errors / t_total) * (errors / p_total)
+
+
+def word_information_preserved(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """WIP = (hits/ref_words)(hits/hyp_words). Parity: ``wip.py:71``."""
+    errors, t_total, p_total = _wil_wip_update(preds, target)
+    return (errors / t_total) * (errors / p_total)

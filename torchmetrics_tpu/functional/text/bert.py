@@ -1,0 +1,146 @@
+"""BERTScore — contextual-embedding greedy matching.
+
+Parity target: reference ``functional/text/bert.py`` (447 LoC): tokenize on
+host, run a transformer encoder, greedy cosine matching per token with
+optional IDF weighting, P/R/F1 outputs.
+
+TPU-native split: the matching math (`bert_score_from_embeddings`) is a pure
+jittable JAX kernel over padded (B, L, D) embeddings — usable under
+``shard_map`` with batch sharding. The encoder is pluggable: a Flax/HF
+model via ``model_name_or_path`` (needs local HF cache; this build has no
+network egress) or any ``user_forward_fn``. Reference behavior of storing
+tokenized inputs as ``"cat"`` states is preserved in the class layer.
+"""
+from collections import Counter
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Array = jax.Array
+
+
+def bert_score_from_embeddings(
+    pred_emb: Array,
+    pred_mask: Array,
+    target_emb: Array,
+    target_mask: Array,
+    pred_idf: Optional[Array] = None,
+    target_idf: Optional[Array] = None,
+) -> Dict[str, Array]:
+    """Greedy-matching P/R/F1 from padded embeddings (pure, jittable).
+
+    Args:
+        pred_emb: (B, Lp, D) candidate token embeddings.
+        pred_mask: (B, Lp) validity mask.
+        target_emb: (B, Lt, D) reference token embeddings.
+        target_mask: (B, Lt) validity mask.
+        pred_idf/target_idf: optional (B, L) token weights (IDF); defaults
+            to the plain mask (uniform weighting).
+    """
+    p = pred_emb / jnp.maximum(jnp.linalg.norm(pred_emb, axis=-1, keepdims=True), 1e-12)
+    t = target_emb / jnp.maximum(jnp.linalg.norm(target_emb, axis=-1, keepdims=True), 1e-12)
+    sim = jnp.einsum("bpd,btd->bpt", p, t, precision=lax.Precision.HIGHEST)
+    pm = pred_mask.astype(jnp.float32)
+    tm = target_mask.astype(jnp.float32)
+    sim = sim - 2.0 * (1.0 - pm[:, :, None]) - 2.0 * (1.0 - tm[:, None, :])
+    w_p = pm if pred_idf is None else pred_idf * pm
+    w_t = tm if target_idf is None else target_idf * tm
+    best_for_pred = jnp.max(sim, axis=2)  # (B, Lp)
+    best_for_tgt = jnp.max(sim, axis=1)  # (B, Lt)
+    precision = jnp.sum(best_for_pred * w_p, axis=1) / jnp.maximum(jnp.sum(w_p, axis=1), 1e-12)
+    recall = jnp.sum(best_for_tgt * w_t, axis=1) / jnp.maximum(jnp.sum(w_t, axis=1), 1e-12)
+    f1 = 2 * precision * recall / jnp.maximum(precision + recall, 1e-12)
+    return {"precision": precision, "recall": recall, "f1": f1}
+
+
+def _idf_weights(ids_corpus: List[List[int]]) -> Dict[int, float]:
+    """log((N+1)/(df+1)) IDF over the reference corpus (reference scheme)."""
+    import math
+
+    n = len(ids_corpus)
+    df: Counter = Counter()
+    for ids in ids_corpus:
+        df.update(set(ids))
+    return {tok: math.log((n + 1) / (c + 1)) for tok, c in df.items()}
+
+
+def _load_default_model(model_name_or_path: str, device=None):
+    """HF Flax encoder + tokenizer from the local cache (no egress)."""
+    try:
+        from transformers import AutoTokenizer, FlaxAutoModel
+
+        tokenizer = AutoTokenizer.from_pretrained(model_name_or_path)
+        model = FlaxAutoModel.from_pretrained(model_name_or_path)
+        return tokenizer, model
+    except Exception as err:  # gated: no network / no flax weights
+        raise ModuleNotFoundError(
+            f"Default BERTScore model {model_name_or_path!r} could not be loaded "
+            "(transformers + a local HF cache are required). Pass `user_forward_fn` "
+            "+ `user_tokenizer` instead."
+        ) from err
+
+
+def bert_score(
+    preds: Union[str, Sequence[str]],
+    target: Union[str, Sequence[str]],
+    model_name_or_path: Optional[str] = None,
+    num_layers: Optional[int] = None,
+    idf: bool = False,
+    lang: str = "en",
+    max_length: int = 512,
+    batch_size: int = 64,
+    user_tokenizer: Any = None,
+    user_forward_fn: Optional[Callable] = None,
+    return_hash: bool = False,
+    **kwargs: Any,
+) -> Dict[str, Array]:
+    """BERTScore P/R/F1 per sentence pair. Parity: reference ``bert.py:bert_score``.
+
+    ``user_forward_fn(input_ids, attention_mask) -> (B, L, D)`` embeddings and
+    ``user_tokenizer(texts, max_length) -> {"input_ids", "attention_mask"}``
+    override the default HF model (which requires a local cache).
+    """
+    preds_ = [preds] if isinstance(preds, str) else list(preds)
+    target_ = [target] if isinstance(target, str) else list(target)
+    if len(preds_) != len(target_):
+        raise ValueError("Number of predicted and reference sentences must be the same!")
+
+    if user_forward_fn is not None:
+        if user_tokenizer is None:
+            raise ValueError("`user_tokenizer` must be provided with `user_forward_fn`.")
+        tok_p = user_tokenizer(preds_, max_length)
+        tok_t = user_tokenizer(target_, max_length)
+        emb_p = user_forward_fn(tok_p["input_ids"], tok_p["attention_mask"])
+        emb_t = user_forward_fn(tok_t["input_ids"], tok_t["attention_mask"])
+    else:
+        name = model_name_or_path or "roberta-large"
+        tokenizer, model = _load_default_model(name)
+        enc_p = tokenizer(preds_, padding=True, truncation=True, max_length=max_length, return_tensors="np")
+        enc_t = tokenizer(target_, padding=True, truncation=True, max_length=max_length, return_tensors="np")
+        tok_p = {"input_ids": jnp.asarray(enc_p["input_ids"]), "attention_mask": jnp.asarray(enc_p["attention_mask"])}
+        tok_t = {"input_ids": jnp.asarray(enc_t["input_ids"]), "attention_mask": jnp.asarray(enc_t["attention_mask"])}
+        emb_p = model(**enc_p).last_hidden_state
+        emb_t = model(**enc_t).last_hidden_state
+        emb_p, emb_t = jnp.asarray(emb_p), jnp.asarray(emb_t)
+
+    pred_idf_arr = target_idf_arr = None
+    if idf:
+        ids_corpus = [list(map(int, row)) for row in jnp.asarray(tok_t["input_ids"])]
+        weights = _idf_weights(ids_corpus)
+        pred_idf_arr = jnp.asarray(
+            [[weights.get(int(tok), 0.0) for tok in row] for row in jnp.asarray(tok_p["input_ids"])]
+        )
+        target_idf_arr = jnp.asarray(
+            [[weights.get(int(tok), 0.0) for tok in row] for row in jnp.asarray(tok_t["input_ids"])]
+        )
+
+    return bert_score_from_embeddings(
+        jnp.asarray(emb_p),
+        jnp.asarray(tok_p["attention_mask"]),
+        jnp.asarray(emb_t),
+        jnp.asarray(tok_t["attention_mask"]),
+        pred_idf_arr,
+        target_idf_arr,
+    )

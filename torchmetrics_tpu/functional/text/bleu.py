@@ -1,0 +1,95 @@
+"""BLEU score — host n-gram counting, device-side sum states.
+
+Parity target: reference ``functional/text/bleu.py`` (corpus BLEU with
+clipped n-gram precision, brevity penalty, add-one smoothing option,
+closest-reference-length convention).
+"""
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .helper import ngram_counts_upto
+
+Array = jax.Array
+
+
+def _default_tokenizer(line: str) -> List[str]:
+    return line.split()
+
+
+def _bleu_counts(
+    preds: Sequence[str],
+    target: Sequence[Sequence[str]],
+    n_gram: int = 4,
+    tokenizer: Callable[[str], Sequence[str]] = _default_tokenizer,
+) -> Tuple[np.ndarray, np.ndarray, int, int]:
+    """Host-side accumulation: (numerator[n], denominator[n], pred_len, tgt_len)."""
+    numerator = np.zeros(n_gram)
+    denominator = np.zeros(n_gram)
+    preds_len = 0
+    target_len = 0
+    for pred, refs in zip(preds, target):
+        pred_tokens = tokenizer(pred) if pred else []
+        ref_tokens = [tokenizer(r) if r else [] for r in refs]
+        preds_len += len(pred_tokens)
+        diffs = [abs(len(pred_tokens) - len(r)) for r in ref_tokens]
+        target_len += len(ref_tokens[diffs.index(min(diffs))])
+        pred_counter = ngram_counts_upto(pred_tokens, n_gram)
+        merged: dict = {}
+        for r in ref_tokens:
+            for k, v in ngram_counts_upto(r, n_gram).items():
+                merged[k] = max(merged.get(k, 0), v)
+        for k, v in pred_counter.items():
+            denominator[len(k) - 1] += v
+            clip = min(v, merged.get(k, 0))
+            if clip:
+                numerator[len(k) - 1] += clip
+    return numerator, denominator, preds_len, target_len
+
+
+def _bleu_score_compute(
+    preds_len: Array,
+    target_len: Array,
+    numerator: Array,
+    denominator: Array,
+    n_gram: int,
+    weights: Sequence[float],
+    smooth: bool,
+) -> Array:
+    """Pure device compute from count states (jittable)."""
+    numerator = jnp.asarray(numerator, dtype=jnp.float32)
+    denominator = jnp.asarray(denominator, dtype=jnp.float32)
+    w = jnp.asarray(weights, dtype=jnp.float32)
+    if smooth:
+        prec = (numerator + 1.0) / (denominator + 1.0)
+        prec = prec.at[0].set(numerator[0] / jnp.maximum(denominator[0], 1.0))
+    else:
+        prec = numerator / jnp.maximum(denominator, 1.0)
+    log_prec = jnp.sum(w * jnp.log(jnp.where(prec > 0, prec, 1.0)))
+    geo_mean = jnp.exp(log_prec)
+    ratio = jnp.asarray(preds_len, jnp.float32) / jnp.maximum(jnp.asarray(target_len, jnp.float32), 1.0)
+    brevity = jnp.where(ratio > 1.0, 1.0, jnp.exp(1.0 - 1.0 / jnp.maximum(ratio, 1e-9)))
+    return jnp.where(jnp.min(numerator) == 0.0, 0.0, brevity * geo_mean)
+
+
+def bleu_score(
+    preds: Sequence[str],
+    target: Sequence[Sequence[str]],
+    n_gram: int = 4,
+    smooth: bool = False,
+    weights: Optional[Sequence[float]] = None,
+) -> Array:
+    """Corpus BLEU. Parity: reference ``bleu.py:bleu_score``."""
+    preds_ = [preds] if isinstance(preds, str) else list(preds)
+    target_ = [[target]] if isinstance(target, str) else [
+        [t] if isinstance(t, str) else list(t) for t in target
+    ]
+    if len(preds_) != len(target_):
+        raise ValueError(f"Corpus has different size {len(preds_)} != {len(target_)}")
+    if weights is not None and len(weights) != n_gram:
+        raise ValueError(f"List of weights has different weights than `n_gram`: {len(weights)} != {n_gram}")
+    weights = weights or [1.0 / n_gram] * n_gram
+    num, den, plen, tlen = _bleu_counts(preds_, target_, n_gram)
+    return _bleu_score_compute(plen, tlen, num, den, n_gram, weights, smooth)

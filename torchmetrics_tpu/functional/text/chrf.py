@@ -1,0 +1,118 @@
+"""chrF / chrF++ score.
+
+Parity target: reference ``functional/text/chrf.py`` (651 LoC) — char +
+word n-gram F-beta averaged over orders; corpus stats accumulate as flat
+count vectors (here: three arrays of length n_char_order + n_word_order,
+which makes the state trivially ``"sum"``-reducible on a mesh instead of
+the reference's dict-of-scalars).
+"""
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .helper import ngram_counts
+
+Array = jax.Array
+
+_EPS = 1e-16
+
+
+def _chrf_tokens(sentence: str, lowercase: bool, whitespace: bool) -> Tuple[List[str], List[str]]:
+    """(char tokens, word tokens) for one sentence."""
+    if lowercase:
+        sentence = sentence.lower()
+    chars = list(sentence) if whitespace else list(sentence.replace(" ", ""))
+    words = sentence.split()
+    return chars, words
+
+
+def _pair_stats(
+    pred: str, ref: str, n_char_order: int, n_word_order: int, lowercase: bool, whitespace: bool
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(matching, pred_total, ref_total) counts per order (char orders then word)."""
+    k = n_char_order + n_word_order
+    matching = np.zeros(k)
+    pred_total = np.zeros(k)
+    ref_total = np.zeros(k)
+    p_chars, p_words = _chrf_tokens(pred, lowercase, whitespace)
+    r_chars, r_words = _chrf_tokens(ref, lowercase, whitespace)
+    for n in range(1, n_char_order + 1):
+        pc, rc = ngram_counts(p_chars, n), ngram_counts(r_chars, n)
+        matching[n - 1] = sum(min(v, rc.get(key, 0)) for key, v in pc.items())
+        pred_total[n - 1] = sum(pc.values())
+        ref_total[n - 1] = sum(rc.values())
+    for n in range(1, n_word_order + 1):
+        pc, rc = ngram_counts(p_words, n), ngram_counts(r_words, n)
+        i = n_char_order + n - 1
+        matching[i] = sum(min(v, rc.get(key, 0)) for key, v in pc.items())
+        pred_total[i] = sum(pc.values())
+        ref_total[i] = sum(rc.values())
+    return matching, pred_total, ref_total
+
+
+def _fscore_from_counts(matching: Array, pred_total: Array, ref_total: Array, beta: float) -> Array:
+    """Mean F-beta over the n-gram orders (jittable)."""
+    precision = jnp.where(pred_total > 0, matching / jnp.maximum(pred_total, 1.0), 0.0)
+    recall = jnp.where(ref_total > 0, matching / jnp.maximum(ref_total, 1.0), 0.0)
+    denom = jnp.maximum(beta**2 * precision + recall, _EPS)
+    f = (1 + beta**2) * precision * recall / denom
+    return jnp.mean(f)
+
+
+def _chrf_update(
+    preds: Sequence[str],
+    target: Sequence[Union[str, Sequence[str]]],
+    n_char_order: int,
+    n_word_order: int,
+    beta: float,
+    lowercase: bool,
+    whitespace: bool,
+    sentence_scores: Optional[list] = None,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Corpus count accumulation; per-sample the best-matching reference
+    (highest sentence-level chrF) contributes its stats (sacrebleu rule)."""
+    k = n_char_order + n_word_order
+    tot_match, tot_pred, tot_ref = np.zeros(k), np.zeros(k), np.zeros(k)
+    for pred, refs in zip(preds, target):
+        refs = [refs] if isinstance(refs, str) else list(refs)
+        best, best_score = None, -1.0
+        for ref in refs:
+            stats = _pair_stats(pred, ref, n_char_order, n_word_order, lowercase, whitespace)
+            score = float(_fscore_from_counts(jnp.asarray(stats[0]), jnp.asarray(stats[1]), jnp.asarray(stats[2]), beta))
+            if score > best_score:
+                best, best_score = stats, score
+        tot_match += best[0]
+        tot_pred += best[1]
+        tot_ref += best[2]
+        if sentence_scores is not None:
+            sentence_scores.append(best_score)
+    return tot_match, tot_pred, tot_ref
+
+
+def chrf_score(
+    preds: Union[str, Sequence[str]],
+    target: Sequence[Union[str, Sequence[str]]],
+    n_char_order: int = 6,
+    n_word_order: int = 2,
+    beta: float = 2.0,
+    lowercase: bool = False,
+    whitespace: bool = False,
+    return_sentence_level_score: bool = False,
+) -> Union[Array, Tuple[Array, Array]]:
+    """chrF (n_word_order=0) / chrF++ (default) score. Parity: ``chrf.py:537``."""
+    if not isinstance(n_char_order, int) or n_char_order < 1:
+        raise ValueError("Expected argument `n_char_order` to be an integer greater than or equal to 1.")
+    if not isinstance(n_word_order, int) or n_word_order < 0:
+        raise ValueError("Expected argument `n_word_order` to be an integer greater than or equal to 0.")
+    if beta < 0:
+        raise ValueError("Expected argument `beta` to be greater than 0.")
+    preds_ = [preds] if isinstance(preds, str) else list(preds)
+    target_ = list(target)
+    sentence_scores: Optional[list] = [] if return_sentence_level_score else None
+    m, p, r = _chrf_update(preds_, target_, n_char_order, n_word_order, beta, lowercase, whitespace, sentence_scores)
+    score = _fscore_from_counts(jnp.asarray(m), jnp.asarray(p), jnp.asarray(r), beta)
+    if return_sentence_level_score:
+        return score, jnp.asarray(sentence_scores, dtype=jnp.float32)
+    return score

@@ -1,0 +1,58 @@
+"""Plain (character/word) edit distance.
+
+Parity target: reference ``functional/text/edit.py`` — Levenshtein between
+prediction/target strings with ``substitution_cost`` and mean/sum/none
+reduction.
+"""
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def _edit_distance_single(a: str, b: str, substitution_cost: int = 1) -> int:
+    la, lb = len(a), len(b)
+    if la == 0:
+        return lb
+    if lb == 0:
+        return la
+    prev = np.arange(lb + 1, dtype=np.int64)
+    for i in range(1, la + 1):
+        cur = np.empty_like(prev)
+        cur[0] = i
+        for j in range(1, lb + 1):
+            cost = 0 if a[i - 1] == b[j - 1] else substitution_cost
+            cur[j] = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + cost)
+        prev = cur
+    return int(prev[-1])
+
+
+def edit_distance(
+    preds: Union[str, Sequence[str]],
+    target: Union[str, Sequence[str]],
+    substitution_cost: int = 1,
+    reduction: Optional[str] = "mean",
+) -> Array:
+    """Character-level edit distance. Parity: reference ``edit.py:edit_distance``."""
+    if not isinstance(substitution_cost, int) or substitution_cost < 0:
+        raise ValueError(
+            f"Expected argument `substitution_cost` to be a positive integer, but got {substitution_cost}"
+        )
+    if reduction not in ("mean", "sum", "none", None):
+        raise ValueError(f"Expected argument `reduction` to be one of ['mean', 'sum', 'none', None]")
+    preds_ = [preds] if isinstance(preds, str) else list(preds)
+    target_ = [target] if isinstance(target, str) else list(target)
+    if len(preds_) != len(target_):
+        raise ValueError(
+            f"Expected argument `preds` and `target` to have same length, but got {len(preds_)} and {len(target_)}"
+        )
+    dists = [ _edit_distance_single(p, t, substitution_cost) for p, t in zip(preds_, target_) ]
+    arr = jnp.asarray(dists, dtype=jnp.float32)
+    if reduction == "mean":
+        return jnp.mean(arr)
+    if reduction == "sum":
+        return jnp.sum(arr)
+    return arr

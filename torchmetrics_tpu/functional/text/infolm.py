@@ -1,0 +1,184 @@
+"""InfoLM — information measures between masked-LM token distributions.
+
+Parity target: reference ``functional/text/infolm.py`` (657 LoC): a masked
+LM predicts a token distribution at each masked position; per sentence the
+(IDF-weighted) mean distribution is formed and compared with an information
+measure. All measures are pure jittable JAX kernels; the LM is pluggable
+like BERTScore (local HF cache or ``user_forward_fn``).
+"""
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_ALLOWED_INFORMATION_MEASURE = (
+    "kl_divergence",
+    "alpha_divergence",
+    "beta_divergence",
+    "ab_divergence",
+    "renyi_divergence",
+    "l1_distance",
+    "l2_distance",
+    "l_infinity_distance",
+    "fisher_rao_distance",
+)
+
+_EPS = 1e-12
+
+
+def _kl_divergence(p: Array, q: Array) -> Array:
+    return jnp.sum(p * (jnp.log(p + _EPS) - jnp.log(q + _EPS)), axis=-1)
+
+
+def _alpha_divergence(p: Array, q: Array, alpha: float) -> Array:
+    return (1.0 - jnp.sum(q**alpha * p ** (1.0 - alpha), axis=-1)) / (alpha * (alpha - 1.0))
+
+
+def _beta_divergence(p: Array, q: Array, beta: float) -> Array:
+    term1 = jnp.sum(q ** (beta + 1.0), axis=-1) / (beta * (beta + 1.0))
+    term2 = jnp.sum(p ** (beta + 1.0), axis=-1) / (beta + 1.0)
+    term3 = jnp.sum(p * q**beta, axis=-1) / beta
+    return term1 + term2 - term3
+
+
+def _ab_divergence(p: Array, q: Array, alpha: float, beta: float) -> Array:
+    term1 = jnp.sum(q ** (beta + alpha), axis=-1) / (beta * (beta + alpha))
+    term2 = jnp.sum(p ** (beta + alpha), axis=-1) / (alpha * (beta + alpha))
+    term3 = jnp.sum(p**alpha * q**beta, axis=-1) / (alpha * beta)
+    return term1 + term2 - term3
+
+
+def _renyi_divergence(p: Array, q: Array, alpha: float) -> Array:
+    return jnp.log(jnp.sum(q**alpha * p ** (1.0 - alpha), axis=-1) + _EPS) / (alpha - 1.0)
+
+
+def _l1_distance(p: Array, q: Array) -> Array:
+    return jnp.sum(jnp.abs(p - q), axis=-1)
+
+
+def _l2_distance(p: Array, q: Array) -> Array:
+    return jnp.sqrt(jnp.sum((p - q) ** 2, axis=-1))
+
+
+def _l_infinity_distance(p: Array, q: Array) -> Array:
+    return jnp.max(jnp.abs(p - q), axis=-1)
+
+
+def _fisher_rao_distance(p: Array, q: Array) -> Array:
+    inner = jnp.clip(jnp.sum(jnp.sqrt(p * q), axis=-1), 0.0, 1.0)
+    return 2.0 * jnp.arccos(inner)
+
+
+class _InformationMeasure:
+    """Dispatch + parameter validation for the measure family."""
+
+    def __init__(self, information_measure: str, alpha: Optional[float] = None, beta: Optional[float] = None) -> None:
+        if information_measure not in _ALLOWED_INFORMATION_MEASURE:
+            raise ValueError(f"Argument `information_measure` is expected to be one of {_ALLOWED_INFORMATION_MEASURE}")
+        needs_alpha = information_measure in ("alpha_divergence", "ab_divergence", "renyi_divergence")
+        needs_beta = information_measure in ("beta_divergence", "ab_divergence")
+        if needs_alpha and not isinstance(alpha, float):
+            raise ValueError(f"Argument `alpha` is expected to be defined for {information_measure}.")
+        if needs_beta and not isinstance(beta, float):
+            raise ValueError(f"Argument `beta` is expected to be defined for {information_measure}.")
+        if information_measure in ("alpha_divergence", "renyi_divergence") and alpha in (0.0, 1.0):
+            raise ValueError("Argument `alpha` cannot be 0 or 1 for this divergence.")
+        if information_measure == "beta_divergence" and beta in (0.0, -1.0):
+            raise ValueError("Argument `beta` cannot be 0 or -1 for beta divergence.")
+        self.measure = information_measure
+        self.alpha = alpha
+        self.beta = beta
+
+    def __call__(self, preds_distribution: Array, target_distribution: Array) -> Array:
+        m = self.measure
+        if m == "kl_divergence":
+            return _kl_divergence(preds_distribution, target_distribution)
+        if m == "alpha_divergence":
+            return _alpha_divergence(preds_distribution, target_distribution, self.alpha)
+        if m == "beta_divergence":
+            return _beta_divergence(preds_distribution, target_distribution, self.beta)
+        if m == "ab_divergence":
+            return _ab_divergence(preds_distribution, target_distribution, self.alpha, self.beta)
+        if m == "renyi_divergence":
+            return _renyi_divergence(preds_distribution, target_distribution, self.alpha)
+        if m == "l1_distance":
+            return _l1_distance(preds_distribution, target_distribution)
+        if m == "l2_distance":
+            return _l2_distance(preds_distribution, target_distribution)
+        if m == "l_infinity_distance":
+            return _l_infinity_distance(preds_distribution, target_distribution)
+        return _fisher_rao_distance(preds_distribution, target_distribution)
+
+
+def _sentence_distribution_from_logits(logits: Array, attention_mask: Array, idf_w: Optional[Array] = None) -> Array:
+    """(B, L, V) masked-LM logits → (B, V) weighted mean token distribution."""
+    probs = jax.nn.softmax(logits, axis=-1)
+    w = attention_mask.astype(jnp.float32)
+    if idf_w is not None:
+        w = w * idf_w
+    num = jnp.einsum("blv,bl->bv", probs, w)
+    return num / jnp.maximum(jnp.sum(w, axis=-1, keepdims=True), _EPS)
+
+
+def infolm(
+    preds: Union[str, Sequence[str]],
+    target: Union[str, Sequence[str]],
+    model_name_or_path: str = "bert-base-uncased",
+    temperature: float = 0.25,
+    information_measure: str = "kl_divergence",
+    idf: bool = True,
+    alpha: Optional[float] = None,
+    beta: Optional[float] = None,
+    max_length: Optional[int] = None,
+    batch_size: int = 64,
+    return_sentence_level_score: bool = False,
+    user_tokenizer: Any = None,
+    user_forward_fn: Optional[Callable] = None,
+) -> Union[Array, Tuple[Array, Array]]:
+    """InfoLM score. Parity: reference ``infolm.py:infolm``.
+
+    The LM must produce per-position vocabulary logits; with no local HF
+    cache pass ``user_forward_fn(input_ids, attention_mask) -> (B, L, V)``.
+    """
+    measure = _InformationMeasure(information_measure, alpha, beta)
+    preds_ = [preds] if isinstance(preds, str) else list(preds)
+    target_ = [target] if isinstance(target, str) else list(target)
+    if len(preds_) != len(target_):
+        raise ValueError("Number of predicted and reference sentences must be the same!")
+
+    if user_forward_fn is not None:
+        if user_tokenizer is None:
+            raise ValueError("`user_tokenizer` must be provided with `user_forward_fn`.")
+        tok_p = user_tokenizer(preds_, max_length or 512)
+        tok_t = user_tokenizer(target_, max_length or 512)
+        logits_p = user_forward_fn(tok_p["input_ids"], tok_p["attention_mask"])
+        logits_t = user_forward_fn(tok_t["input_ids"], tok_t["attention_mask"])
+    else:
+        try:
+            from transformers import AutoTokenizer, FlaxAutoModelForMaskedLM
+
+            tokenizer = AutoTokenizer.from_pretrained(model_name_or_path)
+            model = FlaxAutoModelForMaskedLM.from_pretrained(model_name_or_path)
+        except Exception as err:
+            raise ModuleNotFoundError(
+                f"InfoLM default model {model_name_or_path!r} could not be loaded (requires transformers "
+                "+ a local HF cache). Pass `user_forward_fn` + `user_tokenizer` instead."
+            ) from err
+        enc_p = tokenizer(preds_, padding=True, truncation=True, max_length=max_length, return_tensors="np")
+        enc_t = tokenizer(target_, padding=True, truncation=True, max_length=max_length, return_tensors="np")
+        tok_p = {k: jnp.asarray(v) for k, v in enc_p.items()}
+        tok_t = {k: jnp.asarray(v) for k, v in enc_t.items()}
+        logits_p = jnp.asarray(model(**enc_p).logits)
+        logits_t = jnp.asarray(model(**enc_t).logits)
+
+    logits_p = jnp.asarray(logits_p) / temperature
+    logits_t = jnp.asarray(logits_t) / temperature
+    dist_p = _sentence_distribution_from_logits(logits_p, jnp.asarray(tok_p["attention_mask"]))
+    dist_t = _sentence_distribution_from_logits(logits_t, jnp.asarray(tok_t["attention_mask"]))
+    scores = measure(dist_p, dist_t)
+    mean = jnp.mean(scores)
+    if return_sentence_level_score:
+        return mean, scores
+    return mean

@@ -1,0 +1,94 @@
+"""SQuAD v1.1 evaluation: Exact Match + token F1 on normalized answers.
+
+Parity target: reference ``functional/text/squad.py`` (official SQuAD
+normalization: lowercase, strip punctuation, drop articles, squash spaces).
+"""
+import re
+import string
+from collections import Counter
+from typing import Any, Dict, List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+SINGLE_PRED_TYPE = Dict[str, Any]
+PREDS_TYPE = Union[SINGLE_PRED_TYPE, List[SINGLE_PRED_TYPE]]
+SINGLE_TARGET_TYPE = Dict[str, Any]
+TARGETS_TYPE = Union[SINGLE_TARGET_TYPE, List[SINGLE_TARGET_TYPE]]
+
+
+def _normalize_text(s: str) -> str:
+    s = s.lower()
+    s = "".join(ch for ch in s if ch not in set(string.punctuation))
+    s = re.sub(r"\b(a|an|the)\b", " ", s)
+    return " ".join(s.split())
+
+
+def _get_tokens(s: str) -> List[str]:
+    return _normalize_text(s).split() if s else []
+
+
+def _compute_f1_score(pred: str, target: str) -> float:
+    pred_tokens, tgt_tokens = _get_tokens(pred), _get_tokens(target)
+    common = Counter(pred_tokens) & Counter(tgt_tokens)
+    num_same = sum(common.values())
+    if len(pred_tokens) == 0 or len(tgt_tokens) == 0:
+        return float(pred_tokens == tgt_tokens)
+    if num_same == 0:
+        return 0.0
+    precision = num_same / len(pred_tokens)
+    recall = num_same / len(tgt_tokens)
+    return 2 * precision * recall / (precision + recall)
+
+
+def _compute_exact_match(pred: str, target: str) -> float:
+    return float(_normalize_text(pred) == _normalize_text(target))
+
+
+def _squad_input_check(preds: PREDS_TYPE, targets: TARGETS_TYPE) -> Tuple[Dict[str, str], List[Dict[str, Any]]]:
+    """Flatten SQuAD-format dicts to {id: prediction} + answer records."""
+    if isinstance(preds, dict):
+        preds = [preds]
+    if isinstance(targets, dict):
+        targets = [targets]
+    preds_dict = {}
+    for p in preds:
+        if "prediction_text" not in p or "id" not in p:
+            raise KeyError("Expected keys in a single prediction are 'prediction_text' and 'id'.")
+        preds_dict[p["id"]] = p["prediction_text"]
+    target_list = []
+    for t in targets:
+        if "answers" not in t or "id" not in t:
+            raise KeyError("Expected keys in a single target are 'answers' and 'id'.")
+        if "text" not in t["answers"]:
+            raise KeyError("Expected keys in a 'answers' are 'text'.")
+        target_list.append({"id": t["id"], "answers": list(t["answers"]["text"])})
+    return preds_dict, target_list
+
+
+def _squad_update(preds_dict: Dict[str, str], target_list: List[Dict[str, Any]]) -> Tuple[Array, Array, Array]:
+    f1 = exact = 0.0
+    total = 0
+    for rec in target_list:
+        total += 1
+        pred = preds_dict.get(rec["id"], "")
+        answers = rec["answers"] or [""]
+        exact += max(_compute_exact_match(pred, a) for a in answers)
+        f1 += max(_compute_f1_score(pred, a) for a in answers)
+    return jnp.asarray(f1), jnp.asarray(exact), jnp.asarray(float(total))
+
+
+def _squad_compute(f1: Array, exact_match: Array, total: Array) -> Dict[str, Array]:
+    return {
+        "exact_match": 100.0 * exact_match / jnp.maximum(total, 1.0),
+        "f1": 100.0 * f1 / jnp.maximum(total, 1.0),
+    }
+
+
+def squad(preds: PREDS_TYPE, target: TARGETS_TYPE) -> Dict[str, Array]:
+    """SQuAD EM/F1 (percent). Parity: reference ``squad.py:195``."""
+    preds_dict, target_list = _squad_input_check(preds, target)
+    f1, exact, total = _squad_update(preds_dict, target_list)
+    return _squad_compute(f1, exact, total)

@@ -1,0 +1,168 @@
+"""Translation Edit Rate (TER).
+
+Parity target: reference ``functional/text/ter.py`` (600 LoC, tercom
+semantics): tokenization with optional normalization / punctuation removal
+/ lowercasing / asian character support, then per sentence the minimum
+(shifts + word edits) over references divided by average reference length.
+Shift search: greedy best-improvement over matching sub-spans (length <=
+10, distance <= 50, capped candidates) exactly as tercom's heuristic
+bounds; the inner edit distance is the numpy row DP.
+"""
+import re
+import string
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .helper import edit_distance_fast
+
+Array = jax.Array
+
+_MAX_SHIFT_SIZE = 10
+_MAX_SHIFT_DIST = 50
+_MAX_SHIFT_CANDIDATES = 1000
+
+
+class _TercomTokenizer:
+    """Normalize + tokenize a sentence the tercom way."""
+
+    _ASIAN_PUNCT = r"([、。〈-】〔-〟｡-･・])"
+    _FULL_WIDTH_PUNCT = r"([．，？：；！＂（）])"
+
+    def __init__(
+        self,
+        normalize: bool = False,
+        no_punctuation: bool = False,
+        lowercase: bool = True,
+        asian_support: bool = False,
+    ) -> None:
+        self.normalize = normalize
+        self.no_punctuation = no_punctuation
+        self.lowercase = lowercase
+        self.asian_support = asian_support
+
+    def __call__(self, sentence: str) -> List[str]:
+        s = sentence
+        if self.lowercase:
+            s = s.lower()
+        if self.normalize:
+            s = re.sub(r"<skipped>", "", s)
+            s = re.sub(r"&quot;", '"', s)
+            s = re.sub(r"&amp;", "&", s)
+            s = re.sub(r"&lt;", "<", s)
+            s = re.sub(r"&gt;", ">", s)
+            s = re.sub(r"([{-~\[-\` -\&\(-\+\:-\@\/])", r" \1 ", s)
+            s = re.sub(r"([^0-9])([\.,])", r"\1 \2 ", s)
+            s = re.sub(r"([\.,])([^0-9])", r" \1 \2", s)
+            s = re.sub(r"([0-9])(-)", r"\1 \2 ", s)
+            if self.asian_support:
+                s = re.sub(self._ASIAN_PUNCT, r" \1 ", s)
+                s = re.sub(self._FULL_WIDTH_PUNCT, r" \1 ", s)
+        if self.no_punctuation:
+            punct = string.punctuation
+            if self.asian_support:
+                s = re.sub(self._ASIAN_PUNCT, " ", s)
+                s = re.sub(self._FULL_WIDTH_PUNCT, " ", s)
+            s = "".join(" " if c in punct else c for c in s)
+        return s.split()
+
+
+def _find_shifted_pairs(pred_words: List[str], target_words: List[str]):
+    """Matching sub-spans (pred_start, target_start, length), tercom bounds."""
+    for pred_start in range(len(pred_words)):
+        for target_start in range(len(target_words)):
+            if pred_start == target_start:
+                continue
+            if abs(target_start - pred_start) > _MAX_SHIFT_DIST:
+                continue
+            for length in range(1, _MAX_SHIFT_SIZE + 1):
+                if (
+                    pred_start + length > len(pred_words)
+                    or target_start + length > len(target_words)
+                    or pred_words[pred_start + length - 1] != target_words[target_start + length - 1]
+                ):
+                    break
+                yield pred_start, target_start, length
+
+
+def _apply_shift(words: List[str], start: int, target: int, length: int) -> List[str]:
+    """Move words[start:start+length] so it begins at position `target`."""
+    chunk = words[start : start + length]
+    rest = words[:start] + words[start + length :]
+    insert_at = target if target < start else target - length + 1
+    insert_at = max(0, min(len(rest), insert_at))
+    return rest[:insert_at] + chunk + rest[insert_at:]
+
+
+def _translation_edit_rate(pred_words: List[str], target_words: List[str]) -> float:
+    """shifts + word-level Levenshtein after greedy best-improvement shifting."""
+    if len(target_words) == 0:
+        return 0.0
+    words = list(pred_words)
+    num_shifts = 0
+    checked = 0
+    base = edit_distance_fast(words, target_words)
+    while checked < _MAX_SHIFT_CANDIDATES:
+        best_delta, best_words = 0, None
+        for ps, ts, ln in _find_shifted_pairs(words, target_words):
+            checked += 1
+            cand = _apply_shift(words, ps, ts, ln)
+            delta = base - edit_distance_fast(cand, target_words)
+            if delta > best_delta:
+                best_delta, best_words = delta, cand
+            if checked >= _MAX_SHIFT_CANDIDATES:
+                break
+        if best_words is None or best_delta <= 0:
+            break
+        words = best_words
+        base -= best_delta
+        num_shifts += 1
+    return float(num_shifts + base)
+
+
+def _ter_update(
+    preds: Sequence[str],
+    target: Sequence[Union[str, Sequence[str]]],
+    tokenizer: _TercomTokenizer,
+    sentence_scores: Optional[list] = None,
+) -> Tuple[float, float]:
+    total_edits, total_tgt_len = 0.0, 0.0
+    for pred, refs in zip(preds, target):
+        refs = [refs] if isinstance(refs, str) else list(refs)
+        pred_words = tokenizer(pred)
+        ref_words = [tokenizer(r) for r in refs]
+        edits = min(_translation_edit_rate(pred_words, rw) for rw in ref_words)
+        avg_len = float(np.mean([len(rw) for rw in ref_words]))
+        total_edits += edits
+        total_tgt_len += avg_len
+        if sentence_scores is not None:
+            sentence_scores.append(edits / avg_len if avg_len > 0 else (1.0 if edits else 0.0))
+    return total_edits, total_tgt_len
+
+
+def translation_edit_rate(
+    preds: Union[str, Sequence[str]],
+    target: Sequence[Union[str, Sequence[str]]],
+    normalize: bool = False,
+    no_punctuation: bool = False,
+    lowercase: bool = True,
+    asian_support: bool = False,
+    return_sentence_level_score: bool = False,
+) -> Union[Array, Tuple[Array, Array]]:
+    """Corpus TER = total edits / total avg reference length. Parity: ``ter.py``."""
+    for name, val in (
+        ("normalize", normalize), ("no_punctuation", no_punctuation),
+        ("lowercase", lowercase), ("asian_support", asian_support),
+    ):
+        if not isinstance(val, bool):
+            raise ValueError(f"Expected argument `{name}` to be of type boolean but got {val}.")
+    tokenizer = _TercomTokenizer(normalize, no_punctuation, lowercase, asian_support)
+    preds_ = [preds] if isinstance(preds, str) else list(preds)
+    sentence_scores: Optional[list] = [] if return_sentence_level_score else None
+    edits, tgt_len = _ter_update(preds_, list(target), tokenizer, sentence_scores)
+    score = jnp.asarray(edits / tgt_len if tgt_len > 0 else 0.0, dtype=jnp.float32)
+    if return_sentence_level_score:
+        return score, jnp.asarray(sentence_scores, dtype=jnp.float32)
+    return score

@@ -1,0 +1,4 @@
+"""Nominal metrics (L4). Parity: reference ``src/torchmetrics/nominal/``."""
+from .metrics import CramersV, FleissKappa, PearsonsContingencyCoefficient, TheilsU, TschuprowsT
+
+__all__ = ["CramersV", "FleissKappa", "PearsonsContingencyCoefficient", "TheilsU", "TschuprowsT"]

@@ -1,0 +1,126 @@
+"""Modular nominal metrics — fixed-shape confusion accumulation.
+
+Parity targets: reference ``nominal/{cramers,tschuprows,pearson,theils_u,
+fleiss_kappa}.py`` — (num_classes, num_classes) confmat states with
+``"sum"`` reduction (jittable updates); compute drops empty rows/cols on
+host (data-dependent shape) then evaluates one small XLA program.
+"""
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..functional.nominal.metrics import (
+    _as_labels,
+    _cramers_v_compute,
+    _fleiss_kappa_compute,
+    _fleiss_kappa_update,
+    _pearsons_contingency_coefficient_compute,
+    _theils_u_compute,
+    _tschuprows_t_compute,
+)
+from ..functional.nominal.utils import _confmat_update, _handle_nan_in_data, _nominal_input_validation
+from ..metric import Metric
+from ..utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class _ConfmatNominalMetric(Metric):
+    """Base: accumulate a (C, C) contingency table."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        num_classes: int,
+        nan_strategy: str = "replace",
+        nan_replace_value: Optional[float] = 0.0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(num_classes, int) or num_classes < 1:
+            raise ValueError("Argument `num_classes` must be a positive integer")
+        _nominal_input_validation(nan_strategy, nan_replace_value)
+        self.num_classes = num_classes
+        self.nan_strategy = nan_strategy
+        self.nan_replace_value = nan_replace_value
+        self._compute_jittable = False
+        if nan_strategy == "drop":  # row-dropping is data-dependent-shape
+            self._use_jit = False
+        self.add_state("confmat", jnp.zeros((num_classes, num_classes)), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        p, t = _as_labels(preds), _as_labels(target)
+        p, t = _handle_nan_in_data(p, t, self.nan_strategy, self.nan_replace_value)
+        self.confmat = self.confmat + _confmat_update(p, t, self.num_classes)
+
+
+class CramersV(_ConfmatNominalMetric):
+    """Parity: reference ``nominal/cramers.py:30``."""
+
+    def __init__(self, num_classes: int, bias_correction: bool = True,
+                 nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0,
+                 **kwargs: Any) -> None:
+        super().__init__(num_classes, nan_strategy, nan_replace_value, **kwargs)
+        self.bias_correction = bias_correction
+
+    def compute(self) -> Array:
+        return _cramers_v_compute(np.asarray(self.confmat), self.bias_correction)
+
+
+class TschuprowsT(_ConfmatNominalMetric):
+    """Parity: reference ``nominal/tschuprows.py:30``."""
+
+    def __init__(self, num_classes: int, bias_correction: bool = True,
+                 nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0,
+                 **kwargs: Any) -> None:
+        super().__init__(num_classes, nan_strategy, nan_replace_value, **kwargs)
+        self.bias_correction = bias_correction
+
+    def compute(self) -> Array:
+        return _tschuprows_t_compute(np.asarray(self.confmat), self.bias_correction)
+
+
+class PearsonsContingencyCoefficient(_ConfmatNominalMetric):
+    """Parity: reference ``nominal/pearson.py:33``."""
+
+    def compute(self) -> Array:
+        return _pearsons_contingency_coefficient_compute(np.asarray(self.confmat))
+
+
+class TheilsU(_ConfmatNominalMetric):
+    """Parity: reference ``nominal/theils_u.py:30``."""
+
+    def compute(self) -> Array:
+        return _theils_u_compute(np.asarray(self.confmat))
+
+
+class FleissKappa(Metric):
+    """Parity: reference ``nominal/fleiss_kappa.py:29`` — cat state of counts."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    jittable = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, mode: str = "counts", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if mode not in ("counts", "probs"):
+            raise ValueError("Argument ``mode`` must be one of ['counts', 'probs'].")
+        self.mode = mode
+        self._compute_jittable = False
+        self.add_state("counts", [], dist_reduce_fx="cat")
+
+    def update(self, ratings: Array) -> None:
+        self.counts.append(_fleiss_kappa_update(jnp.asarray(ratings), self.mode))
+
+    def compute(self) -> Array:
+        return _fleiss_kappa_compute(dim_zero_cat(self.counts))

@@ -1,0 +1,148 @@
+"""RetrievalMetric base — padded-batch per-query evaluation.
+
+Parity target: reference ``retrieval/base.py:43`` (cat list states
+``indexes/preds/target``, per-query grouping, ``empty_target_action``
+neg/pos/skip/error, aggregation mean/median/min/max).
+
+TPU-native divergence: the reference loops Python-side over
+``torch.split`` query groups (``base.py:146-183``); here compute groups
+queries ONCE on host into a dense padded ``(Q, L_max)`` batch and scores all
+queries in a single vectorized XLA call (``functional/retrieval/_ops.py``).
+"""
+from abc import ABC, abstractmethod
+from typing import Any, Callable, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..metric import Metric
+from ..utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+def _retrieval_aggregate(values: Array, aggregation: Union[str, Callable] = "mean") -> Array:
+    """Parity: reference ``retrieval/base.py:26-40``."""
+    if aggregation == "mean":
+        return jnp.mean(values)
+    if aggregation == "median":
+        return jnp.median(values)
+    if aggregation == "min":
+        return jnp.min(values)
+    if aggregation == "max":
+        return jnp.max(values)
+    return aggregation(values)
+
+
+def _pad_by_query(
+    indexes: np.ndarray, preds: np.ndarray, target: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Group flat rows by query id into dense (Q, L_max) arrays + mask."""
+    order = np.argsort(indexes, kind="stable")
+    idx_s, p_s, t_s = indexes[order], preds[order], target[order]
+    uniq, starts, counts = np.unique(idx_s, return_index=True, return_counts=True)
+    q, lmax = len(uniq), int(counts.max()) if len(counts) else 0
+    preds_pad = np.zeros((q, lmax), dtype=np.float32)
+    target_pad = np.zeros((q, lmax), dtype=t_s.dtype)
+    mask = np.zeros((q, lmax), dtype=bool)
+    # row positions: offset of each element within its query
+    within = np.arange(len(idx_s)) - np.repeat(starts, counts)
+    rows = np.repeat(np.arange(q), counts)
+    preds_pad[rows, within] = p_s
+    target_pad[rows, within] = t_s
+    mask[rows, within] = True
+    return preds_pad, target_pad, mask
+
+
+class RetrievalMetric(Metric, ABC):
+    """Base for IR metrics over (preds, target, indexes) triplets."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    jittable = False  # host-side grouping; updates are trivial appends
+
+    allow_non_binary_target = False
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        aggregation: Union[str, Callable] = "mean",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if empty_target_action not in ("error", "skip", "neg", "pos"):
+            raise ValueError(f"Argument `empty_target_action` received a wrong value `{empty_target_action}`.")
+        self.empty_target_action = empty_target_action
+        if ignore_index is not None and not isinstance(ignore_index, int):
+            raise ValueError("Argument `ignore_index` must be an integer or None.")
+        self.ignore_index = ignore_index
+        if not (aggregation in ("mean", "median", "min", "max") or callable(aggregation)):
+            raise ValueError(
+                "Argument `aggregation` must be one of `mean`, `median`, `min`, `max` or a custom callable "
+                f"function which takes tensor of values, but got {aggregation}."
+            )
+        self.aggregation = aggregation
+        self._compute_jittable = False
+
+        self.add_state("indexes", [], dist_reduce_fx="cat")
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array, indexes: Array) -> None:
+        if indexes is None:
+            raise ValueError("Argument `indexes` cannot be None")
+        if not (preds.shape == target.shape == indexes.shape):
+            raise ValueError("`indexes`, `preds` and `target` must be of the same shape")
+        if not jnp.issubdtype(jnp.asarray(indexes).dtype, jnp.integer):
+            raise ValueError("`indexes` must be a tensor of integers")
+        if not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating):
+            raise ValueError("`preds` must be a tensor of floats")
+        tgt = jnp.asarray(target)
+        if jnp.issubdtype(tgt.dtype, jnp.floating) and not self.allow_non_binary_target:
+            raise ValueError("`target` must be a tensor of booleans or integers")
+        indexes = jnp.asarray(indexes).reshape(-1)
+        preds = jnp.asarray(preds).reshape(-1).astype(jnp.float32)
+        tgt = tgt.reshape(-1)
+        if self.ignore_index is not None:
+            keep = tgt != self.ignore_index
+            indexes, preds, tgt = indexes[keep], preds[keep], tgt[keep]
+        if not self.allow_non_binary_target and tgt.size and bool((tgt.max() > 1) | (tgt.min() < 0)):
+            raise ValueError("`target` must contain binary values")
+        self.indexes.append(indexes)
+        self.preds.append(preds)
+        self.target.append(tgt)
+
+    # -- per-metric hooks -------------------------------------------------
+    @abstractmethod
+    def _batched_scores(self, preds: Array, target: Array, mask: Array) -> Array:
+        """Per-query scores (Q,) from padded (Q, L) inputs."""
+
+    def _empty_mask(self, target: Array, mask: Array) -> Array:
+        """(Q,) bool: query has no positive target → empty_target_action."""
+        return jnp.sum(target.astype(jnp.float32) * mask, axis=-1) == 0
+
+    def compute(self) -> Array:
+        indexes = np.asarray(dim_zero_cat(self.indexes))
+        preds = np.asarray(dim_zero_cat(self.preds))
+        target = np.asarray(dim_zero_cat(self.target))
+        if indexes.size == 0:
+            return jnp.asarray(0.0)
+        p, t, m = _pad_by_query(indexes, preds, target)
+        p, t, m = jnp.asarray(p), jnp.asarray(t), jnp.asarray(m)
+        empty = self._empty_mask(t, m)
+        if self.empty_target_action == "error" and bool(jnp.any(empty)):
+            raise ValueError("`compute` method was provided with a query with no positive target.")
+        scores = self._batched_scores(p, t, m)
+        if self.empty_target_action == "pos":
+            scores = jnp.where(empty, 1.0, scores)
+        elif self.empty_target_action == "neg":
+            scores = jnp.where(empty, 0.0, scores)
+        elif self.empty_target_action == "skip":
+            keep = ~empty
+            if not bool(jnp.any(keep)):
+                return jnp.asarray(0.0)
+            scores = scores[np.asarray(keep)]
+        return _retrieval_aggregate(scores, self.aggregation)

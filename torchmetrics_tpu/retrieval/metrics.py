@@ -1,0 +1,187 @@
+"""Modular retrieval metrics.
+
+Parity targets: reference ``retrieval/{average_precision,reciprocal_rank,
+precision,recall,fall_out,hit_rate,ndcg,r_precision,auroc}.py`` — each a thin
+``_metric`` override of :class:`RetrievalMetric`; here each supplies the
+batched padded kernel instead (one XLA call for all queries).
+"""
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..functional.retrieval._ops import (
+    batched_auroc,
+    batched_average_precision,
+    batched_fall_out,
+    batched_hit_rate,
+    batched_ndcg,
+    batched_precision,
+    batched_r_precision,
+    batched_recall,
+    batched_reciprocal_rank,
+)
+from .base import RetrievalMetric
+
+Array = jax.Array
+
+
+def _check_top_k(top_k: Optional[int]) -> None:
+    if top_k is not None and not (isinstance(top_k, int) and top_k > 0):
+        raise ValueError("`top_k` has to be a positive integer or None")
+
+
+class RetrievalMAP(RetrievalMetric):
+    """Mean Average Precision. Parity: reference ``retrieval/average_precision.py:28``."""
+
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, empty_target_action: str = "neg", ignore_index: Optional[int] = None,
+                 top_k: Optional[int] = None, aggregation: Any = "mean", **kwargs: Any) -> None:
+        super().__init__(empty_target_action, ignore_index, aggregation, **kwargs)
+        _check_top_k(top_k)
+        self.top_k = top_k
+
+    def _batched_scores(self, preds: Array, target: Array, mask: Array) -> Array:
+        return batched_average_precision(preds, target, mask, self.top_k)
+
+
+class RetrievalMRR(RetrievalMetric):
+    """Mean Reciprocal Rank. Parity: reference ``retrieval/reciprocal_rank.py:28``."""
+
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, empty_target_action: str = "neg", ignore_index: Optional[int] = None,
+                 top_k: Optional[int] = None, aggregation: Any = "mean", **kwargs: Any) -> None:
+        super().__init__(empty_target_action, ignore_index, aggregation, **kwargs)
+        _check_top_k(top_k)
+        self.top_k = top_k
+
+    def _batched_scores(self, preds: Array, target: Array, mask: Array) -> Array:
+        return batched_reciprocal_rank(preds, target, mask, self.top_k)
+
+
+class RetrievalPrecision(RetrievalMetric):
+    """Precision@k. Parity: reference ``retrieval/precision.py:28``."""
+
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, empty_target_action: str = "neg", ignore_index: Optional[int] = None,
+                 top_k: Optional[int] = None, adaptive_k: bool = False,
+                 aggregation: Any = "mean", **kwargs: Any) -> None:
+        super().__init__(empty_target_action, ignore_index, aggregation, **kwargs)
+        _check_top_k(top_k)
+        if not isinstance(adaptive_k, bool):
+            raise ValueError("`adaptive_k` has to be a boolean")
+        self.top_k = top_k
+        self.adaptive_k = adaptive_k
+
+    def _batched_scores(self, preds: Array, target: Array, mask: Array) -> Array:
+        return batched_precision(preds, target, mask, self.top_k, self.adaptive_k)
+
+
+class RetrievalRecall(RetrievalMetric):
+    """Recall@k. Parity: reference ``retrieval/recall.py:28``."""
+
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, empty_target_action: str = "neg", ignore_index: Optional[int] = None,
+                 top_k: Optional[int] = None, aggregation: Any = "mean", **kwargs: Any) -> None:
+        super().__init__(empty_target_action, ignore_index, aggregation, **kwargs)
+        _check_top_k(top_k)
+        self.top_k = top_k
+
+    def _batched_scores(self, preds: Array, target: Array, mask: Array) -> Array:
+        return batched_recall(preds, target, mask, self.top_k)
+
+
+class RetrievalFallOut(RetrievalMetric):
+    """Fall-out@k (lower is better). Parity: reference ``retrieval/fall_out.py:30``.
+
+    The empty-query condition inverts: a query is "empty" when it has no
+    NEGATIVE targets (reference ``fall_out.py:116-155``).
+    """
+
+    higher_is_better = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, empty_target_action: str = "pos", ignore_index: Optional[int] = None,
+                 top_k: Optional[int] = None, aggregation: Any = "mean", **kwargs: Any) -> None:
+        super().__init__(empty_target_action, ignore_index, aggregation, **kwargs)
+        _check_top_k(top_k)
+        self.top_k = top_k
+
+    def _empty_mask(self, target: Array, mask: Array) -> Array:
+        neg = (1.0 - target.astype(jnp.float32)) * mask
+        return jnp.sum(neg, axis=-1) == 0
+
+    def _batched_scores(self, preds: Array, target: Array, mask: Array) -> Array:
+        return batched_fall_out(preds, target, mask, self.top_k)
+
+
+class RetrievalHitRate(RetrievalMetric):
+    """HitRate@k. Parity: reference ``retrieval/hit_rate.py:28``."""
+
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, empty_target_action: str = "neg", ignore_index: Optional[int] = None,
+                 top_k: Optional[int] = None, aggregation: Any = "mean", **kwargs: Any) -> None:
+        super().__init__(empty_target_action, ignore_index, aggregation, **kwargs)
+        _check_top_k(top_k)
+        self.top_k = top_k
+
+    def _batched_scores(self, preds: Array, target: Array, mask: Array) -> Array:
+        return batched_hit_rate(preds, target, mask, self.top_k)
+
+
+class RetrievalNormalizedDCG(RetrievalMetric):
+    """nDCG@k with graded relevance. Parity: reference ``retrieval/ndcg.py:28``."""
+
+    allow_non_binary_target = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, empty_target_action: str = "neg", ignore_index: Optional[int] = None,
+                 top_k: Optional[int] = None, aggregation: Any = "mean", **kwargs: Any) -> None:
+        super().__init__(empty_target_action, ignore_index, aggregation, **kwargs)
+        _check_top_k(top_k)
+        self.top_k = top_k
+
+    def _batched_scores(self, preds: Array, target: Array, mask: Array) -> Array:
+        return batched_ndcg(preds, target, mask, self.top_k)
+
+
+class RetrievalRPrecision(RetrievalMetric):
+    """R-Precision. Parity: reference ``retrieval/r_precision.py:27``."""
+
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def _batched_scores(self, preds: Array, target: Array, mask: Array) -> Array:
+        return batched_r_precision(preds, target, mask)
+
+
+class RetrievalAUROC(RetrievalMetric):
+    """Per-query AUROC. Parity: reference ``retrieval/auroc.py:28``."""
+
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, empty_target_action: str = "neg", ignore_index: Optional[int] = None,
+                 top_k: Optional[int] = None, max_fpr: Optional[float] = None,
+                 aggregation: Any = "mean", **kwargs: Any) -> None:
+        super().__init__(empty_target_action, ignore_index, aggregation, **kwargs)
+        _check_top_k(top_k)
+        if max_fpr is not None and not (isinstance(max_fpr, float) and 0 < max_fpr <= 1):
+            raise ValueError(f"Argument `max_fpr` should be a float in range (0, 1], but got: {max_fpr}")
+        self.top_k = top_k
+        self.max_fpr = max_fpr
+
+    def _batched_scores(self, preds: Array, target: Array, mask: Array) -> Array:
+        return batched_auroc(preds, target, mask, self.top_k, self.max_fpr)

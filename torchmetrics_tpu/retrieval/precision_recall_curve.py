@@ -1,0 +1,127 @@
+"""RetrievalPrecisionRecallCurve & RetrievalRecallAtFixedPrecision.
+
+Parity: reference ``retrieval/precision_recall_curve.py:63,296``.
+Per-query curves come from one batched kernel
+(``functional/retrieval/_ops.py:batched_precision_recall_curve``); the class
+averages them over queries with ``empty_target_action`` semantics.
+"""
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..functional.retrieval._ops import batched_precision_recall_curve
+from ..metric import Metric
+from ..utils.data import dim_zero_cat
+from .base import _pad_by_query
+
+Array = jax.Array
+
+
+def _retrieval_recall_at_fixed_precision(
+    precision: Array, recall: Array, top_k: Array, min_precision: float
+) -> Tuple[Array, Array]:
+    """Highest recall whose averaged precision@k >= min_precision (+ its k)."""
+    ok = precision >= min_precision
+    masked_recall = jnp.where(ok, recall, -jnp.inf)
+    best = jnp.argmax(masked_recall)
+    any_ok = jnp.any(ok)
+    max_recall = jnp.where(any_ok, masked_recall[best], 0.0)
+    best_k = jnp.where(any_ok, top_k[best], top_k[-1])
+    return max_recall, best_k
+
+
+class RetrievalPrecisionRecallCurve(Metric):
+    """Averaged precision@k / recall@k curves over queries, k = 1..max_k."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    jittable = False
+
+    def __init__(
+        self,
+        max_k: Optional[int] = None,
+        adaptive_k: bool = False,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if max_k is not None and not (isinstance(max_k, int) and max_k > 0):
+            raise ValueError("`max_k` has to be a positive integer or None")
+        if not isinstance(adaptive_k, bool):
+            raise ValueError("`adaptive_k` has to be a boolean")
+        if empty_target_action not in ("error", "skip", "neg", "pos"):
+            raise ValueError(f"Argument `empty_target_action` received a wrong value `{empty_target_action}`.")
+        if ignore_index is not None and not isinstance(ignore_index, int):
+            raise ValueError("Argument `ignore_index` must be an integer or None.")
+        self.max_k = max_k
+        self.adaptive_k = adaptive_k
+        self.empty_target_action = empty_target_action
+        self.ignore_index = ignore_index
+        self._compute_jittable = False
+
+        self.add_state("indexes", [], dist_reduce_fx="cat")
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array, indexes: Array) -> None:
+        if indexes is None:
+            raise ValueError("Argument `indexes` cannot be None")
+        if not (preds.shape == target.shape == indexes.shape):
+            raise ValueError("`indexes`, `preds` and `target` must be of the same shape")
+        indexes = jnp.asarray(indexes).reshape(-1)
+        preds = jnp.asarray(preds).reshape(-1).astype(jnp.float32)
+        target = jnp.asarray(target).reshape(-1)
+        if self.ignore_index is not None:
+            keep = target != self.ignore_index
+            indexes, preds, target = indexes[keep], preds[keep], target[keep]
+        self.indexes.append(indexes)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Tuple[Array, Array, Array]:
+        indexes = np.asarray(dim_zero_cat(self.indexes))
+        preds = np.asarray(dim_zero_cat(self.preds))
+        target = np.asarray(dim_zero_cat(self.target))
+        p, t, m = _pad_by_query(indexes, preds, target)
+        max_k = self.max_k or p.shape[1]
+        p, t, m = jnp.asarray(p), jnp.asarray(t), jnp.asarray(m)
+        prec_q, rec_q, ks = batched_precision_recall_curve(p, t, m, max_k, self.adaptive_k)
+        empty = jnp.sum(t.astype(jnp.float32) * m, axis=-1) == 0
+        if self.empty_target_action == "error" and bool(jnp.any(empty)):
+            raise ValueError("`compute` method was provided with a query with no positive target.")
+        if self.empty_target_action == "pos":
+            prec_q = jnp.where(empty[:, None], 1.0, prec_q)
+            rec_q = jnp.where(empty[:, None], 1.0, rec_q)
+        elif self.empty_target_action == "neg":
+            prec_q = jnp.where(empty[:, None], 0.0, prec_q)
+            rec_q = jnp.where(empty[:, None], 0.0, rec_q)
+        elif self.empty_target_action == "skip":
+            keep = np.asarray(~empty)
+            if not keep.any():
+                z = jnp.zeros((max_k,))
+                return z, z, ks
+            prec_q, rec_q = prec_q[keep], rec_q[keep]
+        return jnp.mean(prec_q, axis=0), jnp.mean(rec_q, axis=0), ks
+
+
+class RetrievalRecallAtFixedPrecision(RetrievalPrecisionRecallCurve):
+    """Parity: reference ``retrieval/precision_recall_curve.py:296``."""
+
+    higher_is_better = True
+
+    def __init__(self, min_precision: float = 0.0, max_k: Optional[int] = None,
+                 adaptive_k: bool = False, empty_target_action: str = "neg",
+                 ignore_index: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(max_k=max_k, adaptive_k=adaptive_k,
+                         empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        if not (isinstance(min_precision, float) and 0.0 <= min_precision <= 1.0):
+            raise ValueError("`min_precision` has to be a positive float between 0 and 1")
+        self.min_precision = min_precision
+
+    def compute(self) -> Tuple[Array, Array]:
+        precision, recall, top_k = super().compute()
+        return _retrieval_recall_at_fixed_precision(precision, recall, top_k, self.min_precision)

@@ -1,0 +1,146 @@
+"""ASR error-rate metric classes: WER/CER/MER/WIL/WIP.
+
+Parity targets: reference ``text/{wer,cer,mer,wil,wip}.py`` — sum states
+over host-computed edit counts.
+"""
+from typing import Any, List, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..functional.text.asr import (
+    _cer_update,
+    _mer_update,
+    _wer_update,
+    _wil_wip_update,
+)
+from ..metric import Metric
+
+Array = jax.Array
+
+
+class _HostTextMetric(Metric):
+    jittable = False  # update consumes Python strings
+
+    def _eager_validate(self, *args: Any, **kwargs: Any) -> None:
+        pass
+
+    def _to_array(self, value: Any) -> Any:  # strings pass through untouched
+        return value
+
+
+class WordErrorRate(_HostTextMetric):
+    """Parity: reference ``text/wer.py``."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("errors", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
+        errors, total = _wer_update(preds, target)
+        self.errors = self.errors + errors
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return self.errors / self.total
+
+
+class CharErrorRate(_HostTextMetric):
+    """Parity: reference ``text/cer.py``."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("errors", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
+        errors, total = _cer_update(preds, target)
+        self.errors = self.errors + errors
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return self.errors / self.total
+
+
+class MatchErrorRate(_HostTextMetric):
+    """Parity: reference ``text/mer.py``."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("errors", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
+        errors, total = _mer_update(preds, target)
+        self.errors = self.errors + errors
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return self.errors / self.total
+
+
+class WordInfoLost(_HostTextMetric):
+    """Parity: reference ``text/wil.py``."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("errors", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("target_total", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("preds_total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
+        errors, t_total, p_total = _wil_wip_update(preds, target)
+        self.errors = self.errors + errors
+        self.target_total = self.target_total + t_total
+        self.preds_total = self.preds_total + p_total
+
+    def compute(self) -> Array:
+        return 1.0 - (self.errors / self.target_total) * (self.errors / self.preds_total)
+
+
+class WordInfoPreserved(_HostTextMetric):
+    """Parity: reference ``text/wip.py``."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("errors", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("target_total", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("preds_total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
+        errors, t_total, p_total = _wil_wip_update(preds, target)
+        self.errors = self.errors + errors
+        self.target_total = self.target_total + t_total
+        self.preds_total = self.preds_total + p_total
+
+    def compute(self) -> Array:
+        return (self.errors / self.target_total) * (self.errors / self.preds_total)

@@ -1,0 +1,252 @@
+"""ROUGEScore, EditDistance, SQuAD, BERTScore, InfoLM metric classes.
+
+Parity targets: reference ``text/{rouge,edit,squad,bert,infolm}.py``.
+"""
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..functional.text.bert import bert_score
+from ..functional.text.edit import _edit_distance_single
+from ..functional.text.infolm import _ALLOWED_INFORMATION_MEASURE, infolm
+from ..functional.text.rouge import ALLOWED_ACCUMULATE, ALLOWED_ROUGE_KEYS, _rouge_score_update
+from ..functional.text.squad import PREDS_TYPE, TARGETS_TYPE, _squad_compute, _squad_input_check, _squad_update
+from ..utils.data import dim_zero_cat
+from .asr import _HostTextMetric
+
+Array = jax.Array
+
+
+class ROUGEScore(_HostTextMetric):
+    """Parity: reference ``text/rouge.py:ROUGEScore`` (236 LoC)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, use_stemmer: bool = False, normalizer: Optional[Callable] = None,
+                 tokenizer: Optional[Callable] = None, accumulate: str = "best",
+                 rouge_keys: Union[str, Tuple[str, ...]] = ("rouge1", "rouge2", "rougeL", "rougeLsum"),
+                 **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if isinstance(rouge_keys, str):
+            rouge_keys = (rouge_keys,)
+        for key in rouge_keys:
+            if key not in ALLOWED_ROUGE_KEYS:
+                raise ValueError(f"Got unknown rouge key {key}. Expected to be one of {ALLOWED_ROUGE_KEYS}")
+        if accumulate not in ALLOWED_ACCUMULATE:
+            raise ValueError(f"Got unknown accumulate value {accumulate}. Expected to be one of {ALLOWED_ACCUMULATE}")
+        self.rouge_keys = rouge_keys
+        self.accumulate = accumulate
+        self.stemmer = None
+        if use_stemmer:
+            try:
+                import nltk.stem.porter
+
+                self.stemmer = nltk.stem.porter.PorterStemmer()
+            except ImportError as err:
+                raise ModuleNotFoundError("Stemmer requires that `nltk` is installed.") from err
+        for key in rouge_keys:
+            slug = key.replace(".", "_")
+            self.add_state(f"{slug}_triplets", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Union[str, Sequence[str]],
+               target: Union[str, Sequence[str], Sequence[Sequence[str]]]) -> None:
+        preds_ = [preds] if isinstance(preds, str) else list(preds)
+        target_ = [target] if isinstance(target, str) else list(target)
+        results = _rouge_score_update(preds_, target_, self.rouge_keys, self.accumulate, self.stemmer)
+        for key, triplets in results.items():
+            getattr(self, f"{key}_triplets").append(jnp.asarray(triplets, dtype=jnp.float32).reshape(-1, 3))
+
+    def compute(self) -> Dict[str, Array]:
+        out: Dict[str, Array] = {}
+        for key in self.rouge_keys:
+            vals = getattr(self, f"{key}_triplets")
+            arr = dim_zero_cat(vals) if vals else jnp.zeros((1, 3))
+            out[f"{key}_precision"] = jnp.mean(arr[:, 0])
+            out[f"{key}_recall"] = jnp.mean(arr[:, 1])
+            out[f"{key}_fmeasure"] = jnp.mean(arr[:, 2])
+        return out
+
+
+class EditDistance(_HostTextMetric):
+    """Parity: reference ``text/edit.py:EditDistance``."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, substitution_cost: int = 1, reduction: Optional[str] = "mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(substitution_cost, int) or substitution_cost < 0:
+            raise ValueError(
+                f"Expected argument `substitution_cost` to be a positive integer, but got {substitution_cost}"
+            )
+        if reduction not in ("mean", "sum", "none", None):
+            raise ValueError("Expected argument `reduction` to be one of ['mean', 'sum', 'none', None]")
+        self.substitution_cost = substitution_cost
+        self.reduction = reduction
+        if reduction in ("none", None):
+            self.add_state("values", [], dist_reduce_fx="cat")
+        else:
+            self.add_state("edit_scores_list", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> None:
+        preds_ = [preds] if isinstance(preds, str) else list(preds)
+        target_ = [target] if isinstance(target, str) else list(target)
+        if len(preds_) != len(target_):
+            raise ValueError(
+                f"Expected argument `preds` and `target` to have same length, but got {len(preds_)} and {len(target_)}"
+            )
+        dists = jnp.asarray(
+            [_edit_distance_single(p, t, self.substitution_cost) for p, t in zip(preds_, target_)],
+            dtype=jnp.float32,
+        )
+        if self.reduction in ("none", None):
+            self.values.append(dists)
+        else:
+            self.edit_scores_list.append(dists)
+
+    def compute(self) -> Array:
+        if self.reduction in ("none", None):
+            return dim_zero_cat(self.values) if self.values else jnp.zeros((0,))
+        arr = dim_zero_cat(self.edit_scores_list) if self.edit_scores_list else jnp.zeros((0,))
+        if self.reduction == "mean":
+            return jnp.mean(arr) if arr.size else jnp.asarray(0.0)
+        return jnp.sum(arr)
+
+
+class SQuAD(_HostTextMetric):
+    """Parity: reference ``text/squad.py:SQuAD`` (167 LoC)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 100.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("f1_score", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("exact_match", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: PREDS_TYPE, target: TARGETS_TYPE) -> None:
+        preds_dict, target_list = _squad_input_check(preds, target)
+        f1, exact, total = _squad_update(preds_dict, target_list)
+        self.f1_score = self.f1_score + f1
+        self.exact_match = self.exact_match + exact
+        self.total = self.total + total
+
+    def compute(self) -> Dict[str, Array]:
+        return _squad_compute(self.f1_score, self.exact_match, self.total)
+
+
+class BERTScore(_HostTextMetric):
+    """Parity: reference ``text/bert.py:BERTScore`` — stores raw sentence
+    pairs (the reference stores tokenized ids, same storage semantics) and
+    runs the encoder + greedy matching once at compute."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, model_name_or_path: Optional[str] = None, num_layers: Optional[int] = None,
+                 idf: bool = False, lang: str = "en", max_length: int = 512, batch_size: int = 64,
+                 user_tokenizer: Any = None, user_forward_fn: Optional[Callable] = None,
+                 **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.model_name_or_path = model_name_or_path
+        self.num_layers = num_layers
+        self.idf = idf
+        self.lang = lang
+        self.max_length = max_length
+        self.batch_size = batch_size
+        self.user_tokenizer = user_tokenizer
+        self.user_forward_fn = user_forward_fn
+        self._preds: List[str] = []
+        self._target: List[str] = []
+
+    def update(self, preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> None:
+        self._update_count += 1
+        preds_ = [preds] if isinstance(preds, str) else list(preds)
+        target_ = [target] if isinstance(target, str) else list(target)
+        if len(preds_) != len(target_):
+            raise ValueError("Number of predicted and reference sentences must be the same!")
+        self._preds.extend(preds_)
+        self._target.extend(target_)
+
+    def compute(self) -> Dict[str, Array]:
+        return bert_score(
+            self._preds, self._target,
+            model_name_or_path=self.model_name_or_path, num_layers=self.num_layers,
+            idf=self.idf, lang=self.lang, max_length=self.max_length,
+            batch_size=self.batch_size, user_tokenizer=self.user_tokenizer,
+            user_forward_fn=self.user_forward_fn,
+        )
+
+    def reset(self) -> None:
+        super().reset()
+        self._preds, self._target = [], []
+
+
+class InfoLM(_HostTextMetric):
+    """Parity: reference ``text/infolm.py:InfoLM`` (244 LoC)."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, model_name_or_path: str = "bert-base-uncased", temperature: float = 0.25,
+                 information_measure: str = "kl_divergence", idf: bool = True,
+                 alpha: Optional[float] = None, beta: Optional[float] = None,
+                 max_length: Optional[int] = None, batch_size: int = 64,
+                 return_sentence_level_score: bool = False,
+                 user_tokenizer: Any = None, user_forward_fn: Optional[Callable] = None,
+                 **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if information_measure not in _ALLOWED_INFORMATION_MEASURE:
+            raise ValueError(
+                f"Argument `information_measure` is expected to be one of {_ALLOWED_INFORMATION_MEASURE}"
+            )
+        self.model_name_or_path = model_name_or_path
+        self.temperature = temperature
+        self.information_measure = information_measure
+        self.idf = idf
+        self.alpha = alpha
+        self.beta = beta
+        self.max_length = max_length
+        self.batch_size = batch_size
+        self.return_sentence_level_score = return_sentence_level_score
+        self.user_tokenizer = user_tokenizer
+        self.user_forward_fn = user_forward_fn
+        self._preds: List[str] = []
+        self._target: List[str] = []
+
+    def update(self, preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> None:
+        self._update_count += 1
+        preds_ = [preds] if isinstance(preds, str) else list(preds)
+        target_ = [target] if isinstance(target, str) else list(target)
+        self._preds.extend(preds_)
+        self._target.extend(target_)
+
+    def compute(self):
+        return infolm(
+            self._preds, self._target, model_name_or_path=self.model_name_or_path,
+            temperature=self.temperature, information_measure=self.information_measure,
+            idf=self.idf, alpha=self.alpha, beta=self.beta, max_length=self.max_length,
+            batch_size=self.batch_size, return_sentence_level_score=self.return_sentence_level_score,
+            user_tokenizer=self.user_tokenizer, user_forward_fn=self.user_forward_fn,
+        )
+
+    def reset(self) -> None:
+        super().reset()
+        self._preds, self._target = [], []

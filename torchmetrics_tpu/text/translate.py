@@ -1,0 +1,197 @@
+"""Translation-quality metric classes: BLEU, SacreBLEU, CHRF, TER, EED.
+
+Parity targets: reference ``text/{bleu,sacre_bleu,chrf,ter,eed}.py`` — host
+tokenization/counting; device sum states (count vectors), ratio computes.
+"""
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..functional.text.bleu import _bleu_counts, _bleu_score_compute
+from ..functional.text.chrf import _chrf_update, _fscore_from_counts
+from ..functional.text.eed import _eed_update
+from ..functional.text.sacre_bleu import AVAILABLE_TOKENIZERS, _SacreBLEUTokenizer
+from ..functional.text.ter import _TercomTokenizer, _ter_update
+from ..utils.data import dim_zero_cat
+from .asr import _HostTextMetric
+
+Array = jax.Array
+
+
+class BLEUScore(_HostTextMetric):
+    """Parity: reference ``text/bleu.py:BLEUScore`` (157 LoC)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, n_gram: int = 4, smooth: bool = False,
+                 weights: Optional[Sequence[float]] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.n_gram = n_gram
+        self.smooth = smooth
+        if weights is not None and len(weights) != n_gram:
+            raise ValueError(f"List of weights has different weights than `n_gram`: {len(weights)} != {n_gram}")
+        self.weights = weights or [1.0 / n_gram] * n_gram
+        self.add_state("preds_len", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("target_len", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("numerator", jnp.zeros(n_gram), dist_reduce_fx="sum")
+        self.add_state("denominator", jnp.zeros(n_gram), dist_reduce_fx="sum")
+
+    def _tokenizer(self):
+        return lambda line: line.split()
+
+    def update(self, preds: Sequence[str], target: Sequence[Sequence[str]]) -> None:
+        preds_ = [preds] if isinstance(preds, str) else list(preds)
+        target_ = [[t] if isinstance(t, str) else list(t) for t in target]
+        num, den, plen, tlen = _bleu_counts(preds_, target_, self.n_gram, self._tokenizer())
+        self.numerator = self.numerator + jnp.asarray(num)
+        self.denominator = self.denominator + jnp.asarray(den)
+        self.preds_len = self.preds_len + float(plen)
+        self.target_len = self.target_len + float(tlen)
+
+    def compute(self) -> Array:
+        return _bleu_score_compute(
+            self.preds_len, self.target_len, self.numerator, self.denominator,
+            self.n_gram, self.weights, self.smooth,
+        )
+
+
+class SacreBLEUScore(BLEUScore):
+    """Parity: reference ``text/sacre_bleu.py:SacreBLEUScore``."""
+
+    def __init__(self, n_gram: int = 4, smooth: bool = False, tokenize: str = "13a",
+                 lowercase: bool = False, weights: Optional[Sequence[float]] = None,
+                 **kwargs: Any) -> None:
+        super().__init__(n_gram=n_gram, smooth=smooth, weights=weights, **kwargs)
+        self._sacre_tokenizer = _SacreBLEUTokenizer(tokenize, lowercase)
+
+    def _tokenizer(self):
+        return self._sacre_tokenizer
+
+
+class CHRFScore(_HostTextMetric):
+    """Parity: reference ``text/chrf.py:CHRFScore`` — flat count-vector states."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, n_char_order: int = 6, n_word_order: int = 2, beta: float = 2.0,
+                 lowercase: bool = False, whitespace: bool = False,
+                 return_sentence_level_score: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(n_char_order, int) or n_char_order < 1:
+            raise ValueError("Expected argument `n_char_order` to be an integer greater than or equal to 1.")
+        if not isinstance(n_word_order, int) or n_word_order < 0:
+            raise ValueError("Expected argument `n_word_order` to be an integer greater than or equal to 0.")
+        if beta < 0:
+            raise ValueError("Expected argument `beta` to be greater than 0.")
+        self.n_char_order = n_char_order
+        self.n_word_order = n_word_order
+        self.beta = beta
+        self.lowercase = lowercase
+        self.whitespace = whitespace
+        self.return_sentence_level_score = return_sentence_level_score
+        k = n_char_order + n_word_order
+        self.add_state("matching", jnp.zeros(k), dist_reduce_fx="sum")
+        self.add_state("pred_total", jnp.zeros(k), dist_reduce_fx="sum")
+        self.add_state("ref_total", jnp.zeros(k), dist_reduce_fx="sum")
+        if return_sentence_level_score:
+            self.add_state("sentence_chrf", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Sequence[str], target: Sequence[Union[str, Sequence[str]]]) -> None:
+        preds_ = [preds] if isinstance(preds, str) else list(preds)
+        scores = [] if self.return_sentence_level_score else None
+        m, p, r = _chrf_update(
+            preds_, list(target), self.n_char_order, self.n_word_order,
+            self.beta, self.lowercase, self.whitespace, scores,
+        )
+        self.matching = self.matching + jnp.asarray(m)
+        self.pred_total = self.pred_total + jnp.asarray(p)
+        self.ref_total = self.ref_total + jnp.asarray(r)
+        if self.return_sentence_level_score:
+            self.sentence_chrf.append(jnp.asarray(scores, dtype=jnp.float32))
+
+    def compute(self) -> Union[Array, Tuple[Array, Array]]:
+        score = _fscore_from_counts(self.matching, self.pred_total, self.ref_total, self.beta)
+        if self.return_sentence_level_score:
+            return score, dim_zero_cat(self.sentence_chrf)
+        return score
+
+
+class TranslationEditRate(_HostTextMetric):
+    """Parity: reference ``text/ter.py:TranslationEditRate``."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, normalize: bool = False, no_punctuation: bool = False,
+                 lowercase: bool = True, asian_support: bool = False,
+                 return_sentence_level_score: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.tokenizer = _TercomTokenizer(normalize, no_punctuation, lowercase, asian_support)
+        self.return_sentence_level_score = return_sentence_level_score
+        self.add_state("total_num_edits", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total_tgt_length", jnp.asarray(0.0), dist_reduce_fx="sum")
+        if return_sentence_level_score:
+            self.add_state("sentence_ter", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Union[str, Sequence[str]], target: Sequence[Union[str, Sequence[str]]]) -> None:
+        preds_ = [preds] if isinstance(preds, str) else list(preds)
+        scores = [] if self.return_sentence_level_score else None
+        edits, tgt_len = _ter_update(preds_, list(target), self.tokenizer, scores)
+        self.total_num_edits = self.total_num_edits + edits
+        self.total_tgt_length = self.total_tgt_length + tgt_len
+        if self.return_sentence_level_score:
+            self.sentence_ter.append(jnp.asarray(scores, dtype=jnp.float32))
+
+    def compute(self) -> Union[Array, Tuple[Array, Array]]:
+        score = self.total_num_edits / jnp.maximum(self.total_tgt_length, 1.0)
+        if self.return_sentence_level_score:
+            return score, dim_zero_cat(self.sentence_ter)
+        return score
+
+
+class ExtendedEditDistance(_HostTextMetric):
+    """Parity: reference ``text/eed.py:ExtendedEditDistance``."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, language: str = "en", return_sentence_level_score: bool = False,
+                 alpha: float = 2.0, rho: float = 0.3, deletion: float = 0.2,
+                 insertion: float = 1.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if language not in ("en", "ja"):
+            raise ValueError(f"Expected argument `language` to either be `en` or `ja` but got {language}")
+        for name, val in (("alpha", alpha), ("rho", rho), ("deletion", deletion), ("insertion", insertion)):
+            if not isinstance(val, (int, float)) or val < 0:
+                raise ValueError(f"Parameter `{name}` is expected to be a non-negative number.")
+        self.language = language
+        self.return_sentence_level_score = return_sentence_level_score
+        self.alpha, self.rho, self.deletion, self.insertion = alpha, rho, deletion, insertion
+        self.add_state("sentence_eed", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Union[str, Sequence[str]], target: Sequence[Union[str, Sequence[str]]]) -> None:
+        scores = _eed_update(preds, target, self.language, self.alpha, self.rho, self.deletion, self.insertion)
+        self.sentence_eed.append(jnp.asarray(scores, dtype=jnp.float32))
+
+    def compute(self) -> Union[Array, Tuple[Array, Array]]:
+        all_scores = dim_zero_cat(self.sentence_eed)
+        mean = jnp.mean(all_scores) if all_scores.size else jnp.asarray(0.0)
+        if self.return_sentence_level_score:
+            return mean, all_scores
+        return mean
