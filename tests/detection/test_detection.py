@@ -1,0 +1,342 @@
+"""Detection domain tests: IoU family, panoptic quality, COCO mAP.
+
+Oracle values are the reference implementation's doctest outputs
+(``/root/reference/src/torchmetrics/detection/*.py``, produced by
+torchvision / pycocotools there) plus hand-computed cases.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.detection import (
+    CompleteIntersectionOverUnion,
+    DistanceIntersectionOverUnion,
+    GeneralizedIntersectionOverUnion,
+    IntersectionOverUnion,
+    MeanAveragePrecision,
+    ModifiedPanopticQuality,
+    PanopticQuality,
+)
+from torchmetrics_tpu.functional.detection import (
+    box_convert,
+    complete_intersection_over_union,
+    distance_intersection_over_union,
+    generalized_intersection_over_union,
+    intersection_over_union,
+    modified_panoptic_quality,
+    panoptic_quality,
+)
+
+_PREDS3 = np.array(
+    [
+        [296.55, 93.96, 314.97, 152.79],
+        [328.94, 97.05, 342.49, 122.98],
+        [356.62, 95.47, 372.33, 147.55],
+    ]
+)
+_TARGET3 = np.array(
+    [
+        [300.00, 100.00, 315.00, 150.00],
+        [330.00, 100.00, 350.00, 125.00],
+        [350.00, 100.00, 375.00, 150.00],
+    ]
+)
+
+
+class TestFunctionalIoUVariants:
+    @pytest.mark.parametrize(
+        ("fn", "expected"),
+        [
+            (intersection_over_union, 0.5879),
+            (generalized_intersection_over_union, 0.5638),
+            (distance_intersection_over_union, 0.5793),
+            (complete_intersection_over_union, 0.5790),
+        ],
+    )
+    def test_reference_doctest_values(self, fn, expected):
+        val = fn(jnp.asarray(_PREDS3), jnp.asarray(_TARGET3))
+        assert np.allclose(np.asarray(val), expected, atol=1e-3)
+
+    def test_matrix_mode(self):
+        mat = intersection_over_union(jnp.asarray(_PREDS3), jnp.asarray(_TARGET3), aggregate=False)
+        assert mat.shape == (3, 3)
+        assert np.allclose(np.diag(np.asarray(mat)), [0.6898, 0.5086, 0.5654], atol=1e-3)
+        # off-diagonal pairs don't overlap
+        assert np.allclose(np.asarray(mat) - np.diag(np.diag(np.asarray(mat))), 0.0)
+
+    def test_threshold_replacement(self):
+        mat = intersection_over_union(
+            jnp.asarray(_PREDS3), jnp.asarray(_TARGET3), iou_threshold=0.6, replacement_val=-1.0, aggregate=False
+        )
+        m = np.asarray(mat)
+        assert m[0, 0] > 0.6 and m[1, 1] == -1.0 and m[2, 2] == -1.0
+
+    def test_box_convert_roundtrip(self):
+        boxes = jnp.asarray(_PREDS3)
+        for fmt in ("xywh", "cxcywh"):
+            out = box_convert(box_convert(boxes, "xyxy", fmt), fmt, "xyxy")
+            assert np.allclose(np.asarray(out), np.asarray(boxes), atol=1e-4)
+
+
+class TestModularIoU:
+    _preds = [
+        {
+            "boxes": np.array([[296.55, 93.96, 314.97, 152.79], [298.55, 98.96, 314.97, 151.79]]),
+            "scores": np.array([0.236, 0.56]),
+            "labels": np.array([4, 5]),
+        }
+    ]
+    _target = [{"boxes": np.array([[300.00, 100.00, 315.00, 150.00]]), "labels": np.array([5])}]
+
+    def test_iou_respect_labels(self):
+        metric = IntersectionOverUnion()
+        res = metric(self._preds, self._target)
+        assert np.allclose(np.asarray(res["iou"]), 0.8614, atol=1e-3)
+
+    def test_giou(self):
+        metric = GeneralizedIntersectionOverUnion()
+        res = metric(self._preds, self._target)
+        assert np.allclose(np.asarray(res["giou"]), 0.8613, atol=1e-3)
+
+    @pytest.mark.parametrize("cls", [DistanceIntersectionOverUnion, CompleteIntersectionOverUnion])
+    def test_diou_ciou_run(self, cls):
+        metric = cls()
+        res = metric(self._preds, self._target)
+        key = metric._iou_type
+        assert 0.0 < float(np.asarray(res[key])) <= 1.0
+
+    def test_class_metrics(self):
+        preds = [
+            {
+                "boxes": np.array([[296.55, 93.96, 314.97, 152.79], [298.55, 98.96, 314.97, 151.79]]),
+                "scores": np.array([0.236, 0.56]),
+                "labels": np.array([4, 5]),
+            }
+        ]
+        target = [
+            {
+                "boxes": np.array([[300.00, 100.00, 315.00, 150.00], [300.00, 100.00, 315.00, 150.00]]),
+                "labels": np.array([4, 5]),
+            }
+        ]
+        metric = IntersectionOverUnion(class_metrics=True)
+        res = metric(preds, target)
+        assert np.allclose(np.asarray(res["iou"]), 0.7756, atol=1e-3)
+        assert np.allclose(np.asarray(res["iou/cl_4"]), 0.6898, atol=1e-3)
+        assert np.allclose(np.asarray(res["iou/cl_5"]), 0.8614, atol=1e-3)
+
+    def test_accumulation_over_updates(self):
+        metric = IntersectionOverUnion()
+        metric.update(self._preds, self._target)
+        metric.update(self._preds, self._target)
+        res = metric.compute()
+        assert np.allclose(np.asarray(res["iou"]), 0.8614, atol=1e-3)
+
+    def test_input_validation(self):
+        metric = IntersectionOverUnion()
+        with pytest.raises(ValueError, match="Expected argument"):
+            metric.update(self._preds, [{"boxes": np.zeros((0, 4)), "labels": np.zeros(0)}, {"boxes": np.zeros((0, 4)), "labels": np.zeros(0)}])
+        with pytest.raises(ValueError, match="`boxes` key"):
+            metric.update([{"labels": np.array([1])}], self._target)
+
+
+class TestPanopticQuality:
+    _preds = np.array(
+        [[[[6, 0], [0, 0], [6, 0], [6, 0]],
+          [[0, 0], [0, 0], [6, 0], [0, 1]],
+          [[0, 0], [0, 0], [6, 0], [0, 1]],
+          [[0, 0], [7, 0], [6, 0], [1, 0]],
+          [[0, 0], [7, 0], [7, 0], [7, 0]]]]
+    )
+    _target = np.array(
+        [[[[6, 0], [0, 1], [6, 0], [0, 1]],
+          [[0, 1], [0, 1], [6, 0], [0, 1]],
+          [[0, 1], [0, 1], [6, 0], [1, 0]],
+          [[0, 1], [7, 0], [1, 0], [1, 0]],
+          [[0, 1], [7, 0], [7, 0], [7, 0]]]]
+    )
+
+    def test_reference_doctest(self):
+        pq = PanopticQuality(things={0, 1}, stuffs={6, 7})
+        assert np.allclose(np.asarray(pq(self._preds, self._target)), 0.5463, atol=1e-3)
+
+    def test_functional(self):
+        val = panoptic_quality(self._preds, self._target, things={0, 1}, stuffs={6, 7})
+        assert np.allclose(np.asarray(val, np.float64), 0.5463, atol=1e-3)
+
+    def test_modified_pq(self):
+        preds = np.array([[[0, 0], [0, 1], [6, 0], [7, 0], [0, 2], [1, 0]]])[:, :, None, :]
+        target = np.array([[[0, 1], [0, 0], [6, 0], [7, 0], [6, 0], [255, 0]]])[:, :, None, :]
+        pq = ModifiedPanopticQuality(things={0, 1}, stuffs={6, 7})
+        assert np.allclose(np.asarray(pq(preds, target)), 0.7667, atol=1e-3)
+        val = modified_panoptic_quality(preds, target, things={0, 1}, stuffs={6, 7})
+        assert np.allclose(np.asarray(val, np.float64), 0.7667, atol=1e-3)
+
+    def test_accumulates_across_batches(self):
+        pq = PanopticQuality(things={0, 1}, stuffs={6, 7})
+        pq.update(self._preds, self._target)
+        pq.update(self._preds, self._target)
+        # duplicated data: identical PQ
+        assert np.allclose(np.asarray(pq.compute()), 0.5463, atol=1e-3)
+
+    def test_unknown_category_raises(self):
+        pq = PanopticQuality(things={0}, stuffs={6})
+        bad = np.array([[[[9, 0], [0, 0]], [[0, 0], [6, 0]]]])
+        tgt = np.array([[[[0, 0], [0, 0]], [[0, 0], [6, 0]]]])
+        with pytest.raises(ValueError, match="Unknown categories"):
+            pq.update(bad, tgt)
+        pq_ok = PanopticQuality(things={0}, stuffs={6}, allow_unknown_preds_category=True)
+        pq_ok.update(bad, tgt)  # mapped to void
+
+    def test_category_validation(self):
+        with pytest.raises(ValueError, match="distinct"):
+            PanopticQuality(things={0, 1}, stuffs={1, 6})
+
+
+class TestMeanAveragePrecision:
+    def test_bbox_doctest(self):
+        preds = [dict(boxes=np.array([[258.0, 41.0, 606.0, 285.0]]), scores=np.array([0.536]), labels=np.array([0]))]
+        target = [dict(boxes=np.array([[214.0, 41.0, 562.0, 285.0]]), labels=np.array([0]))]
+        metric = MeanAveragePrecision(iou_type="bbox")
+        metric.update(preds, target)
+        res = metric.compute()
+        assert np.allclose(np.asarray(res["map"]), 0.6, atol=1e-4)
+        assert np.allclose(np.asarray(res["map_50"]), 1.0, atol=1e-4)
+        assert np.allclose(np.asarray(res["map_75"]), 1.0, atol=1e-4)
+        assert np.allclose(np.asarray(res["map_large"]), 0.6, atol=1e-4)
+        assert np.asarray(res["map_small"]) == -1.0
+        for k in ("mar_1", "mar_10", "mar_100"):
+            assert np.allclose(np.asarray(res[k]), 0.6, atol=1e-4)
+
+    def test_segm_doctest(self):
+        mask_pred = np.array(
+            [[0, 0, 0, 0, 0], [0, 0, 1, 1, 0], [0, 0, 1, 1, 0], [0, 0, 0, 0, 0], [0, 0, 0, 0, 0]], bool
+        )
+        mask_tgt = np.array(
+            [[0, 0, 0, 0, 0], [0, 0, 1, 0, 0], [0, 0, 1, 1, 0], [0, 0, 1, 0, 0], [0, 0, 0, 0, 0]], bool
+        )
+        metric = MeanAveragePrecision(iou_type="segm")
+        metric.update(
+            [dict(masks=mask_pred[None], scores=np.array([0.536]), labels=np.array([0]))],
+            [dict(masks=mask_tgt[None], labels=np.array([0]))],
+        )
+        res = metric.compute()
+        assert np.allclose(np.asarray(res["map"]), 0.2, atol=1e-4)
+        assert np.allclose(np.asarray(res["map_50"]), 1.0, atol=1e-4)
+        assert np.allclose(np.asarray(res["map_75"]), 0.0, atol=1e-4)
+
+    def test_perfect_detections(self):
+        boxes = np.array([[10.0, 10.0, 50.0, 50.0], [60.0, 60.0, 120.0, 120.0]])
+        preds = [dict(boxes=boxes, scores=np.array([0.9, 0.8]), labels=np.array([0, 1]))]
+        target = [dict(boxes=boxes, labels=np.array([0, 1]))]
+        metric = MeanAveragePrecision()
+        metric.update(preds, target)
+        res = metric.compute()
+        assert np.allclose(np.asarray(res["map"]), 1.0, atol=1e-4)
+        assert np.allclose(np.asarray(res["mar_100"]), 1.0, atol=1e-4)
+
+    def test_false_positive_penalty(self):
+        gt = np.array([[10.0, 10.0, 50.0, 50.0]])
+        # one perfect match + one high-scoring false positive
+        preds = [
+            dict(
+                boxes=np.vstack([gt, [[200.0, 200.0, 250.0, 250.0]]]),
+                scores=np.array([0.5, 0.9]),
+                labels=np.array([0, 0]),
+            )
+        ]
+        target = [dict(boxes=gt, labels=np.array([0]))]
+        metric = MeanAveragePrecision()
+        metric.update(preds, target)
+        res = metric.compute()
+        # FP ranked above TP: interpolated precision 0.5 at all recall points
+        assert np.allclose(np.asarray(res["map_50"]), 0.5, atol=1e-3)
+
+    def test_crowd_not_penalized(self):
+        gt = np.array([[10.0, 10.0, 50.0, 50.0]])
+        crowd = np.array([[100.0, 100.0, 200.0, 200.0]])
+        preds = [
+            dict(
+                boxes=np.vstack([gt, [[100.0, 100.0, 200.0, 200.0]], [[101.0, 101.0, 199.0, 199.0]]]),
+                scores=np.array([0.9, 0.8, 0.7]),
+                labels=np.array([0, 0, 0]),
+            )
+        ]
+        target = [
+            dict(
+                boxes=np.vstack([gt, crowd]),
+                labels=np.array([0, 0]),
+                iscrowd=np.array([0, 1]),
+            )
+        ]
+        metric = MeanAveragePrecision()
+        metric.update(preds, target)
+        res = metric.compute()
+        # both extra detections match the crowd region -> ignored, not FPs
+        assert np.allclose(np.asarray(res["map_50"]), 1.0, atol=1e-4)
+
+    def test_class_metrics_and_classes(self):
+        boxes = np.array([[10.0, 10.0, 50.0, 50.0]])
+        preds = [dict(boxes=boxes, scores=np.array([0.9]), labels=np.array([3]))]
+        target = [dict(boxes=boxes, labels=np.array([3]))]
+        metric = MeanAveragePrecision(class_metrics=True)
+        metric.update(preds, target)
+        res = metric.compute()
+        # single observed class squeezes to a scalar (reference parity:
+        # doctest shows `'classes': tensor(0, dtype=torch.int32)`)
+        assert np.asarray(res["classes"]).tolist() == 3
+        assert np.allclose(np.asarray(res["map_per_class"]), [1.0], atol=1e-4)
+
+    def test_micro_average(self):
+        boxes = np.array([[10.0, 10.0, 50.0, 50.0]])
+        # wrong label but perfect box: micro (class-agnostic) scores it
+        preds = [dict(boxes=boxes, scores=np.array([0.9]), labels=np.array([1]))]
+        target = [dict(boxes=boxes, labels=np.array([2]))]
+        macro = MeanAveragePrecision(average="macro")
+        macro.update(preds, target)
+        micro = MeanAveragePrecision(average="micro")
+        micro.update(preds, target)
+        assert np.asarray(macro.compute()["map"]) == 0.0
+        assert np.allclose(np.asarray(micro.compute()["map"]), 1.0, atol=1e-4)
+
+    def test_max_detection_thresholds(self):
+        gt = np.array([[10.0, 10.0, 50.0, 50.0], [60.0, 60.0, 100.0, 100.0]])
+        preds = [dict(boxes=gt, scores=np.array([0.9, 0.8]), labels=np.array([0, 0]))]
+        target = [dict(boxes=gt, labels=np.array([0, 0]))]
+        metric = MeanAveragePrecision(max_detection_thresholds=[1, 2])
+        metric.update(preds, target)
+        res = metric.compute()
+        assert "mar_1" in res and "mar_2" in res
+        assert np.allclose(np.asarray(res["mar_1"]), 0.5, atol=1e-4)
+        assert np.allclose(np.asarray(res["mar_2"]), 1.0, atol=1e-4)
+
+    def test_empty_preds_and_targets(self):
+        metric = MeanAveragePrecision()
+        metric.update(
+            [dict(boxes=np.zeros((0, 4)), scores=np.zeros(0), labels=np.zeros(0, np.int64))],
+            [dict(boxes=np.zeros((0, 4)), labels=np.zeros(0, np.int64))],
+        )
+        res = metric.compute()
+        assert np.asarray(res["map"]) == -1.0  # nothing to evaluate
+
+    def test_merge_states_across_ranks(self):
+        """Emulated DDP: states from two ranks merged -> same result as union."""
+        boxes1 = np.array([[10.0, 10.0, 50.0, 50.0]])
+        boxes2 = np.array([[60.0, 60.0, 120.0, 120.0]])
+        m_union = MeanAveragePrecision()
+        m_union.update(
+            [dict(boxes=boxes1, scores=np.array([0.9]), labels=np.array([0])),
+             dict(boxes=boxes2, scores=np.array([0.8]), labels=np.array([0]))],
+            [dict(boxes=boxes1, labels=np.array([0])), dict(boxes=boxes2, labels=np.array([0]))],
+        )
+        r1 = MeanAveragePrecision()
+        r1.update([dict(boxes=boxes1, scores=np.array([0.9]), labels=np.array([0]))],
+                  [dict(boxes=boxes1, labels=np.array([0]))])
+        r2 = MeanAveragePrecision()
+        r2.update([dict(boxes=boxes2, scores=np.array([0.8]), labels=np.array([0]))],
+                  [dict(boxes=boxes2, labels=np.array([0]))])
+        # host-side object merge of ragged list states
+        for name in r1._defaults:
+            r1._state[name] = list(r1._state[name]) + list(r2._state[name])
+        assert np.allclose(np.asarray(r1.compute()["map"]), np.asarray(m_union.compute()["map"]), atol=1e-6)

@@ -1,0 +1,173 @@
+"""Modular IoU-family detection metrics.
+
+Parity targets: reference ``detection/{iou,giou,diou,ciou}.py`` — per-image
+pairwise overlap matrices stored as ragged list states (``dist_reduce_fx=None``),
+label matching via ``respect_labels``, per-class breakdown via
+``class_metrics`` (reference ``detection/iou.py:210-225``).
+
+TPU-native notes: the pairwise matrices come from the jitted JAX kernels in
+``functional/detection/box_ops.py``; the ragged per-image matrices are host
+list states (object-gathered across processes, like the reference's
+``dist_reduce_fx=None`` states).
+"""
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..functional.detection.box_ops import _variant_update, box_convert
+from ..metric import Metric
+
+Array = jax.Array
+
+_ALLOWED_BOX_FORMATS = ("xyxy", "xywh", "cxcywh")
+
+
+def _input_validator(
+    preds: Sequence[Dict[str, Any]],
+    targets: Sequence[Dict[str, Any]],
+    iou_type: str = "bbox",
+    ignore_score: bool = False,
+) -> None:
+    """Validate list-of-dict detection inputs; parity ``detection/helpers.py:19``."""
+    item_key = {"bbox": "boxes", "segm": "masks"}[iou_type]
+    if not isinstance(preds, Sequence) or isinstance(preds, (str, bytes)):
+        raise ValueError(f"Expected argument `preds` to be of type Sequence, but got {preds}")
+    if not isinstance(targets, Sequence) or isinstance(targets, (str, bytes)):
+        raise ValueError(f"Expected argument `target` to be of type Sequence, but got {targets}")
+    if len(preds) != len(targets):
+        raise ValueError(
+            f"Expected argument `preds` and `target` to have the same length, but got {len(preds)} and {len(targets)}"
+        )
+    pred_keys = [item_key, "labels"] + ([] if ignore_score else ["scores"])
+    for k in pred_keys:
+        if any(k not in p for p in preds):
+            raise ValueError(f"Expected all dicts in `preds` to contain the `{k}` key")
+    for k in [item_key, "labels"]:
+        if any(k not in t for t in targets):
+            raise ValueError(f"Expected all dicts in `target` to contain the `{k}` key")
+    for i, item in enumerate(targets):
+        n_item = np.asarray(item[item_key]).shape[0] if np.asarray(item[item_key]).size else 0
+        n_lab = np.asarray(item["labels"]).reshape(-1).shape[0]
+        if n_item != n_lab:
+            raise ValueError(
+                f"Input '{item_key}' and labels of sample {i} in targets have a"
+                f" different length (expected {n_item} labels, got {n_lab})"
+            )
+    if ignore_score:
+        return
+    for i, item in enumerate(preds):
+        n_item = np.asarray(item[item_key]).shape[0] if np.asarray(item[item_key]).size else 0
+        n_lab = np.asarray(item["labels"]).reshape(-1).shape[0]
+        n_sc = np.asarray(item["scores"]).reshape(-1).shape[0]
+        if not (n_item == n_lab == n_sc):
+            raise ValueError(
+                f"Input '{item_key}', labels and scores of sample {i} in predictions have a"
+                f" different length (expected {n_item} labels and scores, got {n_lab} labels and {n_sc} scores)"
+            )
+
+
+def _fix_empty_boxes(boxes: Array) -> Array:
+    b = jnp.asarray(boxes, jnp.float32)
+    if b.size == 0:
+        return jnp.zeros((0, 4), jnp.float32)
+    return b.reshape(-1, 4)
+
+
+class IntersectionOverUnion(Metric):
+    """Mean pairwise IoU over matched-label box pairs.
+
+    Parity: reference ``detection/iou.py:33`` (states ``:170-176``, compute
+    ``:210-225``). Accepts ``preds``/``target`` as lists of per-image dicts
+    with ``boxes``/``labels`` (+``scores`` in preds, unused here).
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: Optional[bool] = True
+    full_state_update: bool = True
+    jittable = False  # ragged per-image inputs; kernels are jitted internally
+
+    _iou_type: str = "iou"
+    _invalid_val: float = -1.0
+
+    def __init__(
+        self,
+        box_format: str = "xyxy",
+        iou_threshold: Optional[float] = None,
+        class_metrics: bool = False,
+        respect_labels: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if box_format not in _ALLOWED_BOX_FORMATS:
+            raise ValueError(f"Expected argument `box_format` to be one of {_ALLOWED_BOX_FORMATS} but got {box_format}")
+        if not isinstance(class_metrics, bool):
+            raise ValueError("Expected argument `class_metrics` to be a boolean")
+        if not isinstance(respect_labels, bool):
+            raise ValueError("Expected argument `respect_labels` to be a boolean")
+        self.box_format = box_format
+        self.iou_threshold = iou_threshold
+        self.class_metrics = class_metrics
+        self.respect_labels = respect_labels
+        self._compute_jittable = False
+
+        self.add_state("groundtruth_labels", [], dist_reduce_fx=None)
+        self.add_state("iou_matrix", [], dist_reduce_fx=None)
+
+    def update(self, preds: List[Dict[str, Array]], target: List[Dict[str, Array]]) -> None:
+        _input_validator(preds, target, ignore_score=True)
+        for p, t in zip(preds, target):
+            det_boxes = box_convert(_fix_empty_boxes(p["boxes"]), self.box_format, "xyxy")
+            gt_boxes = box_convert(_fix_empty_boxes(t["boxes"]), self.box_format, "xyxy")
+            gt_labels = jnp.asarray(t["labels"]).reshape(-1)
+            self.groundtruth_labels.append(gt_labels)
+            mat = _variant_update(self._iou_type, det_boxes, gt_boxes, self.iou_threshold, self._invalid_val)
+            if self.respect_labels:
+                p_labels = jnp.asarray(p["labels"]).reshape(-1)
+                label_eq = p_labels[:, None] == gt_labels[None, :]
+                mat = jnp.where(label_eq, mat, self._invalid_val)
+            self.iou_matrix.append(mat)
+
+    def compute(self) -> Dict[str, Array]:
+        # one device->host transfer per stored matrix/label array
+        mats = [np.asarray(m) for m in self.iou_matrix]
+        labels = [np.asarray(g).reshape(-1) for g in self.groundtruth_labels]
+        flat = np.concatenate([m.reshape(-1) for m in mats]) if mats else np.zeros((0,), np.float32)
+        flat = flat[flat != self._invalid_val]
+        score = jnp.asarray(flat.mean() if flat.size else np.nan, jnp.float32)
+        results: Dict[str, Array] = {self._iou_type: score}
+        if self.class_metrics:
+            gt_labels = np.concatenate(labels) if labels else np.zeros((0,), np.int32)
+            for cl in sorted(np.unique(gt_labels).tolist()):
+                total, count = 0.0, 0
+                for mat, gl in zip(mats, labels):
+                    m = mat[:, gl == cl]
+                    m = m[m != self._invalid_val]
+                    total += float(m.sum())
+                    count += int(m.size)
+                results[f"{self._iou_type}/cl_{int(cl)}"] = jnp.asarray(
+                    total / count if count else np.nan, jnp.float32
+                )
+        return results
+
+
+class GeneralizedIntersectionOverUnion(IntersectionOverUnion):
+    """Parity: reference ``detection/giou.py:29``."""
+
+    _iou_type = "giou"
+    _invalid_val = -1.0
+
+
+class DistanceIntersectionOverUnion(IntersectionOverUnion):
+    """Parity: reference ``detection/diou.py:29``."""
+
+    _iou_type = "diou"
+    _invalid_val = -1.0
+
+
+class CompleteIntersectionOverUnion(IntersectionOverUnion):
+    """Parity: reference ``detection/ciou.py:29`` (invalid sentinel -2, ``:103``)."""
+
+    _iou_type = "ciou"
+    _invalid_val = -2.0

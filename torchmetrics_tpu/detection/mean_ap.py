@@ -1,0 +1,218 @@
+"""MeanAveragePrecision (COCO mAP / mAR).
+
+Parity target: reference ``detection/mean_ap.py`` (states ``:442-450``, args
+``:375``, compute ``:513-590``, stats order from COCOeval ``summarize``).
+The reference shells out to the pycocotools C extension; this build owns the
+COCO protocol in ``functional/detection/coco_eval.py`` (numpy host core,
+JAX-kernel IoU available for large batches, optional C++ fast path).
+
+States are ragged per-image arrays kept as host list states
+(``dist_reduce_fx=None`` in the reference; object-gather across processes).
+"""
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..functional.detection.coco_eval import (
+    DEFAULT_IOU_THRESHOLDS,
+    DEFAULT_MAX_DETS,
+    DEFAULT_REC_THRESHOLDS,
+    evaluate_detections,
+    summarize,
+)
+from ..metric import Metric
+from .iou import _input_validator
+
+
+def _validate_iou_type_arg(iou_type: Union[str, Tuple[str, ...]]) -> Tuple[str, ...]:
+    allowed = ("bbox", "segm")
+    if isinstance(iou_type, str):
+        iou_type = (iou_type,)
+    if any(tp not in allowed for tp in iou_type):
+        raise ValueError(f"Expected argument `iou_type` to be one of {allowed} or a list of, but got {iou_type}")
+    return tuple(iou_type)
+
+
+class MeanAveragePrecision(Metric):
+    """COCO-protocol mean average precision / recall for object detection.
+
+    Accepts ``preds``/``target`` as lists of per-image dicts (``boxes``,
+    ``scores``, ``labels``, optional ``masks``/``iscrowd``/``area``), exactly
+    like the reference (``detection/mean_ap.py:92-148``). Output dict keys:
+    ``map, map_50, map_75, map_{small,medium,large}, mar_{maxdets...},
+    mar_{small,medium,large}, map_per_class, mar_<last>_per_class, classes``.
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: Optional[bool] = True
+    full_state_update: bool = True
+    jittable = False  # ragged host states; IoU kernels vectorized internally
+
+    def __init__(
+        self,
+        box_format: str = "xyxy",
+        iou_type: Union[str, Tuple[str, ...]] = "bbox",
+        iou_thresholds: Optional[List[float]] = None,
+        rec_thresholds: Optional[List[float]] = None,
+        max_detection_thresholds: Optional[List[int]] = None,
+        class_metrics: bool = False,
+        extended_summary: bool = False,
+        average: str = "macro",
+        backend: str = "native",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if box_format not in ("xyxy", "xywh", "cxcywh"):
+            raise ValueError(f"Expected argument `box_format` to be one of ('xyxy', 'xywh', 'cxcywh') but got {box_format}")
+        self.box_format = box_format
+        self.iou_type = _validate_iou_type_arg(iou_type)
+        if iou_thresholds is not None and not isinstance(iou_thresholds, (list, tuple)):
+            raise ValueError(f"Expected argument `iou_thresholds` to either be `None` or a list of floats but got {iou_thresholds}")
+        if rec_thresholds is not None and not isinstance(rec_thresholds, (list, tuple)):
+            raise ValueError(f"Expected argument `rec_thresholds` to either be `None` or a list of floats but got {rec_thresholds}")
+        if max_detection_thresholds is not None and not isinstance(max_detection_thresholds, (list, tuple)):
+            raise ValueError(f"Expected argument `max_detection_thresholds` to either be `None` or a list of ints but got {max_detection_thresholds}")
+        self.iou_thresholds = list(iou_thresholds) if iou_thresholds is not None else DEFAULT_IOU_THRESHOLDS.tolist()
+        self.rec_thresholds = list(rec_thresholds) if rec_thresholds is not None else DEFAULT_REC_THRESHOLDS.tolist()
+        self.max_detection_thresholds = sorted(
+            max_detection_thresholds if max_detection_thresholds is not None else DEFAULT_MAX_DETS
+        )
+        if not isinstance(class_metrics, bool):
+            raise ValueError("Expected argument `class_metrics` to be a boolean")
+        self.class_metrics = class_metrics
+        if not isinstance(extended_summary, bool):
+            raise ValueError("Expected argument `extended_summary` to be a boolean")
+        self.extended_summary = extended_summary
+        if average not in ("macro", "micro"):
+            raise ValueError(f"Expected argument `average` to be one of ('macro', 'micro') but got {average}")
+        self.average = average
+        if backend not in ("native", "cpp"):
+            raise ValueError(f"Expected argument `backend` to be one of ('native', 'cpp') but got {backend}")
+        self.backend = backend  # "native" numpy/JAX core; "cpp" compiled fast path
+        self._compute_jittable = False
+
+        self.add_state("detection_box", [], dist_reduce_fx=None)
+        self.add_state("detection_mask", [], dist_reduce_fx=None)
+        self.add_state("detection_scores", [], dist_reduce_fx=None)
+        self.add_state("detection_labels", [], dist_reduce_fx=None)
+        self.add_state("groundtruth_box", [], dist_reduce_fx=None)
+        self.add_state("groundtruth_mask", [], dist_reduce_fx=None)
+        self.add_state("groundtruth_labels", [], dist_reduce_fx=None)
+        self.add_state("groundtruth_crowds", [], dist_reduce_fx=None)
+        self.add_state("groundtruth_area", [], dist_reduce_fx=None)
+
+    def update(self, preds: List[Dict[str, Any]], target: List[Dict[str, Any]]) -> None:
+        """Append per-image detections/groundtruths; parity ``mean_ap.py:470``."""
+        for tp in self.iou_type:
+            _input_validator(preds, target, iou_type=tp)
+        for item in preds:
+            self.detection_box.append(self._boxes_xyxy(item) if "bbox" in self.iou_type else np.zeros((0, 4)))
+            self.detection_mask.append(self._masks(item) if "segm" in self.iou_type else None)
+            self.detection_scores.append(np.asarray(item["scores"], np.float64).reshape(-1))
+            self.detection_labels.append(np.asarray(item["labels"]).reshape(-1).astype(np.int64))
+        for item in target:
+            self.groundtruth_box.append(self._boxes_xyxy(item) if "bbox" in self.iou_type else np.zeros((0, 4)))
+            self.groundtruth_mask.append(self._masks(item) if "segm" in self.iou_type else None)
+            labels = np.asarray(item["labels"]).reshape(-1).astype(np.int64)
+            self.groundtruth_labels.append(labels)
+            crowds = np.asarray(item.get("iscrowd", np.zeros(len(labels)))).reshape(-1).astype(np.int64)
+            self.groundtruth_crowds.append(crowds)
+            area = np.asarray(item.get("area", np.zeros(0, np.float64))).reshape(-1).astype(np.float64)
+            self.groundtruth_area.append(area)
+
+    def _boxes_xyxy(self, item: Dict[str, Any]) -> np.ndarray:
+        boxes = np.asarray(item["boxes"], np.float64)
+        if boxes.size == 0:
+            return np.zeros((0, 4), np.float64)
+        boxes = boxes.reshape(-1, 4)
+        # convert in float64 numpy: routing through 32-bit JAX here could
+        # flip a borderline IoU exactly at an evaluation threshold
+        if self.box_format == "xywh":
+            x, y, w, h = boxes.T
+            boxes = np.stack([x, y, x + w, y + h], axis=1)
+        elif self.box_format == "cxcywh":
+            cx, cy, w, h = boxes.T
+            boxes = np.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=1)
+        return boxes
+
+    @staticmethod
+    def _masks(item: Dict[str, Any]) -> np.ndarray:
+        masks = np.asarray(item["masks"])
+        if masks.size == 0:
+            return np.zeros((0, 1, 1), bool)
+        return masks.astype(bool)
+
+    def _get_classes(self) -> List[int]:
+        classes = set()
+        for lab in self.detection_labels:
+            classes.update(np.asarray(lab).tolist())
+        for lab in self.groundtruth_labels:
+            classes.update(np.asarray(lab).tolist())
+        return sorted(int(c) for c in classes)
+
+    def compute(self) -> Dict[str, Any]:
+        result: Dict[str, Any] = {}
+        n_img = len(self.detection_labels)
+        for i_type in self.iou_type:
+            prefix = "" if len(self.iou_type) == 1 else f"{i_type}_"
+            dets, gts = [], []
+            for i in range(n_img):
+                d = {"scores": self.detection_scores[i], "labels": self.detection_labels[i]}
+                g = {
+                    "labels": self.groundtruth_labels[i],
+                    "iscrowd": self.groundtruth_crowds[i],
+                    "area": self.groundtruth_area[i],
+                }
+                if i_type == "bbox":
+                    d["boxes"] = self.detection_box[i]
+                    g["boxes"] = self.groundtruth_box[i]
+                else:
+                    d["masks"] = self.detection_mask[i]
+                    g["masks"] = self.groundtruth_mask[i]
+                dets.append(d)
+                gts.append(g)
+
+            ev = evaluate_detections(
+                dets,
+                gts,
+                iou_type=i_type,
+                iou_thresholds=np.asarray(self.iou_thresholds),
+                rec_thresholds=np.asarray(self.rec_thresholds),
+                max_dets=self.max_detection_thresholds,
+                class_agnostic=self.average == "micro",
+            )
+            summ = summarize(ev)
+            for key in ("map", "map_50", "map_75", "map_small", "map_medium", "map_large",
+                        "mar_small", "mar_medium", "mar_large"):
+                result[f"{prefix}{key}"] = jnp.asarray(summ[key], jnp.float32)
+            for md in self.max_detection_thresholds:
+                result[f"{prefix}mar_{md}"] = jnp.asarray(summ[f"mar_{md}"], jnp.float32)
+
+            if self.extended_summary:
+                result[f"{prefix}ious"] = {
+                    k: jnp.asarray(v, jnp.float32) for k, v in ev["ious"].items()
+                }
+                result[f"{prefix}precision"] = jnp.asarray(ev["precision"], jnp.float32)
+                result[f"{prefix}recall"] = jnp.asarray(ev["recall"], jnp.float32)
+                result[f"{prefix}scores"] = jnp.asarray(ev["scores"], jnp.float32)
+
+            last_md = self.max_detection_thresholds[-1]
+            if self.class_metrics:
+                if self.average == "micro":
+                    # per-class numbers require a macro pass (reference :555-560)
+                    ev = evaluate_detections(
+                        dets, gts, iou_type=i_type,
+                        iou_thresholds=np.asarray(self.iou_thresholds),
+                        rec_thresholds=np.asarray(self.rec_thresholds),
+                        max_dets=self.max_detection_thresholds,
+                        class_agnostic=False,
+                    )
+                    summ = summarize(ev)
+                result[f"{prefix}map_per_class"] = jnp.asarray(summ["map_per_class"], jnp.float32)
+                result[f"{prefix}mar_{last_md}_per_class"] = jnp.asarray(summ["mar_per_class"], jnp.float32)
+            else:
+                result[f"{prefix}map_per_class"] = jnp.asarray([-1.0], jnp.float32)
+                result[f"{prefix}mar_{last_md}_per_class"] = jnp.asarray([-1.0], jnp.float32)
+        result["classes"] = jnp.asarray(self._get_classes(), jnp.int32)
+        return result

@@ -1,0 +1,90 @@
+"""PanopticQuality and ModifiedPanopticQuality metric classes.
+
+Parity target: reference ``detection/panoptic_qualities.py`` (401 LoC) —
+fixed ``(num_categories,)`` sum states (``:114-117``), update over
+``(..., H, W, 2)`` color maps, scalar PQ compute.
+"""
+from typing import Any, Collection
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..functional.detection.panoptic_quality import (
+    _panoptic_quality_compute,
+    _panoptic_quality_update,
+    _parse_categories,
+    _validate_inputs,
+)
+from ..metric import Metric
+
+
+class PanopticQuality(Metric):
+    """Panoptic Quality for panoptic segmentations (things + stuffs).
+
+    Parity: reference ``detection/panoptic_qualities.py:30``. Inputs are
+    integer color maps ``(..., height, width, 2)`` where the last dimension
+    holds ``(category_id, instance_id)``.
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    jittable = False  # segment discovery is host-side np.unique
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    _modified: bool = False
+
+    def __init__(
+        self,
+        things: Collection[int],
+        stuffs: Collection[int],
+        allow_unknown_preds_category: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.things, self.stuffs = _parse_categories(things, stuffs)
+        self.allow_unknown_preds_category = allow_unknown_preds_category
+        self._compute_jittable = False
+        n_cat = len(self.things) + len(self.stuffs)
+        self.add_state("iou_sum", jnp.zeros(n_cat, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("true_positives", jnp.zeros(n_cat, jnp.int32), dist_reduce_fx="sum")
+        self.add_state("false_positives", jnp.zeros(n_cat, jnp.int32), dist_reduce_fx="sum")
+        self.add_state("false_negatives", jnp.zeros(n_cat, jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Any, target: Any) -> None:
+        preds = np.asarray(preds)
+        target = np.asarray(target)
+        _validate_inputs(preds, target)
+        iou_sum, tp, fp, fn = _panoptic_quality_update(
+            preds,
+            target,
+            self.things,
+            self.stuffs,
+            self.allow_unknown_preds_category,
+            modified_stuffs=self.stuffs if self._modified else None,
+        )
+        self.iou_sum = self.iou_sum + jnp.asarray(iou_sum)
+        self.true_positives = self.true_positives + jnp.asarray(tp, self.true_positives.dtype)
+        self.false_positives = self.false_positives + jnp.asarray(fp, self.false_positives.dtype)
+        self.false_negatives = self.false_negatives + jnp.asarray(fn, self.false_negatives.dtype)
+
+    def compute(self) -> jnp.ndarray:
+        return jnp.asarray(
+            _panoptic_quality_compute(
+                np.asarray(self.iou_sum),
+                np.asarray(self.true_positives),
+                np.asarray(self.false_positives),
+                np.asarray(self.false_negatives),
+            ),
+            jnp.float32,
+        )
+
+
+class ModifiedPanopticQuality(PanopticQuality):
+    """Modified PQ — stuff categories scored per-pixel (IoU > 0, one segment).
+
+    Parity: reference ``detection/panoptic_qualities.py:275``.
+    """
+
+    _modified = True

@@ -3,7 +3,7 @@
 ``torchmetrics_tpu.functional.<domain>``; the pairwise family is re-exported
 flat (it has no modular classes, reference §2.8).
 """
-from . import audio, classification, clustering, image, nominal, pairwise, regression, retrieval, text
+from . import audio, classification, clustering, detection, image, nominal, pairwise, regression, retrieval, text
 from .pairwise import (
     pairwise_cosine_similarity,
     pairwise_euclidean_distance,
@@ -16,6 +16,7 @@ __all__ = [
     "audio",
     "classification",
     "clustering",
+    "detection",
     "image",
     "nominal",
     "pairwise",

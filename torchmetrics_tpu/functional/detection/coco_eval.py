@@ -1,0 +1,332 @@
+"""COCO-protocol mAP evaluation core (host side).
+
+A from-scratch reimplementation of the COCOeval matching + accumulation
+algorithm (the reference delegates to the ``pycocotools`` C extension,
+``detection/mean_ap.py:50-71``; this build owns the algorithm). The
+per-image pairwise IoU matrices are computed with the JAX kernels from
+``box_ops.py``; the greedy score-ordered matching and the PR accumulation run
+in numpy on host — they are O(dets·gts) bookkeeping, not FLOPs.
+
+A C++ implementation of the inner matching loop is used when the compiled
+extension is available (``torchmetrics_tpu/native``); this numpy path is the
+always-available fallback and the correctness oracle for it.
+"""
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# COCO default parameter space — the reference builds these with
+# torch.linspace in float32 (``detection/mean_ap.py`` ctor), so t=0.6 is
+# really 0.60000002: an IoU of exactly 0.6 does NOT match there. Keep the
+# same float32 grid for bit-parity with reference results.
+DEFAULT_IOU_THRESHOLDS = np.linspace(0.5, 0.95, int(np.round((0.95 - 0.5) / 0.05)) + 1, dtype=np.float32).astype(np.float64)
+DEFAULT_REC_THRESHOLDS = np.linspace(0.0, 1.0, int(np.round(1.0 / 0.01)) + 1, dtype=np.float32).astype(np.float64)
+DEFAULT_MAX_DETS = (1, 10, 100)
+AREA_RANGES = {
+    "all": (0.0, 1e5**2),
+    "small": (0.0, 32.0**2),
+    "medium": (32.0**2, 96.0**2),
+    "large": (96.0**2, 1e5**2),
+}
+
+
+def bbox_iou_np(dt: np.ndarray, gt: np.ndarray, iscrowd: np.ndarray) -> np.ndarray:
+    """Pairwise IoU with COCO crowd semantics (union = dt area for crowd gt)."""
+    if dt.size == 0 or gt.size == 0:
+        return np.zeros((dt.shape[0], gt.shape[0]), np.float64)
+    lt = np.maximum(dt[:, None, :2], gt[None, :, :2])
+    rb = np.minimum(dt[:, None, 2:], gt[None, :, 2:])
+    wh = np.clip(rb - lt, 0.0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    area_dt = (dt[:, 2] - dt[:, 0]) * (dt[:, 3] - dt[:, 1])
+    area_gt = (gt[:, 2] - gt[:, 0]) * (gt[:, 3] - gt[:, 1])
+    union = area_dt[:, None] + area_gt[None, :] - inter
+    union = np.where(iscrowd[None, :].astype(bool), area_dt[:, None], union)
+    return np.where(union > 0, inter / np.where(union > 0, union, 1.0), 0.0)
+
+
+def mask_iou_np(dt: np.ndarray, gt: np.ndarray, iscrowd: np.ndarray) -> np.ndarray:
+    """Pairwise mask IoU over flattened boolean masks ``(N, P)`` / ``(M, P)``."""
+    if dt.size == 0 or gt.size == 0:
+        return np.zeros((dt.shape[0], gt.shape[0]), np.float64)
+    dtf = dt.reshape(dt.shape[0], -1).astype(np.float64)
+    gtf = gt.reshape(gt.shape[0], -1).astype(np.float64)
+    inter = dtf @ gtf.T
+    a_dt = dtf.sum(1)
+    a_gt = gtf.sum(1)
+    union = a_dt[:, None] + a_gt[None, :] - inter
+    union = np.where(iscrowd[None, :].astype(bool), a_dt[:, None], union)
+    return np.where(union > 0, inter / np.where(union > 0, union, 1.0), 0.0)
+
+
+def match_image(
+    ious: np.ndarray,
+    dt_scores: np.ndarray,
+    gt_ignore: np.ndarray,
+    gt_crowd: np.ndarray,
+    dt_area_ignore: np.ndarray,
+    iou_thresholds: np.ndarray,
+    max_det: int,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Greedy COCO matching for one (image, category) pair.
+
+    Returns ``(dt_matched, dt_ignored, scores)`` each shaped ``(T, D)`` /
+    ``(D,)`` where D = min(#dets, max_det), following COCOeval's
+    ``evaluateImg``: detections in score order claim the best still-free gt
+    with IoU >= t; crowd gts are matchable many times; a match to an ignored
+    gt marks the detection ignored; unmatched detections outside the area
+    range are ignored.
+    """
+    order = np.argsort(-dt_scores, kind="stable")[:max_det]
+    ious = ious[order]
+    scores = dt_scores[order]
+    dt_area_ignore = dt_area_ignore[order]
+    n_t = len(iou_thresholds)
+    n_d = len(order)
+    n_g = ious.shape[1]
+    # gt sorted: non-ignored first (COCO sorts gt by ignore flag)
+    g_order = np.argsort(gt_ignore, kind="stable")
+    ious = ious[:, g_order]
+    g_ignore = gt_ignore[g_order].astype(bool)
+    g_crowd = gt_crowd[g_order].astype(bool)
+
+    dt_matched = np.zeros((n_t, n_d), dtype=bool)
+    dt_ignored = np.zeros((n_t, n_d), dtype=bool)
+    for ti, t in enumerate(iou_thresholds):
+        g_used = np.zeros(n_g, dtype=bool)
+        for di in range(n_d):
+            best_iou = min(t, 1 - 1e-10)
+            best_g = -1
+            for gi in range(n_g):
+                if g_used[gi] and not g_crowd[gi]:
+                    continue
+                # best non-ignored candidate found and this gt is ignored:
+                # later gts are all ignored (sorted) → stop
+                if best_g > -1 and not g_ignore[best_g] and g_ignore[gi]:
+                    break
+                if ious[di, gi] < best_iou:
+                    continue
+                best_iou = ious[di, gi]
+                best_g = gi
+            if best_g == -1:
+                continue
+            g_used[best_g] = True
+            dt_matched[ti, di] = True
+            dt_ignored[ti, di] = g_ignore[best_g]
+        # unmatched detections outside the area range are ignored
+        dt_ignored[ti] |= (~dt_matched[ti]) & dt_area_ignore.astype(bool)
+    return dt_matched, dt_ignored, scores
+
+
+def accumulate(
+    per_image: List[Dict],
+    classes: Sequence[int],
+    iou_thresholds: np.ndarray,
+    rec_thresholds: np.ndarray,
+    max_dets: Sequence[int],
+    area_keys: Sequence[str] = ("all", "small", "medium", "large"),
+) -> Dict[str, np.ndarray]:
+    """PR accumulation over all (class, area, maxDet) cells.
+
+    ``per_image`` entries hold, per image: dict class -> precomputed matching
+    inputs (see :func:`evaluate_detections`). Returns ``precision`` of shape
+    ``(T, R, K, A, M)`` and ``recall`` ``(T, K, A, M)`` (COCOeval layout),
+    plus ``scores`` ``(T, R, K, A, M)``.
+    """
+    n_t, n_r = len(iou_thresholds), len(rec_thresholds)
+    n_k, n_a, n_m = len(classes), len(area_keys), len(max_dets)
+    precision = -np.ones((n_t, n_r, n_k, n_a, n_m))
+    recall = -np.ones((n_t, n_k, n_a, n_m))
+    scores_out = -np.ones((n_t, n_r, n_k, n_a, n_m))
+
+    for ki, cls in enumerate(classes):
+        for ai, area in enumerate(area_keys):
+            for mi, max_det in enumerate(max_dets):
+                all_scores, all_matched, all_ignored = [], [], []
+                n_gt = 0
+                for img in per_image:
+                    cell = img.get((cls, area, max_det))
+                    if cell is None:
+                        continue
+                    matched, ignored, scores, n_pos = cell
+                    all_scores.append(scores)
+                    all_matched.append(matched)
+                    all_ignored.append(ignored)
+                    n_gt += n_pos
+                if n_gt == 0:
+                    continue
+                if not all_scores:
+                    continue
+                scores = np.concatenate(all_scores)
+                order = np.argsort(-scores, kind="mergesort")
+                scores = scores[order]
+                matched = np.concatenate(all_matched, axis=1)[:, order]
+                ignored = np.concatenate(all_ignored, axis=1)[:, order]
+
+                tps = matched & ~ignored
+                fps = ~matched & ~ignored
+                tp_cum = np.cumsum(tps, axis=1).astype(np.float64)
+                fp_cum = np.cumsum(fps, axis=1).astype(np.float64)
+                for ti in range(n_t):
+                    tp, fp = tp_cum[ti], fp_cum[ti]
+                    rc = tp / n_gt
+                    pr = tp / np.maximum(tp + fp, np.finfo(np.float64).eps)
+                    recall[ti, ki, ai, mi] = rc[-1] if len(rc) else 0.0
+                    # precision envelope (monotone non-increasing from right)
+                    pr = pr.tolist()
+                    for i in range(len(pr) - 1, 0, -1):
+                        if pr[i] > pr[i - 1]:
+                            pr[i - 1] = pr[i]
+                    inds = np.searchsorted(rc, rec_thresholds, side="left")
+                    q = np.zeros(n_r)
+                    ss = np.zeros(n_r)
+                    for ri, pi in enumerate(inds):
+                        if pi < len(pr):
+                            q[ri] = pr[pi]
+                            ss[ri] = scores[pi]
+                    precision[ti, :, ki, ai, mi] = q
+                    scores_out[ti, :, ki, ai, mi] = ss
+    return {"precision": precision, "recall": recall, "scores": scores_out}
+
+
+def evaluate_detections(
+    detections: List[Dict[str, np.ndarray]],
+    groundtruths: List[Dict[str, np.ndarray]],
+    iou_type: str = "bbox",
+    iou_thresholds: Optional[np.ndarray] = None,
+    rec_thresholds: Optional[np.ndarray] = None,
+    max_dets: Sequence[int] = DEFAULT_MAX_DETS,
+    class_agnostic: bool = False,
+) -> Dict[str, np.ndarray]:
+    """Full COCO evaluation over per-image dicts.
+
+    Each detection dict: ``boxes`` (N,4 xyxy) or ``masks`` (N,H,W bool),
+    ``scores`` (N,), ``labels`` (N,). Each groundtruth dict: ``boxes``/
+    ``masks``, ``labels``, optional ``iscrowd`` (N,), optional ``area`` (N,).
+    Returns the COCOeval accumulation arrays + the class list.
+    """
+    iou_thresholds = DEFAULT_IOU_THRESHOLDS if iou_thresholds is None else np.asarray(iou_thresholds)
+    rec_thresholds = DEFAULT_REC_THRESHOLDS if rec_thresholds is None else np.asarray(rec_thresholds)
+    max_dets = tuple(sorted(max_dets))
+
+    classes = set()
+    for d in detections:
+        classes.update(np.asarray(d["labels"]).reshape(-1).tolist())
+    for g in groundtruths:
+        classes.update(np.asarray(g["labels"]).reshape(-1).tolist())
+    classes = [0] if class_agnostic else sorted(int(c) for c in classes)
+
+    area_keys = tuple(AREA_RANGES)
+    per_image: List[Dict] = []
+    ious_map: Dict[Tuple[int, int], np.ndarray] = {}
+    for img_idx, (det, gt) in enumerate(zip(detections, groundtruths)):
+        dt_labels = np.asarray(det["labels"]).reshape(-1)
+        gt_labels = np.asarray(gt["labels"]).reshape(-1)
+        if class_agnostic:
+            dt_labels = np.zeros_like(dt_labels)
+            gt_labels = np.zeros_like(gt_labels)
+        dt_scores = np.asarray(det["scores"], np.float64).reshape(-1)
+        gt_crowd = np.asarray(gt.get("iscrowd", np.zeros(len(gt_labels)))).reshape(-1).astype(bool)
+
+        if iou_type == "bbox":
+            dt_geom = np.asarray(det["boxes"], np.float64).reshape(-1, 4)
+            gt_geom = np.asarray(gt["boxes"], np.float64).reshape(-1, 4)
+            dt_areas = (dt_geom[:, 2] - dt_geom[:, 0]) * (dt_geom[:, 3] - dt_geom[:, 1])
+            gt_areas = (gt_geom[:, 2] - gt_geom[:, 0]) * (gt_geom[:, 3] - gt_geom[:, 1])
+            iou_fn = bbox_iou_np
+        else:
+            dt_geom = np.asarray(det["masks"]).astype(bool)
+            gt_geom = np.asarray(gt["masks"]).astype(bool)
+            dt_areas = dt_geom.reshape(dt_geom.shape[0], -1).sum(1).astype(np.float64) if dt_geom.size else np.zeros(0)
+            gt_areas = gt_geom.reshape(gt_geom.shape[0], -1).sum(1).astype(np.float64) if gt_geom.size else np.zeros(0)
+            iou_fn = mask_iou_np
+        if "area" in gt and np.asarray(gt["area"]).size:
+            gt_areas = np.asarray(gt["area"], np.float64).reshape(-1)
+
+        img_cells: Dict = {}
+        for cls in classes:
+            d_sel = np.nonzero(dt_labels == cls)[0]
+            g_sel = np.nonzero(gt_labels == cls)[0]
+            if len(d_sel) == 0 and len(g_sel) == 0:
+                continue
+            ious_full = iou_fn(
+                dt_geom[d_sel], gt_geom[g_sel], gt_crowd[g_sel]
+            )
+            ious_map[(img_idx, cls)] = ious_full
+            for area in area_keys:
+                lo, hi = AREA_RANGES[area]
+                g_ignore = gt_crowd[g_sel] | (gt_areas[g_sel] < lo) | (gt_areas[g_sel] > hi)
+                d_area_ignore = (dt_areas[d_sel] < lo) | (dt_areas[d_sel] > hi)
+                n_pos = int((~g_ignore).sum())
+                for max_det in max_dets:
+                    matched, ignored, scores = match_image(
+                        ious_full,
+                        dt_scores[d_sel],
+                        g_ignore.astype(np.int64),
+                        gt_crowd[g_sel].astype(np.int64),
+                        d_area_ignore,
+                        iou_thresholds,
+                        max_det,
+                    )
+                    img_cells[(cls, area, max_det)] = (matched, ignored, scores, n_pos)
+        per_image.append(img_cells)
+
+    out = accumulate(per_image, classes, iou_thresholds, rec_thresholds, max_dets, area_keys)
+    out["ious"] = ious_map
+    out["classes"] = np.asarray(classes, np.int64)
+    out["iou_thresholds"] = iou_thresholds
+    out["rec_thresholds"] = rec_thresholds
+    out["max_dets"] = np.asarray(max_dets)
+    return out
+
+
+def summarize(eval_out: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """COCO summary numbers from the accumulation arrays (mean over valid)."""
+    precision = eval_out["precision"]  # (T, R, K, A, M)
+    recall = eval_out["recall"]  # (T, K, A, M)
+    iou_t = eval_out["iou_thresholds"]
+    max_dets = eval_out["max_dets"].tolist()
+    area_idx = {k: i for i, k in enumerate(AREA_RANGES)}
+    m_last = len(max_dets) - 1
+
+    def _ap(t_sel=None, area="all"):
+        p = precision[:, :, :, area_idx[area], m_last]
+        if t_sel is not None:
+            sel = np.isclose(iou_t, t_sel)
+            if not sel.any():
+                return np.float32(-1.0)
+            p = p[sel]
+        p = p[p > -1]
+        return np.float32(p.mean()) if p.size else np.float32(-1.0)
+
+    def _ar(mi, area="all"):
+        r = recall[:, :, area_idx[area], mi]
+        r = r[r > -1]
+        return np.float32(r.mean()) if r.size else np.float32(-1.0)
+
+    res = {
+        "map": _ap(),
+        "map_50": _ap(0.5),
+        "map_75": _ap(0.75),
+        "map_small": _ap(area="small"),
+        "map_medium": _ap(area="medium"),
+        "map_large": _ap(area="large"),
+        "mar_small": _ar(m_last, "small"),
+        "mar_medium": _ar(m_last, "medium"),
+        "mar_large": _ar(m_last, "large"),
+    }
+    for mi, md in enumerate(max_dets):
+        res[f"mar_{md}"] = _ar(mi)
+    # per-class ap/ar at the largest maxDet over the "all" range
+    k = precision.shape[2]
+    map_pc, mar_pc = np.full(k, -1.0, np.float32), np.full(k, -1.0, np.float32)
+    for ki in range(k):
+        p = precision[:, :, ki, area_idx["all"], m_last]
+        p = p[p > -1]
+        map_pc[ki] = p.mean() if p.size else -1.0
+        r = recall[:, ki, area_idx["all"], m_last]
+        r = r[r > -1]
+        mar_pc[ki] = r.mean() if r.size else -1.0
+    res["map_per_class"] = map_pc
+    res["mar_per_class"] = mar_pc
+    return res

@@ -1,0 +1,237 @@
+"""Panoptic Quality (PQ) and Modified PQ.
+
+Parity target: reference ``functional/detection/_panoptic_quality_common.py``
+(469 LoC) + ``functional/detection/panoptic_quality.py``. The reference walks
+Python dicts of segment "colors"; here segment areas and pairwise
+intersections come from a single vectorized ``np.unique`` pass over integer
+pixel encodings — the per-category stats land in fixed-shape ``(C,)`` sum
+states that reduce with ``psum`` across devices.
+"""
+from typing import Any, Collection, Dict, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ...utils.prints import rank_zero_warn
+
+
+def _parse_categories(things: Collection[int], stuffs: Collection[int]) -> Tuple[Set[int], Set[int]]:
+    if not all(isinstance(v, (int, np.integer)) for v in things):
+        raise TypeError(f"Expected argument `things` to contain `int` categories, but got {things}")
+    if not all(isinstance(v, (int, np.integer)) for v in stuffs):
+        raise TypeError(f"Expected argument `stuffs` to contain `int` categories, but got {stuffs}")
+    things_parsed = set(int(t) for t in things)
+    if len(things_parsed) < len(list(things)):
+        rank_zero_warn("The provided `things` categories contained duplicates, which have been removed.", UserWarning)
+    stuffs_parsed = set(int(s) for s in stuffs)
+    if len(stuffs_parsed) < len(list(stuffs)):
+        rank_zero_warn("The provided `stuffs` categories contained duplicates, which have been removed.", UserWarning)
+    if things_parsed & stuffs_parsed:
+        raise ValueError(
+            f"Expected arguments `things` and `stuffs` to have distinct keys, but got {things} and {stuffs}"
+        )
+    if not (things_parsed | stuffs_parsed):
+        raise ValueError("At least one of `things` and `stuffs` must be non-empty.")
+    return things_parsed, stuffs_parsed
+
+
+def _validate_inputs(preds: np.ndarray, target: np.ndarray) -> None:
+    if preds.shape != target.shape:
+        raise ValueError(
+            f"Expected argument `preds` and `target` to have the same shape, got {preds.shape} and {target.shape}"
+        )
+    if preds.ndim < 3:
+        raise ValueError(
+            "Expected argument `preds` to have at least one spatial dimension (B, *spatial_dims, 2), "
+            f"got {preds.shape}"
+        )
+    if preds.shape[-1] != 2:
+        raise ValueError(
+            f"Expected argument `preds` to have exactly 2 channels in the last dimension (category, instance), "
+            f"got {preds.shape} instead"
+        )
+
+
+def _encode(colors: np.ndarray, offset: np.int64) -> np.ndarray:
+    """(N, 2) integer colors -> unique int64 keys (cat * offset + inst)."""
+    return colors[:, 0].astype(np.int64) * offset + colors[:, 1].astype(np.int64)
+
+
+def _panoptic_update_sample(
+    pred: np.ndarray,
+    target: np.ndarray,
+    things: Set[int],
+    stuffs: Set[int],
+    cat_to_idx: Dict[int, int],
+    allow_unknown_preds_category: bool,
+    modified_stuffs: Optional[Set[int]] = None,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Per-sample (iou_sum, tp, fp, fn) each shaped (num_categories,).
+
+    Vectorized port of the reference's dict-walk
+    (``_panoptic_quality_update_sample``), including the void filtering rules:
+    unmatched target segments >50% void in prediction are not FNs; unmatched
+    prediction segments >50% void in target are not FPs.
+    """
+    modified_stuffs = modified_stuffs or set()
+    n_cat = len(cat_to_idx)
+    iou_sum = np.zeros(n_cat, np.float64)
+    tp = np.zeros(n_cat, np.int64)
+    fp = np.zeros(n_cat, np.int64)
+    fn = np.zeros(n_cat, np.int64)
+
+    pred = pred.reshape(-1, 2).astype(np.int64)
+    target = target.reshape(-1, 2).astype(np.int64)
+
+    known = np.isin(pred[:, 0], sorted(things | stuffs))
+    if not known.all():
+        if not allow_unknown_preds_category:
+            raise ValueError(
+                f"Unknown categories found: {sorted(set(pred[~known, 0].tolist()))}"
+            )
+    known_t = np.isin(target[:, 0], sorted(things | stuffs))
+
+    # void encoding: category -1 is reserved (reference synthesizes a fresh
+    # void color, ``_get_void_color``)
+    offset = np.int64(max(int(pred[:, 1].max(initial=0)), int(target[:, 1].max(initial=0))) + 2)
+    void_key = np.int64(-1)
+    pk = np.where(known, _encode(pred, offset), void_key)
+    tk = np.where(known_t, _encode(target, offset), void_key)
+
+    # areas per segment
+    p_keys, p_areas = np.unique(pk, return_counts=True)
+    t_keys, t_areas = np.unique(tk, return_counts=True)
+    p_area = dict(zip(p_keys.tolist(), p_areas.tolist()))
+    t_area = dict(zip(t_keys.tolist(), t_areas.tolist()))
+
+    # pairwise intersections via a combined key
+    pair_base = np.int64(len(t_keys) + 1)
+    t_idx_arr = np.searchsorted(t_keys, tk)
+    p_idx_sorted = np.searchsorted(p_keys, pk)
+    combined = p_idx_sorted.astype(np.int64) * pair_base + t_idx_arr.astype(np.int64)
+    c_keys, c_areas = np.unique(combined, return_counts=True)
+    pair_p = p_keys[(c_keys // pair_base).astype(np.int64)]
+    pair_t = t_keys[(c_keys % pair_base).astype(np.int64)]
+    inter = dict(zip(zip(pair_p.tolist(), pair_t.tolist()), c_areas.tolist()))
+
+    matched_p: Set[int] = set()
+    matched_t: Set[int] = set()
+    for (p_key, t_key), in_area in inter.items():
+        if t_key == void_key or p_key == void_key:
+            continue
+        cat_p, cat_t = p_key // offset, t_key // offset
+        if cat_p != cat_t:
+            continue
+        p_void = inter.get((p_key, void_key), 0)
+        void_t = inter.get((void_key, t_key), 0)
+        union = p_area[p_key] - p_void + t_area[t_key] - void_t - in_area
+        iou = in_area / union if union > 0 else 0.0
+        idx = cat_to_idx[int(cat_t)]
+        if int(cat_t) not in modified_stuffs and iou > 0.5:
+            matched_p.add(p_key)
+            matched_t.add(t_key)
+            iou_sum[idx] += iou
+            tp[idx] += 1
+        elif int(cat_t) in modified_stuffs and iou > 0:
+            iou_sum[idx] += iou
+
+    # false negatives: unmatched target segments not mostly void in prediction
+    for t_key in t_keys.tolist():
+        if t_key == void_key or t_key in matched_t:
+            continue
+        cat = int(t_key // offset)
+        if cat in modified_stuffs:
+            continue
+        void_t = inter.get((void_key, t_key), 0)
+        if void_t / t_area[t_key] <= 0.5:
+            fn[cat_to_idx[cat]] += 1
+
+    # false positives: unmatched prediction segments not mostly void in target
+    for p_key in p_keys.tolist():
+        if p_key == void_key or p_key in matched_p:
+            continue
+        cat = int(p_key // offset)
+        if cat in modified_stuffs:
+            continue
+        p_void = inter.get((p_key, void_key), 0)
+        if p_void / p_area[p_key] <= 0.5:
+            fp[cat_to_idx[cat]] += 1
+
+    # modified metric: stuff TP counts the number of target segments
+    for t_key in t_keys.tolist():
+        if t_key == void_key:
+            continue
+        cat = int(t_key // offset)
+        if cat in modified_stuffs:
+            tp[cat_to_idx[cat]] += 1
+
+    return iou_sum, tp, fp, fn
+
+
+def _panoptic_quality_update(
+    preds: np.ndarray,
+    target: np.ndarray,
+    things: Set[int],
+    stuffs: Set[int],
+    allow_unknown_preds_category: bool = False,
+    modified_stuffs: Optional[Set[int]] = None,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    cats = sorted(things) + sorted(stuffs)
+    cat_to_idx = {c: i for i, c in enumerate(cats)}
+    n_cat = len(cats)
+    iou_sum = np.zeros(n_cat, np.float64)
+    tp = np.zeros(n_cat, np.int64)
+    fp = np.zeros(n_cat, np.int64)
+    fn = np.zeros(n_cat, np.int64)
+    flat_p = preds.reshape(-1, *preds.shape[-3:]) if preds.ndim > 3 else preds[None]
+    flat_t = target.reshape(-1, *target.shape[-3:]) if target.ndim > 3 else target[None]
+    for p, t in zip(flat_p, flat_t):
+        s = _panoptic_update_sample(p, t, things, stuffs, cat_to_idx, allow_unknown_preds_category, modified_stuffs)
+        iou_sum += s[0]
+        tp += s[1]
+        fp += s[2]
+        fn += s[3]
+    return iou_sum, tp, fp, fn
+
+
+def _panoptic_quality_compute(
+    iou_sum: np.ndarray, tp: np.ndarray, fp: np.ndarray, fn: np.ndarray
+) -> np.ndarray:
+    """Mean PQ over categories with a non-zero denominator (reference formula)."""
+    denom = tp + 0.5 * fp + 0.5 * fn
+    pq = np.where(denom > 0, iou_sum / np.where(denom > 0, denom, 1.0), 0.0)
+    valid = denom > 0
+    return np.float64(pq[valid].mean()) if valid.any() else np.float64(0.0)
+
+
+def panoptic_quality(
+    preds: Any,
+    target: Any,
+    things: Collection[int],
+    stuffs: Collection[int],
+    allow_unknown_preds_category: bool = False,
+) -> np.ndarray:
+    """One-shot Panoptic Quality; parity ``functional/detection/panoptic_quality.py``."""
+    things_s, stuffs_s = _parse_categories(things, stuffs)
+    preds = np.asarray(preds)
+    target = np.asarray(target)
+    _validate_inputs(preds, target)
+    stats = _panoptic_quality_update(preds, target, things_s, stuffs_s, allow_unknown_preds_category)
+    return _panoptic_quality_compute(*stats)
+
+
+def modified_panoptic_quality(
+    preds: Any,
+    target: Any,
+    things: Collection[int],
+    stuffs: Collection[int],
+    allow_unknown_preds_category: bool = False,
+) -> np.ndarray:
+    """One-shot Modified PQ (stuff categories scored per-pixel, iou > 0)."""
+    things_s, stuffs_s = _parse_categories(things, stuffs)
+    preds = np.asarray(preds)
+    target = np.asarray(target)
+    _validate_inputs(preds, target)
+    stats = _panoptic_quality_update(
+        preds, target, things_s, stuffs_s, allow_unknown_preds_category, modified_stuffs=stuffs_s
+    )
+    return _panoptic_quality_compute(*stats)
