@@ -1,0 +1,102 @@
+"""Segmentation morphology toolbox vs scipy.ndimage oracles.
+
+Mirrors the reference's strategy of checking ``functional/segmentation/utils``
+against scipy (``tests/unittests`` use scipy.ndimage as the oracle)."""
+import numpy as np
+import pytest
+from scipy import ndimage
+
+from torchmetrics_tpu.functional.segmentation import (
+    binary_dilation,
+    binary_erosion,
+    distance_transform,
+    generate_binary_structure,
+    get_neighbour_tables,
+    mask_edges,
+    surface_distance,
+    table_contour_length,
+    table_surface_area,
+)
+
+
+@pytest.mark.parametrize("rank", [2, 3])
+@pytest.mark.parametrize("connectivity", [1, 2, 3])
+def test_generate_binary_structure(rank, connectivity):
+    ours = np.asarray(generate_binary_structure(rank, connectivity))
+    theirs = ndimage.generate_binary_structure(rank, connectivity)
+    assert (ours == theirs).all()
+
+
+@pytest.mark.parametrize("connectivity", [1, 2])
+def test_binary_erosion_dilation_vs_scipy(connectivity):
+    rng = np.random.RandomState(0)
+    img = (rng.rand(1, 1, 17, 23) > 0.4).astype(np.int32)
+    st = generate_binary_structure(2, connectivity)
+    ours = np.asarray(binary_erosion(img, st))[0, 0]
+    theirs = ndimage.binary_erosion(img[0, 0], np.asarray(st)).astype(np.int32)
+    assert (ours == theirs).all()
+    ours_d = np.asarray(binary_dilation(img, st))[0, 0]
+    theirs_d = ndimage.binary_dilation(img[0, 0], np.asarray(st)).astype(np.int32)
+    assert (ours_d == theirs_d).all()
+
+
+@pytest.mark.parametrize("metric", ["euclidean", "chessboard", "taxicab"])
+@pytest.mark.parametrize("sampling", [(1.0, 1.0), (2.0, 0.5)])
+def test_distance_transform_vs_scipy(metric, sampling):
+    rng = np.random.RandomState(1)
+    img = (rng.rand(19, 26) > 0.3).astype(np.int32)
+    img[0, 0] = 0  # ensure background exists
+    ours = np.asarray(distance_transform(img, sampling=sampling, metric=metric))
+    if metric == "euclidean":
+        theirs = ndimage.distance_transform_edt(img, sampling=sampling)
+    else:
+        if sampling != (1.0, 1.0):
+            pytest.skip("scipy cdt has no sampling")
+        theirs = ndimage.distance_transform_cdt(
+            img, metric="chessboard" if metric == "chessboard" else "taxicab"
+        )
+    np.testing.assert_allclose(ours, theirs, atol=1e-5)
+
+
+def test_mask_edges_and_surface_distance():
+    rng = np.random.RandomState(2)
+    a = np.zeros((20, 20), np.int32)
+    a[5:15, 5:15] = 1
+    b = np.zeros((20, 20), np.int32)
+    b[6:16, 4:14] = 1
+    ea, eb = mask_edges(a, b)
+    # edge = mask minus eroded mask
+    exp_a = a - ndimage.binary_erosion(a, ndimage.generate_binary_structure(2, 1)).astype(np.int32)
+    assert (np.asarray(ea).astype(np.int32) == exp_a).all()
+    d = np.asarray(surface_distance(np.asarray(ea).astype(np.int32), np.asarray(eb).astype(np.int32)))
+    assert d.shape[0] == int(exp_a.sum())
+    assert (d >= 0).all() and np.isfinite(d).all()
+
+
+def test_contour_table_square():
+    # a filled rectangle's contour length from the neighbour-code table should
+    # approximate its perimeter
+    table, kernel = table_contour_length((1.0, 1.0))
+    assert table.shape == (16,)
+    assert np.asarray(table)[0] == 0 and np.asarray(table)[15] == 0
+    # straight-edge codes measure 1 pixel of contour
+    assert np.isclose(np.asarray(table)[3], 1.0)  # vertical edge through cell
+    assert np.isclose(np.asarray(table)[5], 1.0)  # horizontal edge
+
+
+def test_surface_area_table_flat_plane():
+    table, kernel = table_surface_area((1.0, 1.0, 1.0))
+    t = np.asarray(table)
+    assert t.shape == (256,)
+    assert t[0] == 0 and t[255] == 0
+    # flat plane: top 4 corners inside, bottom 4 outside -> area 1 per cell
+    code_top = sum(1 << (7 - k) for k in range(8) if ((k >> 2) & 1) == 0)
+    assert np.isclose(t[code_top], 1.0, atol=1e-6)
+    # table must be symmetric under inside/outside complement
+    assert np.allclose(t, t[::-1], atol=1e-6)
+
+
+def test_distance_transform_no_background():
+    img = np.ones((5, 5), np.int32)
+    out = np.asarray(distance_transform(img))
+    assert np.isinf(out).all()

@@ -1,0 +1,273 @@
+"""Native host-side kernels (C++), loaded via ctypes.
+
+This is the TPU build's first-party replacement for the reference's
+third-party native backends (SURVEY.md §2.9): pycocotools' RLE/COCOeval C
+code, scipy's ``linear_sum_assignment`` and the Python Levenshtein DP.
+
+The shared library is compiled lazily with ``g++ -O3`` on first import and
+cached next to the source (keyed by a source hash). Every entry point has a
+pure-Python/numpy fallback, so the package works even without a toolchain —
+``NATIVE_AVAILABLE`` reports which path is active.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "tm_native.cpp")
+
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+
+class _NativeAvailable:
+    """Truthy proxy that triggers the lazy build on first check.
+
+    ``import torchmetrics_tpu`` must not pay for (or require) a g++ build;
+    the compile runs on the first ``NATIVE_AVAILABLE`` consultation — i.e.
+    the first native-eligible code path actually exercised.
+    """
+
+    def __bool__(self) -> bool:
+        return _ensure_loaded()
+
+
+NATIVE_AVAILABLE = _NativeAvailable()
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    try:
+        with open(_SRC, "rb") as f:
+            src = f.read()
+        tag = hashlib.sha256(src).hexdigest()[:16]
+        so_path = os.path.join(_HERE, f"_tm_native_{tag}.so")
+        if not os.path.exists(so_path):
+            # build into a temp file then atomically rename (safe under
+            # concurrent pytest workers)
+            fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
+            os.close(fd)
+            cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+                   _SRC, "-o", tmp]
+            try:
+                subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+                os.replace(tmp, so_path)
+            except Exception:
+                # -march=native can fail on exotic hosts; retry plain
+                try:
+                    subprocess.run(["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                                    _SRC, "-o", tmp], check=True, capture_output=True, timeout=120)
+                    os.replace(tmp, so_path)
+                except Exception:
+                    if os.path.exists(tmp):
+                        os.unlink(tmp)
+                    return None
+        lib = ctypes.CDLL(so_path)
+    except Exception:
+        return None
+
+    i64 = ctypes.c_int64
+    p_i64 = ctypes.POINTER(ctypes.c_int64)
+    p_u32 = ctypes.POINTER(ctypes.c_uint32)
+    p_u8 = ctypes.POINTER(ctypes.c_uint8)
+    p_f64 = ctypes.POINTER(ctypes.c_double)
+
+    lib.tm_edit_distance.restype = i64
+    lib.tm_edit_distance.argtypes = [p_i64, i64, p_i64, i64]
+    lib.tm_edit_distance_counts.restype = None
+    lib.tm_edit_distance_counts.argtypes = [p_i64, i64, p_i64, i64, p_i64]
+    lib.tm_edit_distance_batch.restype = None
+    lib.tm_edit_distance_batch.argtypes = [p_i64, p_i64, p_i64, p_i64, i64, p_i64]
+    lib.tm_edit_distance_counts_batch.restype = None
+    lib.tm_edit_distance_counts_batch.argtypes = [p_i64, p_i64, p_i64, p_i64, i64, p_i64]
+    lib.tm_linear_sum_assignment.restype = ctypes.c_int
+    lib.tm_linear_sum_assignment.argtypes = [p_f64, i64, i64, p_i64]
+    lib.tm_rle_encode.restype = i64
+    lib.tm_rle_encode.argtypes = [p_u8, i64, i64, p_u32]
+    lib.tm_rle_decode.restype = None
+    lib.tm_rle_decode.argtypes = [p_u32, i64, i64, i64, p_u8]
+    lib.tm_rle_area.restype = ctypes.c_uint64
+    lib.tm_rle_area.argtypes = [p_u32, i64]
+    lib.tm_rle_iou.restype = None
+    lib.tm_rle_iou.argtypes = [p_u32, p_i64, i64, p_u32, p_i64, i64, p_u8, p_f64]
+    lib.tm_box_iou.restype = None
+    lib.tm_box_iou.argtypes = [p_f64, i64, p_f64, i64, p_u8, p_f64]
+    lib.tm_coco_match.restype = None
+    lib.tm_coco_match.argtypes = [p_f64, i64, i64, p_u8, p_u8, p_f64, i64, p_i64, p_i64, p_u8]
+    return lib
+
+
+def _ensure_loaded() -> bool:
+    global _lib, _load_attempted
+    if not _load_attempted:
+        _load_attempted = True
+        if os.environ.get("TM_TPU_DISABLE_NATIVE", "0") != "1":
+            _lib = _build_and_load()
+    return _lib is not None
+
+
+def _as_i64(x) -> np.ndarray:
+    return np.ascontiguousarray(x, dtype=np.int64)
+
+
+def _ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+# ---------------------------------------------------------------------------
+# Token packing: text metrics deal in hashable tokens (str/int); the native
+# DP needs int64 ids. Interning is per-call — only equality matters.
+# ---------------------------------------------------------------------------
+
+def _intern(seqs: Sequence[Sequence]) -> List[np.ndarray]:
+    table: dict = {}
+    out = []
+    for s in seqs:
+        ids = np.empty(len(s), dtype=np.int64)
+        for i, tok in enumerate(s):
+            ids[i] = table.setdefault(tok, len(table))
+        out.append(ids)
+    return out
+
+
+def _pack(arrs: List[np.ndarray]) -> Tuple[np.ndarray, np.ndarray]:
+    off = np.zeros(len(arrs) + 1, dtype=np.int64)
+    for i, a in enumerate(arrs):
+        off[i + 1] = off[i] + len(a)
+    flat = np.concatenate(arrs) if arrs else np.zeros(0, dtype=np.int64)
+    return np.ascontiguousarray(flat, dtype=np.int64), off
+
+
+def edit_distance_batch(preds: Sequence[Sequence], targets: Sequence[Sequence]) -> np.ndarray:
+    """Unit-cost Levenshtein distance for each (pred, target) pair."""
+    assert len(preds) == len(targets)
+    ids = _intern(list(preds) + list(targets))
+    p_flat, p_off = _pack(ids[: len(preds)])
+    t_flat, t_off = _pack(ids[len(preds):])
+    out = np.empty(len(preds), dtype=np.int64)
+    if len(preds):
+        _lib.tm_edit_distance_batch(
+            _ptr(p_flat, ctypes.c_int64), _ptr(p_off, ctypes.c_int64),
+            _ptr(t_flat, ctypes.c_int64), _ptr(t_off, ctypes.c_int64),
+            len(preds), _ptr(out, ctypes.c_int64))
+    return out
+
+
+def edit_distance_counts_batch(preds: Sequence[Sequence], targets: Sequence[Sequence]) -> np.ndarray:
+    """(batch, 4) int64 array of [substitutions, deletions, insertions, hits]."""
+    assert len(preds) == len(targets)
+    ids = _intern(list(preds) + list(targets))
+    p_flat, p_off = _pack(ids[: len(preds)])
+    t_flat, t_off = _pack(ids[len(preds):])
+    out = np.zeros((len(preds), 4), dtype=np.int64)
+    if len(preds):
+        _lib.tm_edit_distance_counts_batch(
+            _ptr(p_flat, ctypes.c_int64), _ptr(p_off, ctypes.c_int64),
+            _ptr(t_flat, ctypes.c_int64), _ptr(t_off, ctypes.c_int64),
+            len(preds), _ptr(out, ctypes.c_int64))
+    return out
+
+
+def linear_sum_assignment(cost: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Minimum-cost assignment; same contract as scipy's for n <= m."""
+    cost = np.ascontiguousarray(cost, dtype=np.float64)
+    n, m = cost.shape
+    transposed = n > m
+    if transposed:
+        cost = np.ascontiguousarray(cost.T)
+        n, m = m, n
+    col4row = np.empty(n, dtype=np.int64)
+    rc = _lib.tm_linear_sum_assignment(_ptr(cost, ctypes.c_double), n, m,
+                                       _ptr(col4row, ctypes.c_int64))
+    if rc != 0:
+        raise ValueError("infeasible assignment problem")
+    rows = np.arange(n, dtype=np.int64)
+    if transposed:
+        order = np.argsort(col4row)
+        return col4row[order], rows[order]
+    return rows, col4row
+
+
+def rle_encode(mask: np.ndarray) -> np.ndarray:
+    """COCO column-major RLE counts (uint32) of a dense (h, w) binary mask."""
+    mask = np.ascontiguousarray(mask, dtype=np.uint8)
+    h, w = mask.shape
+    buf = np.empty(h * w + 1, dtype=np.uint32)
+    n = _lib.tm_rle_encode(_ptr(mask, ctypes.c_uint8), h, w, _ptr(buf, ctypes.c_uint32))
+    return buf[:n].copy()
+
+
+def rle_decode(counts: np.ndarray, h: int, w: int) -> np.ndarray:
+    counts = np.ascontiguousarray(counts, dtype=np.uint32)
+    out = np.zeros((h, w), dtype=np.uint8)
+    _lib.tm_rle_decode(_ptr(counts, ctypes.c_uint32), len(counts), h, w,
+                       _ptr(out, ctypes.c_uint8))
+    return out
+
+
+def rle_area(counts: np.ndarray) -> int:
+    counts = np.ascontiguousarray(counts, dtype=np.uint32)
+    return int(_lib.tm_rle_area(_ptr(counts, ctypes.c_uint32), len(counts)))
+
+
+def rle_iou(dt: List[np.ndarray], gt: List[np.ndarray], iscrowd: np.ndarray) -> np.ndarray:
+    """Pairwise IoU between RLE masks without decoding (crowd semantics)."""
+    if not dt or not gt:
+        return np.zeros((len(dt), len(gt)), dtype=np.float64)
+    dt_flat = np.concatenate([np.asarray(c, np.uint32) for c in dt]).astype(np.uint32)
+    gt_flat = np.concatenate([np.asarray(c, np.uint32) for c in gt]).astype(np.uint32)
+    dt_off = np.zeros(len(dt) + 1, dtype=np.int64)
+    gt_off = np.zeros(len(gt) + 1, dtype=np.int64)
+    for i, c in enumerate(dt):
+        dt_off[i + 1] = dt_off[i] + len(c)
+    for j, c in enumerate(gt):
+        gt_off[j + 1] = gt_off[j] + len(c)
+    crowd = np.ascontiguousarray(iscrowd, dtype=np.uint8)
+    out = np.empty((len(dt), len(gt)), dtype=np.float64)
+    _lib.tm_rle_iou(_ptr(dt_flat, ctypes.c_uint32), _ptr(dt_off, ctypes.c_int64), len(dt),
+                    _ptr(gt_flat, ctypes.c_uint32), _ptr(gt_off, ctypes.c_int64), len(gt),
+                    _ptr(crowd, ctypes.c_uint8), _ptr(out, ctypes.c_double))
+    return out
+
+
+def box_iou(dt: np.ndarray, gt: np.ndarray, iscrowd: np.ndarray) -> np.ndarray:
+    """Pairwise xyxy box IoU with COCO crowd semantics."""
+    dt = np.ascontiguousarray(dt, dtype=np.float64).reshape(-1, 4)
+    gt = np.ascontiguousarray(gt, dtype=np.float64).reshape(-1, 4)
+    crowd = np.ascontiguousarray(iscrowd, dtype=np.uint8)
+    out = np.empty((len(dt), len(gt)), dtype=np.float64)
+    if len(dt) and len(gt):
+        _lib.tm_box_iou(_ptr(dt, ctypes.c_double), len(dt), _ptr(gt, ctypes.c_double),
+                        len(gt), _ptr(crowd, ctypes.c_uint8), _ptr(out, ctypes.c_double))
+    return out
+
+
+def coco_match(ious: np.ndarray, gt_ignore: np.ndarray, gt_crowd: np.ndarray,
+               iou_thrs: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Greedy COCO matching across thresholds.
+
+    Returns (dt_matches, gt_matches, dt_ignore): (T, n_dt)/(T, n_gt) 1-based
+    match ids (0 = unmatched) and the ignore flags propagated to detections.
+    """
+    ious = np.ascontiguousarray(ious, dtype=np.float64)
+    n_dt, n_gt = ious.shape
+    gt_ignore = np.ascontiguousarray(gt_ignore, dtype=np.uint8)
+    gt_crowd = np.ascontiguousarray(gt_crowd, dtype=np.uint8)
+    iou_thrs = np.ascontiguousarray(iou_thrs, dtype=np.float64)
+    T = len(iou_thrs)
+    dt_m = np.zeros((T, n_dt), dtype=np.int64)
+    gt_m = np.zeros((T, n_gt), dtype=np.int64)
+    dt_ig = np.zeros((T, n_dt), dtype=np.uint8)
+    if n_dt and n_gt:
+        _lib.tm_coco_match(_ptr(ious, ctypes.c_double), n_dt, n_gt,
+                           _ptr(gt_ignore, ctypes.c_uint8), _ptr(gt_crowd, ctypes.c_uint8),
+                           _ptr(iou_thrs, ctypes.c_double), T,
+                           _ptr(dt_m, ctypes.c_int64), _ptr(gt_m, ctypes.c_int64),
+                           _ptr(dt_ig, ctypes.c_uint8))
+    return dt_m, gt_m, dt_ig

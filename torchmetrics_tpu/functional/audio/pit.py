@@ -54,8 +54,14 @@ def permutation_invariant_training(
     if mode == "speaker-wise":
         matrix = _pair_metric_matrix(preds, target, metric_func, **kwargs)  # (B, sp, st)
         if spk > 3:
-            # Hungarian on host: optimal without enumerating spk! options
-            from scipy.optimize import linear_sum_assignment
+            # Hungarian on host: optimal without enumerating spk! options.
+            # First-party C++ Jonker-Volgenant (``_native``); scipy fallback.
+            from ... import _native
+
+            if _native.NATIVE_AVAILABLE:
+                linear_sum_assignment = _native.linear_sum_assignment
+            else:
+                from scipy.optimize import linear_sum_assignment
 
             mat_np = np.asarray(matrix)
             best_perm = np.empty((mat_np.shape[0], spk), dtype=np.int64)

@@ -7,13 +7,15 @@ per-image pairwise IoU matrices are computed with the JAX kernels from
 ``box_ops.py``; the greedy score-ordered matching and the PR accumulation run
 in numpy on host — they are O(dets·gts) bookkeeping, not FLOPs.
 
-A C++ implementation of the inner matching loop is used when the compiled
-extension is available (``torchmetrics_tpu/native``); this numpy path is the
-always-available fallback and the correctness oracle for it.
+A C++ implementation of the inner matching loop + pairwise IoU is used when
+the compiled extension is available (``torchmetrics_tpu._native``); this
+numpy path is the always-available fallback and the correctness oracle for it.
 """
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from ... import _native
 
 # COCO default parameter space — the reference builds these with
 # torch.linspace in float32 (``detection/mean_ap.py`` ctor), so t=0.6 is
@@ -34,6 +36,8 @@ def bbox_iou_np(dt: np.ndarray, gt: np.ndarray, iscrowd: np.ndarray) -> np.ndarr
     """Pairwise IoU with COCO crowd semantics (union = dt area for crowd gt)."""
     if dt.size == 0 or gt.size == 0:
         return np.zeros((dt.shape[0], gt.shape[0]), np.float64)
+    if _native.NATIVE_AVAILABLE:
+        return _native.box_iou(dt, gt, iscrowd)
     lt = np.maximum(dt[:, None, :2], gt[None, :, :2])
     rb = np.minimum(dt[:, None, 2:], gt[None, :, 2:])
     wh = np.clip(rb - lt, 0.0, None)
@@ -89,6 +93,15 @@ def match_image(
     ious = ious[:, g_order]
     g_ignore = gt_ignore[g_order].astype(bool)
     g_crowd = gt_crowd[g_order].astype(bool)
+
+    if _native.NATIVE_AVAILABLE and n_d and n_g:
+        dt_m, _gt_m, dt_ig = _native.coco_match(
+            ious, g_ignore.astype(np.uint8), g_crowd.astype(np.uint8), iou_thresholds
+        )
+        dt_matched = dt_m > 0
+        dt_ignored = dt_ig.astype(bool)
+        dt_ignored |= (~dt_matched) & dt_area_ignore.astype(bool)[None, :]
+        return dt_matched, dt_ignored, scores
 
     dt_matched = np.zeros((n_t, n_d), dtype=bool)
     dt_ignored = np.zeros((n_t, n_d), dtype=bool)

@@ -182,8 +182,11 @@ def _panoptic_quality_update(
     tp = np.zeros(n_cat, np.int64)
     fp = np.zeros(n_cat, np.int64)
     fn = np.zeros(n_cat, np.int64)
-    flat_p = preds.reshape(-1, *preds.shape[-3:]) if preds.ndim > 3 else preds[None]
-    flat_t = target.reshape(-1, *target.shape[-3:]) if target.ndim > 3 else target[None]
+    # dim 0 is always batch; all spatial dims flatten per sample (the
+    # reference does ``torch.flatten(inputs, 1, -2)``) — segments must NOT
+    # merge across batch elements
+    flat_p = preds.reshape(preds.shape[0], -1, 2)
+    flat_t = target.reshape(target.shape[0], -1, 2)
     for p, t in zip(flat_p, flat_t):
         s = _panoptic_update_sample(p, t, things, stuffs, cat_to_idx, allow_unknown_preds_category, modified_stuffs)
         iou_sum += s[0]

@@ -1,0 +1,27 @@
+"""Segmentation support toolbox (no public metrics at the reference version —
+``functional/segmentation/utils.py`` morphology utilities only, SURVEY.md §2.8)."""
+from .utils import (
+    binary_dilation,
+    binary_erosion,
+    check_if_binarized,
+    distance_transform,
+    generate_binary_structure,
+    get_neighbour_tables,
+    mask_edges,
+    surface_distance,
+    table_contour_length,
+    table_surface_area,
+)
+
+__all__ = [
+    "binary_dilation",
+    "binary_erosion",
+    "check_if_binarized",
+    "distance_transform",
+    "generate_binary_structure",
+    "get_neighbour_tables",
+    "mask_edges",
+    "surface_distance",
+    "table_contour_length",
+    "table_surface_area",
+]

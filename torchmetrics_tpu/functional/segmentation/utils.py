@@ -1,0 +1,329 @@
+"""Segmentation morphology toolbox (JAX).
+
+Parity targets: reference ``functional/segmentation/utils.py`` (781 LoC,
+fns at :27 check_if_binarized, :64 generate_binary_structure, :107
+binary_erosion, :177 distance_transform, :278 mask_edges, :336
+surface_distance, :387-505 neighbour-code tables).
+
+TPU-first design notes:
+- erosion/dilation are windowed reductions (``lax.reduce_window``) — one
+  fused XLA op, no im2col unfold like the reference's ``_unfold``.
+- distance transforms use Meijster's two-phase separable decomposition,
+  with each 1D phase expressed as a dense min-plus broadcast reduce
+  (O(n^2) per line but fully vectorized — XLA tiles it; no sequential
+  envelope scan, which would serialize on TPU).
+"""
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+Array = jax.Array
+
+
+def check_if_binarized(x: Array) -> None:
+    """Raise if the tensor is not binary (only 0s and 1s).
+
+    Parity: reference ``functional/segmentation/utils.py:27``.
+    """
+    xv = np.asarray(x)
+    if not np.all((xv == 0) | (xv == 1)):
+        raise ValueError("Input x should be binarized")
+
+
+def generate_binary_structure(rank: int, connectivity: int) -> Array:
+    """Binary structuring element a la ``scipy.ndimage.generate_binary_structure``.
+
+    Parity: reference ``functional/segmentation/utils.py:64``.
+    """
+    if connectivity < 1:
+        out = np.zeros((3,) * rank, dtype=bool)
+        out[(1,) * rank] = True
+        return jnp.asarray(out)
+    grids = np.meshgrid(*([np.arange(3)] * rank), indexing="ij")
+    dist = sum(np.abs(g - 1) for g in grids)
+    return jnp.asarray(dist <= connectivity)
+
+
+def _reduce_window_bool(x: Array, structure: Array, init: float, op) -> Array:
+    """Windowed reduce over the trailing spatial dims with a mask-shaped window."""
+    # implement "min over structure's True offsets" by shifting: for small 3^r
+    # structures a shift-and-combine is cheaper than a dense reduce_window
+    rank = structure.ndim
+    offs = np.argwhere(np.asarray(structure)) - 1  # offsets in [-1, 0, 1]^rank
+    out = None
+    for off in offs:
+        shifted = x
+        for ax, o in enumerate(off):
+            shifted = jnp.roll(shifted, -int(o), axis=-(rank - ax))
+            # zero-pad semantics at the border (border_value=0)
+            idx = [slice(None)] * shifted.ndim
+            axis = shifted.ndim - rank + ax
+            if o == 1:
+                idx[axis] = slice(-1, None)
+            elif o == -1:
+                idx[axis] = slice(0, 1)
+            if o != 0:
+                pad = jnp.zeros_like(shifted[tuple(idx)])
+                keep = [slice(None)] * shifted.ndim
+                keep[axis] = slice(0, -1) if o == 1 else slice(1, None)
+                body = shifted[tuple(keep)]
+                shifted = jnp.concatenate(
+                    (body, pad) if o == 1 else (pad, body), axis=axis
+                )
+        out = shifted if out is None else op(out, shifted)
+    return out
+
+
+def binary_erosion(image: Array, structure: Optional[Array] = None, border_value: int = 0) -> Array:
+    """Binary erosion over the trailing spatial dims of a (B, C, *spatial) image.
+
+    Parity: reference ``functional/segmentation/utils.py:107`` (which unfolds;
+    here: shift-and-AND over the structuring element's offsets — fuses in XLA).
+    """
+    if image.ndim not in (4, 5):
+        raise ValueError(f"Expected argument `image` to be of rank 4 or 5 but got rank {image.ndim}")
+    check_if_binarized(image)
+    rank = image.ndim - 2
+    if structure is None:
+        structure = generate_binary_structure(rank, 1)
+    x = image.astype(jnp.float32)
+    if border_value == 0:
+        eroded = _reduce_window_bool(x, structure, 1.0, jnp.minimum)
+    else:
+        # border treated as foreground: pad with 1s via inverted dilation
+        eroded = 1.0 - _reduce_window_bool(1.0 - x, structure, 0.0, jnp.maximum)
+        # interior handling identical; only borders differ
+    return eroded.astype(image.dtype)
+
+
+def binary_dilation(image: Array, structure: Optional[Array] = None) -> Array:
+    """Binary dilation — companion of :func:`binary_erosion`."""
+    if image.ndim not in (4, 5):
+        raise ValueError(f"Expected argument `image` to be of rank 4 or 5 but got rank {image.ndim}")
+    check_if_binarized(image)
+    rank = image.ndim - 2
+    if structure is None:
+        structure = generate_binary_structure(rank, 1)
+    x = image.astype(jnp.float32)
+    return _reduce_window_bool(x, structure, 0.0, jnp.maximum).astype(image.dtype)
+
+
+def _dt_1d_l1(bg: Array, axis: int, spacing: float) -> Array:
+    """Per-line L1 distance to the nearest background element along ``axis``.
+
+    Vectorized min-plus: d[i] = min_j (|i-j| : bg[j]); inf when no bg.
+    """
+    n = bg.shape[axis]
+    idx = jnp.arange(n, dtype=jnp.float32)
+    # move axis last
+    bgm = jnp.moveaxis(bg, axis, -1)
+    dist_pairs = jnp.abs(idx[:, None] - idx[None, :]) * spacing  # (n, n)
+    masked = jnp.where(bgm[..., None, :], dist_pairs, jnp.inf)  # (..., n, n)
+    out = jnp.min(masked, axis=-1)
+    return jnp.moveaxis(out, -1, axis)
+
+
+def _phase2(g: Array, axis: int, spacing: float, metric: str) -> Array:
+    """Meijster phase 2: combine per-column distances g along ``axis``."""
+    n = g.shape[axis]
+    idx = jnp.arange(n, dtype=jnp.float32)
+    gm = jnp.moveaxis(g, axis, -1)  # (..., n)
+    dx = jnp.abs(idx[:, None] - idx[None, :]) * spacing  # (n, n) |x - x'|
+    if metric == "euclidean":
+        cand = jnp.sqrt(dx**2 + jnp.where(jnp.isinf(gm), jnp.inf, gm) [..., None, :] ** 2)
+        cand = jnp.where(jnp.isinf(gm)[..., None, :], jnp.inf, cand)
+    elif metric == "taxicab":
+        cand = dx + gm[..., None, :]
+    else:  # chessboard
+        cand = jnp.maximum(dx, gm[..., None, :])
+    out = jnp.min(cand, axis=-1)
+    return jnp.moveaxis(out, -1, axis)
+
+
+def distance_transform(
+    x: Array,
+    sampling: Optional[Sequence[float]] = None,
+    metric: str = "euclidean",
+    engine: str = "xla",
+) -> Array:
+    """Distance from each foreground element to the nearest background element.
+
+    Parity: reference ``functional/segmentation/utils.py:177`` (metrics
+    euclidean / chessboard / taxicab; ``sampling`` = per-axis spacing).
+    Supports 2D ``(H, W)`` or batched ``(..., H, W)`` input. Elements with no
+    background anywhere get ``inf``.
+
+    TPU-first: Meijster's separable two-phase algorithm with each 1D phase a
+    dense min-plus reduce — O(H*W*(H+W)) vectorized work, no sequential scans.
+    """
+    if metric not in ("euclidean", "chessboard", "taxicab"):
+        raise ValueError(
+            f"Expected argument `metric` to be one of 'euclidean', 'chessboard', 'taxicab' but got {metric}"
+        )
+    if sampling is None:
+        sampling = (1.0, 1.0)
+    if len(sampling) != 2:
+        raise ValueError(f"Expected argument `sampling` to have length 2 but got length {len(sampling)}")
+    x = jnp.asarray(x)
+    bg = x == 0
+    # phase 1: vertical (axis -2) L1 distances to background
+    g = _dt_1d_l1(bg, -2, float(sampling[0]))
+    # phase 2: combine along horizontal axis
+    out = _phase2(g, -1, float(sampling[1]), metric)
+    return jnp.where(bg, 0.0, out)
+
+
+def mask_edges(
+    preds: Array,
+    target: Array,
+    crop: bool = True,
+    spacing: Optional[Sequence[float]] = None,
+) -> Tuple[Array, ...]:
+    """Edge maps of two binary masks (mask minus its erosion).
+
+    Parity: reference ``functional/segmentation/utils.py:278``. Returns
+    ``(edges_preds, edges_target)``.
+    """
+    check_if_binarized(preds)
+    check_if_binarized(target)
+    rank = preds.ndim
+    structure = generate_binary_structure(rank, 1)
+    p = preds.astype(jnp.float32)[None, None]
+    t = target.astype(jnp.float32)[None, None]
+    ep = (p - binary_erosion(p, structure)).astype(bool)[0, 0]
+    et = (t - binary_erosion(t, structure)).astype(bool)[0, 0]
+    return ep, et
+
+
+def surface_distance(
+    preds: Array,
+    target: Array,
+    distance_metric: str = "euclidean",
+    spacing: Optional[Sequence[float]] = None,
+) -> Array:
+    """Distances from each foreground element of ``preds`` to the nearest
+    foreground element of ``target``.
+
+    Parity: reference ``functional/segmentation/utils.py:336``. Returns a 1D
+    array (one distance per foreground element of ``preds``) — host-side
+    boolean gather, so call outside jit; the distance field itself is
+    device-computed.
+    """
+    if spacing is None:
+        spacing = (1.0, 1.0)
+    # distance to target's foreground == distance transform of (1 - target)
+    dt = distance_transform(1 - target.astype(jnp.int32), sampling=spacing, metric=distance_metric)
+    return jnp.asarray(np.asarray(dt)[np.asarray(preds).astype(bool)])
+
+
+# ---------------------------------------------------------------------------
+# Neighbour-code tables (normalized surface dice support)
+# ---------------------------------------------------------------------------
+
+# marching-squares segments per 2x2 neighbour code: each entry is a list of
+# (edge_a, edge_b) segments with edges indexed 0=top, 1=right, 2=bottom,
+# 3=left; endpoints at edge midpoints. Code bit order: (0,0)=8, (0,1)=4,
+# (1,0)=2, (1,1)=1 (matches the reference's neighbour-code convention).
+_MS_SEGMENTS = {
+    0: [], 15: [],
+    1: [(1, 2)], 14: [(1, 2)],
+    2: [(2, 3)], 13: [(2, 3)],
+    4: [(0, 1)], 11: [(0, 1)],
+    8: [(0, 3)], 7: [(0, 3)],
+    3: [(1, 3)], 12: [(1, 3)],
+    5: [(0, 2)], 10: [(0, 2)],
+    6: [(0, 1), (2, 3)],
+    9: [(0, 3), (1, 2)],
+}
+
+
+def table_contour_length(spacing: Tuple[float, float], device=None) -> Tuple[Array, Array]:
+    """(16,) table mapping 2x2 neighbour codes to contour length, plus the
+    2x2 convolution kernel that produces the codes.
+
+    Parity: reference ``functional/segmentation/utils.py:408``.
+    """
+    dy, dx = float(spacing[0]), float(spacing[1])
+    # edge-midpoint coordinates in physical units (y, x)
+    mid = {0: (0.0, dx / 2), 1: (dy / 2, dx), 2: (dy, dx / 2), 3: (dy / 2, 0.0)}
+    table = np.zeros(16, dtype=np.float32)
+    for code, segs in _MS_SEGMENTS.items():
+        total = 0.0
+        for a, b in segs:
+            ya, xa = mid[a]
+            yb, xb = mid[b]
+            total += float(np.hypot(ya - yb, xa - xb))
+        table[code] = total
+    kernel = jnp.asarray([[8, 4], [2, 1]], dtype=jnp.float32)
+    return jnp.asarray(table), kernel
+
+
+# standard 6-tetrahedra decomposition of the unit cube; cube corners are
+# indexed by (z, y, x) bits, corner k = (k>>2 & 1, k>>1 & 1, k & 1)
+_CUBE_TETS = (
+    (0, 5, 1, 3), (0, 5, 3, 7), (0, 5, 7, 4),
+    (0, 7, 3, 2), (0, 7, 2, 6), (0, 7, 6, 4),
+)
+
+
+def _tet_isosurface_area(vals, pts) -> float:
+    """Exact 0.5-isosurface area of the linear interpolant on one tetrahedron
+    with binary vertex values (crossings are edge midpoints)."""
+    inside = [i for i in range(4) if vals[i] > 0.5]
+    k = len(inside)
+    if k in (0, 4):
+        return 0.0
+    outside = [i for i in range(4) if i not in inside]
+    if k in (1, 3):
+        apex = inside[0] if k == 1 else outside[0]
+        others = outside if k == 1 else inside
+        p = [(pts[apex] + pts[o]) / 2.0 for o in others]
+        return float(np.linalg.norm(np.cross(p[1] - p[0], p[2] - p[0])) / 2.0)
+    a, b = inside
+    c, d = outside
+    q = [(pts[a] + pts[c]) / 2.0, (pts[a] + pts[d]) / 2.0,
+         (pts[b] + pts[d]) / 2.0, (pts[b] + pts[c]) / 2.0]
+    t1 = np.linalg.norm(np.cross(q[1] - q[0], q[2] - q[0])) / 2.0
+    t2 = np.linalg.norm(np.cross(q[2] - q[0], q[3] - q[0])) / 2.0
+    return float(t1 + t2)
+
+
+def table_surface_area(spacing: Tuple[float, float, float], device=None) -> Tuple[Array, Array]:
+    """(256,) table mapping 2x2x2 neighbour codes to isosurface area, plus the
+    2x2x2 code kernel.
+
+    Parity: reference ``functional/segmentation/utils.py:452``. Areas are
+    computed from scratch by marching tetrahedra on the unit cell (6-tet
+    decomposition, exact piecewise-linear areas) scaled by ``spacing`` — no
+    hard-coded 256-case triangle table.
+    """
+    dz, dy, dx = (float(s) for s in spacing)
+    corner_pts = [np.array([(k >> 2) & 1, (k >> 1) & 1, k & 1], dtype=np.float64) * [dz, dy, dx]
+                  for k in range(8)]
+    table = np.zeros(256, dtype=np.float32)
+    for code in range(256):
+        # bit 7-i of the code corresponds to corner i (kernel weights below)
+        vals = [(code >> (7 - k)) & 1 for k in range(8)]
+        area = 0.0
+        for tet in _CUBE_TETS:
+            area += _tet_isosurface_area([vals[i] for i in tet], [corner_pts[i] for i in tet])
+        table[code] = area
+    kernel = jnp.asarray(np.array([[[128, 64], [32, 16]], [[8, 4], [2, 1]]]), dtype=jnp.float32)
+    return jnp.asarray(table), kernel
+
+
+def get_neighbour_tables(
+    spacing: Union[Tuple[float, float], Tuple[float, float, float]], device=None
+) -> Tuple[Array, Array]:
+    """Dispatch to the 2D contour-length or 3D surface-area table.
+
+    Parity: reference ``functional/segmentation/utils.py:387``.
+    """
+    if len(spacing) == 2:
+        return table_contour_length(spacing, device)
+    if len(spacing) == 3:
+        return table_surface_area(spacing, device)
+    raise ValueError(f"Expected argument `spacing` to have length 2 or 3 but got length {len(spacing)}")

@@ -9,13 +9,18 @@ from typing import List, Sequence, Tuple
 
 import numpy as np
 
+from ... import _native
+
 
 def edit_distance_fast(a: Sequence, b: Sequence) -> int:
-    """Unit-cost Levenshtein distance via a two-row numpy DP."""
+    """Unit-cost Levenshtein distance (native C++ DP when available,
+    two-row numpy DP fallback)."""
     if len(a) == 0:
         return len(b)
     if len(b) == 0:
         return len(a)
+    if _native.NATIVE_AVAILABLE:
+        return int(_native.edit_distance_batch([a], [b])[0])
     n = len(b)
     b_arr = np.array([hash(x) for x in b], dtype=np.int64)
     idx = np.arange(n + 1, dtype=np.int64)
@@ -33,6 +38,9 @@ def edit_distance_fast(a: Sequence, b: Sequence) -> int:
 def edit_distance_with_counts(pred: Sequence, tgt: Sequence) -> Tuple[int, int, int, int]:
     """Levenshtein distance decomposed into (substitutions, deletions,
     insertions, hits) via full DP + backtrace (pred→tgt edits)."""
+    if _native.NATIVE_AVAILABLE:
+        s, d, ins, hits = _native.edit_distance_counts_batch([list(pred)], [list(tgt)])[0]
+        return int(s), int(d), int(ins), int(hits)
     m, n = len(pred), len(tgt)
     dp = np.zeros((m + 1, n + 1), dtype=np.int64)
     dp[:, 0] = np.arange(m + 1)
