@@ -1,0 +1,131 @@
+"""CLIPScore / CLIP-IQA with a tiny randomly-initialized Flax CLIP.
+
+The real pretrained checkpoints cannot be downloaded offline; a random tiny
+CLIP exercises the full metric path (processor → Flax forward → cosine →
+state accumulation) and the math is checked against a manual numpy
+computation with the same model.
+"""
+import numpy as np
+import pytest
+
+transformers = pytest.importorskip("transformers")
+
+import jax.numpy as jnp  # noqa: E402
+
+from torchmetrics_tpu.functional.multimodal import clip_image_quality_assessment, clip_score  # noqa: E402
+from torchmetrics_tpu.multimodal import CLIPImageQualityAssessment, CLIPScore  # noqa: E402
+
+IMG = 32
+
+
+class _StubProcessor:
+    """Minimal processor: chars → token ids; images → CHW float pixel_values."""
+
+    def __call__(self, text=None, images=None, return_tensors="np", padding=False):
+        out = {}
+        if text is not None:
+            ids = [[1] + [2 + (ord(c) % 90) for c in t[:14]] + [3] for t in text]
+            maxlen = max(len(i) for i in ids)
+            input_ids = np.zeros((len(ids), maxlen), dtype=np.int64)
+            mask = np.zeros((len(ids), maxlen), dtype=np.int64)
+            for r, i in enumerate(ids):
+                input_ids[r, : len(i)] = i
+                mask[r, : len(i)] = 1
+            out["input_ids"] = input_ids
+            out["attention_mask"] = mask
+        if images is not None:
+            arr = np.stack([np.asarray(i, dtype=np.float32) for i in images])
+            out["pixel_values"] = arr
+        return out
+
+
+@pytest.fixture(scope="module")
+def tiny_clip():
+    from transformers import CLIPConfig, CLIPTextConfig, CLIPVisionConfig, FlaxCLIPModel
+
+    cfg = CLIPConfig.from_text_vision_configs(
+        CLIPTextConfig(hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+                       num_attention_heads=2, max_position_embeddings=16, vocab_size=100,
+                       projection_dim=24),
+        CLIPVisionConfig(hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+                         num_attention_heads=2, image_size=IMG, patch_size=16,
+                         projection_dim=24),
+        projection_dim=24,
+    )
+    model = FlaxCLIPModel(cfg, seed=0)
+    return model, _StubProcessor()
+
+
+def test_clip_score_matches_manual(tiny_clip):
+    model, proc = tiny_clip
+    rng = np.random.RandomState(0)
+    imgs = [rng.rand(3, IMG, IMG).astype(np.float32) for _ in range(4)]
+    texts = ["a cat", "a dog", "a house", "a tree"]
+
+    val = clip_score(imgs, texts, model_name_or_path=(model, proc))
+
+    pix = np.stack(imgs)
+    img_f = np.asarray(model.get_image_features(jnp.asarray(pix)))
+    img_f = img_f / np.linalg.norm(img_f, axis=-1, keepdims=True)
+    tok = proc(text=texts)
+    txt_f = np.asarray(model.get_text_features(jnp.asarray(tok["input_ids"]),
+                                               jnp.asarray(tok["attention_mask"])))
+    txt_f = txt_f / np.linalg.norm(txt_f, axis=-1, keepdims=True)
+    expected = max(float((100 * (img_f * txt_f).sum(-1)).mean()), 0.0)
+    assert np.isclose(float(val), expected, atol=1e-4)
+
+
+def test_clip_score_class_accumulates(tiny_clip):
+    model, proc = tiny_clip
+    rng = np.random.RandomState(1)
+    metric = CLIPScore(model_name_or_path=(model, proc))
+    all_imgs, all_txts = [], []
+    for _ in range(3):
+        imgs = [rng.rand(3, IMG, IMG).astype(np.float32) for _ in range(2)]
+        txts = ["hello", "world"]
+        metric.update(imgs, txts)
+        all_imgs += imgs
+        all_txts += txts
+    batched = clip_score(all_imgs, all_txts, model_name_or_path=(model, proc))
+    assert np.isclose(float(metric.compute()), float(batched), atol=1e-4)
+
+
+def test_clip_score_image_image(tiny_clip):
+    model, proc = tiny_clip
+    rng = np.random.RandomState(2)
+    imgs = [rng.rand(3, IMG, IMG).astype(np.float32) for _ in range(2)]
+    val = clip_score(imgs, [i.copy() for i in imgs], model_name_or_path=(model, proc))
+    assert np.isclose(float(val), 100.0, atol=1e-3)  # identical images → cos=1
+
+
+def test_clip_score_mismatched_lengths(tiny_clip):
+    model, proc = tiny_clip
+    imgs = [np.random.rand(3, IMG, IMG).astype(np.float32)]
+    with pytest.raises(ValueError, match="same"):
+        clip_score(imgs, ["a", "b"], model_name_or_path=(model, proc))
+
+
+def test_clip_iqa_functional_and_class(tiny_clip):
+    model, proc = tiny_clip
+    rng = np.random.RandomState(3)
+    imgs = rng.rand(3, 3, IMG, IMG).astype(np.float32)
+
+    out = clip_image_quality_assessment(imgs, model_name_or_path=(model, proc),
+                                        prompts=("quality",))
+    assert out.shape == (3,)
+    assert ((np.asarray(out) >= 0) & (np.asarray(out) <= 1)).all()
+
+    multi = clip_image_quality_assessment(imgs, model_name_or_path=(model, proc),
+                                          prompts=("quality", ("Nice photo.", "Awful photo.")))
+    assert set(multi.keys()) == {"quality", "user_defined_0"}
+
+    metric = CLIPImageQualityAssessment(model_name_or_path=(model, proc), prompts=("quality",))
+    metric.update(imgs[:2])
+    metric.update(imgs[2:])
+    np.testing.assert_allclose(np.asarray(metric.compute()), np.asarray(out), atol=1e-5)
+
+
+def test_clip_iqa_bad_prompts(tiny_clip):
+    with pytest.raises(ValueError, match="must be one of"):
+        from torchmetrics_tpu.functional.multimodal.clip_iqa import _format_prompts
+        _format_prompts(("not_a_prompt",))

@@ -59,18 +59,36 @@ def test_distance_transform_vs_scipy(metric, sampling):
 
 
 def test_mask_edges_and_surface_distance():
-    rng = np.random.RandomState(2)
     a = np.zeros((20, 20), np.int32)
     a[5:15, 5:15] = 1
     b = np.zeros((20, 20), np.int32)
     b[6:16, 4:14] = 1
-    ea, eb = mask_edges(a, b)
+    ea, eb = mask_edges(a, b, crop=False)
     # edge = mask minus eroded mask
     exp_a = a - ndimage.binary_erosion(a, ndimage.generate_binary_structure(2, 1)).astype(np.int32)
     assert (np.asarray(ea).astype(np.int32) == exp_a).all()
     d = np.asarray(surface_distance(np.asarray(ea).astype(np.int32), np.asarray(eb).astype(np.int32)))
     assert d.shape[0] == int(exp_a.sum())
     assert (d >= 0).all() and np.isfinite(d).all()
+    # crop=True pads each spatial dim by one (reference keeps the frame)
+    ea_c, eb_c = mask_edges(a, b, crop=True)
+    assert ea_c.shape == (22, 22)
+    assert int(np.asarray(ea_c).sum()) == int(exp_a.sum())
+
+
+def test_mask_edges_spacing_four_tuple():
+    a = np.zeros((12, 12), np.int32)
+    a[3:9, 3:9] = 1
+    ep, et, ap_, at_ = mask_edges(a, a, crop=False, spacing=(1.0, 1.0))
+    # neighbour-code conv output is (H-1, W-1) for a 2x2 valid conv
+    assert ep.shape == (11, 11)
+    # contour of a 6x6 pixel square through cell midpoints: 4 straight sides
+    # of 5 units plus 4 diagonal corner cuts of length sqrt(2)/2 each
+    assert np.isclose(float(np.asarray(ap_).sum()), 20.0 + 4 * np.sqrt(0.5), atol=1e-5)
+    # empty masks with crop: zero 4-tuple
+    z = np.zeros((12, 12), np.int32)
+    out = mask_edges(z, z, crop=True, spacing=(1.0, 1.0))
+    assert len(out) == 4 and not np.asarray(out[0]).any()
 
 
 def test_contour_table_square():

@@ -136,18 +136,67 @@ def _intern(seqs: Sequence[Sequence]) -> List[np.ndarray]:
     return out
 
 
-def _pack(arrs: List[np.ndarray]) -> Tuple[np.ndarray, np.ndarray]:
+def _pack(arrs: List[np.ndarray], dtype=np.int64) -> Tuple[np.ndarray, np.ndarray]:
+    """Flatten a list of 1D arrays into (flat, prefix_offsets)."""
     off = np.zeros(len(arrs) + 1, dtype=np.int64)
     for i, a in enumerate(arrs):
         off[i + 1] = off[i] + len(a)
-    flat = np.concatenate(arrs) if arrs else np.zeros(0, dtype=np.int64)
-    return np.ascontiguousarray(flat, dtype=np.int64), off
+    flat = np.concatenate(arrs) if arrs else np.zeros(0, dtype=dtype)
+    return np.ascontiguousarray(flat, dtype=dtype), off
+
+
+def _py_edit_distance(a: np.ndarray, b: np.ndarray) -> int:
+    """Two-row numpy Levenshtein (fallback)."""
+    la, lb = len(a), len(b)
+    if la == 0 or lb == 0:
+        return la + lb
+    prev = np.arange(lb + 1, dtype=np.int64)
+    for i in range(1, la + 1):
+        cur = np.empty(lb + 1, dtype=np.int64)
+        cur[0] = i
+        sub = prev[:-1] + (b != a[i - 1])
+        best = np.minimum(prev[1:] + 1, sub)
+        for j in range(1, lb + 1):  # insertion chain
+            cur[j] = min(best[j - 1], cur[j - 1] + 1)
+        prev = cur
+    return int(prev[-1])
+
+
+def _py_edit_distance_counts(pred: np.ndarray, tgt: np.ndarray) -> Tuple[int, int, int, int]:
+    """Full-DP + backtrace (fallback)."""
+    m, n = len(pred), len(tgt)
+    dp = np.zeros((m + 1, n + 1), dtype=np.int64)
+    dp[:, 0] = np.arange(m + 1)
+    dp[0, :] = np.arange(n + 1)
+    for i in range(1, m + 1):
+        for j in range(1, n + 1):
+            cost = 0 if pred[i - 1] == tgt[j - 1] else 1
+            dp[i, j] = min(dp[i - 1, j] + 1, dp[i, j - 1] + 1, dp[i - 1, j - 1] + cost)
+    s = d = ins = hits = 0
+    i, j = m, n
+    while i > 0 or j > 0:
+        if i > 0 and j > 0 and dp[i, j] == dp[i - 1, j - 1] + (pred[i - 1] != tgt[j - 1]):
+            if pred[i - 1] == tgt[j - 1]:
+                hits += 1
+            else:
+                s += 1
+            i, j = i - 1, j - 1
+        elif i > 0 and dp[i, j] == dp[i - 1, j] + 1:
+            d += 1
+            i -= 1
+        else:
+            ins += 1
+            j -= 1
+    return s, d, ins, hits
 
 
 def edit_distance_batch(preds: Sequence[Sequence], targets: Sequence[Sequence]) -> np.ndarray:
     """Unit-cost Levenshtein distance for each (pred, target) pair."""
     assert len(preds) == len(targets)
     ids = _intern(list(preds) + list(targets))
+    if not _ensure_loaded():
+        return np.array([_py_edit_distance(p, t) for p, t in zip(ids[: len(preds)], ids[len(preds):])],
+                        dtype=np.int64)
     p_flat, p_off = _pack(ids[: len(preds)])
     t_flat, t_off = _pack(ids[len(preds):])
     out = np.empty(len(preds), dtype=np.int64)
@@ -163,6 +212,9 @@ def edit_distance_counts_batch(preds: Sequence[Sequence], targets: Sequence[Sequ
     """(batch, 4) int64 array of [substitutions, deletions, insertions, hits]."""
     assert len(preds) == len(targets)
     ids = _intern(list(preds) + list(targets))
+    if not _ensure_loaded():
+        return np.array([_py_edit_distance_counts(p, t) for p, t in zip(ids[: len(preds)], ids[len(preds):])],
+                        dtype=np.int64).reshape(len(preds), 4)
     p_flat, p_off = _pack(ids[: len(preds)])
     t_flat, t_off = _pack(ids[len(preds):])
     out = np.zeros((len(preds), 4), dtype=np.int64)
@@ -176,6 +228,11 @@ def edit_distance_counts_batch(preds: Sequence[Sequence], targets: Sequence[Sequ
 
 def linear_sum_assignment(cost: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     """Minimum-cost assignment; same contract as scipy's for n <= m."""
+    if not _ensure_loaded():
+        from scipy.optimize import linear_sum_assignment as sp_lsa
+
+        r, c = sp_lsa(cost)
+        return np.asarray(r, np.int64), np.asarray(c, np.int64)
     cost = np.ascontiguousarray(cost, dtype=np.float64)
     n, m = cost.shape
     transposed = n > m
@@ -194,10 +251,26 @@ def linear_sum_assignment(cost: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     return rows, col4row
 
 
+def _rle_to_dense_cols(counts: np.ndarray) -> np.ndarray:
+    """Column-major flat boolean expansion of RLE counts (fallback helper)."""
+    counts = np.asarray(counts, dtype=np.int64)
+    vals = np.zeros(len(counts), dtype=np.uint8)
+    vals[1::2] = 1
+    return np.repeat(vals, counts)
+
+
 def rle_encode(mask: np.ndarray) -> np.ndarray:
     """COCO column-major RLE counts (uint32) of a dense (h, w) binary mask."""
     mask = np.ascontiguousarray(mask, dtype=np.uint8)
     h, w = mask.shape
+    if not _ensure_loaded():
+        flat = (mask != 0).T.reshape(-1)  # column-major scan
+        change = np.nonzero(np.diff(flat))[0] + 1
+        bounds = np.concatenate(([0], change, [flat.size]))
+        runs = np.diff(bounds)
+        if flat.size and flat[0]:
+            runs = np.concatenate(([0], runs))
+        return runs.astype(np.uint32)
     buf = np.empty(h * w + 1, dtype=np.uint32)
     n = _lib.tm_rle_encode(_ptr(mask, ctypes.c_uint8), h, w, _ptr(buf, ctypes.c_uint32))
     return buf[:n].copy()
@@ -205,6 +278,8 @@ def rle_encode(mask: np.ndarray) -> np.ndarray:
 
 def rle_decode(counts: np.ndarray, h: int, w: int) -> np.ndarray:
     counts = np.ascontiguousarray(counts, dtype=np.uint32)
+    if not _ensure_loaded():
+        return _rle_to_dense_cols(counts).reshape(w, h).T.copy()
     out = np.zeros((h, w), dtype=np.uint8)
     _lib.tm_rle_decode(_ptr(counts, ctypes.c_uint32), len(counts), h, w,
                        _ptr(out, ctypes.c_uint8))
@@ -212,23 +287,26 @@ def rle_decode(counts: np.ndarray, h: int, w: int) -> np.ndarray:
 
 
 def rle_area(counts: np.ndarray) -> int:
+    if not _ensure_loaded():
+        return int(np.asarray(counts, dtype=np.int64)[1::2].sum())
     counts = np.ascontiguousarray(counts, dtype=np.uint32)
     return int(_lib.tm_rle_area(_ptr(counts, ctypes.c_uint32), len(counts)))
 
 
 def rle_iou(dt: List[np.ndarray], gt: List[np.ndarray], iscrowd: np.ndarray) -> np.ndarray:
-    """Pairwise IoU between RLE masks without decoding (crowd semantics)."""
+    """Pairwise IoU between RLE masks of one image extent (crowd semantics)."""
     if not dt or not gt:
         return np.zeros((len(dt), len(gt)), dtype=np.float64)
-    dt_flat = np.concatenate([np.asarray(c, np.uint32) for c in dt]).astype(np.uint32)
-    gt_flat = np.concatenate([np.asarray(c, np.uint32) for c in gt]).astype(np.uint32)
-    dt_off = np.zeros(len(dt) + 1, dtype=np.int64)
-    gt_off = np.zeros(len(gt) + 1, dtype=np.int64)
-    for i, c in enumerate(dt):
-        dt_off[i + 1] = dt_off[i] + len(c)
-    for j, c in enumerate(gt):
-        gt_off[j + 1] = gt_off[j] + len(c)
     crowd = np.ascontiguousarray(iscrowd, dtype=np.uint8)
+    if not _ensure_loaded():
+        dtm = np.stack([_rle_to_dense_cols(c) for c in dt]).astype(np.float64)
+        gtm = np.stack([_rle_to_dense_cols(c) for c in gt]).astype(np.float64)
+        inter = dtm @ gtm.T
+        a_dt, a_gt = dtm.sum(1), gtm.sum(1)
+        union = np.where(crowd[None, :].astype(bool), a_dt[:, None], a_dt[:, None] + a_gt[None, :] - inter)
+        return np.where(union > 0, inter / np.where(union > 0, union, 1.0), 0.0)
+    dt_flat, dt_off = _pack([np.asarray(c) for c in dt], dtype=np.uint32)
+    gt_flat, gt_off = _pack([np.asarray(c) for c in gt], dtype=np.uint32)
     out = np.empty((len(dt), len(gt)), dtype=np.float64)
     _lib.tm_rle_iou(_ptr(dt_flat, ctypes.c_uint32), _ptr(dt_off, ctypes.c_int64), len(dt),
                     _ptr(gt_flat, ctypes.c_uint32), _ptr(gt_off, ctypes.c_int64), len(gt),
@@ -241,6 +319,15 @@ def box_iou(dt: np.ndarray, gt: np.ndarray, iscrowd: np.ndarray) -> np.ndarray:
     dt = np.ascontiguousarray(dt, dtype=np.float64).reshape(-1, 4)
     gt = np.ascontiguousarray(gt, dtype=np.float64).reshape(-1, 4)
     crowd = np.ascontiguousarray(iscrowd, dtype=np.uint8)
+    if not _ensure_loaded():
+        lt = np.maximum(dt[:, None, :2], gt[None, :, :2])
+        rb = np.minimum(dt[:, None, 2:], gt[None, :, 2:])
+        wh = np.clip(rb - lt, 0.0, None)
+        inter = wh[..., 0] * wh[..., 1]
+        a_dt = (dt[:, 2] - dt[:, 0]) * (dt[:, 3] - dt[:, 1])
+        a_gt = (gt[:, 2] - gt[:, 0]) * (gt[:, 3] - gt[:, 1])
+        union = np.where(crowd[None, :].astype(bool), a_dt[:, None], a_dt[:, None] + a_gt[None, :] - inter)
+        return np.where(union > 0, inter / np.where(union > 0, union, 1.0), 0.0)
     out = np.empty((len(dt), len(gt)), dtype=np.float64)
     if len(dt) and len(gt):
         _lib.tm_box_iou(_ptr(dt, ctypes.c_double), len(dt), _ptr(gt, ctypes.c_double),
@@ -264,6 +351,26 @@ def coco_match(ious: np.ndarray, gt_ignore: np.ndarray, gt_crowd: np.ndarray,
     dt_m = np.zeros((T, n_dt), dtype=np.int64)
     gt_m = np.zeros((T, n_gt), dtype=np.int64)
     dt_ig = np.zeros((T, n_dt), dtype=np.uint8)
+    if n_dt and n_gt and not _ensure_loaded():
+        for t in range(T):
+            for d in range(n_dt):
+                iou = min(iou_thrs[t], 1 - 1e-10)
+                match = -1
+                for g in range(n_gt):
+                    if gt_m[t, g] > 0 and not gt_crowd[g]:
+                        continue
+                    if match > -1 and not gt_ignore[match] and gt_ignore[g]:
+                        break
+                    if ious[d, g] < iou:
+                        continue
+                    iou = ious[d, g]
+                    match = g
+                if match == -1:
+                    continue
+                dt_ig[t, d] = gt_ignore[match]
+                dt_m[t, d] = match + 1
+                gt_m[t, match] = d + 1
+        return dt_m, gt_m, dt_ig
     if n_dt and n_gt:
         _lib.tm_coco_match(_ptr(ious, ctypes.c_double), n_dt, n_gt,
                            _ptr(gt_ignore, ctypes.c_uint8), _ptr(gt_crowd, ctypes.c_uint8),

@@ -3,7 +3,20 @@
 ``torchmetrics_tpu.functional.<domain>``; the pairwise family is re-exported
 flat (it has no modular classes, reference §2.8).
 """
-from . import audio, classification, clustering, detection, image, nominal, pairwise, regression, retrieval, text
+from . import (
+    audio,
+    classification,
+    clustering,
+    detection,
+    image,
+    multimodal,
+    nominal,
+    pairwise,
+    regression,
+    retrieval,
+    segmentation,
+    text,
+)
 from .pairwise import (
     pairwise_cosine_similarity,
     pairwise_euclidean_distance,
@@ -18,6 +31,7 @@ __all__ = [
     "clustering",
     "detection",
     "image",
+    "multimodal",
     "nominal",
     "pairwise",
     "pairwise_cosine_similarity",
@@ -27,5 +41,6 @@ __all__ = [
     "pairwise_minkowski_distance",
     "regression",
     "retrieval",
+    "segmentation",
     "text",
 ]

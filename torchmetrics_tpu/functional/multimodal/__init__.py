@@ -1,0 +1,5 @@
+"""Multimodal functional metrics (SURVEY.md §2.8)."""
+from .clip_iqa import clip_image_quality_assessment
+from .clip_score import clip_score
+
+__all__ = ["clip_image_quality_assessment", "clip_score"]

@@ -1,0 +1,112 @@
+"""CLIP Image Quality Assessment (CLIP-IQA).
+
+Parity target: reference ``functional/multimodal/clip_iqa.py`` (333 LoC):
+images are scored against learned prompt *pairs* (e.g. "Good photo." /
+"Bad photo."); the per-image score for a prompt pair is the softmax over the
+two cosine logits, taking the positive prompt's probability.
+
+TPU-first: anchor (text) embeddings are computed once at metric setup and
+cached as a fixed (2P, D) array; per-batch work is ONE image-encoder forward
++ a (N, D) @ (D, 2P) matmul + softmax over pairs — all inside jit on device.
+"""
+from typing import Any, Dict, List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .clip_score import _image_features, _resolve_model, _text_features
+
+Array = jax.Array
+
+# built-in prompt pairs, identical to the reference's _PROMPTS table
+# (``functional/multimodal/clip_iqa.py:43``)
+_PROMPTS: Dict[str, Tuple[str, str]] = {
+    "quality": ("Good photo.", "Bad photo."),
+    "brightness": ("Bright photo.", "Dark photo."),
+    "noisiness": ("Clean photo.", "Noisy photo."),
+    "colorfullness": ("Colorful photo.", "Dull photo."),
+    "sharpness": ("Sharp photo.", "Blurry photo."),
+    "contrast": ("High contrast photo.", "Low contrast photo."),
+    "complexity": ("Complex photo.", "Simple photo."),
+    "natural": ("Natural photo.", "Synthetic photo."),
+    "happy": ("Happy photo.", "Sad photo."),
+    "scary": ("Scary photo.", "Peaceful photo."),
+    "new": ("New photo.", "Old photo."),
+    "warm": ("Warm photo.", "Cold photo."),
+    "real": ("Real photo.", "Abstract photo."),
+    "beautiful": ("Beautiful photo.", "Ugly photo."),
+    "lonely": ("Lonely photo.", "Sociable photo."),
+    "relaxing": ("Relaxing photo.", "Stressful photo."),
+}
+
+
+def _format_prompts(prompts: Tuple[Union[str, Tuple[str, str]], ...]) -> Tuple[List[str], List[str]]:
+    """Expand prompt keywords / custom pairs into a flat prompt list + names.
+
+    Parity: reference ``_clip_iqa_format_prompts`` (``clip_iqa.py:92``).
+    """
+    if not isinstance(prompts, tuple):
+        raise ValueError("Argument `prompts` must be a tuple containing strings or tuples of strings")
+    names: List[str] = []
+    flat: List[str] = []
+    count = 0
+    for p in prompts:
+        if isinstance(p, str):
+            if p not in _PROMPTS:
+                raise ValueError(
+                    f"All elements of `prompts` must be one of {list(_PROMPTS.keys())} "
+                    f"if not custom tuple prompts, got {p}."
+                )
+            names.append(p)
+            flat.extend(_PROMPTS[p])
+        elif isinstance(p, tuple):
+            if len(p) != 2 or not all(isinstance(s, str) for s in p):
+                raise ValueError("If a tuple is provided in argument `prompts`, it must be of length 2")
+            names.append(f"user_defined_{count}")
+            flat.extend(p)
+            count += 1
+        else:
+            raise ValueError("Argument `prompts` must be a tuple containing strings or tuples of strings")
+    return flat, names
+
+
+def _clip_iqa_anchors(prompts_flat: List[str], model: Any, processor: Any) -> Array:
+    """(2P, D) normalized anchor embeddings, computed once."""
+    return _text_features(prompts_flat, model, processor)
+
+
+def _clip_iqa_update(images, anchors: Array, model: Any, processor: Any,
+                     data_range: float = 1.0) -> Array:
+    """(N, P) positive-prompt probabilities per image.
+
+    Parity: reference ``_clip_iqa_update`` + ``_clip_iqa_compute``.
+    """
+    imgs = np.asarray(images, dtype=np.float32) / float(data_range)
+    feats = _image_features(list(imgs), model, processor)  # (N, D) normalized
+    logits = 100.0 * feats @ anchors.T  # (N, 2P)
+    pairs = logits.reshape(feats.shape[0], -1, 2)
+    probs = jax.nn.softmax(pairs, axis=-1)[..., 0]  # (N, P)
+    return probs
+
+
+def clip_image_quality_assessment(
+    images,
+    model_name_or_path: Union[str, Tuple[Any, Any]] = "clip_iqa",
+    data_range: float = 1.0,
+    prompts: Tuple[Union[str, Tuple[str, str]], ...] = ("quality",),
+) -> Union[Array, Dict[str, Array]]:
+    """One-shot CLIP-IQA. Single prompt → (N,) array; multiple → dict by name.
+
+    Parity: reference ``functional/multimodal/clip_iqa.py:clip_image_quality_assessment``.
+    """
+    flat, names = _format_prompts(prompts)
+    model, processor = _resolve_model(
+        model_name_or_path if model_name_or_path != "clip_iqa" else "openai/clip-vit-base-patch16",
+        "clip_image_quality_assessment",
+    )
+    anchors = _clip_iqa_anchors(flat, model, processor)
+    probs = _clip_iqa_update(images, anchors, model, processor, data_range)
+    if len(names) == 1:
+        return probs[:, 0]
+    return {name: probs[:, i] for i, name in enumerate(names)}

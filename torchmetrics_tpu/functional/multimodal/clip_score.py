@@ -1,0 +1,116 @@
+"""CLIPScore — CLIP image/text (or image/image, text/text) alignment.
+
+Parity target: reference ``functional/multimodal/clip_score.py:90``
+(``_clip_score_update``): score = 100 * cosine(img_emb, txt_emb) per pair,
+summed; ``CLIPScore.compute`` clamps the mean at 0
+(``multimodal/clip_score.py:261-263``).
+
+TPU-first: the CLIP forward runs as a jitted Flax apply on device; only the
+host-side tokenize/resize (the processor) stays in Python. The model is
+injectable so the metric works offline: pass either a HF name/path (resolved
+via ``transformers`` Flax classes) or a ``(model, processor)`` pair where
+``model`` exposes ``get_image_features``/``get_text_features`` and
+``processor(text=..., images=...)`` returns numpy arrays.
+"""
+from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...utils.imports import _TRANSFORMERS_AVAILABLE, ModuleNotFoundHint
+from ...utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+_DEFAULT_MODEL = "openai/clip-vit-large-patch14"
+
+
+def _resolve_model(model_name_or_path: Union[str, Tuple[Any, Any]], metric_name: str) -> Tuple[Any, Any]:
+    """Resolve to a (model, processor) pair with Flax CLIP semantics."""
+    if isinstance(model_name_or_path, tuple):
+        model, processor = model_name_or_path
+        return model, processor
+    if not _TRANSFORMERS_AVAILABLE:
+        raise ModuleNotFoundHint(metric_name, "transformers", "multimodal")
+    from transformers import AutoProcessor, FlaxCLIPModel
+
+    model = FlaxCLIPModel.from_pretrained(model_name_or_path)
+    processor = AutoProcessor.from_pretrained(model_name_or_path)
+    return model, processor
+
+
+def _image_features(images, model: Any, processor: Any) -> Array:
+    """L2-normalized image embeddings. Parity: ``clip_score.py:_get_image_feature``."""
+    if not isinstance(images, (list, tuple)):
+        images = [images] if np.asarray(images).ndim == 3 else list(np.asarray(images))
+    if not all(np.asarray(i).ndim == 3 for i in images):
+        raise ValueError("Expected all images to be 3d but found image that has either more or less")
+    processed = processor(images=[np.asarray(i) for i in images], return_tensors="np")
+    feats = model.get_image_features(jnp.asarray(processed["pixel_values"]))
+    return feats / jnp.linalg.norm(feats, axis=-1, keepdims=True)
+
+
+def _text_features(text, model: Any, processor: Any) -> Array:
+    """L2-normalized text embeddings. Parity: ``clip_score.py:_get_text_feature``."""
+    if not isinstance(text, (list, tuple)):
+        text = [text]
+    processed = processor(text=list(text), return_tensors="np", padding=True)
+    input_ids = np.asarray(processed["input_ids"])
+    mask = np.asarray(processed["attention_mask"])
+    max_pos = getattr(getattr(getattr(model, "config", None), "text_config", None), "max_position_embeddings", None)
+    if max_pos is not None and input_ids.shape[-1] > max_pos:
+        rank_zero_warn(
+            f"Encountered caption longer than max_position_embeddings={max_pos}. Will truncate captions to this "
+            "length. If longer captions are needed, initialize with a model that supports longer sequences",
+            UserWarning,
+        )
+        input_ids = input_ids[..., :max_pos]
+        mask = mask[..., :max_pos]
+    feats = model.get_text_features(jnp.asarray(input_ids), jnp.asarray(mask))
+    return feats / jnp.linalg.norm(feats, axis=-1, keepdims=True)
+
+
+def _detect_modality(x) -> str:
+    """'image' for arrays of pixels, 'text' for strings."""
+    if isinstance(x, str):
+        return "text"
+    if isinstance(x, (list, tuple)):
+        if len(x) == 0:
+            raise ValueError("Source and target cannot be empty lists")
+        return "text" if isinstance(x[0], str) else "image"
+    return "image"
+
+
+def _clip_score_update(
+    source,
+    target,
+    model: Any,
+    processor: Any,
+) -> Tuple[Array, int]:
+    """Sum of 100*cosine over pairs + pair count.
+
+    Parity: reference ``functional/multimodal/clip_score.py:90`` extended to
+    image-image / text-text pairs (SURVEY.md §2.8).
+    """
+    src_mod, tgt_mod = _detect_modality(source), _detect_modality(target)
+    src_feats = _image_features(source, model, processor) if src_mod == "image" else _text_features(source, model, processor)
+    tgt_feats = _image_features(target, model, processor) if tgt_mod == "image" else _text_features(target, model, processor)
+    if src_feats.shape[0] != tgt_feats.shape[0]:
+        raise ValueError(
+            f"Expected the number of source and target examples to be the same but got {src_feats.shape[0]} "
+            f"and {tgt_feats.shape[0]}"
+        )
+    score = 100.0 * jnp.sum(src_feats * tgt_feats, axis=-1)
+    return jnp.sum(score), src_feats.shape[0]
+
+
+def clip_score(
+    source,
+    target,
+    model_name_or_path: Union[str, Tuple[Any, Any]] = _DEFAULT_MODEL,
+) -> Array:
+    """One-shot CLIPScore. Parity: reference ``functional/multimodal/clip_score.py:clip_score``."""
+    model, processor = _resolve_model(model_name_or_path, "clip_score")
+    score_sum, n = _clip_score_update(source, target, model, processor)
+    return jnp.maximum(score_sum / n, 0.0)

@@ -100,15 +100,20 @@ def binary_erosion(image: Array, structure: Optional[Array] = None, border_value
 
 
 def binary_dilation(image: Array, structure: Optional[Array] = None) -> Array:
-    """Binary dilation — companion of :func:`binary_erosion`."""
+    """Binary dilation — companion of :func:`binary_erosion`.
+
+    The structuring element is mirrored (scipy semantics: dilation reflects
+    the structure about its center before sweeping).
+    """
     if image.ndim not in (4, 5):
         raise ValueError(f"Expected argument `image` to be of rank 4 or 5 but got rank {image.ndim}")
     check_if_binarized(image)
     rank = image.ndim - 2
     if structure is None:
         structure = generate_binary_structure(rank, 1)
+    mirrored = jnp.asarray(np.flip(np.asarray(structure)).copy())
     x = image.astype(jnp.float32)
-    return _reduce_window_bool(x, structure, 0.0, jnp.maximum).astype(image.dtype)
+    return _reduce_window_bool(x, mirrored, 0.0, jnp.maximum).astype(image.dtype)
 
 
 def _dt_1d_l1(bg: Array, axis: int, spacing: float) -> Array:
@@ -163,6 +168,20 @@ def distance_transform(
         raise ValueError(
             f"Expected argument `metric` to be one of 'euclidean', 'chessboard', 'taxicab' but got {metric}"
         )
+    if engine not in ("xla", "scipy"):
+        raise ValueError(f"Expected argument `engine` to be one of 'xla', 'scipy' but got {engine}")
+    if engine == "scipy":
+        # memory-lean host path (the reference's alternative engine)
+        from scipy import ndimage
+
+        xs = np.asarray(x)
+        if metric == "euclidean":
+            return jnp.asarray(ndimage.distance_transform_edt(xs, sampling=sampling))
+        return jnp.asarray(
+            ndimage.distance_transform_cdt(xs, metric="chessboard" if metric == "chessboard" else "taxicab").astype(
+                np.float32
+            )
+        )
     if sampling is None:
         sampling = (1.0, 1.0)
     if len(sampling) != 2:
@@ -182,20 +201,54 @@ def mask_edges(
     crop: bool = True,
     spacing: Optional[Sequence[float]] = None,
 ) -> Tuple[Array, ...]:
-    """Edge maps of two binary masks (mask minus its erosion).
+    """Edges of binary segmentation masks.
 
-    Parity: reference ``functional/segmentation/utils.py:278``. Returns
-    ``(edges_preds, edges_target)``.
+    Parity: reference ``functional/segmentation/utils.py:278``. Without
+    ``spacing``: edge = mask XOR eroded mask, returns ``(edges_preds,
+    edges_target)``. With ``spacing``: neighbour-code convolution against the
+    contour-length (2D) / surface-area (3D) table, returns the 4-tuple
+    ``(edges_preds, edges_target, areas_preds, areas_target)``. ``crop`` pads
+    each spatial dim by 1 (reference keeps the padded frame).
     """
+    if preds.shape != target.shape:
+        raise ValueError(f"Expected `preds` and `target` to have the same shape, got {preds.shape} and {target.shape}")
+    if preds.ndim not in (2, 3):
+        raise ValueError(f"Expected argument `preds` to be of rank 2 or 3 but got rank `{preds.ndim}`.")
     check_if_binarized(preds)
     check_if_binarized(target)
-    rank = preds.ndim
-    structure = generate_binary_structure(rank, 1)
-    p = preds.astype(jnp.float32)[None, None]
-    t = target.astype(jnp.float32)[None, None]
-    ep = (p - binary_erosion(p, structure)).astype(bool)[0, 0]
-    et = (t - binary_erosion(t, structure)).astype(bool)[0, 0]
-    return ep, et
+    preds = preds.astype(jnp.int32)
+    target = target.astype(jnp.int32)
+
+    if crop:
+        if not bool(np.asarray(preds | target).any()):
+            z = jnp.zeros_like(preds)
+            return (z, jnp.zeros_like(target), z, jnp.zeros_like(target))
+        pad_width = [(1, 1)] * preds.ndim
+        preds = jnp.pad(preds, pad_width)
+        target = jnp.pad(target, pad_width)
+
+    if spacing is None:
+        structure = generate_binary_structure(preds.ndim, 1)
+        p = preds.astype(jnp.float32)[None, None]
+        t = target.astype(jnp.float32)[None, None]
+        ep = jnp.logical_xor(binary_erosion(p, structure)[0, 0].astype(bool), preds.astype(bool))
+        et = jnp.logical_xor(binary_erosion(t, structure)[0, 0].astype(bool), target.astype(bool))
+        return ep, et
+
+    if len(spacing) != preds.ndim:
+        raise ValueError(f"Expected `spacing` of length {preds.ndim} to match the mask rank, got {len(spacing)}")
+    table, kernel = get_neighbour_tables(tuple(spacing))
+    ndim = preds.ndim
+    vol = jnp.stack([preds, target]).astype(jnp.float32)[:, None]  # (2, 1, *spatial)
+    dn = lax.conv_dimension_numbers(vol.shape, (1, 1) + kernel.shape,
+                                    ("NCHW", "OIHW", "NCHW") if ndim == 2 else ("NCDHW", "OIDHW", "NCDHW"))
+    codes = lax.conv_general_dilated(vol, kernel[None, None], (1,) * ndim, "VALID",
+                                     dimension_numbers=dn)[:, 0]
+    codes_i = codes.astype(jnp.int32)
+    all_ones = len(np.asarray(table)) - 1
+    edges = (codes_i != 0) & (codes_i != all_ones)
+    areas = jnp.take(table, codes_i)
+    return edges[0], edges[1], areas[0], areas[1]
 
 
 def surface_distance(

@@ -47,8 +47,10 @@ def _resolve_feature_extractor(feature: Union[int, Callable], metric_name: str) 
     if isinstance(feature, int):
         raise ModuleNotFoundError(
             f"Metric `{metric_name}` with `feature={feature}` requires the pretrained FID-InceptionV3 weights, "
-            "which are not available in this offline environment. Pass a callable feature extractor instead "
-            "(any function mapping (N, C, H, W) images to (N, D) features, e.g. a Flax module apply)."
+            "which are not available in this offline environment. Build the architecture with "
+            "`torchmetrics_tpu.models.make_fid_inception(feature)` and load converted weights via "
+            "`torchmetrics_tpu.models.convert_torch_state_dict(...)`, or pass any callable mapping "
+            "(N, C, H, W) images to (N, D) features as `feature=`."
         )
     raise TypeError(f"Got unknown input to argument `feature`: {feature}")
 

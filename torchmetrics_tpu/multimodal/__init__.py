@@ -1,0 +1,5 @@
+"""Multimodal domain (SURVEY.md §2.8): CLIPScore, CLIP-IQA."""
+from .clip_iqa import CLIPImageQualityAssessment
+from .clip_score import CLIPScore
+
+__all__ = ["CLIPImageQualityAssessment", "CLIPScore"]

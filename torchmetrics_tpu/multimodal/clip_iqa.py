@@ -1,0 +1,52 @@
+"""Modular CLIP-IQA.
+
+Parity: reference ``multimodal/clip_iqa.py`` (262 LoC): per-image
+positive-prompt probabilities accumulated as ``"cat"`` list state; compute
+returns the per-image scores (single prompt → (N,), multiple → dict).
+"""
+from typing import Any, Dict, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..functional.multimodal.clip_iqa import _clip_iqa_anchors, _clip_iqa_update, _format_prompts
+from ..functional.multimodal.clip_score import _resolve_model
+from ..metric import Metric
+from ..utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class CLIPImageQualityAssessment(Metric):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    feature_network = "model"
+    jittable = False
+
+    def __init__(
+        self,
+        model_name_or_path: Union[str, Tuple[Any, Any]] = "openai/clip-vit-base-patch16",
+        data_range: float = 1.0,
+        prompts: Tuple[Union[str, Tuple[str, str]], ...] = ("quality",),
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self._prompts_flat, self.prompts_names = _format_prompts(prompts)
+        self.data_range = float(data_range)
+        self.model, self.processor = _resolve_model(model_name_or_path, "CLIPImageQualityAssessment")
+        self.anchors = _clip_iqa_anchors(self._prompts_flat, self.model, self.processor)
+        self.add_state("probs_list", [], dist_reduce_fx="cat")
+
+    def update(self, images) -> None:
+        """Accumulate per-image positive-prompt probabilities."""
+        probs = _clip_iqa_update(images, self.anchors, self.model, self.processor, self.data_range)
+        self.probs_list.append(probs)
+
+    def compute(self) -> Union[Array, Dict[str, Array]]:
+        probs = dim_zero_cat(self.probs_list)  # (N, P)
+        if len(self.prompts_names) == 1:
+            return probs[:, 0].squeeze()
+        return {name: probs[:, i] for i, name in enumerate(self.prompts_names)}

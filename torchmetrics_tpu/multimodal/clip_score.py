@@ -1,0 +1,41 @@
+"""Modular CLIPScore.
+
+Parity: reference ``multimodal/clip_score.py`` (303 LoC): ``score``/
+``n_samples`` sum states (``:130-131``), compute = clamp(score/n, min=0)
+(``:261-263``).
+"""
+from typing import Any, Tuple, Union
+
+import jax.numpy as jnp
+
+from ..functional.multimodal.clip_score import _DEFAULT_MODEL, _clip_score_update, _resolve_model
+from ..metric import Metric
+
+
+class CLIPScore(Metric):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 100.0
+    feature_network = "model"
+    jittable = False  # host tokenizer/processor in update
+
+    def __init__(
+        self,
+        model_name_or_path: Union[str, Tuple[Any, Any]] = _DEFAULT_MODEL,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.model, self.processor = _resolve_model(model_name_or_path, "CLIPScore")
+        self.add_state("score", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("n_samples", jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, source, target) -> None:
+        """Accumulate 100*cosine similarity over (source, target) pairs."""
+        score_sum, n = _clip_score_update(source, target, self.model, self.processor)
+        self.score = self.score + score_sum
+        self.n_samples = self.n_samples + n
+
+    def compute(self):
+        return jnp.maximum(self.score / self.n_samples, 0.0)
