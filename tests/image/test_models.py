@@ -1,0 +1,99 @@
+"""Flax feature-extractor architectures (models/): shapes, param counts,
+torch->flax weight-converter round-trip."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.models import (
+    FIDInceptionV3,
+    convert_torch_state_dict,
+    make_fid_inception,
+    make_lpips,
+)
+
+
+def test_inception_taps_and_param_count():
+    mod, params, _ = make_fid_inception((64, 192, 768, 2048, "logits_unbiased"))
+    imgs = jnp.asarray(np.random.RandomState(0).rand(2, 3, 48, 48) * 255, jnp.float32)
+    out = mod.apply(params, imgs)
+    assert out[64].shape == (2, 64)
+    assert out[192].shape == (2, 192)
+    assert out[768].shape == (2, 768)
+    assert out[2048].shape == (2, 2048)
+    assert out["logits_unbiased"].shape == (2, 1008)
+    # the FID-InceptionV3 with a 1008-way head has ~23.85M parameters
+    n_params = sum(x.size for x in jax.tree.leaves(params["params"]))
+    assert 23_500_000 < n_params < 24_200_000
+
+
+def _fake_torch_state_dict(flax_tree):
+    """Invert the converter's mapping to build a synthetic torch state_dict."""
+    sd = {}
+
+    def walk(node, path):
+        if isinstance(node, dict) and "kernel" in node and path[-1] == "conv":
+            sd[".".join(path) + ".weight"] = np.transpose(np.asarray(node["kernel"]), (3, 2, 0, 1))
+            return
+        if isinstance(node, dict) and path and path[-1] == "bn":
+            sd[".".join(path) + ".weight"] = np.asarray(node["scale"])
+            sd[".".join(path) + ".bias"] = np.asarray(node["bias"])
+            return
+        if isinstance(node, dict) and "kernel" in node and path[-1] == "fc":
+            sd["fc.weight"] = np.asarray(node["kernel"]).T
+            return
+        for k, v in node.items():
+            walk(v, path + [k])
+
+    walk(flax_tree["params"], [])
+
+    def walk_stats(node, path):
+        if isinstance(node, dict) and "mean" in node and "var" in node:
+            sd[".".join(path) + ".running_mean"] = np.asarray(node["mean"])
+            sd[".".join(path) + ".running_var"] = np.asarray(node["var"])
+            return
+        for k, v in node.items():
+            walk_stats(v, path + [k])
+
+    walk_stats(flax_tree["batch_stats"], [])
+    return sd
+
+
+def test_weight_converter_round_trip():
+    mod, params, _ = make_fid_inception(2048)
+    sd = _fake_torch_state_dict(params)
+    converted = convert_torch_state_dict(sd)
+    flat_a = dict(jax.tree_util.tree_flatten_with_path(params)[0])
+    flat_b = dict(jax.tree_util.tree_flatten_with_path(converted)[0])
+    assert set(map(str, flat_a)) == set(map(str, flat_b))
+    for k in flat_a:
+        np.testing.assert_allclose(np.asarray(flat_a[k]), np.asarray(flat_b[k]), atol=0)
+    # converted params drive the forward identically
+    imgs = jnp.asarray(np.random.RandomState(1).rand(1, 3, 32, 32) * 255, jnp.float32)
+    np.testing.assert_allclose(np.asarray(mod.apply(params, imgs)[2048]),
+                               np.asarray(mod.apply(converted, imgs)[2048]), rtol=1e-6)
+
+
+@pytest.mark.parametrize("net_type", ["alex", "vgg"])
+def test_lpips_properties(net_type):
+    _, _, dist = make_lpips(net_type)
+    x = jnp.asarray(np.random.RandomState(2).rand(2, 3, 64, 64) * 2 - 1, jnp.float32)
+    y = jnp.asarray(np.random.RandomState(3).rand(2, 3, 64, 64) * 2 - 1, jnp.float32)
+    d_self = np.asarray(dist(x, x))
+    d_cross = np.asarray(dist(x, y))
+    np.testing.assert_allclose(d_self, 0.0, atol=1e-6)
+    assert (np.abs(d_cross) > 1e-8).all()
+    # symmetric up to numerics
+    np.testing.assert_allclose(np.asarray(dist(y, x)), d_cross, atol=1e-5)
+
+
+def test_lpips_metric_integration():
+    from torchmetrics_tpu.image.lpip import LearnedPerceptualImagePatchSimilarity
+
+    _, _, dist = make_lpips("alex")
+    m = LearnedPerceptualImagePatchSimilarity(net_type=dist)
+    x = jnp.asarray(np.random.RandomState(4).rand(4, 3, 32, 32) * 2 - 1, jnp.float32)
+    y = jnp.asarray(np.random.RandomState(5).rand(4, 3, 32, 32) * 2 - 1, jnp.float32)
+    m.update(x, y)
+    val = float(m.compute())
+    assert np.isclose(val, float(np.asarray(dist(x, y)).mean()), atol=1e-5)

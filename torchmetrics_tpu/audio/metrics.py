@@ -9,12 +9,10 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 
-from ..functional.audio.gated import (
-    perceptual_evaluation_speech_quality,
-    short_time_objective_intelligibility,
-    speech_reverberation_modulation_energy_ratio,
-)
+from ..functional.audio.gated import perceptual_evaluation_speech_quality
 from ..functional.audio.pit import permutation_invariant_training
+from ..functional.audio.srmr import speech_reverberation_modulation_energy_ratio
+from ..functional.audio.stoi import short_time_objective_intelligibility
 from ..functional.audio.sdr import (
     signal_distortion_ratio,
     source_aggregated_signal_distortion_ratio,
@@ -200,7 +198,8 @@ class PerceptualEvaluationSpeechQuality(_MeanAudioMetric):
 
 
 class ShortTimeObjectiveIntelligibility(_MeanAudioMetric):
-    """Parity: reference ``audio/stoi.py`` (gated pystoi backend)."""
+    """Parity: reference ``audio/stoi.py``. First-party implementation
+    (``functional/audio/stoi.py``) — no pystoi dependency."""
 
     is_differentiable = False
     higher_is_better = True
@@ -210,12 +209,6 @@ class ShortTimeObjectiveIntelligibility(_MeanAudioMetric):
 
     def __init__(self, fs: int, extended: bool = False, **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        from ..functional.audio.gated import _PYSTOI_AVAILABLE
-
-        if not _PYSTOI_AVAILABLE:
-            raise ModuleNotFoundError(
-                "STOI metric requires that `pystoi` is installed. Install as `pip install pystoi`."
-            )
         self.fs = fs
         self.extended = extended
 
@@ -224,7 +217,8 @@ class ShortTimeObjectiveIntelligibility(_MeanAudioMetric):
 
 
 class SpeechReverberationModulationEnergyRatio(_MeanAudioMetric):
-    """Parity: reference ``audio/srmr.py`` (gated gammatone backend)."""
+    """Parity: reference ``audio/srmr.py``. First-party implementation
+    (``functional/audio/srmr.py``) — no gammatone/torchaudio dependency."""
 
     is_differentiable = False
     higher_is_better = True
@@ -232,12 +226,6 @@ class SpeechReverberationModulationEnergyRatio(_MeanAudioMetric):
 
     def __init__(self, fs: int, **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        from ..functional.audio.gated import _GAMMATONE_AVAILABLE, _TORCHAUDIO_AVAILABLE
-
-        if not (_GAMMATONE_AVAILABLE and _TORCHAUDIO_AVAILABLE):
-            raise ModuleNotFoundError(
-                "SRMR metric requires that `gammatone` and `torchaudio` are installed."
-            )
         self.fs = fs
 
     def update(self, preds: Array) -> None:  # SRMR is reference-free

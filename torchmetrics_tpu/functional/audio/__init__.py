@@ -1,10 +1,8 @@
 """Functional audio kernels (L3). Parity: reference ``functional/audio/``."""
-from .gated import (
-    perceptual_evaluation_speech_quality,
-    short_time_objective_intelligibility,
-    speech_reverberation_modulation_energy_ratio,
-)
+from .gated import perceptual_evaluation_speech_quality
 from .pit import permutation_invariant_training, pit_permutate
+from .srmr import speech_reverberation_modulation_energy_ratio
+from .stoi import short_time_objective_intelligibility
 from .sdr import (
     signal_distortion_ratio,
     source_aggregated_signal_distortion_ratio,

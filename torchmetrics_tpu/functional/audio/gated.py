@@ -1,11 +1,12 @@
-"""Gated audio metrics: PESQ / STOI / SRMR.
+"""Gated audio metric: PESQ.
 
-Parity targets: reference ``functional/audio/{pesq,stoi,srmr}.py`` — all
-three wrap host-side third-party backends (ITU P.862 C library, pystoi
-numpy, gammatone filterbank). The same gating pattern is kept: the
-functions import their backend lazily and raise a ``ModuleNotFoundError``
-with an install hint when absent (reference ``utilities/imports.py``
-RequirementCache behavior, SURVEY.md §2.11).
+Parity target: reference ``functional/audio/pesq.py`` — wraps the ITU
+P.862 C library on host (the reference does the same; a from-scratch
+P.862 port is out of scope). The reference gating pattern is kept: the
+backend imports lazily and raises ``ModuleNotFoundError`` with an install
+hint when absent (reference ``utilities/imports.py`` RequirementCache
+behavior, SURVEY.md §2.11). STOI and SRMR are first-party now — see
+``stoi.py`` / ``srmr.py``.
 """
 from typing import Any
 
@@ -61,42 +62,3 @@ def perceptual_evaluation_speech_quality(
     return jnp.asarray(np.asarray(scores, dtype=np.float32).reshape(p.shape[:-1]))
 
 
-def short_time_objective_intelligibility(
-    preds: Array, target: Array, fs: int, extended: bool = False, keep_same_device: bool = False
-) -> Array:
-    """STOI via the host pystoi backend. Parity: ``stoi.py``."""
-    if not _PYSTOI_AVAILABLE:
-        raise ModuleNotFoundError(
-            "STOI metric requires that `pystoi` is installed. Install as `pip install torchmetrics[audio]` "
-            "or `pip install pystoi`."
-        )
-    from pystoi import stoi as stoi_backend
-
-    p = np.asarray(preds, dtype=np.float64)
-    t = np.asarray(target, dtype=np.float64)
-    if p.ndim == 1:
-        return jnp.asarray(stoi_backend(t, p, fs, extended))
-    flat_p = p.reshape(-1, p.shape[-1])
-    flat_t = t.reshape(-1, t.shape[-1])
-    scores = [stoi_backend(ti, pi, fs, extended) for ti, pi in zip(flat_t, flat_p)]
-    return jnp.asarray(np.asarray(scores, dtype=np.float32).reshape(p.shape[:-1]))
-
-
-def speech_reverberation_modulation_energy_ratio(
-    preds: Array,
-    fs: int,
-    n_cochlear_filters: int = 23,
-    low_freq: float = 125.0,
-    min_cf: float = 4.0,
-    max_cf: float = 128.0,
-    norm: bool = False,
-    fast: bool = False,
-    **kwargs: Any,
-) -> Array:
-    """SRMR via the gammatone/torchaudio backend. Parity: ``srmr.py``."""
-    if not (_GAMMATONE_AVAILABLE and _TORCHAUDIO_AVAILABLE):
-        raise ModuleNotFoundError(
-            "SRMR metric requires that `gammatone` and `torchaudio` are installed. "
-            "Install as `pip install torchmetrics[audio]`."
-        )
-    raise NotImplementedError("SRMR backend integration pending (gammatone present but unported).")

@@ -33,20 +33,14 @@ AREA_RANGES = {
 
 
 def bbox_iou_np(dt: np.ndarray, gt: np.ndarray, iscrowd: np.ndarray) -> np.ndarray:
-    """Pairwise IoU with COCO crowd semantics (union = dt area for crowd gt)."""
+    """Pairwise IoU with COCO crowd semantics (union = dt area for crowd gt).
+
+    Thin shim over ``_native.box_iou`` (C++ kernel when built, numpy
+    fallback inside ``_native`` otherwise).
+    """
     if dt.size == 0 or gt.size == 0:
         return np.zeros((dt.shape[0], gt.shape[0]), np.float64)
-    if _native.NATIVE_AVAILABLE:
-        return _native.box_iou(dt, gt, iscrowd)
-    lt = np.maximum(dt[:, None, :2], gt[None, :, :2])
-    rb = np.minimum(dt[:, None, 2:], gt[None, :, 2:])
-    wh = np.clip(rb - lt, 0.0, None)
-    inter = wh[..., 0] * wh[..., 1]
-    area_dt = (dt[:, 2] - dt[:, 0]) * (dt[:, 3] - dt[:, 1])
-    area_gt = (gt[:, 2] - gt[:, 0]) * (gt[:, 3] - gt[:, 1])
-    union = area_dt[:, None] + area_gt[None, :] - inter
-    union = np.where(iscrowd[None, :].astype(bool), area_dt[:, None], union)
-    return np.where(union > 0, inter / np.where(union > 0, union, 1.0), 0.0)
+    return _native.box_iou(dt, gt, iscrowd)
 
 
 def mask_iou_np(dt: np.ndarray, gt: np.ndarray, iscrowd: np.ndarray) -> np.ndarray:

@@ -221,8 +221,12 @@ def mask_edges(
 
     if crop:
         if not bool(np.asarray(preds | target).any()):
-            z = jnp.zeros_like(preds)
-            return (z, jnp.zeros_like(target), z, jnp.zeros_like(target))
+            zp = jnp.zeros_like(preds, dtype=bool)
+            zt = jnp.zeros_like(target, dtype=bool)
+            if spacing is None:
+                return zp, zt
+            zf = jnp.zeros(preds.shape, jnp.float32)
+            return zp, zt, zf, jnp.zeros(target.shape, jnp.float32)
         pad_width = [(1, 1)] * preds.ndim
         preds = jnp.pad(preds, pad_width)
         target = jnp.pad(target, pad_width)

@@ -1,0 +1,6 @@
+"""Flax feature-extractor architectures for embedding-network metrics
+(SURVEY.md §2.9: FID-InceptionV3, LPIPS backbones) + weight conversion."""
+from .inception import FIDInceptionV3, convert_torch_state_dict, make_fid_inception
+from .lpips import LPIPSNet, make_lpips
+
+__all__ = ["FIDInceptionV3", "LPIPSNet", "convert_torch_state_dict", "make_fid_inception", "make_lpips"]

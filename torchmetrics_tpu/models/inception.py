@@ -1,0 +1,262 @@
+"""FID InceptionV3 in Flax (linen).
+
+Parity target: the feature network behind the reference's FID/KID/IS/MiFID —
+``NoTrainInceptionV3`` (reference ``image/fid.py:44``) wrapping
+torch-fidelity's TF-ported ``FeatureExtractorInceptionV3``. That network
+differs from torchvision's InceptionV3 in the FID-critical details, all
+reproduced here:
+
+- pool branches of the A/C/E blocks use 3x3 stride-1 average pooling with
+  ``count_include_pad=False``;
+- the final E block (Mixed_7c) uses **max** pooling in its pool branch;
+- the classifier head has 1008 logits (TF class layout);
+- input is resized to 299x299 bilinear (no antialias, like
+  ``F.interpolate(..., align_corners=False)``) and normalized from [0, 255]
+  to [-1, 1].
+
+Feature taps match torch-fidelity's ``features_list``: ``64`` (after first
+maxpool), ``192`` (after second maxpool), ``768`` (end of the 17x17 stage),
+``2048`` (global avgpool), ``"logits_unbiased"``.
+
+Weights: this offline build cannot download the FID checkpoint; use
+:func:`convert_torch_state_dict` to convert a locally-available
+torch-fidelity ``pt_inception-2015-12-05`` state_dict, then
+``flax_params = load_params(path)``. Random init is fully supported for
+architecture tests.
+"""
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+Array = jax.Array
+
+
+class BasicConv2d(nn.Module):
+    """Conv → BatchNorm(eps=1e-3, no scale-learn in eval) → ReLU."""
+
+    features: int
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: Any = "VALID"
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        x = nn.Conv(self.features, self.kernel, self.strides, padding=self.padding,
+                    use_bias=False, name="conv")(x)
+        x = nn.BatchNorm(use_running_average=True, epsilon=1e-3, momentum=0.9, name="bn")(x)
+        return nn.relu(x)
+
+
+def _avg_pool_3x3_valid_count(x: Array) -> Array:
+    """3x3 stride-1 pad-1 average pool with count_include_pad=False."""
+    ones = jnp.ones(x.shape[:-1] + (1,), x.dtype)
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, (1, 3, 3, 1), (1, 1, 1, 1),
+                                   [(0, 0), (1, 1), (1, 1), (0, 0)])
+    counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, (1, 3, 3, 1), (1, 1, 1, 1),
+                                   [(0, 0), (1, 1), (1, 1), (0, 0)])
+    return summed / counts
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        b1 = BasicConv2d(64, (1, 1), name="branch1x1")(x)
+        b5 = BasicConv2d(48, (1, 1), name="branch5x5_1")(x)
+        b5 = BasicConv2d(64, (5, 5), padding=((2, 2), (2, 2)), name="branch5x5_2")(b5)
+        b3 = BasicConv2d(64, (1, 1), name="branch3x3dbl_1")(x)
+        b3 = BasicConv2d(96, (3, 3), padding=((1, 1), (1, 1)), name="branch3x3dbl_2")(b3)
+        b3 = BasicConv2d(96, (3, 3), padding=((1, 1), (1, 1)), name="branch3x3dbl_3")(b3)
+        bp = _avg_pool_3x3_valid_count(x)
+        bp = BasicConv2d(self.pool_features, (1, 1), name="branch_pool")(bp)
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class InceptionB(nn.Module):
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        b3 = BasicConv2d(384, (3, 3), (2, 2), name="branch3x3")(x)
+        bd = BasicConv2d(64, (1, 1), name="branch3x3dbl_1")(x)
+        bd = BasicConv2d(96, (3, 3), padding=((1, 1), (1, 1)), name="branch3x3dbl_2")(bd)
+        bd = BasicConv2d(96, (3, 3), (2, 2), name="branch3x3dbl_3")(bd)
+        bp = nn.max_pool(x, (3, 3), (2, 2))
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class InceptionC(nn.Module):
+    channels_7x7: int
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        c7 = self.channels_7x7
+        b1 = BasicConv2d(192, (1, 1), name="branch1x1")(x)
+        b7 = BasicConv2d(c7, (1, 1), name="branch7x7_1")(x)
+        b7 = BasicConv2d(c7, (1, 7), padding=((0, 0), (3, 3)), name="branch7x7_2")(b7)
+        b7 = BasicConv2d(192, (7, 1), padding=((3, 3), (0, 0)), name="branch7x7_3")(b7)
+        bd = BasicConv2d(c7, (1, 1), name="branch7x7dbl_1")(x)
+        bd = BasicConv2d(c7, (7, 1), padding=((3, 3), (0, 0)), name="branch7x7dbl_2")(bd)
+        bd = BasicConv2d(c7, (1, 7), padding=((0, 0), (3, 3)), name="branch7x7dbl_3")(bd)
+        bd = BasicConv2d(c7, (7, 1), padding=((3, 3), (0, 0)), name="branch7x7dbl_4")(bd)
+        bd = BasicConv2d(192, (1, 7), padding=((0, 0), (3, 3)), name="branch7x7dbl_5")(bd)
+        bp = _avg_pool_3x3_valid_count(x)
+        bp = BasicConv2d(192, (1, 1), name="branch_pool")(bp)
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class InceptionD(nn.Module):
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        b3 = BasicConv2d(192, (1, 1), name="branch3x3_1")(x)
+        b3 = BasicConv2d(320, (3, 3), (2, 2), name="branch3x3_2")(b3)
+        b7 = BasicConv2d(192, (1, 1), name="branch7x7x3_1")(x)
+        b7 = BasicConv2d(192, (1, 7), padding=((0, 0), (3, 3)), name="branch7x7x3_2")(b7)
+        b7 = BasicConv2d(192, (7, 1), padding=((3, 3), (0, 0)), name="branch7x7x3_3")(b7)
+        b7 = BasicConv2d(192, (3, 3), (2, 2), name="branch7x7x3_4")(b7)
+        bp = nn.max_pool(x, (3, 3), (2, 2))
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class InceptionE(nn.Module):
+    pool_mode: str  # "avg" (Mixed_7b) or "max" (Mixed_7c, FID variant)
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        b1 = BasicConv2d(320, (1, 1), name="branch1x1")(x)
+        b3 = BasicConv2d(384, (1, 1), name="branch3x3_1")(x)
+        b3a = BasicConv2d(384, (1, 3), padding=((0, 0), (1, 1)), name="branch3x3_2a")(b3)
+        b3b = BasicConv2d(384, (3, 1), padding=((1, 1), (0, 0)), name="branch3x3_2b")(b3)
+        b3 = jnp.concatenate([b3a, b3b], axis=-1)
+        bd = BasicConv2d(448, (1, 1), name="branch3x3dbl_1")(x)
+        bd = BasicConv2d(384, (3, 3), padding=((1, 1), (1, 1)), name="branch3x3dbl_2")(bd)
+        bda = BasicConv2d(384, (1, 3), padding=((0, 0), (1, 1)), name="branch3x3dbl_3a")(bd)
+        bdb = BasicConv2d(384, (3, 1), padding=((1, 1), (0, 0)), name="branch3x3dbl_3b")(bd)
+        bd = jnp.concatenate([bda, bdb], axis=-1)
+        if self.pool_mode == "max":
+            bp = nn.max_pool(x, (3, 3), (1, 1), padding=((1, 1), (1, 1)))
+        else:
+            bp = _avg_pool_3x3_valid_count(x)
+        bp = BasicConv2d(192, (1, 1), name="branch_pool")(bp)
+        return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+
+class FIDInceptionV3(nn.Module):
+    """The torch-fidelity FID feature extractor, NHWC internally.
+
+    ``__call__`` takes (N, 3, H, W) images in [0, 255] (float or uint8) and
+    returns a dict of the requested feature taps.
+    """
+
+    features_list: Sequence[Any] = (2048,)
+
+    @nn.compact
+    def __call__(self, x: Array) -> Dict[Any, Array]:
+        x = jnp.asarray(x, jnp.float32)
+        # (N, 3, H, W) -> resize -> normalize to [-1, 1] -> NHWC
+        n, c, h, w = x.shape
+        x = jax.image.resize(x, (n, c, 299, 299), jax.image.ResizeMethod.LINEAR)
+        x = (x - 128.0) / 128.0
+        x = jnp.transpose(x, (0, 2, 3, 1))
+
+        out: Dict[Any, Array] = {}
+        x = BasicConv2d(32, (3, 3), (2, 2), name="Conv2d_1a_3x3")(x)
+        x = BasicConv2d(32, (3, 3), name="Conv2d_2a_3x3")(x)
+        x = BasicConv2d(64, (3, 3), padding=((1, 1), (1, 1)), name="Conv2d_2b_3x3")(x)
+        x = nn.max_pool(x, (3, 3), (2, 2))
+        if 64 in self.features_list:
+            out[64] = _gap(x)
+        x = BasicConv2d(80, (1, 1), name="Conv2d_3b_1x1")(x)
+        x = BasicConv2d(192, (3, 3), name="Conv2d_4a_3x3")(x)
+        x = nn.max_pool(x, (3, 3), (2, 2))
+        if 192 in self.features_list:
+            out[192] = _gap(x)
+        x = InceptionA(32, name="Mixed_5b")(x)
+        x = InceptionA(64, name="Mixed_5c")(x)
+        x = InceptionA(64, name="Mixed_5d")(x)
+        x = InceptionB(name="Mixed_6a")(x)
+        x = InceptionC(128, name="Mixed_6b")(x)
+        x = InceptionC(160, name="Mixed_6c")(x)
+        x = InceptionC(160, name="Mixed_6d")(x)
+        x = InceptionC(192, name="Mixed_6e")(x)
+        if 768 in self.features_list:
+            out[768] = _gap(x)
+        x = InceptionD(name="Mixed_7a")(x)
+        x = InceptionE("avg", name="Mixed_7b")(x)
+        x = InceptionE("max", name="Mixed_7c")(x)
+        pooled = x.mean(axis=(1, 2))  # global average pool -> (N, 2048)
+        if 2048 in self.features_list:
+            out[2048] = pooled
+        if "logits_unbiased" in self.features_list or 1008 in self.features_list:
+            logits = nn.Dense(1008, use_bias=False, name="fc")(pooled)
+            out["logits_unbiased"] = logits
+            if 1008 in self.features_list:
+                out[1008] = logits
+        return out
+
+
+def _gap(x: Array) -> Array:
+    """torch-fidelity taps 64/192/768 via adaptive avg pool to (1, 1)."""
+    return x.mean(axis=(1, 2))
+
+
+def make_fid_inception(features: Any = 2048, rng_seed: int = 0):
+    """Build (module, params, extract_fn) with random init.
+
+    ``extract_fn(imgs)`` maps (N, 3, H, W) [0, 255] images to (N, D)
+    features for the single requested tap — directly usable as the
+    ``feature=`` callable of FID/KID/IS/MiFID.
+    """
+    feats = (features,) if not isinstance(features, (tuple, list)) else tuple(features)
+    mod = FIDInceptionV3(features_list=feats)
+    params = mod.init(jax.random.PRNGKey(rng_seed), jnp.zeros((1, 3, 32, 32)))
+
+    @jax.jit
+    def extract(imgs: Array) -> Array:
+        return mod.apply(params, imgs)[feats[0]]
+
+    return mod, params, extract
+
+
+# ---------------------------------------------------------------------------
+# torch -> flax weight conversion
+# ---------------------------------------------------------------------------
+
+def convert_torch_state_dict(state_dict: Dict[str, "np.ndarray"]) -> Dict:
+    """Convert a torch-fidelity FID-InceptionV3 ``state_dict`` (tensors or
+    numpy arrays) into this module's flax params/batch_stats pytree.
+
+    Mapping: ``<block>.conv.weight`` (O, I, kH, kW) → ``params/<block>/conv``
+    kernel (kH, kW, I, O); BN ``weight/bias`` → scale/bias params; BN
+    ``running_mean/var`` → batch_stats; ``fc.weight`` (O, I) → Dense kernel
+    (I, O).
+    """
+    params: Dict = {}
+    batch_stats: Dict = {}
+
+    def _set(tree: Dict, path: Sequence[str], value):
+        node = tree
+        for key in path[:-1]:
+            node = node.setdefault(key, {})
+        node[path[-1]] = jnp.asarray(np.asarray(value))
+
+    for name, tensor in state_dict.items():
+        arr = np.asarray(tensor)
+        parts = name.split(".")
+        if parts[-2:] == ["conv", "weight"]:
+            _set(params, parts[:-1] + ["kernel"], arr.transpose(2, 3, 1, 0))
+        elif parts[-2] == "bn" and parts[-1] == "weight":
+            _set(params, parts[:-1] + ["scale"], arr)
+        elif parts[-2] == "bn" and parts[-1] == "bias":
+            _set(params, parts[:-1] + ["bias"], arr)
+        elif parts[-1] == "running_mean":
+            _set(batch_stats, parts[:-1] + ["mean"], arr)
+        elif parts[-1] == "running_var":
+            _set(batch_stats, parts[:-1] + ["var"], arr)
+        elif parts == ["fc", "weight"]:
+            _set(params, ["fc", "kernel"], arr.T)
+        elif parts == ["fc", "bias"]:
+            _set(params, ["fc", "bias"], arr)
+    return {"params": params, "batch_stats": batch_stats}

@@ -1,0 +1,131 @@
+"""LPIPS network in Flax.
+
+Parity target: reference ``functional/image/lpips.py:258`` (``_LPIPS``):
+vendored AlexNet/VGG16 backbones with 5 feature taps, per-tap channel-unit
+normalization, squared difference, 1x1 ``NetLinLayer`` heads, spatial mean,
+sum over taps. The reference ships head weights in-repo (``lpips_models/
+{alex,vgg,squeeze}.pth``) and takes backbones from torchvision.
+
+Offline build: the architecture + weight converter live here; pretrained
+tensors (torch ``state_dict``) convert via :func:`convert_lpips_torch` when
+available locally. Random init exercises the full pipeline for tests.
+"""
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+Array = jax.Array
+
+# input scaling constants from the LPIPS reference implementation
+_SHIFT = (-0.030, -0.088, -0.188)
+_SCALE = (0.458, 0.448, 0.450)
+
+_ALEX_CFG = ((64, 11, 4, 2), (192, 5, 1, 2), (384, 3, 1, 1), (256, 3, 1, 1), (256, 3, 1, 1))
+# VGG16 conv plan: taps after relu1_2, relu2_2, relu3_3, relu4_3, relu5_3
+_VGG_PLAN = ((64, 64), (128, 128), (256, 256, 256), (512, 512, 512), (512, 512, 512))
+
+
+class AlexFeatures(nn.Module):
+    """AlexNet feature trunk with taps after each of the 5 relu stages."""
+
+    @nn.compact
+    def __call__(self, x: Array) -> Tuple[Array, ...]:
+        taps = []
+        for i, (feats, k, s, p) in enumerate(_ALEX_CFG):
+            if i in (1, 2):  # maxpool precedes conv2 and conv3
+                x = nn.max_pool(x, (3, 3), (2, 2))
+            x = nn.Conv(feats, (k, k), (s, s), padding=((p, p), (p, p)), name=f"conv{i}")(x)
+            x = nn.relu(x)
+            taps.append(x)
+        return tuple(taps)
+
+
+class VGG16Features(nn.Module):
+    """VGG16 trunk with taps after the last relu of each of the 5 stages."""
+
+    @nn.compact
+    def __call__(self, x: Array) -> Tuple[Array, ...]:
+        taps = []
+        idx = 0
+        for stage, widths in enumerate(_VGG_PLAN):
+            if stage > 0:
+                x = nn.max_pool(x, (2, 2), (2, 2))
+            for w in widths:
+                x = nn.Conv(w, (3, 3), padding=((1, 1), (1, 1)), name=f"conv{idx}")(x)
+                x = nn.relu(x)
+                idx += 1
+            taps.append(x)
+        return tuple(taps)
+
+
+def _unit_normalize(x: Array, eps: float = 1e-10) -> Array:
+    return x / jnp.sqrt(jnp.sum(x**2, axis=-1, keepdims=True) + eps)
+
+
+class LPIPSNet(nn.Module):
+    """Full LPIPS distance network. Input: two (N, 3, H, W) images in [-1, 1]."""
+
+    net_type: str = "alex"  # "alex" | "vgg"
+
+    @nn.compact
+    def __call__(self, img0: Array, img1: Array, normalize: bool = False) -> Array:
+        if normalize:  # [0, 1] -> [-1, 1] (reference `normalize` flag)
+            img0 = 2 * img0 - 1
+            img1 = 2 * img1 - 1
+        shift = jnp.asarray(_SHIFT).reshape(1, 3, 1, 1)
+        scale = jnp.asarray(_SCALE).reshape(1, 3, 1, 1)
+        img0 = jnp.transpose((img0 - shift) / scale, (0, 2, 3, 1))
+        img1 = jnp.transpose((img1 - shift) / scale, (0, 2, 3, 1))
+        trunk = AlexFeatures(name="net") if self.net_type == "alex" else VGG16Features(name="net")
+        f0 = trunk(img0)
+        f1 = trunk(img1)
+        total = 0.0
+        for i, (a, b) in enumerate(zip(f0, f1)):
+            d = (_unit_normalize(a) - _unit_normalize(b)) ** 2
+            w = nn.Conv(1, (1, 1), use_bias=False, name=f"lin{i}")(d)  # NetLinLayer
+            total = total + w.mean(axis=(1, 2))[:, 0]  # spatial average
+        return total
+
+
+def make_lpips(net_type: str = "alex", rng_seed: int = 0):
+    """(module, params, distance_fn) with random init; ``distance_fn(x, y)``
+    maps two (N, 3, H, W) [-1, 1] image batches to (N,) distances — directly
+    usable as the ``net_type=`` callable of
+    ``LearnedPerceptualImagePatchSimilarity``."""
+    mod = LPIPSNet(net_type=net_type)
+    params = mod.init(jax.random.PRNGKey(rng_seed), jnp.zeros((1, 3, 64, 64)), jnp.zeros((1, 3, 64, 64)))
+
+    @jax.jit
+    def distance(x: Array, y: Array) -> Array:
+        return mod.apply(params, x, y)
+
+    return mod, params, distance
+
+
+def convert_lpips_torch(backbone_state: Dict, heads_state: Dict, net_type: str = "alex") -> Dict:
+    """Convert torchvision backbone + reference in-repo head weights
+    (``lpips_models/{alex,vgg}.pth``) to this module's params pytree.
+
+    Backbone conv ``weight`` (O, I, kH, kW) → kernel (kH, kW, I, O); head
+    entries ``lin<k>.model.1.weight`` (1, C, 1, 1) → ``lin<k>`` kernel.
+    """
+    params: Dict = {"net": {}}
+    conv_idx = 0
+    items = [(k, v) for k, v in backbone_state.items() if k.endswith("weight") and np.asarray(v).ndim == 4]
+    for (k, v) in items:
+        arr = np.asarray(v)
+        params["net"][f"conv{conv_idx}"] = {"kernel": jnp.asarray(arr.transpose(2, 3, 1, 0))}
+        bias_key = k[: -len("weight")] + "bias"
+        if bias_key in backbone_state:
+            params["net"][f"conv{conv_idx}"]["bias"] = jnp.asarray(np.asarray(backbone_state[bias_key]))
+        conv_idx += 1
+    for k, v in heads_state.items():
+        if "weight" not in k:
+            continue
+        lin = k.split(".")[0]  # "lin0".."lin4"
+        arr = np.asarray(v)  # (1, C, 1, 1)
+        params[lin] = {"kernel": jnp.asarray(arr.transpose(2, 3, 1, 0))}
+    return {"params": params}

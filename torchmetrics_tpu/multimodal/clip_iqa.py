@@ -28,7 +28,7 @@ class CLIPImageQualityAssessment(Metric):
 
     def __init__(
         self,
-        model_name_or_path: Union[str, Tuple[Any, Any]] = "openai/clip-vit-base-patch16",
+        model_name_or_path: Union[str, Tuple[Any, Any]] = "clip_iqa",
         data_range: float = 1.0,
         prompts: Tuple[Union[str, Tuple[str, str]], ...] = ("quality",),
         **kwargs: Any,
@@ -36,6 +36,10 @@ class CLIPImageQualityAssessment(Metric):
         super().__init__(**kwargs)
         self._prompts_flat, self.prompts_names = _format_prompts(prompts)
         self.data_range = float(data_range)
+        # "clip_iqa" sentinel maps to the base CLIP checkpoint, matching the
+        # functional API (functional/multimodal/clip_iqa.py)
+        if model_name_or_path == "clip_iqa":
+            model_name_or_path = "openai/clip-vit-base-patch16"
         self.model, self.processor = _resolve_model(model_name_or_path, "CLIPImageQualityAssessment")
         self.anchors = _clip_iqa_anchors(self._prompts_flat, self.model, self.processor)
         self.add_state("probs_list", [], dist_reduce_fx="cat")
