@@ -1,0 +1,50 @@
+"""BASELINE config 5 — BERTScore with a user-provided encoder + ROUGE.
+
+Mirrors the reference's ``examples/bert_score-own_model.py``: any callable
+that maps token batches to embeddings works as the encoder — no HF download
+needed. ROUGE runs host-side (strings never touch the device)."""
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))  # in-repo run
+
+from typing import Dict, List
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.text.bert import bert_score_from_embeddings
+from torchmetrics_tpu.functional.text.rouge import rouge_score
+
+
+def _toy_tokenize(texts: List[str], max_len: int = 16):
+    ids = np.zeros((len(texts), max_len), np.int32)
+    mask = np.zeros((len(texts), max_len), np.float32)
+    for i, t in enumerate(texts):
+        toks = [hash(w) % 1000 for w in t.split()][:max_len]
+        ids[i, : len(toks)] = toks
+        mask[i, : len(toks)] = 1
+    return ids, mask
+
+
+def main() -> None:
+    preds = ["the quick brown fox jumps", "hello world"]
+    target = ["a quick brown fox leaps", "hello there world"]
+
+    # toy embedding table stands in for a real encoder
+    table = jax.random.normal(jax.random.PRNGKey(0), (1000, 32))
+    p_ids, p_mask = _toy_tokenize(preds)
+    t_ids, t_mask = _toy_tokenize(target)
+    score = bert_score_from_embeddings(
+        table[p_ids], jnp.asarray(p_mask), table[t_ids], jnp.asarray(t_mask)
+    )
+    print({k: np.asarray(v).round(3).tolist() for k, v in score.items()})
+
+    rouge: Dict = rouge_score(preds, target)
+    print({k: round(float(v), 3) for k, v in rouge.items() if k.endswith("fmeasure")})
+
+
+if __name__ == "__main__":
+    main()

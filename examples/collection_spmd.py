@@ -1,0 +1,59 @@
+"""BASELINE config 2 — MetricCollection(Accuracy, F1, AUROC) with
+DDP-equivalent sync via XLA collectives on a device mesh.
+
+All member updates trace into ONE XLA program; state sync is a psum over
+the data-parallel mesh axis inside shard_map (no NCCL, no gather-then-
+reduce — SURVEY.md §2.10).
+
+Run on CPU-simulated devices:
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/collection_spmd.py
+"""
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))  # in-repo run
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
+from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassAUROC, MulticlassF1Score
+from torchmetrics_tpu.collections import MetricCollection
+
+
+def main() -> None:
+    num_classes = 8
+    devices = jax.devices()
+    mesh = Mesh(np.array(devices), ("dp",))
+    coll = MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=num_classes, average="micro"),
+            "f1": MulticlassF1Score(num_classes=num_classes, average="macro"),
+            "auroc": MulticlassAUROC(num_classes=num_classes, thresholds=32),
+        }
+    )
+
+    def eval_shard(preds, target):
+        states = coll.init_state()
+        states = coll.update_state(states, preds, target)
+        return coll.reduce_state(states, "dp")  # psum/all_gather over dp
+
+    fn = jax.jit(shard_map(eval_shard, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P()))
+
+    batch = 64 * len(devices)
+    preds = jax.nn.softmax(jax.random.normal(jax.random.PRNGKey(0), (batch, num_classes)), axis=-1)
+    target = jax.random.randint(jax.random.PRNGKey(1), (batch,), 0, num_classes)
+    states = fn(preds, target)
+    print({k: float(v) for k, v in coll.compute_state(states).items()})
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+"""BASELINE config 3 — MeanAveragePrecision over per-image detections.
+
+Exercises the list-state path (per-image ragged boxes) and the first-party
+COCOeval core (native C++ matcher + RLE kernels when built).
+"""
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))  # in-repo run
+
+import numpy as np
+
+from torchmetrics_tpu.detection import MeanAveragePrecision
+
+
+def main() -> None:
+    rng = np.random.RandomState(0)
+    metric = MeanAveragePrecision(iou_type="bbox")
+    for _ in range(4):  # four images
+        n_gt, n_det = rng.randint(1, 5), rng.randint(1, 6)
+        gt = np.sort(rng.rand(n_gt, 4) * 100, axis=-1)[:, [0, 1, 2, 3]]
+        gt[:, 2:] += 5
+        jitter = rng.randn(n_det, 4)
+        det = gt[rng.randint(0, n_gt, n_det)] + jitter
+        metric.update(
+            [{"boxes": det, "scores": rng.rand(n_det), "labels": rng.randint(0, 3, n_det)}],
+            [{"boxes": gt, "labels": rng.randint(0, 3, n_gt)}],
+        )
+    result = metric.compute()
+    print({k: (float(v) if np.ndim(v) == 0 else np.asarray(v).round(3).tolist())
+           for k, v in result.items() if k.startswith("map")})
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,32 @@
+"""BASELINE config 4 — FrechetInceptionDistance + SSIM with the on-TPU
+Flax InceptionV3 extractor (random init offline; convert pretrained weights
+with ``torchmetrics_tpu.models.convert_torch_state_dict`` for real FID)."""
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))  # in-repo run
+
+import numpy as np
+
+import jax.numpy as jnp
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu.models import make_fid_inception
+
+
+def main() -> None:
+    rng = np.random.RandomState(0)
+    _, _, extract = make_fid_inception(2048)
+    fid = tm.FrechetInceptionDistance(feature=extract)
+    ssim = tm.StructuralSimilarityIndexMeasure(data_range=1.0)
+
+    real = jnp.asarray(rng.rand(8, 3, 64, 64) * 255, jnp.float32)
+    fake = jnp.asarray(np.clip(np.asarray(real) + rng.randn(8, 3, 64, 64) * 20, 0, 255), jnp.float32)
+    fid.update(real, real=True)
+    fid.update(fake, real=False)
+    ssim.update(fake / 255.0, real / 255.0)
+    print(f"FID {float(fid.compute()):.4f}  SSIM {float(ssim.compute()):.4f}")
+
+
+if __name__ == "__main__":
+    main()

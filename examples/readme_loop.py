@@ -1,0 +1,33 @@
+"""BASELINE config 1 — the README training-loop pattern.
+
+Per-step ``forward`` returns the batch value while accumulating global
+state; ``compute`` gives the epoch value (reference README usage).
+"""
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))  # in-repo run
+
+import jax
+import jax.numpy as jnp
+
+import torchmetrics_tpu as tm
+
+
+def main() -> None:
+    num_classes = 5
+    metric = tm.classification.MulticlassAccuracy(num_classes=num_classes, average="micro")
+
+    key = jax.random.PRNGKey(0)
+    for step in range(10):
+        key, k1, k2 = jax.random.split(key, 3)
+        preds = jax.nn.softmax(jax.random.normal(k1, (64, num_classes)), axis=-1)
+        target = jax.random.randint(k2, (64,), 0, num_classes)
+        batch_acc = metric(preds, target)
+        print(f"step {step}: batch acc {float(batch_acc):.3f}")
+    print(f"epoch acc {float(metric.compute()):.3f}")
+    metric.reset()
+
+
+if __name__ == "__main__":
+    main()

@@ -251,6 +251,54 @@ def linear_sum_assignment(cost: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     return rows, col4row
 
 
+def rle_from_coco_string(s, h: int = 0, w: int = 0) -> np.ndarray:
+    """Decode COCO's compressed RLE string (the ``counts: bytes/str`` form
+    produced by pycocotools) into plain uint32 run counts.
+
+    Format: each count is a little-endian sequence of 6-bit chunks, char =
+    chunk + 48 with bit 0x20 as continuation; counts from the 3rd on are
+    delta-encoded against counts[i-2].
+    """
+    if isinstance(s, bytes):
+        s = s.decode("ascii")
+    counts = []
+    i = 0
+    while i < len(s):
+        x = 0
+        k = 0
+        more = True
+        while more:
+            c = ord(s[i]) - 48
+            x |= (c & 0x1F) << (5 * k)
+            more = bool(c & 0x20)
+            i += 1
+            k += 1
+            if not more and (c & 0x10):
+                x |= -1 << (5 * k)  # sign-extend
+        if len(counts) > 2:
+            x += counts[-2]
+        counts.append(x)
+    return np.asarray(counts, dtype=np.uint32)
+
+
+def rle_to_coco_string(counts: np.ndarray) -> bytes:
+    """Encode plain run counts into COCO's compressed RLE string."""
+    counts = np.asarray(counts, dtype=np.int64)
+    out = []
+    for i, x in enumerate(counts.tolist()):
+        if i > 2:
+            x -= int(counts[i - 2])
+        more = True
+        while more:
+            c = x & 0x1F
+            x >>= 5
+            more = not ((x == 0 and not (c & 0x10)) or (x == -1 and (c & 0x10)))
+            if more:
+                c |= 0x20
+            out.append(chr(c + 48))
+    return "".join(out).encode("ascii")
+
+
 def _rle_to_dense_cols(counts: np.ndarray) -> np.ndarray:
     """Column-major flat boolean expansion of RLE counts (fallback helper)."""
     counts = np.asarray(counts, dtype=np.int64)

@@ -14,6 +14,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 import jax.numpy as jnp
 import numpy as np
 
+from .. import _native
 from ..functional.detection.coco_eval import (
     DEFAULT_IOU_THRESHOLDS,
     DEFAULT_MAX_DETS,
@@ -137,8 +138,24 @@ class MeanAveragePrecision(Metric):
         return boxes
 
     @staticmethod
-    def _masks(item: Dict[str, Any]) -> np.ndarray:
-        masks = np.asarray(item["masks"])
+    def _masks(item: Dict[str, Any]):
+        """Dense (N, H, W) boolean masks, or COCO RLE dicts kept as-is.
+
+        The reference accepts RLE-encoded masks (``detection/mean_ap.py``
+        update path gathers RLE tuples); here RLEs stay encoded end-to-end —
+        pairwise IoU runs directly on run-lengths in the native kernel
+        (``_native.rle_iou``), never decoding to dense.
+        """
+        masks = item["masks"]
+        if isinstance(masks, (list, tuple)) and len(masks) and isinstance(masks[0], dict):
+            out = []
+            for m in masks:
+                counts = m["counts"]
+                if isinstance(counts, (bytes, str)):  # pycocotools compressed form
+                    counts = _native.rle_from_coco_string(counts)
+                out.append({"size": tuple(m["size"]), "counts": np.asarray(counts, np.uint32)})
+            return out
+        masks = np.asarray(masks)
         if masks.size == 0:
             return np.zeros((0, 1, 1), bool)
         return masks.astype(bool)

@@ -24,9 +24,6 @@ def _module_available(name: str) -> bool:
 
 
 _PESQ_AVAILABLE = _module_available("pesq")
-_PYSTOI_AVAILABLE = _module_available("pystoi")
-_GAMMATONE_AVAILABLE = _module_available("gammatone")
-_TORCHAUDIO_AVAILABLE = _module_available("torchaudio")
 
 
 def perceptual_evaluation_speech_quality(

@@ -268,9 +268,11 @@ def _multiclass_stat_scores_update(
     idx = (num_classes * target + preds_c).astype(jnp.int32)
 
     if multidim_average == "global":
-        flat_idx = idx.reshape(-1)
-        flat_w = w.reshape(-1)
-        cm = jnp.zeros((num_classes * num_classes,), jnp.float32).at[flat_idx].add(flat_w)
+        from ...ops.bincount import weighted_bincount
+
+        # Pallas compare-reduce on TPU, XLA scatter-add elsewhere (the
+        # backend dispatch lives inside weighted_bincount)
+        cm = weighted_bincount(idx.reshape(-1), w.reshape(-1), num_classes * num_classes)
         cm = cm.reshape(num_classes, num_classes)
         tp = jnp.diagonal(cm)
         fn = jnp.sum(cm, axis=1) - tp

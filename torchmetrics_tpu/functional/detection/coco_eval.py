@@ -43,8 +43,29 @@ def bbox_iou_np(dt: np.ndarray, gt: np.ndarray, iscrowd: np.ndarray) -> np.ndarr
     return _native.box_iou(dt, gt, iscrowd)
 
 
-def mask_iou_np(dt: np.ndarray, gt: np.ndarray, iscrowd: np.ndarray) -> np.ndarray:
-    """Pairwise mask IoU over flattened boolean masks ``(N, P)`` / ``(M, P)``."""
+def _is_rle_list(masks) -> bool:
+    return isinstance(masks, list) and (len(masks) == 0 or isinstance(masks[0], dict))
+
+
+def rle_iou_np(dt, gt, iscrowd: np.ndarray) -> np.ndarray:
+    """Pairwise IoU of COCO RLE mask lists without decoding (native kernel
+    with numpy fallback inside ``_native``)."""
+    if len(dt) == 0 or len(gt) == 0:
+        return np.zeros((len(dt), len(gt)), np.float64)
+    return _native.rle_iou([m["counts"] for m in dt], [m["counts"] for m in gt], iscrowd)
+
+
+def mask_iou_np(dt, gt, iscrowd: np.ndarray) -> np.ndarray:
+    """Pairwise mask IoU: dense (N, H, W) boolean arrays or RLE dict lists
+    (mixed inputs are normalized by encoding the dense side)."""
+    if _is_rle_list(dt) or _is_rle_list(gt):
+        def _norm(masks):
+            if _is_rle_list(masks):
+                return list(masks)
+            dense = np.asarray(masks).astype(np.uint8)
+            return [{"size": dense.shape[1:], "counts": _native.rle_encode(m)} for m in dense]
+
+        return rle_iou_np(_norm(dt), _norm(gt), iscrowd)
     if dt.size == 0 or gt.size == 0:
         return np.zeros((dt.shape[0], gt.shape[0]), np.float64)
     dtf = dt.reshape(dt.shape[0], -1).astype(np.float64)
@@ -241,6 +262,18 @@ def evaluate_detections(
             dt_areas = (dt_geom[:, 2] - dt_geom[:, 0]) * (dt_geom[:, 3] - dt_geom[:, 1])
             gt_areas = (gt_geom[:, 2] - gt_geom[:, 0]) * (gt_geom[:, 3] - gt_geom[:, 1])
             iou_fn = bbox_iou_np
+        elif _is_rle_list(det["masks"]) or _is_rle_list(gt["masks"]):
+            def _to_rle_list(masks):
+                if _is_rle_list(masks):
+                    return list(masks)
+                dense = np.asarray(masks).astype(np.uint8)  # mixed input: encode dense side
+                return [{"size": dense.shape[1:], "counts": _native.rle_encode(m)} for m in dense]
+
+            dt_geom = _to_rle_list(det["masks"])
+            gt_geom = _to_rle_list(gt["masks"])
+            dt_areas = np.asarray([_native.rle_area(m["counts"]) for m in dt_geom], np.float64)
+            gt_areas = np.asarray([_native.rle_area(m["counts"]) for m in gt_geom], np.float64)
+            iou_fn = mask_iou_np
         else:
             dt_geom = np.asarray(det["masks"]).astype(bool)
             gt_geom = np.asarray(gt["masks"]).astype(bool)
@@ -256,9 +289,10 @@ def evaluate_detections(
             g_sel = np.nonzero(gt_labels == cls)[0]
             if len(d_sel) == 0 and len(g_sel) == 0:
                 continue
-            ious_full = iou_fn(
-                dt_geom[d_sel], gt_geom[g_sel], gt_crowd[g_sel]
-            )
+            if isinstance(dt_geom, list):  # RLE dict lists index elementwise
+                ious_full = iou_fn([dt_geom[i] for i in d_sel], [gt_geom[j] for j in g_sel], gt_crowd[g_sel])
+            else:
+                ious_full = iou_fn(dt_geom[d_sel], gt_geom[g_sel], gt_crowd[g_sel])
             ious_map[(img_idx, cls)] = ious_full
             for area in area_keys:
                 lo, hi = AREA_RANGES[area]

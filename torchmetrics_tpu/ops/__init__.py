@@ -1,0 +1,5 @@
+"""Pallas TPU kernels for hot ops (SURVEY.md §7: "pallas kernels for the
+hot ops"). Each kernel ships with an XLA fallback for non-TPU backends."""
+from .bincount import weighted_bincount
+
+__all__ = ["weighted_bincount"]

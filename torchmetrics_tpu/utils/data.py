@@ -49,10 +49,16 @@ def _flatten(x: Sequence) -> list:
 def _bincount(x: Array, minlength: int) -> Array:
     """Static-shape bincount: ``minlength`` must be a Python int under jit.
 
-    Uses ``jnp.bincount(length=...)`` which XLA lowers to a scatter-add; on TPU
-    this is deterministic (no fallback shims needed, unlike reference
+    On TPU this dispatches to the Pallas compare-reduce kernel
+    (``ops/bincount.py`` — no scatter serialization); elsewhere
+    ``jnp.bincount(length=...)`` (XLA scatter-add). Deterministic on all
+    backends (no fallback shims needed, unlike reference
     ``utilities/data.py:179-207``).
     """
+    from ..ops.bincount import _on_tpu, weighted_bincount
+
+    if _on_tpu():
+        return weighted_bincount(x.reshape(-1), None, minlength)  # int32, exact
     return jnp.bincount(x.reshape(-1).astype(jnp.int32), length=minlength)
 
 

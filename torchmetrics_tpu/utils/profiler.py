@@ -1,0 +1,81 @@
+"""Lightweight metric-overhead instrumentation.
+
+The reference has no profiling beyond a usage ping (SURVEY.md §5); the
+north-star benchmark here is *metric-sync wallclock/step*, so the framework
+ships a small built-in timer:
+
+- :class:`StepTimer` — accumulates wall-clock per named phase with
+  block-until-ready semantics so device work is actually counted;
+- :func:`annotate` — wraps a phase in ``jax.profiler.TraceAnnotation`` so
+  the phases show up in TPU profiler traces (xprof) too.
+"""
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Any, Dict
+
+import jax
+
+__all__ = ["StepTimer", "annotate"]
+
+
+@contextmanager
+def annotate(name: str):
+    """jax.profiler trace annotation (visible in xprof timelines)."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+class StepTimer:
+    """Accumulate per-phase wall-clock across steps.
+
+    Example::
+
+        timer = StepTimer()
+        for batch in loader:
+            with timer.phase("metric_update"):
+                state = metric.update_state(state, *batch)
+        print(timer.summary())   # {"metric_update": {"total_s": ..., "count": ..., "mean_ms": ...}}
+    """
+
+    def __init__(self, block_until_ready: bool = True) -> None:
+        self._totals: Dict[str, float] = defaultdict(float)
+        self._counts: Dict[str, int] = defaultdict(int)
+        self._block = block_until_ready
+        self._live: Any = None
+
+    @contextmanager
+    def phase(self, name: str, result: Any = None):
+        """Time a phase; set ``timer.live = device_value`` inside the block
+        (or pass ``result``) to block on it before stopping the clock."""
+        self._live = result
+        t0 = time.perf_counter()
+        with jax.profiler.TraceAnnotation(name):
+            yield self
+        if self._block and self._live is not None:
+            jax.block_until_ready(self._live)
+        self._totals[name] += time.perf_counter() - t0
+        self._counts[name] += 1
+        self._live = None
+
+    @property
+    def live(self) -> Any:
+        return self._live
+
+    @live.setter
+    def live(self, value: Any) -> None:
+        self._live = value
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        return {
+            name: {
+                "total_s": self._totals[name],
+                "count": self._counts[name],
+                "mean_ms": 1000.0 * self._totals[name] / max(self._counts[name], 1),
+            }
+            for name in self._totals
+        }
+
+    def reset(self) -> None:
+        self._totals.clear()
+        self._counts.clear()
