@@ -47,6 +47,14 @@ def _is_rle_list(masks) -> bool:
     return isinstance(masks, list) and (len(masks) == 0 or isinstance(masks[0], dict))
 
 
+def _as_rle_list(masks) -> list:
+    """Normalize masks to an RLE dict list, encoding dense (N, H, W) input."""
+    if _is_rle_list(masks):
+        return list(masks)
+    dense = np.asarray(masks).astype(np.uint8)
+    return [{"size": dense.shape[1:], "counts": _native.rle_encode(m)} for m in dense]
+
+
 def rle_iou_np(dt, gt, iscrowd: np.ndarray) -> np.ndarray:
     """Pairwise IoU of COCO RLE mask lists without decoding (native kernel
     with numpy fallback inside ``_native``)."""
@@ -59,13 +67,7 @@ def mask_iou_np(dt, gt, iscrowd: np.ndarray) -> np.ndarray:
     """Pairwise mask IoU: dense (N, H, W) boolean arrays or RLE dict lists
     (mixed inputs are normalized by encoding the dense side)."""
     if _is_rle_list(dt) or _is_rle_list(gt):
-        def _norm(masks):
-            if _is_rle_list(masks):
-                return list(masks)
-            dense = np.asarray(masks).astype(np.uint8)
-            return [{"size": dense.shape[1:], "counts": _native.rle_encode(m)} for m in dense]
-
-        return rle_iou_np(_norm(dt), _norm(gt), iscrowd)
+        return rle_iou_np(_as_rle_list(dt), _as_rle_list(gt), iscrowd)
     if dt.size == 0 or gt.size == 0:
         return np.zeros((dt.shape[0], gt.shape[0]), np.float64)
     dtf = dt.reshape(dt.shape[0], -1).astype(np.float64)
@@ -263,14 +265,8 @@ def evaluate_detections(
             gt_areas = (gt_geom[:, 2] - gt_geom[:, 0]) * (gt_geom[:, 3] - gt_geom[:, 1])
             iou_fn = bbox_iou_np
         elif _is_rle_list(det["masks"]) or _is_rle_list(gt["masks"]):
-            def _to_rle_list(masks):
-                if _is_rle_list(masks):
-                    return list(masks)
-                dense = np.asarray(masks).astype(np.uint8)  # mixed input: encode dense side
-                return [{"size": dense.shape[1:], "counts": _native.rle_encode(m)} for m in dense]
-
-            dt_geom = _to_rle_list(det["masks"])
-            gt_geom = _to_rle_list(gt["masks"])
+            dt_geom = _as_rle_list(det["masks"])
+            gt_geom = _as_rle_list(gt["masks"])
             dt_areas = np.asarray([_native.rle_area(m["counts"]) for m in dt_geom], np.float64)
             gt_areas = np.asarray([_native.rle_area(m["counts"]) for m in gt_geom], np.float64)
             iou_fn = mask_iou_np

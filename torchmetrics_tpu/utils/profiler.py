@@ -47,16 +47,21 @@ class StepTimer:
     @contextmanager
     def phase(self, name: str, result: Any = None):
         """Time a phase; set ``timer.live = device_value`` inside the block
-        (or pass ``result``) to block on it before stopping the clock."""
+        (or pass ``result``) to block on it before stopping the clock.
+        Reentrant (nested phases keep their own live slots) and
+        exception-safe (time is recorded even if the block raises)."""
+        outer_live = self._live
         self._live = result
         t0 = time.perf_counter()
-        with jax.profiler.TraceAnnotation(name):
-            yield self
-        if self._block and self._live is not None:
-            jax.block_until_ready(self._live)
-        self._totals[name] += time.perf_counter() - t0
-        self._counts[name] += 1
-        self._live = None
+        try:
+            with jax.profiler.TraceAnnotation(name):
+                yield self
+            if self._block and self._live is not None:
+                jax.block_until_ready(self._live)
+        finally:
+            self._totals[name] += time.perf_counter() - t0
+            self._counts[name] += 1
+            self._live = outer_live
 
     @property
     def live(self) -> Any:
