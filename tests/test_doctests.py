@@ -1,0 +1,24 @@
+"""Docstring examples as API tests (reference test strategy §4: doctests run
+over ``src/`` as part of the suite, ``Makefile:26``)."""
+import doctest
+
+import pytest
+
+import torchmetrics_tpu.aggregation
+import torchmetrics_tpu.classification.accuracy
+import torchmetrics_tpu.collections
+import torchmetrics_tpu.regression.mse
+
+MODULES = [
+    torchmetrics_tpu.aggregation,
+    torchmetrics_tpu.classification.accuracy,
+    torchmetrics_tpu.collections,
+    torchmetrics_tpu.regression.mse,
+]
+
+
+@pytest.mark.parametrize("module", MODULES, ids=lambda m: m.__name__)
+def test_doctests(module):
+    results = doctest.testmod(module, verbose=False)
+    assert results.attempted > 0, f"no doctests found in {module.__name__}"
+    assert results.failed == 0

@@ -1,0 +1,47 @@
+"""Uneven per-rank state sync — the reference's pad-to-max gather protocol
+(``utilities/distributed.py:124-147``; ``tests/unittests/bases/test_ddp.py``
+uneven-shape cases). Ranks holding different sample counts must merge
+losslessly for every cat-state metric form."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import torchmetrics_tpu as tm
+
+
+def test_cat_metric_uneven_ranks():
+    r0, r1 = tm.CatMetric(), tm.CatMetric()
+    r0.update(jnp.asarray([1.0, 2.0, 3.0]))          # rank 0: 3 samples
+    r1.update(jnp.asarray([4.0]))                     # rank 1: 1 sample
+    merged = r0.merge_states([r0.metric_state, r1.metric_state])
+    for k, v in merged.items():
+        setattr(r0, k, list(v) if isinstance(v, tuple) else v)
+    np.testing.assert_allclose(np.asarray(r0.compute()), [1.0, 2.0, 3.0, 4.0])
+
+
+def test_spearman_uneven_ranks():
+    # list-state regression metric: per-rank batches of different sizes
+    full = tm.SpearmanCorrCoef()
+    p = np.random.RandomState(0).rand(10).astype(np.float32)
+    t = (2 * p + np.random.RandomState(1).rand(10) * 0.1).astype(np.float32)
+    full.update(jnp.asarray(p), jnp.asarray(t))
+    expected = float(full.compute())
+
+    r0, r1 = tm.SpearmanCorrCoef(), tm.SpearmanCorrCoef()
+    r0.update(jnp.asarray(p[:7]), jnp.asarray(t[:7]))
+    r1.update(jnp.asarray(p[7:]), jnp.asarray(t[7:]))
+    merged = r0.merge_states([r0.metric_state, r1.metric_state])
+    for k, v in merged.items():
+        setattr(r0, k, list(v) if isinstance(v, tuple) else v)
+    assert np.isclose(float(r0.compute()), expected, atol=1e-6)
+
+
+def test_empty_rank_cat_state():
+    # one rank saw no data at all (reference test_ddp empty-list sync case)
+    r0, r1 = tm.CatMetric(), tm.CatMetric()
+    r0.update(jnp.asarray([5.0, 6.0]))
+    merged = r0.merge_states([r0.metric_state, r1.metric_state])
+    for k, v in merged.items():
+        setattr(r0, k, list(v) if isinstance(v, tuple) else v)
+    np.testing.assert_allclose(np.asarray(r0.compute()), [5.0, 6.0])

@@ -143,7 +143,17 @@ class CatMetric(BaseAggregator):
 
 
 class MeanMetric(BaseAggregator):
-    """Weighted running mean. Parity: reference ``aggregation.py:493``."""
+    """Weighted running mean. Parity: reference ``aggregation.py:493``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import MeanMetric
+        >>> metric = MeanMetric()
+        >>> _ = metric(jnp.asarray([1.0, 2.0, 3.0]))
+        >>> _ = metric(jnp.asarray([4.0, 5.0]))
+        >>> float(metric.compute())
+        3.0
+    """
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("sum", jnp.asarray(0.0, dtype=jnp.float32), nan_strategy, **kwargs)

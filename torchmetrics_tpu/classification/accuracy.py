@@ -17,6 +17,19 @@ Array = jax.Array
 
 
 class BinaryAccuracy(BinaryStatScores):
+    """Binary accuracy over thresholded probabilities / logits.
+
+    Parity: reference ``classification/accuracy.py`` (BinaryAccuracy).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryAccuracy
+        >>> metric = BinaryAccuracy()
+        >>> metric.update(jnp.asarray([0.2, 0.7, 0.6, 0.1]), jnp.asarray([0, 1, 0, 0]))
+        >>> float(metric.compute())
+        0.75
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update = False

@@ -39,6 +39,20 @@ class MetricCollection:
     Args mirror the reference: ``metrics`` (Metric, sequence, or mapping),
     ``prefix``/``postfix`` key decoration, ``compute_groups`` (True for
     auto-discovery, a list-of-lists of names for manual groups, False off).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import MetricCollection
+        >>> from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassF1Score
+        >>> coll = MetricCollection({
+        ...     "acc": MulticlassAccuracy(num_classes=3, average="micro"),
+        ...     "f1": MulticlassF1Score(num_classes=3, average="micro"),
+        ... })
+        >>> coll.update(jnp.asarray([0, 1, 2, 1]), jnp.asarray([0, 1, 1, 1]))
+        >>> {k: round(float(v), 2) for k, v in coll.compute().items()}
+        {'acc': 0.75, 'f1': 0.75}
+        >>> sorted(coll.compute_groups[0])  # identical states discovered + shared
+        ['acc', 'f1']
     """
 
     def __init__(

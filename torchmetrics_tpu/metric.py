@@ -432,6 +432,11 @@ class Metric:
                     merged_list.extend(list(v))
                 out[name] = tuple(merged_list)
                 continue
+            if red == Reduction.CAT:
+                # per-rank sample counts may differ (reference pad-to-max
+                # gather protocol) — concatenate without equal-shape stacking
+                out[name] = jnp.concatenate([jnp.asarray(v) for v in vals], axis=0)
+                continue
             stack = jnp.stack([jnp.asarray(v) for v in vals])
             if red == Reduction.SUM:
                 out[name] = jnp.sum(stack, axis=0)
@@ -441,8 +446,6 @@ class Metric:
                 out[name] = jnp.max(stack, axis=0)
             elif red == Reduction.MIN:
                 out[name] = jnp.min(stack, axis=0)
-            elif red == Reduction.CAT:
-                out[name] = jnp.concatenate(list(stack), axis=0)
             elif callable(red):
                 out[name] = red(stack)
             else:

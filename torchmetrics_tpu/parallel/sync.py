@@ -200,6 +200,11 @@ class FakeSync(SyncBackend):
 
     def sync_tensor(self, value: Array, reduction) -> Array:
         peers = [jnp.asarray(s[self._current_name]) for s in self._group]
+        if reduction == Reduction.CAT:
+            # ranks may hold different sample counts (the reference's
+            # pad-to-max gather, utilities/distributed.py:124-147) —
+            # concatenate before any equal-shape stacking
+            return jnp.concatenate(peers, axis=0)
         gathered = jnp.stack(peers, axis=0)
         if reduction == Reduction.SUM:
             return jnp.sum(gathered, axis=0)
@@ -209,8 +214,6 @@ class FakeSync(SyncBackend):
             return jnp.max(gathered, axis=0)
         if reduction == Reduction.MIN:
             return jnp.min(gathered, axis=0)
-        if reduction == Reduction.CAT:
-            return jnp.concatenate(peers, axis=0)
         if reduction == Reduction.NONE:
             return gathered
         if callable(reduction):

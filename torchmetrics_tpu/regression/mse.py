@@ -11,6 +11,19 @@ Array = jax.Array
 
 
 class MeanSquaredError(Metric):
+    """Mean squared error (or RMSE with ``squared=False``).
+
+    Parity: reference ``regression/mse.py``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import MeanSquaredError
+        >>> metric = MeanSquaredError()
+        >>> metric.update(jnp.asarray([1.0, 2.0, 3.0]), jnp.asarray([1.0, 2.0, 5.0]))
+        >>> round(float(metric.compute()), 4)
+        1.3333
+    """
+
     is_differentiable = True
     higher_is_better = False
     full_state_update = False

@@ -42,12 +42,9 @@ def _state_tree(obj: Any) -> Dict[str, Any]:
 
 def _apply_tree(obj: Any, tree: Dict[str, Any]) -> None:
     if hasattr(obj, "metric_state"):
-        for name, value in tree.items():
-            current = getattr(obj, name)
-            if isinstance(current, list):
-                setattr(obj, name, [jnp.asarray(v) for v in value])
-            else:
-                setattr(obj, name, jnp.asarray(value))
+        # Metric.load_state_dict owns the list-state registry semantics
+        obj.load_state_dict(dict(tree), strict=False)
+        obj._computed = None  # drop any cached compute result
         # restored state counts as updated (avoids the compute-before-update
         # warning on a freshly-constructed metric)
         if getattr(obj, "_update_count", None) == 0:
