@@ -78,6 +78,15 @@ def bench_ours() -> float:
 
 def bench_reference() -> float:
     """Reference TorchMetrics from the read-only mount, torch CPU."""
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests", "helpers"))
+    try:
+        from lightning_utilities_stub import install_stub
+
+        install_stub()  # reference imports lightning_utilities; stub it
+    except Exception:
+        pass
+    finally:
+        sys.path.pop(0)
     sys.path.insert(0, "/root/reference/src")
     try:
         import torch

@@ -1,0 +1,125 @@
+"""Task-dispatch at-fixed scanners + remaining mc/ml variants vs numpy oracles."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.classification import (
+    multiclass_precision_at_fixed_recall,
+    multiclass_sensitivity_at_specificity,
+    multiclass_specificity_at_sensitivity,
+    multilabel_precision_at_fixed_recall,
+    precision_at_fixed_recall,
+    recall_at_fixed_precision,
+    sensitivity_at_specificity,
+    specificity_at_sensitivity,
+)
+
+
+def _np_best(objective, constraint, thresholds, min_c):
+    feasible = constraint >= min_c
+    if not feasible.any():
+        return 0.0, 1e6
+    masked = np.where(feasible, objective, -1.0)
+    i = int(np.argmax(masked))
+    thr = thresholds[min(i, len(thresholds) - 1)]
+    return float(masked[i]), float(thr)
+
+
+def _np_roc(preds, target):
+    order = np.argsort(-preds, kind="stable")
+    p, t = preds[order], target[order]
+    tps = np.cumsum(t)
+    fps = np.cumsum(1 - t)
+    dist = np.r_[np.where(np.diff(p) != 0)[0], len(p) - 1]
+    tpr = np.r_[0.0, tps[dist] / max(t.sum(), 1)]
+    fpr = np.r_[0.0, fps[dist] / max((1 - t).sum(), 1)]
+    thr = np.r_[1.0, p[dist]]
+    return fpr, tpr, thr
+
+
+@pytest.mark.parametrize("min_spec", [0.2, 0.5, 0.8])
+def test_binary_sensitivity_at_specificity_vs_numpy(min_spec):
+    rng = np.random.RandomState(int(min_spec * 10))
+    preds = rng.rand(200)
+    target = (rng.rand(200) < preds).astype(np.int32)
+    val, thr = sensitivity_at_specificity(jnp.asarray(preds), jnp.asarray(target),
+                                          task="binary", min_specificity=min_spec)
+    fpr, tpr, t = _np_roc(preds, target)
+    exp_val, _ = _np_best(tpr, 1 - fpr, t, min_spec)
+    assert np.isclose(float(val), exp_val, atol=1e-6)
+
+
+def test_multiclass_variants_shapes():
+    rng = np.random.RandomState(0)
+    n, c = 120, 4
+    logits = rng.rand(n, c)
+    preds = jnp.asarray(logits / logits.sum(-1, keepdims=True))
+    target = jnp.asarray(rng.randint(0, c, n))
+    for fn, kw in [
+        (multiclass_precision_at_fixed_recall, dict(min_recall=0.5)),
+        (multiclass_sensitivity_at_specificity, dict(min_specificity=0.5)),
+        (multiclass_specificity_at_sensitivity, dict(min_sensitivity=0.5)),
+    ]:
+        for thresholds in (None, 50):
+            v, t = fn(preds, target, c, list(kw.values())[0], thresholds=thresholds)
+            assert v.shape == (c,) and t.shape == (c,)
+            assert ((np.asarray(v) >= 0) & (np.asarray(v) <= 1)).all()
+
+
+def test_dispatch_and_exact_binned_agree():
+    rng = np.random.RandomState(1)
+    preds = rng.rand(500)
+    target = (rng.rand(500) < preds).astype(np.int32)
+    exact = recall_at_fixed_precision(jnp.asarray(preds), jnp.asarray(target),
+                                      task="binary", min_precision=0.6)
+    binned = recall_at_fixed_precision(jnp.asarray(preds), jnp.asarray(target),
+                                       task="binary", min_precision=0.6, thresholds=2000)
+    assert np.isclose(float(exact[0]), float(binned[0]), atol=2e-2)
+
+    with pytest.raises(ValueError, match="task"):
+        precision_at_fixed_recall(jnp.asarray(preds), jnp.asarray(target),
+                                  task="bogus", min_recall=0.5)
+    with pytest.raises(ValueError, match="num_labels"):
+        specificity_at_sensitivity(jnp.asarray(preds), jnp.asarray(target),
+                                   task="multilabel", min_sensitivity=0.5)
+
+
+def test_multilabel_precision_at_fixed_recall_runs():
+    rng = np.random.RandomState(2)
+    preds = jnp.asarray(rng.rand(64, 3))
+    target = jnp.asarray(rng.randint(0, 2, (64, 3)))
+    v, t = multilabel_precision_at_fixed_recall(preds, target, 3, 0.5, thresholds=32)
+    assert v.shape == (3,)
+
+
+def test_multilabel_exact_mode_respects_ignore_index():
+    # regression: exact mode must exclude ignored entries just like binned
+    rng = np.random.RandomState(5)
+    preds = rng.rand(100, 2).astype(np.float32)
+    target = (rng.rand(100, 2) > 0.5).astype(np.int64)
+    target[:30, 0] = -1  # ignored entries with high-score negatives mixed in
+
+    from torchmetrics_tpu.functional.classification import (
+        multilabel_specificity_at_sensitivity,
+        multilabel_roc,
+    )
+
+    v_exact, _ = multilabel_specificity_at_sensitivity(
+        jnp.asarray(preds), jnp.asarray(target), 2, 0.5, thresholds=None, ignore_index=-1)
+    # oracle: drop ignored rows per label, compute on the clean subset
+    keep = target[:, 0] != -1
+    v_clean, _ = multilabel_specificity_at_sensitivity(
+        jnp.asarray(np.stack([preds[keep, 0], preds[:, 1][keep]], 1)),
+        jnp.asarray(np.stack([target[keep, 0], target[:, 1][keep]], 1)),
+        2, 0.5, thresholds=None)
+    assert np.isclose(float(v_exact[0]), float(v_clean[0]), atol=1e-6)
+
+    # exact and (finely) binned modes must agree under ignore_index
+    fpr_e, tpr_e, _ = multilabel_roc(jnp.asarray(preds), jnp.asarray(target), 2,
+                                     thresholds=None, ignore_index=-1)
+    fpr_b, tpr_b, _ = multilabel_roc(jnp.asarray(preds), jnp.asarray(target), 2,
+                                     thresholds=500, ignore_index=-1)
+    # compare terminal TPR/FPR (full curve grids differ)
+    assert np.isclose(float(np.asarray(fpr_e[0])[-1]), 1.0, atol=1e-6)
+    assert np.isclose(float(np.asarray(tpr_b)[0, -1]), 1.0, atol=1e-6)

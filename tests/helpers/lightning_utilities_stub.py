@@ -1,0 +1,98 @@
+"""Minimal in-test stub of ``lightning_utilities`` so the reference
+TorchMetrics (oracle) imports without the real package.
+
+Provides exactly the four symbols the reference uses:
+``apply_to_collection``, ``core.enums.StrEnum``, ``core.imports.
+RequirementCache``/``package_available``. Install with
+:func:`install_stub` BEFORE importing ``torchmetrics`` from the mount.
+"""
+import importlib.util
+import sys
+import types
+from enum import Enum
+
+
+def _apply_to_collection(data, dtype, function, *args, **kwargs):
+    if isinstance(data, dtype):
+        return function(data, *args, **kwargs)
+    if isinstance(data, (list, tuple)):
+        out = [_apply_to_collection(d, dtype, function, *args, **kwargs) for d in data]
+        return type(data)(out) if not hasattr(data, "_fields") else type(data)(*out)
+    if isinstance(data, dict):
+        return {k: _apply_to_collection(v, dtype, function, *args, **kwargs) for k, v in data.items()}
+    return data
+
+
+class _StrEnum(str, Enum):
+    @classmethod
+    def from_str(cls, value, source="key"):
+        for st in cls:
+            if st.name.lower() == value.lower().replace("-", "_") or st.value.lower() == value.lower():
+                return st
+        return None
+
+    @classmethod
+    def try_from_str(cls, value, source="key"):
+        return cls.from_str(value, source)
+
+    def __eq__(self, other):
+        if isinstance(other, Enum):
+            other = other.value
+        return self.value.lower() == str(other).lower()
+
+    def __hash__(self):
+        return hash(self.value.lower())
+
+
+def _package_available(name: str) -> bool:
+    try:
+        return importlib.util.find_spec(name) is not None
+    except (ImportError, ModuleNotFoundError, ValueError):
+        return False
+
+
+class _RequirementCache:
+    def __init__(self, requirement: str = "", module: str = None):
+        self.requirement = requirement
+        self.module = module
+
+    def _check(self) -> bool:
+        if self.module is not None:
+            return _package_available(self.module)
+        name = self.requirement.split(">")[0].split("=")[0].split("<")[0].split("[")[0].strip()
+        return _package_available(name.replace("-", "_"))
+
+    def __bool__(self) -> bool:
+        return self._check()
+
+    def __str__(self) -> str:
+        return f"Requirement {self.requirement!r} {'met' if self._check() else 'not met'}"
+
+    __repr__ = __str__
+
+
+def install_stub() -> None:
+    """Register the stub modules in sys.modules (idempotent)."""
+    if "lightning_utilities" in sys.modules:
+        return
+    root = types.ModuleType("lightning_utilities")
+    core = types.ModuleType("lightning_utilities.core")
+    enums = types.ModuleType("lightning_utilities.core.enums")
+    imports = types.ModuleType("lightning_utilities.core.imports")
+    apply_mod = types.ModuleType("lightning_utilities.core.apply_func")
+
+    root.apply_to_collection = _apply_to_collection
+    apply_mod.apply_to_collection = _apply_to_collection
+    enums.StrEnum = _StrEnum
+    imports.RequirementCache = _RequirementCache
+    imports.package_available = _package_available
+    root.core = core
+    core.enums = enums
+    core.imports = imports
+    core.apply_func = apply_mod
+
+    sys.modules["lightning_utilities"] = root
+    sys.modules["lightning_utilities.core"] = core
+    sys.modules["lightning_utilities.core.enums"] = enums
+    sys.modules["lightning_utilities.core.imports"] = imports
+    sys.modules["lightning_utilities.core.apply_func"] = apply_mod

@@ -1,0 +1,58 @@
+"""PSNRB vs the reference implementation (torch CPU) as oracle."""
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.image import peak_signal_noise_ratio_with_blocked_effect
+from torchmetrics_tpu.image import PeakSignalNoiseRatioWithBlockedEffect
+
+
+def _reference_psnrb(preds: np.ndarray, target: np.ndarray, block_size: int = 8):
+    import os
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "helpers"))
+    from lightning_utilities_stub import install_stub
+
+    install_stub()
+    sys.path.insert(0, "/root/reference/src")
+    try:
+        import torch
+        from torchmetrics.functional.image.psnrb import peak_signal_noise_ratio_with_blocked_effect as ref
+
+        return float(ref(torch.from_numpy(preds), torch.from_numpy(target), block_size=block_size))
+    finally:
+        sys.path.pop(0)
+
+
+@pytest.mark.parametrize("shape", [(2, 1, 16, 16), (1, 1, 24, 32)])
+@pytest.mark.parametrize("block_size", [4, 8])
+def test_psnrb_vs_reference(shape, block_size):
+    rng = np.random.RandomState(shape[0] * block_size)
+    target = rng.rand(*shape).astype(np.float32)
+    preds = np.clip(target + rng.randn(*shape).astype(np.float32) * 0.1, 0, 1)
+    try:
+        expected = _reference_psnrb(preds, target, block_size)
+    except Exception:
+        pytest.skip("reference torchmetrics not importable")
+    ours = float(peak_signal_noise_ratio_with_blocked_effect(
+        jnp.asarray(preds), jnp.asarray(target), block_size=block_size))
+    assert np.isclose(ours, expected, atol=1e-4), (ours, expected)
+
+
+def test_psnrb_class_accumulates():
+    rng = np.random.RandomState(0)
+    t1 = rng.rand(2, 1, 16, 16).astype(np.float32)
+    p1 = np.clip(t1 + 0.05 * rng.randn(*t1.shape).astype(np.float32), 0, 1)
+    m = PeakSignalNoiseRatioWithBlockedEffect()
+    m.update(jnp.asarray(p1), jnp.asarray(t1))
+    v = float(m.compute())
+    assert np.isfinite(v) and v > 0
+
+    with pytest.raises(ValueError, match="grayscale"):
+        peak_signal_noise_ratio_with_blocked_effect(
+            jnp.zeros((1, 3, 8, 8)), jnp.zeros((1, 3, 8, 8)))
+    with pytest.raises(ValueError, match="block_size"):
+        PeakSignalNoiseRatioWithBlockedEffect(block_size=0)

@@ -63,7 +63,12 @@ from .recall_fixed_precision import (
     BinarySpecificityAtSensitivity,
     MulticlassPrecisionAtFixedRecall,
     MulticlassRecallAtFixedPrecision,
+    MulticlassSensitivityAtSpecificity,
+    MulticlassSpecificityAtSensitivity,
+    MultilabelPrecisionAtFixedRecall,
     MultilabelRecallAtFixedPrecision,
+    MultilabelSensitivityAtSpecificity,
+    MultilabelSpecificityAtSensitivity,
     PrecisionAtFixedRecall,
     RecallAtFixedPrecision,
     SensitivityAtSpecificity,
@@ -97,8 +102,11 @@ __all__ = [
     "MultilabelCoverageError", "MultilabelRankingAveragePrecision", "MultilabelRankingLoss",
     "RecallAtFixedPrecision", "BinaryRecallAtFixedPrecision", "MulticlassRecallAtFixedPrecision", "MultilabelRecallAtFixedPrecision",
     "PrecisionAtFixedRecall", "BinaryPrecisionAtFixedRecall", "MulticlassPrecisionAtFixedRecall",
+    "MultilabelPrecisionAtFixedRecall",
     "SensitivityAtSpecificity", "BinarySensitivityAtSpecificity",
+    "MulticlassSensitivityAtSpecificity", "MultilabelSensitivityAtSpecificity",
     "SpecificityAtSensitivity", "BinarySpecificityAtSensitivity",
+    "MulticlassSpecificityAtSensitivity", "MultilabelSpecificityAtSensitivity",
     "AUROC", "BinaryAUROC", "MulticlassAUROC", "MultilabelAUROC",
     "AveragePrecision", "BinaryAveragePrecision", "MulticlassAveragePrecision", "MultilabelAveragePrecision",
     "PrecisionRecallCurve", "BinaryPrecisionRecallCurve", "MulticlassPrecisionRecallCurve", "MultilabelPrecisionRecallCurve",

@@ -15,7 +15,7 @@ from ..functional.classification.precision_recall_curve import (
     _multilabel_precision_recall_curve_compute,
 )
 from ..functional.classification.roc import _binary_roc_compute
-from ..functional.classification.specificity_sensitivity import _best_subject_to
+from ..functional.classification.specificity_sensitivity import _best_subject_to, _scan_per_class
 from ..metric import Metric
 from ..utils.enums import ClassificationTask
 from .base import _ClassificationTaskWrapper
@@ -110,22 +110,12 @@ class _PerClassAtFixed(MulticlassPrecisionRecallCurve):
         self.min_value = min_value
 
     def compute(self):
+        pick = (lambda p, r: (r, p)) if self._objective_is_recall else (lambda p, r: (p, r))
         if self.thresholds is None:
-            precision, recall, t = _multiclass_precision_recall_curve_compute(
-                self._exact_state(), self.num_classes, None
-            )
-            outs = [
-                _best_subject_to(r if self._objective_is_recall else p,
-                                 p if self._objective_is_recall else r, h, self.min_value)
-                for p, r, h in zip(precision, recall, t)
-            ]
-            return jnp.stack([o[0] for o in outs]), jnp.stack([o[1] for o in outs])
-        precision, recall, t = _multiclass_precision_recall_curve_compute(
-            self.confmat, self.num_classes, self.thresholds
-        )
-        if self._objective_is_recall:
-            return _best_subject_to(recall, precision, t, self.min_value)
-        return _best_subject_to(precision, recall, t, self.min_value)
+            curves = _multiclass_precision_recall_curve_compute(self._exact_state(), self.num_classes, None)
+            return _scan_per_class(curves, None, pick, self.min_value)
+        curves = _multiclass_precision_recall_curve_compute(self.confmat, self.num_classes, self.thresholds)
+        return _scan_per_class(curves, self.thresholds, pick, self.min_value)
 
 
 class MulticlassRecallAtFixedPrecision(_PerClassAtFixed):
@@ -143,16 +133,92 @@ class MultilabelRecallAtFixedPrecision(MultilabelPrecisionRecallCurve):
         self.min_precision = min_precision
 
     def compute(self):
+        pick = lambda p, r: (r, p)  # noqa: E731
         if self.thresholds is None:
-            precision, recall, t = _multilabel_precision_recall_curve_compute(
+            curves = _multilabel_precision_recall_curve_compute(
                 self._exact_state(), self.num_labels, None, self.ignore_index
             )
-            outs = [_best_subject_to(r, p, h, self.min_precision) for p, r, h in zip(precision, recall, t)]
-            return jnp.stack([o[0] for o in outs]), jnp.stack([o[1] for o in outs])
-        precision, recall, t = _multilabel_precision_recall_curve_compute(
-            self.confmat, self.num_labels, self.thresholds
-        )
-        return _best_subject_to(recall, precision, t, self.min_precision)
+            return _scan_per_class(curves, None, pick, self.min_precision)
+        curves = _multilabel_precision_recall_curve_compute(self.confmat, self.num_labels, self.thresholds)
+        return _scan_per_class(curves, self.thresholds, pick, self.min_precision)
+
+
+class _PerClassRocScan(MulticlassPrecisionRecallCurve):
+    """Multiclass ROC-curve scanner (sensitivity/specificity pairs)."""
+
+    _objective_is_tpr = True  # True: sensitivity@specificity, False: reverse
+
+    def __init__(self, num_classes: int, min_value: float, thresholds: Thresholds = None,
+                 ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(num_classes, thresholds, ignore_index, validate_args, **kwargs)
+        self.min_value = min_value
+
+    def _pick(self, fpr, tpr):
+        return (tpr, 1 - fpr) if self._objective_is_tpr else (1 - fpr, tpr)
+
+    def compute(self):
+        from ..functional.classification.roc import _multiclass_roc_compute
+
+        if self.thresholds is None:
+            curves = _multiclass_roc_compute(self._exact_state(), self.num_classes, None)
+            return _scan_per_class(curves, None, self._pick, self.min_value)
+        curves = _multiclass_roc_compute(self.confmat, self.num_classes, self.thresholds)
+        return _scan_per_class(curves, self.thresholds, self._pick, self.min_value)
+
+
+class MulticlassSensitivityAtSpecificity(_PerClassRocScan):
+    """Parity: reference ``classification/sensitivity_specificity.py`` (multiclass)."""
+
+    _objective_is_tpr = True
+
+
+class MulticlassSpecificityAtSensitivity(_PerClassRocScan):
+    """Parity: reference ``classification/specificity_sensitivity.py`` (multiclass)."""
+
+    _objective_is_tpr = False
+
+
+class _PerLabelScan(MultilabelPrecisionRecallCurve):
+    """Multilabel curve scanner (PR or ROC picked by subclass)."""
+
+    _use_roc = False
+    _pick = staticmethod(lambda a, b: (a, b))
+
+    def __init__(self, num_labels: int, min_value: float, thresholds: Thresholds = None,
+                 ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(num_labels, thresholds, ignore_index, validate_args, **kwargs)
+        self.min_value = min_value
+
+    def compute(self):
+        from ..functional.classification.roc import _multilabel_roc_compute
+
+        compute = _multilabel_roc_compute if self._use_roc else _multilabel_precision_recall_curve_compute
+        if self.thresholds is None:
+            curves = compute(self._exact_state(), self.num_labels, None, self.ignore_index)
+            return _scan_per_class(curves, None, self._pick, self.min_value)
+        curves = compute(self.confmat, self.num_labels, self.thresholds)
+        return _scan_per_class(curves, self.thresholds, self._pick, self.min_value)
+
+
+class MultilabelPrecisionAtFixedRecall(_PerLabelScan):
+    """Parity: reference ``classification/precision_fixed_recall.py`` (multilabel)."""
+
+    _use_roc = False
+    _pick = staticmethod(lambda precision, recall: (precision, recall))
+
+
+class MultilabelSensitivityAtSpecificity(_PerLabelScan):
+    """Parity: reference ``classification/sensitivity_specificity.py`` (multilabel)."""
+
+    _use_roc = True
+    _pick = staticmethod(lambda fpr, tpr: (tpr, 1 - fpr))
+
+
+class MultilabelSpecificityAtSensitivity(_PerLabelScan):
+    """Parity: reference ``classification/specificity_sensitivity.py`` (multilabel)."""
+
+    _use_roc = True
+    _pick = staticmethod(lambda fpr, tpr: (1 - fpr, tpr))
 
 
 class RecallAtFixedPrecision(_ClassificationTaskWrapper):
@@ -188,26 +254,44 @@ class PrecisionAtFixedRecall(_ClassificationTaskWrapper):
             if not isinstance(num_classes, int):
                 raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
             return MulticlassPrecisionAtFixedRecall(num_classes, min_recall, **kwargs)
-        raise NotImplementedError("MultilabelPrecisionAtFixedRecall: use per-label RecallAtFixedPrecision instead")
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
+        return MultilabelPrecisionAtFixedRecall(num_labels, min_recall, **kwargs)
 
 
 class SensitivityAtSpecificity(_ClassificationTaskWrapper):
-    """Task facade (binary only here)."""
+    """Task facade. Parity: reference ``classification/sensitivity_specificity.py``."""
 
     def __new__(cls, task: str, min_specificity: float, thresholds: Thresholds = None,
+                num_classes: Optional[int] = None, num_labels: Optional[int] = None,
                 ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> Metric:
         task = ClassificationTask.from_str(task)
+        kwargs.update({"thresholds": thresholds, "ignore_index": ignore_index, "validate_args": validate_args})
         if task == ClassificationTask.BINARY:
-            return BinarySensitivityAtSpecificity(min_specificity, thresholds, ignore_index, validate_args, **kwargs)
-        raise NotImplementedError("SensitivityAtSpecificity currently supports the binary task")
+            return BinarySensitivityAtSpecificity(min_specificity, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+            return MulticlassSensitivityAtSpecificity(num_classes, min_specificity, **kwargs)
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
+        return MultilabelSensitivityAtSpecificity(num_labels, min_specificity, **kwargs)
 
 
 class SpecificityAtSensitivity(_ClassificationTaskWrapper):
-    """Task facade (binary only here)."""
+    """Task facade. Parity: reference ``classification/specificity_sensitivity.py``."""
 
     def __new__(cls, task: str, min_sensitivity: float, thresholds: Thresholds = None,
+                num_classes: Optional[int] = None, num_labels: Optional[int] = None,
                 ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> Metric:
         task = ClassificationTask.from_str(task)
+        kwargs.update({"thresholds": thresholds, "ignore_index": ignore_index, "validate_args": validate_args})
         if task == ClassificationTask.BINARY:
-            return BinarySpecificityAtSensitivity(min_sensitivity, thresholds, ignore_index, validate_args, **kwargs)
-        raise NotImplementedError("SpecificityAtSensitivity currently supports the binary task")
+            return BinarySpecificityAtSensitivity(min_sensitivity, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.")
+            return MulticlassSpecificityAtSensitivity(num_classes, min_sensitivity, **kwargs)
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.")
+        return MultilabelSpecificityAtSensitivity(num_labels, min_sensitivity, **kwargs)

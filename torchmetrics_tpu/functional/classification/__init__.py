@@ -61,8 +61,18 @@ from .specificity_sensitivity import (
     binary_recall_at_fixed_precision,
     binary_sensitivity_at_specificity,
     binary_specificity_at_sensitivity,
+    multiclass_precision_at_fixed_recall,
     multiclass_recall_at_fixed_precision,
+    multiclass_sensitivity_at_specificity,
+    multiclass_specificity_at_sensitivity,
+    multilabel_precision_at_fixed_recall,
     multilabel_recall_at_fixed_precision,
+    multilabel_sensitivity_at_specificity,
+    multilabel_specificity_at_sensitivity,
+    precision_at_fixed_recall,
+    recall_at_fixed_precision,
+    sensitivity_at_specificity,
+    specificity_at_sensitivity,
 )
 from .auroc import auroc, binary_auroc, multiclass_auroc, multilabel_auroc
 from .average_precision import (
@@ -88,6 +98,11 @@ __all__ = [
     "binary_recall_at_fixed_precision", "binary_precision_at_fixed_recall",
     "binary_sensitivity_at_specificity", "binary_specificity_at_sensitivity",
     "multiclass_recall_at_fixed_precision", "multilabel_recall_at_fixed_precision",
+    "multiclass_precision_at_fixed_recall", "multilabel_precision_at_fixed_recall",
+    "multiclass_sensitivity_at_specificity", "multilabel_sensitivity_at_specificity",
+    "multiclass_specificity_at_sensitivity", "multilabel_specificity_at_sensitivity",
+    "precision_at_fixed_recall", "recall_at_fixed_precision",
+    "sensitivity_at_specificity", "specificity_at_sensitivity",
     "auroc", "binary_auroc", "multiclass_auroc", "multilabel_auroc",
     "average_precision", "binary_average_precision", "multiclass_average_precision", "multilabel_average_precision",
     "precision_recall_curve", "binary_precision_recall_curve", "multiclass_precision_recall_curve", "multilabel_precision_recall_curve",

@@ -238,11 +238,16 @@ def _multilabel_precision_recall_curve_format(
     preds = preds.reshape(-1, num_labels)
     target = target.reshape(-1, num_labels)
     preds = normalize_logits_if_needed(preds.astype(jnp.float32), "sigmoid")
+    thr = _adjust_threshold_arg(thresholds)
     mask = None
     if ignore_index is not None:
         mask = (target != ignore_index)
-        target = jnp.clip(target, 0, 1)
-    return preds, target.astype(jnp.int32), _adjust_threshold_arg(thresholds), mask
+        if thr is not None:
+            # binned path masks via weights and needs targets in {0, 1};
+            # exact mode must KEEP the ignore marker — the per-label
+            # `t != ignore_index` filter in compute relies on it
+            target = jnp.clip(target, 0, 1)
+    return preds, target.astype(jnp.int32), thr, mask
 
 
 def _multilabel_precision_recall_curve_update(

@@ -159,3 +159,144 @@ def binary_specificity_at_sensitivity(
         fpr, tpr, t = _binary_roc_compute(state, thr)
     specificity = 1 - fpr
     return _best_subject_to(specificity, tpr, t, min_sensitivity)
+
+
+# -- remaining multiclass/multilabel variants (generic over curve + roles) --
+
+def _mc_curve(preds, target, num_classes, thresholds, ignore_index, roc: bool):
+    preds, target, thr, mask = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    compute = _multiclass_roc_compute if roc else _multiclass_precision_recall_curve_compute
+    if thr is None:
+        if mask is not None:
+            preds, target = preds[mask], target[mask]
+        return compute((preds, target), num_classes, None), None
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thr, mask)
+    return compute(state, num_classes, thr), thr
+
+
+def _ml_curve(preds, target, num_labels, thresholds, ignore_index, roc: bool):
+    preds, target, thr, mask = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    compute = _multilabel_roc_compute if roc else _multilabel_precision_recall_curve_compute
+    if thr is None:
+        return compute((preds, target), num_labels, None, ignore_index), None
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thr, mask)
+    return compute(state, num_labels, thr), thr
+
+
+def _scan_per_class(curves, thr, pick, min_constraint):
+    a, b, t = curves
+    if thr is None:  # exact mode: per-class ragged curves in python lists
+        outs = [_best_subject_to(*pick(ai, bi), hi, min_constraint) for ai, bi, hi in zip(a, b, t)]
+        return jnp.stack([o[0] for o in outs]), jnp.stack([o[1] for o in outs])
+    return _best_subject_to(*pick(a, b), t, min_constraint)
+
+
+def multiclass_precision_at_fixed_recall(
+    preds: Array, target: Array, num_classes: int, min_recall: float, thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Parity: reference ``precision_fixed_recall.py:multiclass_precision_at_fixed_recall``."""
+    curves, thr = _mc_curve(preds, target, num_classes, thresholds, ignore_index, roc=False)
+    return _scan_per_class(curves, thr, lambda p, r: (p, r), min_recall)
+
+
+def multilabel_precision_at_fixed_recall(
+    preds: Array, target: Array, num_labels: int, min_recall: float, thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Parity: reference ``precision_fixed_recall.py:multilabel_precision_at_fixed_recall``."""
+    curves, thr = _ml_curve(preds, target, num_labels, thresholds, ignore_index, roc=False)
+    return _scan_per_class(curves, thr, lambda p, r: (p, r), min_recall)
+
+
+def multiclass_sensitivity_at_specificity(
+    preds: Array, target: Array, num_classes: int, min_specificity: float, thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Parity: reference ``sensitivity_specificity.py:multiclass_sensitivity_at_specificity``."""
+    curves, thr = _mc_curve(preds, target, num_classes, thresholds, ignore_index, roc=True)
+    return _scan_per_class(curves, thr, lambda fpr, tpr: (tpr, 1 - fpr), min_specificity)
+
+
+def multilabel_sensitivity_at_specificity(
+    preds: Array, target: Array, num_labels: int, min_specificity: float, thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Parity: reference ``sensitivity_specificity.py:multilabel_sensitivity_at_specificity``."""
+    curves, thr = _ml_curve(preds, target, num_labels, thresholds, ignore_index, roc=True)
+    return _scan_per_class(curves, thr, lambda fpr, tpr: (tpr, 1 - fpr), min_specificity)
+
+
+def multiclass_specificity_at_sensitivity(
+    preds: Array, target: Array, num_classes: int, min_sensitivity: float, thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Parity: reference ``specificity_sensitivity.py:multiclass_specificity_at_sensitivity``."""
+    curves, thr = _mc_curve(preds, target, num_classes, thresholds, ignore_index, roc=True)
+    return _scan_per_class(curves, thr, lambda fpr, tpr: (1 - fpr, tpr), min_sensitivity)
+
+
+def multilabel_specificity_at_sensitivity(
+    preds: Array, target: Array, num_labels: int, min_sensitivity: float, thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Parity: reference ``specificity_sensitivity.py:multilabel_specificity_at_sensitivity``."""
+    curves, thr = _ml_curve(preds, target, num_labels, thresholds, ignore_index, roc=True)
+    return _scan_per_class(curves, thr, lambda fpr, tpr: (1 - fpr, tpr), min_sensitivity)
+
+
+# -- task-dispatch facades (reference functional one-shots) -----------------
+
+def _dispatch(task, binary_fn, mc_fn, ml_fn, preds, target, constraint,
+              num_classes=None, num_labels=None, **kw):
+    if task == "binary":
+        return binary_fn(preds, target, constraint, **kw)
+    if task == "multiclass":
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` must be an int for task='multiclass', got {num_classes}")
+        return mc_fn(preds, target, num_classes, constraint, **kw)
+    if task == "multilabel":
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` must be an int for task='multilabel', got {num_labels}")
+        return ml_fn(preds, target, num_labels, constraint, **kw)
+    raise ValueError(f"Expected argument `task` to be one of 'binary', 'multiclass' or 'multilabel', got {task}")
+
+
+def recall_at_fixed_precision(preds, target, task, min_precision, num_classes=None, num_labels=None,
+                              thresholds=None, ignore_index=None, validate_args=True):
+    """Parity: reference ``recall_fixed_precision.py:recall_at_fixed_precision``."""
+    return _dispatch(task, binary_recall_at_fixed_precision, multiclass_recall_at_fixed_precision,
+                     multilabel_recall_at_fixed_precision, preds, target, min_precision,
+                     num_classes, num_labels, thresholds=thresholds, ignore_index=ignore_index,
+                     validate_args=validate_args)
+
+
+def precision_at_fixed_recall(preds, target, task, min_recall, num_classes=None, num_labels=None,
+                              thresholds=None, ignore_index=None, validate_args=True):
+    """Parity: reference ``precision_fixed_recall.py:precision_at_fixed_recall``."""
+    return _dispatch(task, binary_precision_at_fixed_recall, multiclass_precision_at_fixed_recall,
+                     multilabel_precision_at_fixed_recall, preds, target, min_recall,
+                     num_classes, num_labels, thresholds=thresholds, ignore_index=ignore_index,
+                     validate_args=validate_args)
+
+
+def sensitivity_at_specificity(preds, target, task, min_specificity, num_classes=None, num_labels=None,
+                               thresholds=None, ignore_index=None, validate_args=True):
+    """Parity: reference ``sensitivity_specificity.py:sensitivity_at_specificity``."""
+    return _dispatch(task, binary_sensitivity_at_specificity, multiclass_sensitivity_at_specificity,
+                     multilabel_sensitivity_at_specificity, preds, target, min_specificity,
+                     num_classes, num_labels, thresholds=thresholds, ignore_index=ignore_index,
+                     validate_args=validate_args)
+
+
+def specificity_at_sensitivity(preds, target, task, min_sensitivity, num_classes=None, num_labels=None,
+                               thresholds=None, ignore_index=None, validate_args=True):
+    """Parity: reference ``specificity_sensitivity.py:specificity_at_sensitivity``."""
+    return _dispatch(task, binary_specificity_at_sensitivity, multiclass_specificity_at_sensitivity,
+                     multilabel_specificity_at_sensitivity, preds, target, min_sensitivity,
+                     num_classes, num_labels, thresholds=thresholds, ignore_index=ignore_index,
+                     validate_args=validate_args)

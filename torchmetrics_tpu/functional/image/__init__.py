@@ -4,7 +4,9 @@ from .d_lambda import (
     spatial_distortion_index,
     spectral_distortion_index,
 )
+from .gradients import image_gradients
 from .psnr import peak_signal_noise_ratio
+from .psnrb import peak_signal_noise_ratio_with_blocked_effect
 from .rmse_sw import (
     error_relative_global_dimensionless_synthesis,
     relative_average_spectral_error,
@@ -22,8 +24,10 @@ from .vif import visual_information_fidelity
 
 __all__ = [
     "error_relative_global_dimensionless_synthesis",
+    "image_gradients",
     "multiscale_structural_similarity_index_measure",
     "peak_signal_noise_ratio",
+    "peak_signal_noise_ratio_with_blocked_effect",
     "quality_with_no_reference",
     "relative_average_spectral_error",
     "root_mean_squared_error_using_sliding_window",

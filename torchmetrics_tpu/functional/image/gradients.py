@@ -1,0 +1,35 @@
+"""Image gradients (dy, dx) of a (B, C, H, W) batch.
+
+Parity target: reference ``functional/image/gradients.py:image_gradients``:
+forward differences along H and W with a zero last row/column (TF
+``image_gradients`` convention).
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def image_gradients(img: Array) -> Tuple[Array, Array]:
+    """Return (dy, dx), each shaped like ``img``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.image import image_gradients
+        >>> img = jnp.arange(16, dtype=jnp.float32).reshape(1, 1, 4, 4)
+        >>> dy, dx = image_gradients(img)
+        >>> [int(v) for v in dy[0, 0, 0]]
+        [4, 4, 4, 4]
+        >>> [int(v) for v in dx[0, 0, :, 0]]
+        [1, 1, 1, 0]
+    """
+    img = jnp.asarray(img)
+    if img.ndim != 4:
+        raise RuntimeError(f"The `img` expects a 4D tensor but got {img.ndim}D tensor")
+    if not jnp.issubdtype(img.dtype, jnp.floating) and not jnp.issubdtype(img.dtype, jnp.integer):
+        raise TypeError(f"The `img` expects a numeric dtype but got {img.dtype}")
+    dy = jnp.pad(img[..., 1:, :] - img[..., :-1, :], ((0, 0), (0, 0), (0, 1), (0, 0)))
+    dx = jnp.pad(img[..., :, 1:] - img[..., :, :-1], ((0, 0), (0, 0), (0, 0), (0, 1)))
+    return dy, dx

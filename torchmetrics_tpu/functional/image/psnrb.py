@@ -1,0 +1,78 @@
+"""PSNR with Blocked Effect (PSNRB).
+
+Parity target: reference ``functional/image/psnrb.py`` +
+``image/psnrb.py``: PSNR penalized by the blockiness factor B — the excess
+of squared differences across ``block_size``-aligned column/row boundaries
+over the non-boundary differences, log-weighted.
+
+TPU-first: boundary selection uses static boolean masks (host-built from
+shapes) applied as weights — no gather on symmetric-difference index sets,
+one fused elementwise reduction per direction.
+"""
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _boundary_masks(height: int, width: int, block_size: int) -> Tuple[jnp.ndarray, ...]:
+    import numpy as np
+
+    h_idx = np.arange(width - 1)
+    h_b = np.zeros(width - 1, bool)
+    h_b[block_size - 1 : width - 1 : block_size] = True
+    v_idx = np.arange(height - 1)
+    v_b = np.zeros(height - 1, bool)
+    v_b[block_size - 1 : height - 1 : block_size] = True
+    del h_idx, v_idx
+    return jnp.asarray(h_b), jnp.asarray(~h_b), jnp.asarray(v_b), jnp.asarray(~v_b)
+
+
+def _compute_bef(x: Array, block_size: int = 8) -> Array:
+    """Blockiness of a (N, 1, H, W) batch (summed over the batch)."""
+    if x.shape[1] > 1:
+        raise ValueError(f"`psnrb` metric expects grayscale images, but got images with {x.shape[1]} channels.")
+    _, _, height, width = x.shape
+    h_b, h_bc, v_b, v_bc = _boundary_masks(height, width, block_size)
+
+    dh = (x[..., :, 1:] - x[..., :, :-1]) ** 2  # (N, 1, H, W-1)
+    dv = (x[..., 1:, :] - x[..., :-1, :]) ** 2  # (N, 1, H-1, W)
+    d_b = jnp.sum(dh * h_b) + jnp.sum(dv * v_b[:, None])
+    d_bc = jnp.sum(dh * h_bc) + jnp.sum(dv * v_bc[:, None])
+
+    n_hb = height * (width / block_size) - 1
+    n_hbc = (height * (width - 1)) - n_hb
+    n_vb = width * (height / block_size) - 1
+    n_vbc = (width * (height - 1)) - n_vb
+    d_b = d_b / (n_hb + n_vb)
+    d_bc = d_bc / (n_hbc + n_vbc)
+    t = math.log2(block_size) / math.log2(min(height, width))
+    return jnp.where(d_b > d_bc, t * (d_b - d_bc), 0.0)
+
+
+def _psnrb_update(preds: Array, target: Array, block_size: int = 8) -> Tuple[Array, Array, Array]:
+    sse = jnp.sum((preds - target) ** 2)
+    n = jnp.asarray(target.size)
+    bef = _compute_bef(preds, block_size=block_size)
+    return sse, bef, n
+
+
+def _psnrb_compute(sum_squared_error: Array, bef: Array, num_obs: Array, data_range: Array) -> Array:
+    mse = sum_squared_error / num_obs + bef
+    return jnp.where(data_range > 2, 10 * jnp.log10(data_range.astype(jnp.float32) ** 2 / mse),
+                     10 * jnp.log10(1.0 / mse))
+
+
+def peak_signal_noise_ratio_with_blocked_effect(preds: Array, target: Array, block_size: int = 8) -> Array:
+    """One-shot PSNRB.
+
+    Parity: reference ``functional/image/psnrb.py:peak_signal_noise_ratio_with_blocked_effect``.
+    """
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    sse, bef, n = _psnrb_update(preds, target, block_size)
+    data_range = jnp.max(target) - jnp.min(target)
+    return _psnrb_compute(sse, bef, n, data_range)

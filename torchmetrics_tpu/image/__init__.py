@@ -5,7 +5,7 @@ from .kid import KernelInceptionDistance
 from .lpip import LearnedPerceptualImagePatchSimilarity
 from .mifid import MemorizationInformedFrechetInceptionDistance
 from .perceptual_path_length import PerceptualPathLength
-from .psnr import PeakSignalNoiseRatio
+from .psnr import PeakSignalNoiseRatio, PeakSignalNoiseRatioWithBlockedEffect
 from .simple import (
     ErrorRelativeGlobalDimensionlessSynthesis,
     QualityWithNoReference,
@@ -30,6 +30,7 @@ __all__ = [
     "MemorizationInformedFrechetInceptionDistance",
     "MultiScaleStructuralSimilarityIndexMeasure",
     "PeakSignalNoiseRatio",
+    "PeakSignalNoiseRatioWithBlockedEffect",
     "PerceptualPathLength",
     "QualityWithNoReference",
     "RelativeAverageSpectralError",
