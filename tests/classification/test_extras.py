@@ -63,8 +63,10 @@ def _np_ece(conf, acc, n_bins=15, norm="l1"):
 
 @pytest.mark.parametrize("norm", ["l1", "l2", "max"])
 def test_binary_calibration_error(norm):
-    conf = np.where(BP > 0.5, BP, 1 - BP)
-    acc = ((BP > 0.5).astype(int) == BT).astype(float)
+    # reference semantics: confidence = raw positive-class probability,
+    # accuracy = the target itself (calibration_error.py:136-138)
+    conf = BP
+    acc = BT.astype(float)
     ref = _np_ece(conf, acc, norm=norm)
     got = float(binary_calibration_error(jnp.asarray(BP), jnp.asarray(BT), norm=norm))
     np.testing.assert_allclose(got, ref, atol=1e-6)

@@ -1,0 +1,260 @@
+"""Direct reference-equivalence sweep: run OUR functional metrics and the
+reference TorchMetrics (torch CPU, imported from the read-only mount via the
+lightning_utilities stub) on IDENTICAL random inputs and assert closeness.
+
+This is the reference's own primary correctness oracle (SURVEY.md §4 point 1)
+applied wholesale — one parametrized case per functional kernel family.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "helpers"))
+from lightning_utilities_stub import install_stub  # noqa: E402
+
+install_stub()
+sys.path.insert(0, "/root/reference/src")
+torch = pytest.importorskip("torch")
+
+import torchmetrics.functional as RF  # noqa: E402  (reference)
+import torchmetrics.functional.clustering as RFC  # noqa: E402
+import torchmetrics.functional.image as RFI  # noqa: E402
+import torchmetrics.functional.nominal as RFN  # noqa: E402
+import torchmetrics.functional.text as RFT  # noqa: E402
+
+import torchmetrics_tpu.functional as F  # noqa: E402  (ours)
+
+RNG = np.random.RandomState(1234)
+N = 128
+NC = 5
+
+# shared random inputs
+P_BIN = RNG.rand(N).astype(np.float32)
+T_BIN = (RNG.rand(N) < P_BIN).astype(np.int64)
+P_MC = RNG.rand(N, NC).astype(np.float32)
+P_MC /= P_MC.sum(-1, keepdims=True)
+T_MC = RNG.randint(0, NC, N)
+P_ML = RNG.rand(N, NC).astype(np.float32)
+T_ML = (RNG.rand(N, NC) > 0.5).astype(np.int64)
+X_REG = RNG.randn(N).astype(np.float32)
+Y_REG = (X_REG * 0.8 + RNG.randn(N) * 0.3).astype(np.float32)
+X_POS = np.abs(X_REG) + 0.1
+Y_POS = np.abs(Y_REG) + 0.1
+IMG_A = RNG.rand(2, 3, 32, 32).astype(np.float32)
+IMG_B = np.clip(IMG_A + RNG.randn(2, 3, 32, 32).astype(np.float32) * 0.1, 0, 1)
+AUD_A = RNG.randn(2, 800).astype(np.float32)
+AUD_B = (AUD_A + RNG.randn(2, 800).astype(np.float32) * 0.3).astype(np.float32)
+
+
+def _t(x):
+    return torch.from_numpy(np.asarray(x))
+
+
+def _j(x):
+    return jnp.asarray(x)
+
+
+CASES = [
+    # ---- classification -----------------------------------------------------
+    ("binary_accuracy", lambda: F.classification.binary_accuracy(_j(P_BIN), _j(T_BIN)),
+     lambda: RF.classification.binary_accuracy(_t(P_BIN), _t(T_BIN)), 1e-6),
+    ("multiclass_accuracy_macro", lambda: F.classification.multiclass_accuracy(_j(P_MC), _j(T_MC), NC),
+     lambda: RF.classification.multiclass_accuracy(_t(P_MC), _t(T_MC), NC), 1e-6),
+    ("multilabel_f1", lambda: F.classification.multilabel_f1_score(_j(P_ML), _j(T_ML), NC),
+     lambda: RF.classification.multilabel_f1_score(_t(P_ML), _t(T_ML), NC), 1e-6),
+    ("binary_auroc", lambda: F.classification.binary_auroc(_j(P_BIN), _j(T_BIN)),
+     lambda: RF.classification.binary_auroc(_t(P_BIN), _t(T_BIN)), 1e-6),
+    ("multiclass_auroc", lambda: F.classification.multiclass_auroc(_j(P_MC), _j(T_MC), NC),
+     lambda: RF.classification.multiclass_auroc(_t(P_MC), _t(T_MC), NC), 1e-6),
+    ("binary_average_precision", lambda: F.classification.binary_average_precision(_j(P_BIN), _j(T_BIN)),
+     lambda: RF.classification.binary_average_precision(_t(P_BIN), _t(T_BIN)), 1e-6),
+    ("binary_calibration_error", lambda: F.classification.binary_calibration_error(_j(P_BIN), _j(T_BIN)),
+     lambda: RF.classification.binary_calibration_error(_t(P_BIN), _t(T_BIN)), 1e-6),
+    ("multiclass_cohen_kappa", lambda: F.classification.multiclass_cohen_kappa(_j(P_MC), _j(T_MC), NC),
+     lambda: RF.classification.multiclass_cohen_kappa(_t(P_MC), _t(T_MC), NC), 1e-6),
+    ("multiclass_confusion_matrix", lambda: F.classification.multiclass_confusion_matrix(_j(P_MC), _j(T_MC), NC),
+     lambda: RF.classification.multiclass_confusion_matrix(_t(P_MC), _t(T_MC), NC), 0),
+    ("binary_mcc", lambda: F.classification.binary_matthews_corrcoef(_j(P_BIN), _j(T_BIN)),
+     lambda: RF.classification.binary_matthews_corrcoef(_t(P_BIN), _t(T_BIN)), 1e-6),
+    ("binary_hamming", lambda: F.classification.binary_hamming_distance(_j(P_BIN), _j(T_BIN)),
+     lambda: RF.classification.binary_hamming_distance(_t(P_BIN), _t(T_BIN)), 1e-6),
+    ("multiclass_jaccard", lambda: F.classification.multiclass_jaccard_index(_j(P_MC), _j(T_MC), NC),
+     lambda: RF.classification.multiclass_jaccard_index(_t(P_MC), _t(T_MC), NC), 1e-6),
+    ("binary_hinge", lambda: F.classification.binary_hinge_loss(_j(P_BIN), _j(T_BIN)),
+     lambda: RF.classification.binary_hinge_loss(_t(P_BIN), _t(T_BIN)), 1e-5),
+    ("binary_specificity", lambda: F.classification.binary_specificity(_j(P_BIN), _j(T_BIN)),
+     lambda: RF.classification.binary_specificity(_t(P_BIN), _t(T_BIN)), 1e-6),
+    ("multilabel_ranking_ap", lambda: F.classification.multilabel_ranking_average_precision(_j(P_ML), _j(T_ML), NC),
+     lambda: RF.classification.multilabel_ranking_average_precision(_t(P_ML), _t(T_ML), NC), 1e-6),
+    ("binary_roc", lambda: F.classification.binary_roc(_j(P_BIN), _j(T_BIN), thresholds=20)[1],
+     lambda: RF.classification.binary_roc(_t(P_BIN), _t(T_BIN), thresholds=20)[1], 1e-6),
+    # ---- regression ---------------------------------------------------------
+    ("mse", lambda: F.regression.mean_squared_error(_j(X_REG), _j(Y_REG)),
+     lambda: RF.mean_squared_error(_t(X_REG), _t(Y_REG)), 1e-5),
+    ("mae", lambda: F.regression.mean_absolute_error(_j(X_REG), _j(Y_REG)),
+     lambda: RF.mean_absolute_error(_t(X_REG), _t(Y_REG)), 1e-6),
+    ("mape", lambda: F.regression.mean_absolute_percentage_error(_j(X_POS), _j(Y_POS)),
+     lambda: RF.mean_absolute_percentage_error(_t(X_POS), _t(Y_POS)), 1e-5),
+    ("msle", lambda: F.regression.mean_squared_log_error(_j(X_POS), _j(Y_POS)),
+     lambda: RF.mean_squared_log_error(_t(X_POS), _t(Y_POS)), 1e-5),
+    ("log_cosh", lambda: F.regression.log_cosh_error(_j(X_REG), _j(Y_REG)),
+     lambda: RF.log_cosh_error(_t(X_REG), _t(Y_REG)), 1e-5),
+    ("pearson", lambda: F.regression.pearson_corrcoef(_j(X_REG), _j(Y_REG)),
+     lambda: RF.pearson_corrcoef(_t(X_REG), _t(Y_REG)), 1e-4),
+    ("spearman", lambda: F.regression.spearman_corrcoef(_j(X_REG), _j(Y_REG)),
+     lambda: RF.spearman_corrcoef(_t(X_REG), _t(Y_REG)), 1e-4),
+    ("kendall", lambda: F.regression.kendall_rank_corrcoef(_j(X_REG), _j(Y_REG)),
+     lambda: RF.kendall_rank_corrcoef(_t(X_REG), _t(Y_REG)), 1e-4),
+    ("r2", lambda: F.regression.r2_score(_j(X_REG), _j(Y_REG)),
+     lambda: RF.r2_score(_t(X_REG), _t(Y_REG)), 1e-4),
+    ("explained_variance", lambda: F.regression.explained_variance(_j(X_REG), _j(Y_REG)),
+     lambda: RF.explained_variance(_t(X_REG), _t(Y_REG)), 1e-4),
+    ("concordance", lambda: F.regression.concordance_corrcoef(_j(X_REG), _j(Y_REG)),
+     lambda: RF.concordance_corrcoef(_t(X_REG), _t(Y_REG)), 1e-4),
+    ("cosine_similarity", lambda: F.regression.cosine_similarity(_j(X_REG.reshape(8, 16)), _j(Y_REG.reshape(8, 16))),
+     lambda: RF.cosine_similarity(_t(X_REG.reshape(8, 16)), _t(Y_REG.reshape(8, 16))), 1e-5),
+    ("minkowski", lambda: F.regression.minkowski_distance(_j(X_REG), _j(Y_REG), p=3.0),
+     lambda: RF.minkowski_distance(_t(X_REG), _t(Y_REG), p=3.0), 1e-4),
+    ("rse", lambda: F.regression.relative_squared_error(_j(X_REG), _j(Y_REG)),
+     lambda: RF.relative_squared_error(_t(X_REG), _t(Y_REG)), 1e-4),
+    ("smape", lambda: F.regression.symmetric_mean_absolute_percentage_error(_j(X_POS), _j(Y_POS)),
+     lambda: RF.symmetric_mean_absolute_percentage_error(_t(X_POS), _t(Y_POS)), 1e-5),
+    ("wmape", lambda: F.regression.weighted_mean_absolute_percentage_error(_j(X_POS), _j(Y_POS)),
+     lambda: RF.weighted_mean_absolute_percentage_error(_t(X_POS), _t(Y_POS)), 1e-5),
+    ("tweedie", lambda: F.regression.tweedie_deviance_score(_j(X_POS), _j(Y_POS), power=1.5),
+     lambda: RF.tweedie_deviance_score(_t(X_POS), _t(Y_POS), power=1.5), 1e-4),
+    ("csi", lambda: F.regression.critical_success_index(_j(P_BIN), _j(T_BIN.astype(np.float32)), 0.5),
+     lambda: RF.critical_success_index(_t(P_BIN), _t(T_BIN.astype(np.float32)), 0.5), 1e-6),
+    ("kl_divergence", lambda: F.regression.kl_divergence(_j(P_MC), _j(np.roll(P_MC, 1, 0))),
+     lambda: RF.kl_divergence(_t(P_MC), _t(np.roll(P_MC, 1, 0))), 1e-5),
+    # ---- image --------------------------------------------------------------
+    ("psnr", lambda: F.image.peak_signal_noise_ratio(_j(IMG_B), _j(IMG_A), data_range=1.0),
+     lambda: RF.peak_signal_noise_ratio(_t(IMG_B), _t(IMG_A), data_range=1.0), 1e-4),
+    ("ssim", lambda: F.image.structural_similarity_index_measure(_j(IMG_B), _j(IMG_A), data_range=1.0),
+     lambda: RF.structural_similarity_index_measure(_t(IMG_B), _t(IMG_A), data_range=1.0), 1e-4),
+    ("uqi", lambda: F.image.universal_image_quality_index(_j(IMG_B), _j(IMG_A)),
+     lambda: RF.universal_image_quality_index(_t(IMG_B), _t(IMG_A)), 1e-4),
+    ("sam", lambda: F.image.spectral_angle_mapper(_j(IMG_B), _j(IMG_A)),
+     lambda: RF.spectral_angle_mapper(_t(IMG_B), _t(IMG_A)), 1e-4),
+    ("ergas", lambda: F.image.error_relative_global_dimensionless_synthesis(_j(IMG_B), _j(IMG_A)),
+     lambda: RF.error_relative_global_dimensionless_synthesis(_t(IMG_B), _t(IMG_A)), 1e-3),
+    ("rase", lambda: F.image.relative_average_spectral_error(_j(IMG_B), _j(IMG_A)),
+     lambda: RF.relative_average_spectral_error(_t(IMG_B), _t(IMG_A)), 1e-3),
+    ("scc", lambda: F.image.spatial_correlation_coefficient(_j(IMG_B), _j(IMG_A)),
+     lambda: RFI.spatial_correlation_coefficient(_t(IMG_B), _t(IMG_A)), 1e-4),
+    ("total_variation", lambda: F.image.total_variation(_j(IMG_A)),
+     lambda: RF.total_variation(_t(IMG_A)), 1e-2),
+    ("rmse_sw", lambda: F.image.root_mean_squared_error_using_sliding_window(_j(IMG_B), _j(IMG_A)),
+     lambda: RF.root_mean_squared_error_using_sliding_window(_t(IMG_B), _t(IMG_A)), 1e-4),
+    # ---- audio --------------------------------------------------------------
+    ("snr", lambda: F.audio.signal_noise_ratio(_j(AUD_B), _j(AUD_A)),
+     lambda: RF.signal_noise_ratio(_t(AUD_B), _t(AUD_A)), 1e-4),
+    ("si_snr", lambda: F.audio.scale_invariant_signal_noise_ratio(_j(AUD_B), _j(AUD_A)),
+     lambda: RF.scale_invariant_signal_noise_ratio(_t(AUD_B), _t(AUD_A)), 1e-4),
+    ("si_sdr", lambda: F.audio.scale_invariant_signal_distortion_ratio(_j(AUD_B), _j(AUD_A)),
+     lambda: RF.scale_invariant_signal_distortion_ratio(_t(AUD_B), _t(AUD_A)), 1e-4),
+    ("sdr", lambda: F.audio.signal_distortion_ratio(_j(AUD_B), _j(AUD_A)),
+     lambda: RF.signal_distortion_ratio(_t(AUD_B), _t(AUD_A)), 1e-2),
+    # ---- pairwise -----------------------------------------------------------
+    ("pairwise_cosine", lambda: F.pairwise_cosine_similarity(_j(IMG_A.reshape(6, -1))),
+     lambda: RF.pairwise_cosine_similarity(_t(IMG_A.reshape(6, -1))), 1e-4),
+    ("pairwise_euclidean", lambda: F.pairwise_euclidean_distance(_j(IMG_A.reshape(6, -1))),
+     lambda: RF.pairwise_euclidean_distance(_t(IMG_A.reshape(6, -1))), 1e-2),
+]
+
+TEXT_CASES = [
+    ("bleu", lambda: F.text.bleu_score(["the cat sat on the mat"], [["the cat sat on a mat"]]),
+     lambda: RF.bleu_score(["the cat sat on the mat"], [["the cat sat on a mat"]]), 1e-5),
+    ("chrf", lambda: F.text.chrf_score(["hello world"], [["hello there world"]]),
+     lambda: RF.chrf_score(["hello world"], [["hello there world"]]), 1e-5),
+    ("wer", lambda: F.text.word_error_rate(["hello big world"], ["hello world"]),
+     lambda: RF.word_error_rate(["hello big world"], ["hello world"]), 1e-6),
+    ("cer", lambda: F.text.char_error_rate(["abcd"], ["abxd"]),
+     lambda: RF.char_error_rate(["abcd"], ["abxd"]), 1e-6),
+    ("mer", lambda: F.text.match_error_rate(["hello big world"], ["hello world"]),
+     lambda: RF.match_error_rate(["hello big world"], ["hello world"]), 1e-6),
+    ("wil", lambda: F.text.word_information_lost(["hello big world"], ["hello world"]),
+     lambda: RF.word_information_lost(["hello big world"], ["hello world"]), 1e-6),
+    ("wip", lambda: F.text.word_information_preserved(["hello big world"], ["hello world"]),
+     lambda: RF.word_information_preserved(["hello big world"], ["hello world"]), 1e-6),
+    ("edit", lambda: F.text.edit_distance(["kitten"], ["sitting"]),
+     lambda: RFT.edit_distance(["kitten"], ["sitting"]), 1e-6),
+    ("ter", lambda: F.text.translation_edit_rate(["the cat sat"], [["the big cat sat"]]),
+     lambda: RF.translation_edit_rate(["the cat sat"], [["the big cat sat"]]), 1e-5),
+]
+
+
+@pytest.mark.parametrize("name,ours,ref,atol", CASES, ids=[c[0] for c in CASES])
+def test_reference_parity(name, ours, ref, atol):
+    a = np.asarray(ours())
+    b = np.asarray(ref().detach().numpy() if hasattr(ref(), "detach") else ref())
+    np.testing.assert_allclose(a, b, atol=atol, rtol=1e-4,
+                               err_msg=f"{name}: ours={a} reference={b}")
+
+
+@pytest.mark.parametrize("name,ours,ref,atol", TEXT_CASES, ids=[c[0] for c in TEXT_CASES])
+def test_reference_parity_text(name, ours, ref, atol):
+    a = np.asarray(ours())
+    r = ref()
+    b = np.asarray(r.detach().numpy() if hasattr(r, "detach") else r)
+    np.testing.assert_allclose(a, b, atol=atol, rtol=1e-4,
+                               err_msg=f"{name}: ours={a} reference={b}")
+
+
+def test_reference_parity_perplexity():
+    logits = RNG.randn(2, 10, 7).astype(np.float32)
+    tokens = RNG.randint(0, 7, (2, 10))
+    ours = float(F.text.perplexity(_j(logits), _j(tokens)))
+    ref = float(RF.text.perplexity(_t(logits), _t(tokens)))
+    assert np.isclose(ours, ref, rtol=1e-4)
+
+
+def test_reference_parity_rouge():
+    ours = F.text.rouge_score(["the cat sat on the mat"], ["a cat sat on the mat"])
+    try:
+        ref = RF.text.rouge_score(["the cat sat on the mat"], ["a cat sat on the mat"])
+    except Exception:
+        pytest.skip("reference rouge needs nltk")
+    for k in ("rouge1_fmeasure", "rouge2_fmeasure", "rougeL_fmeasure"):
+        assert np.isclose(float(ours[k]), float(ref[k]), atol=1e-5), k
+
+
+def test_reference_parity_clustering_nominal():
+    labels_a = RNG.randint(0, 4, 200)
+    labels_b = RNG.randint(0, 4, 200)
+    pairs = [
+        ("mutual_info", F.clustering.mutual_info_score, RFC.mutual_info_score),
+        ("adjusted_rand", F.clustering.adjusted_rand_score, RFC.adjusted_rand_score),
+        ("rand", F.clustering.rand_score, RFC.rand_score),
+        ("fowlkes_mallows", F.clustering.fowlkes_mallows_index, RFC.fowlkes_mallows_index),
+        ("nmi", F.clustering.normalized_mutual_info_score, RFC.normalized_mutual_info_score),
+    ]
+    for name, ours_fn, ref_fn in pairs:
+        o = float(ours_fn(_j(labels_a), _j(labels_b)))
+        r = float(ref_fn(_t(labels_a), _t(labels_b)))
+        assert np.isclose(o, r, atol=1e-5), (name, o, r)
+    o = float(F.nominal.cramers_v(_j(labels_a), _j(labels_b)))
+    r = float(RFN.cramers_v(_t(labels_a), _t(labels_b)))
+    assert np.isclose(o, r, atol=1e-4), ("cramers_v", o, r)
+
+
+def test_reference_parity_retrieval():
+    idx = np.repeat(np.arange(10), 20)
+    preds = RNG.rand(200).astype(np.float32)
+    target = (RNG.rand(200) > 0.7).astype(np.int64)
+    pairs = [
+        ("map", F.retrieval.retrieval_average_precision, RF.retrieval.retrieval_average_precision),
+        ("mrr", F.retrieval.retrieval_reciprocal_rank, RF.retrieval.retrieval_reciprocal_rank),
+        ("ndcg", F.retrieval.retrieval_normalized_dcg, RF.retrieval.retrieval_normalized_dcg),
+        ("fall_out", F.retrieval.retrieval_fall_out, RF.retrieval.retrieval_fall_out),
+        ("hit_rate", F.retrieval.retrieval_hit_rate, RF.retrieval.retrieval_hit_rate),
+    ]
+    for name, ours_fn, ref_fn in pairs:
+        # per-query functional form: first query's slice
+        o = float(ours_fn(_j(preds[:20]), _j(target[:20])))
+        r = float(ref_fn(_t(preds[:20]), _t(target[:20])))
+        assert np.isclose(o, r, atol=1e-5), (name, o, r)

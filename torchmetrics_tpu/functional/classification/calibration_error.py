@@ -59,9 +59,10 @@ def _binary_calibration_error_update(
     if ignore_index is not None:
         keep = target != ignore_index
         preds, target = preds[keep], jnp.clip(target[keep], 0, 1)
-    confidences = jnp.where(preds > 0.5, preds, 1 - preds)
-    accuracies = ((preds > 0.5).astype(jnp.int32) == target).astype(jnp.float32)
-    return confidences, accuracies
+    # reference semantics (calibration_error.py:136-138): the confidence is
+    # the raw positive-class probability and the "accuracy" is the target
+    # itself — NOT legacy top-1-confidence binning
+    return preds, target.astype(jnp.float32)
 
 
 def binary_calibration_error(

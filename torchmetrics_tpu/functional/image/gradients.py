@@ -22,7 +22,7 @@ def image_gradients(img: Array) -> Tuple[Array, Array]:
         >>> dy, dx = image_gradients(img)
         >>> [int(v) for v in dy[0, 0, 0]]
         [4, 4, 4, 4]
-        >>> [int(v) for v in dx[0, 0, :, 0]]
+        >>> [int(v) for v in dx[0, 0, 0, :]]
         [1, 1, 1, 0]
     """
     img = jnp.asarray(img)

@@ -21,13 +21,10 @@ Array = jax.Array
 def _boundary_masks(height: int, width: int, block_size: int) -> Tuple[jnp.ndarray, ...]:
     import numpy as np
 
-    h_idx = np.arange(width - 1)
     h_b = np.zeros(width - 1, bool)
     h_b[block_size - 1 : width - 1 : block_size] = True
-    v_idx = np.arange(height - 1)
     v_b = np.zeros(height - 1, bool)
     v_b[block_size - 1 : height - 1 : block_size] = True
-    del h_idx, v_idx
     return jnp.asarray(h_b), jnp.asarray(~h_b), jnp.asarray(v_b), jnp.asarray(~v_b)
 
 

@@ -1,6 +1,11 @@
 """Sliding-window RMSE (+ ERGAS / RASE which build on it).
 
 Parity: reference ``src/torchmetrics/functional/image/{rmse_sw,ergas,rase}.py``.
+The reference's uniform filter reflection-pads to SAME size
+(``functional/image/utils.py:112``) and the final means run over the map with
+``round(window_size/2)`` border columns/rows cropped; RASE additionally
+divides the window-mean target by ``window_size**2``
+(``rase.py:45`` — a reference quirk kept for bit-parity).
 """
 from typing import Optional, Tuple
 
@@ -13,33 +18,63 @@ from .helper import depthwise_conv2d, uniform_kernel_2d
 Array = jax.Array
 
 
+def _reflect_pad(x: Array, window_size: int) -> Array:
+    """Scipy-style symmetric padding matching the reference's
+    ``_single_dimension_pad`` (``functional/image/utils.py:76``): the edge
+    element repeats (symmetric, not reflect), with ``window_size // 2``
+    elements before and ``window_size // 2 + window_size % 2 - 1`` after —
+    making the filtered map exactly input-sized."""
+    f = window_size // 2
+    after = f + (window_size % 2) - 1
+    return jnp.pad(x, ((0, 0), (0, 0), (f, after), (f, after)), mode="symmetric")
+
+
+def _uniform_filter_same(x: Array, window_size: int) -> Array:
+    """Window MEAN with reflection padding; output matches input H/W for even
+    windows (one extra row/col for odd, like the reference)."""
+    channel = x.shape[1]
+    kernel = uniform_kernel_2d(channel, (window_size, window_size))
+    return depthwise_conv2d(_reflect_pad(x, window_size), kernel)
+
+
+def _crop(x: Array, window_size: int) -> Array:
+    cs = round(window_size / 2)
+    if cs == 0:
+        return x
+    return x[..., cs:-cs, cs:-cs]
+
+
 def _rmse_sw_update(
     preds: Array, target: Array, window_size: int
 ) -> Tuple[Array, Array, Array]:
-    """Returns (rmse_per_sample_mean, rmse_map_sum, total_windows)."""
+    """Returns (rmse_cropped_mean_per_batchsum, rmse_map_sum, total_images)."""
     _check_same_shape(preds, target)
+    if preds.ndim != 4:
+        raise ValueError(f"Expected `preds` and `target` to have BxCxHxW shape. But got {preds.shape}.")
+    if round(window_size / 2) >= preds.shape[2] or round(window_size / 2) >= preds.shape[3]:
+        raise ValueError(
+            f"Parameter `round(window_size / 2)` is expected to be smaller than "
+            f"{min(preds.shape[2], preds.shape[3])} but got {round(window_size / 2)}."
+        )
     preds = preds.astype(jnp.float32)
     target = target.astype(jnp.float32)
-    channel = preds.shape[1]
-    kernel = uniform_kernel_2d(channel, (window_size, window_size))
-    diff_sq = (preds - target) ** 2
-    mse_map = depthwise_conv2d(diff_sq, kernel)  # local mean of squared error
-    rmse_map = jnp.sqrt(jnp.clip(mse_map, min=0.0))
-    n = preds.shape[0]
-    rmse_per_sample = jnp.sqrt(jnp.mean(mse_map.reshape(n, -1), axis=-1))
-    return rmse_per_sample, rmse_map, jnp.asarray(rmse_map[0].size, dtype=jnp.float32)
+    mse_map = _uniform_filter_same((preds - target) ** 2, window_size)
+    rmse_map = jnp.sqrt(jnp.clip(mse_map, min=0.0))  # (N, C, H', W')
+    rmse_val_sum = jnp.mean(jnp.sum(_crop(rmse_map, window_size), axis=0))
+    return rmse_val_sum, jnp.sum(rmse_map, axis=0), jnp.asarray(preds.shape[0], jnp.float32)
 
 
 def root_mean_squared_error_using_sliding_window(
     preds: Array, target: Array, window_size: int = 8, return_rmse_map: bool = False
 ):
-    """Parity: reference ``rmse_sw.py:74``."""
+    """Parity: reference ``rmse_sw.py:104`` (cropped-border mean of the
+    reflection-padded RMSE map)."""
     if not isinstance(window_size, int) or window_size < 1:
         raise ValueError("Argument `window_size` is expected to be a positive integer.")
-    rmse_per_sample, rmse_map, _ = _rmse_sw_update(preds, target, window_size)
-    rmse = jnp.mean(rmse_per_sample)
+    rmse_val_sum, rmse_map_sum, total = _rmse_sw_update(preds, target, window_size)
+    rmse = rmse_val_sum / total
     if return_rmse_map:
-        return rmse, rmse_map
+        return rmse, rmse_map_sum / total
     return rmse
 
 
@@ -72,19 +107,16 @@ def error_relative_global_dimensionless_synthesis(
 
 
 def relative_average_spectral_error(preds: Array, target: Array, window_size: int = 8) -> Array:
-    """RASE. Parity: reference ``rase.py:54``."""
+    """RASE. Parity: reference ``rase.py:71`` (including the window_size**2
+    scaling of the window-mean target, ``rase.py:45``)."""
     if not isinstance(window_size, int) or window_size < 1:
         raise ValueError("Argument `window_size` is expected to be a positive integer.")
     _check_same_shape(preds, target)
     preds = preds.astype(jnp.float32)
     target = target.astype(jnp.float32)
-    channel = preds.shape[1]
-    kernel = uniform_kernel_2d(channel, (window_size, window_size))
-    # per-window mean target and rmse per band
-    mean_target_map = depthwise_conv2d(target, kernel)  # (N,C,h',w')
-    mse_map = depthwise_conv2d((preds - target) ** 2, kernel)
-    rmse_map = jnp.sqrt(jnp.clip(mse_map, min=0.0))
-    # RASE = 100 / mu * sqrt(mean_over_bands(rmse^2)), averaged over windows
-    mu = jnp.mean(mean_target_map, axis=1, keepdims=True)
-    rase_map = 100.0 / mu * jnp.sqrt(jnp.mean(rmse_map**2, axis=1, keepdims=True))
-    return jnp.mean(rase_map)
+    _, rmse_map_sum, total = _rmse_sw_update(preds, target, window_size)
+    rmse_map = rmse_map_sum / total  # (C, H', W')
+    target_mean = jnp.mean(_uniform_filter_same(target, window_size) / (window_size**2), axis=0)  # (C, H', W')
+    target_mean = jnp.mean(target_mean, axis=0)  # mean over channels -> (H', W')
+    rase_map = 100.0 / target_mean * jnp.sqrt(jnp.mean(rmse_map**2, axis=0))
+    return jnp.mean(_crop(rase_map[None, None], window_size))

@@ -9,34 +9,50 @@ import jax
 import jax.numpy as jnp
 
 from ...utils.checks import _check_same_shape
-from .helper import depthwise_conv2d, reflect_pad_2d
+from .helper import depthwise_conv2d
 
 Array = jax.Array
 
 _LAPLACIAN = jnp.asarray([[-1.0, -1.0, -1.0], [-1.0, 8.0, -1.0], [-1.0, -1.0, -1.0]])
 
 
+def _hp_filter_2x(x: Array, hp_filter: Array) -> Array:
+    """Signal-convolve with the (flipped) high-pass filter, times 2.
+
+    Parity: reference ``scc.py:_hp_2d_laplacian`` — true convolution
+    (kernel flip) over symmetric padding with floor-left/ceil-right split,
+    result scaled by 2.0.
+    """
+    kh, kw = hp_filter.shape
+    top, bottom = (kh - 1) // 2, kh - 1 - (kh - 1) // 2
+    left, right = (kw - 1) // 2, kw - 1 - (kw - 1) // 2
+    padded = jnp.pad(x, ((0, 0), (0, 0), (top, bottom), (left, right)), mode="symmetric")
+    kernel = jnp.flip(hp_filter)[None, None]
+    return depthwise_conv2d(padded, kernel) * 2.0
+
+
 def _scc_per_channel(preds: Array, target: Array, hp_filter: Array, window_size: int) -> Array:
     """preds/target: (N, 1, H, W) single channel."""
-    pad = (hp_filter.shape[0] - 1) // 2
-    kernel = hp_filter[None, None]
-    preds_hp = depthwise_conv2d(reflect_pad_2d(preds, pad, pad), kernel)
-    target_hp = depthwise_conv2d(reflect_pad_2d(target, pad, pad), kernel)
+    preds_hp = _hp_filter_2x(preds, hp_filter)
+    target_hp = _hp_filter_2x(target, hp_filter)
 
-    win = jnp.ones((1, 1, window_size, window_size))
-    n_w = window_size * window_size
+    # local stats over ZERO-padded SAME windows, ceil-left/floor-right split
+    # (reference ``scc.py:_local_variance_covariance`` uses F.pad default 0s)
+    left = -(-(window_size - 1) // 2)  # ceil
+    right = (window_size - 1) // 2
+    win = jnp.ones((1, 1, window_size, window_size)) / (window_size**2)
 
-    def local_sum(x):
-        return depthwise_conv2d(x, win)
+    def local_mean(x):
+        xp = jnp.pad(x, ((0, 0), (0, 0), (left, right), (left, right)))
+        return depthwise_conv2d(xp, win)
 
-    mu_p = local_sum(preds_hp) / n_w
-    mu_t = local_sum(target_hp) / n_w
-    var_p = local_sum(preds_hp**2) / n_w - mu_p**2
-    var_t = local_sum(target_hp**2) / n_w - mu_t**2
-    cov = local_sum(preds_hp * target_hp) / n_w - mu_p * mu_t
-    denom = var_p * var_t
-    scc = jnp.where(denom > 0, cov / jnp.sqrt(jnp.where(denom > 0, denom, 1.0)), 0.0)
-    return scc
+    mu_p = local_mean(preds_hp)
+    mu_t = local_mean(target_hp)
+    var_p = jnp.clip(local_mean(preds_hp**2) - mu_p**2, min=0.0)
+    var_t = jnp.clip(local_mean(target_hp**2) - mu_t**2, min=0.0)
+    cov = local_mean(preds_hp * target_hp) - mu_p * mu_t
+    den = jnp.sqrt(var_t) * jnp.sqrt(var_p)
+    return jnp.where(den == 0, 0.0, cov / jnp.where(den == 0, 1.0, den))
 
 
 def spatial_correlation_coefficient(

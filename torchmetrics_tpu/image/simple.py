@@ -159,14 +159,18 @@ class RootMeanSquaredErrorUsingSlidingWindow(Metric):
         if not isinstance(window_size, int) or window_size < 1:
             raise ValueError("Argument `window_size` is expected to be a positive integer.")
         self.window_size = window_size
-        self.add_state("vals", [], dist_reduce_fx="cat")
+        # reference states (image/rmse_sw.py): batch-summed cropped-map mean
+        # + image count, divided at compute
+        self.add_state("rmse_val_sum", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total_images", jnp.asarray(0.0), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
-        rmse_per_sample, _, _ = _rmse_sw_update(preds, target, self.window_size)
-        self.vals.append(rmse_per_sample)
+        rmse_val_sum, _, total = _rmse_sw_update(preds, target, self.window_size)
+        self.rmse_val_sum = self.rmse_val_sum + rmse_val_sum
+        self.total_images = self.total_images + total
 
     def compute(self) -> Array:
-        return jnp.mean(dim_zero_cat(self.vals))
+        return self.rmse_val_sum / self.total_images
 
 
 class SpatialCorrelationCoefficient(Metric):
