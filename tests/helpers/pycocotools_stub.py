@@ -1,0 +1,65 @@
+"""In-test stub of ``pycocotools.mask`` backed by torchmetrics_tpu's own
+native RLE kernels — lets the reference's pure-torch legacy mAP
+(``detection/_mean_ap.py``) run as a correctness oracle without the real
+C extension."""
+import importlib.machinery
+import sys
+import types
+
+import numpy as np
+
+from torchmetrics_tpu import _native
+
+
+def encode(mask_f):
+    """Fortran-ordered (H, W) or (H, W, N) uint8 -> RLE dict(s)."""
+    arr = np.asarray(mask_f)
+    if arr.ndim == 2:
+        counts = _native.rle_encode(np.ascontiguousarray(arr).astype(np.uint8))
+        return {"size": list(arr.shape), "counts": _native.rle_to_coco_string(counts)}
+    return [encode(np.ascontiguousarray(arr[..., i])) for i in range(arr.shape[-1])]
+
+
+def decode(rle):
+    if isinstance(rle, list):
+        return np.stack([decode(r) for r in rle], axis=-1)
+    counts = rle["counts"]
+    if isinstance(counts, (bytes, str)):
+        counts = _native.rle_from_coco_string(counts)
+    h, w = rle["size"]
+    return _native.rle_decode(np.asarray(counts, np.uint32), h, w)
+
+
+def area(rle):
+    if isinstance(rle, list):
+        return np.asarray([area(r) for r in rle])
+    counts = rle["counts"]
+    if isinstance(counts, (bytes, str)):
+        counts = _native.rle_from_coco_string(counts)
+    return float(_native.rle_area(np.asarray(counts, np.uint32)))
+
+
+def iou(dt, gt, iscrowd):
+    def _counts(r):
+        c = r["counts"]
+        return _native.rle_from_coco_string(c) if isinstance(c, (bytes, str)) else np.asarray(c, np.uint32)
+
+    return _native.rle_iou([_counts(d) for d in dt], [_counts(g) for g in gt],
+                           np.asarray(iscrowd, np.uint8))
+
+
+def install_stub() -> None:
+    if "pycocotools" in sys.modules:
+        return
+    root = types.ModuleType("pycocotools")
+    root.__spec__ = importlib.machinery.ModuleSpec("pycocotools", None, is_package=True)
+    root.__path__ = []
+    mask_mod = types.ModuleType("pycocotools.mask")
+    mask_mod.__spec__ = importlib.machinery.ModuleSpec("pycocotools.mask", None)
+    mask_mod.encode = encode
+    mask_mod.decode = decode
+    mask_mod.area = area
+    mask_mod.iou = iou
+    root.mask = mask_mod
+    sys.modules["pycocotools"] = root
+    sys.modules["pycocotools.mask"] = mask_mod

@@ -1,0 +1,53 @@
+"""Minimal ``torchvision.ops`` stub (box_area / box_iou / box_convert in
+pure torch) so the reference's legacy mAP oracle runs without torchvision."""
+import importlib.machinery
+import sys
+import types
+
+import torch
+
+
+def box_area(boxes):
+    return (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+
+
+def box_iou(boxes1, boxes2):
+    a1 = box_area(boxes1)
+    a2 = box_area(boxes2)
+    lt = torch.max(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = torch.min(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = (rb - lt).clamp(min=0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / (a1[:, None] + a2[None, :] - inter)
+
+
+def box_convert(boxes, in_fmt, out_fmt):
+    if in_fmt == out_fmt:
+        return boxes
+    if in_fmt == "xywh" and out_fmt == "xyxy":
+        x, y, w, h = boxes.unbind(-1)
+        return torch.stack([x, y, x + w, y + h], dim=-1)
+    if in_fmt == "cxcywh" and out_fmt == "xyxy":
+        cx, cy, w, h = boxes.unbind(-1)
+        return torch.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], dim=-1)
+    if in_fmt == "xyxy" and out_fmt == "xywh":
+        x0, y0, x1, y1 = boxes.unbind(-1)
+        return torch.stack([x0, y0, x1 - x0, y1 - y0], dim=-1)
+    raise NotImplementedError(f"{in_fmt} -> {out_fmt}")
+
+
+def install_stub() -> None:
+    if "torchvision" in sys.modules:
+        return
+    root = types.ModuleType("torchvision")
+    root.__spec__ = importlib.machinery.ModuleSpec("torchvision", None, is_package=True)
+    root.__path__ = []
+    root.__version__ = "0.99.0"
+    ops = types.ModuleType("torchvision.ops")
+    ops.__spec__ = importlib.machinery.ModuleSpec("torchvision.ops", None)
+    ops.box_area = box_area
+    ops.box_iou = box_iou
+    ops.box_convert = box_convert
+    root.ops = ops
+    sys.modules["torchvision"] = root
+    sys.modules["torchvision.ops"] = ops

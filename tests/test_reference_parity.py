@@ -188,16 +188,9 @@ TEXT_CASES = [
 ]
 
 
-@pytest.mark.parametrize("name,ours,ref,atol", CASES, ids=[c[0] for c in CASES])
+@pytest.mark.parametrize("name,ours,ref,atol", CASES + TEXT_CASES,
+                         ids=[c[0] for c in CASES + TEXT_CASES])
 def test_reference_parity(name, ours, ref, atol):
-    a = np.asarray(ours())
-    b = np.asarray(ref().detach().numpy() if hasattr(ref(), "detach") else ref())
-    np.testing.assert_allclose(a, b, atol=atol, rtol=1e-4,
-                               err_msg=f"{name}: ours={a} reference={b}")
-
-
-@pytest.mark.parametrize("name,ours,ref,atol", TEXT_CASES, ids=[c[0] for c in TEXT_CASES])
-def test_reference_parity_text(name, ours, ref, atol):
     a = np.asarray(ours())
     r = ref()
     b = np.asarray(r.detach().numpy() if hasattr(r, "detach") else r)
@@ -243,7 +236,6 @@ def test_reference_parity_clustering_nominal():
 
 
 def test_reference_parity_retrieval():
-    idx = np.repeat(np.arange(10), 20)
     preds = RNG.rand(200).astype(np.float32)
     target = (RNG.rand(200) > 0.7).astype(np.int64)
     pairs = [
@@ -258,3 +250,23 @@ def test_reference_parity_retrieval():
         o = float(ours_fn(_j(preds[:20]), _j(target[:20])))
         r = float(ref_fn(_t(preds[:20]), _t(target[:20])))
         assert np.isclose(o, r, atol=1e-5), (name, o, r)
+
+
+def test_reference_parity_retrieval_grouped():
+    """Grouped (indexes=) class API against the reference RetrievalMAP/NDCG."""
+    import torchmetrics as RT
+
+    import torchmetrics_tpu as tm
+
+    idx = np.repeat(np.arange(10), 20)
+    preds = RNG.rand(200).astype(np.float32)
+    target = (RNG.rand(200) > 0.7).astype(np.int64)
+    for ours_cls, ref_cls in [(tm.RetrievalMAP, RT.RetrievalMAP),
+                              (tm.RetrievalNormalizedDCG, RT.RetrievalNormalizedDCG),
+                              (tm.RetrievalMRR, RT.RetrievalMRR)]:
+        ours = ours_cls()
+        ref = ref_cls()
+        ours.update(_j(preds), _j(target), indexes=_j(idx))
+        ref.update(_t(preds), _t(target), indexes=_t(idx))
+        o, r = float(ours.compute()), float(ref.compute())
+        assert np.isclose(o, r, atol=1e-5), (ours_cls.__name__, o, r)

@@ -199,7 +199,11 @@ def accumulate(
                 fp_cum = np.cumsum(fps, axis=1).astype(np.float64)
                 for ti in range(n_t):
                     tp, fp = tp_cum[ti], fp_cum[ti]
-                    rc = tp / n_gt
+                    # float32 like the reference: the recall grid is the
+                    # float32 quantization of linspace(0,1,101), and exact
+                    # float64 recalls (e.g. 2/5) land on the wrong side of
+                    # float32(0.4) in searchsorted
+                    rc = (tp / n_gt).astype(np.float32)
                     pr = tp / np.maximum(tp + fp, np.finfo(np.float64).eps)
                     recall[ti, ki, ai, mi] = rc[-1] if len(rc) else 0.0
                     # precision envelope (monotone non-increasing from right)

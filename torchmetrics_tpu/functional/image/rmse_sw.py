@@ -106,17 +106,27 @@ def error_relative_global_dimensionless_synthesis(
     return scores
 
 
+def _rase_update(preds: Array, target: Array, window_size: int) -> Tuple[Array, Array, Array]:
+    """Per-batch accumulables: (rmse_map_sum (C,H',W'), target_window_sum
+    (C,H',W'), n_images). Parity: reference ``rase.py:24`` (_rase_update)."""
+    _, rmse_map_sum, total = _rmse_sw_update(preds, target, window_size)
+    target_sum = jnp.sum(_uniform_filter_same(target.astype(jnp.float32), window_size) / (window_size**2), axis=0)
+    return rmse_map_sum, target_sum, total
+
+
+def _rase_compute(rmse_map_sum: Array, target_sum: Array, total: Array, window_size: int) -> Array:
+    """Parity: reference ``rase.py:49`` (_rase_compute) — pooled maps over
+    ALL images, then the nonlinear RASE map + border crop."""
+    rmse_map = rmse_map_sum / total
+    target_mean = jnp.mean(target_sum / total, axis=0)  # mean over channels
+    rase_map = 100.0 / target_mean * jnp.sqrt(jnp.mean(rmse_map**2, axis=0))
+    return jnp.mean(_crop(rase_map[None, None], window_size))
+
+
 def relative_average_spectral_error(preds: Array, target: Array, window_size: int = 8) -> Array:
     """RASE. Parity: reference ``rase.py:71`` (including the window_size**2
     scaling of the window-mean target, ``rase.py:45``)."""
     if not isinstance(window_size, int) or window_size < 1:
         raise ValueError("Argument `window_size` is expected to be a positive integer.")
     _check_same_shape(preds, target)
-    preds = preds.astype(jnp.float32)
-    target = target.astype(jnp.float32)
-    _, rmse_map_sum, total = _rmse_sw_update(preds, target, window_size)
-    rmse_map = rmse_map_sum / total  # (C, H', W')
-    target_mean = jnp.mean(_uniform_filter_same(target, window_size) / (window_size**2), axis=0)  # (C, H', W')
-    target_mean = jnp.mean(target_mean, axis=0)  # mean over channels -> (H', W')
-    rase_map = 100.0 / target_mean * jnp.sqrt(jnp.mean(rmse_map**2, axis=0))
-    return jnp.mean(_crop(rase_map[None, None], window_size))
+    return _rase_compute(*_rase_update(preds.astype(jnp.float32), target, window_size), window_size)

@@ -139,13 +139,25 @@ class RelativeAverageSpectralError(Metric):
         if not isinstance(window_size, int) or window_size < 1:
             raise ValueError(f"Argument `window_size` is expected to be a positive integer, but got {window_size}")
         self.window_size = window_size
-        self.add_state("vals", [], dist_reduce_fx="cat")
+        # reference states (image/rase.py): summed rmse/target window maps
+        # pooled over ALL images before the nonlinear compute; scalar zero
+        # defaults broadcast into map shape on first update
+        self.add_state("rmse_map", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("target_sum", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total_images", jnp.asarray(0.0), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
-        self.vals.append(jnp.atleast_1d(_rase_fn(preds, target, self.window_size)))
+        from ..functional.image.rmse_sw import _rase_update
+
+        rmse_map_sum, target_sum, total = _rase_update(preds, target, self.window_size)
+        self.rmse_map = self.rmse_map + rmse_map_sum
+        self.target_sum = self.target_sum + target_sum
+        self.total_images = self.total_images + total
 
     def compute(self) -> Array:
-        return jnp.mean(dim_zero_cat(self.vals))
+        from ..functional.image.rmse_sw import _rase_compute
+
+        return _rase_compute(self.rmse_map, self.target_sum, self.total_images, self.window_size)
 
 
 class RootMeanSquaredErrorUsingSlidingWindow(Metric):
