@@ -49,8 +49,15 @@ def iou(dt, gt, iscrowd):
 
 
 def install_stub() -> None:
+    import importlib.util
+
     if "pycocotools" in sys.modules:
         return
+    try:  # prefer the real package when it exists — never shadow it
+        if importlib.util.find_spec("pycocotools") is not None:
+            return
+    except (ImportError, ValueError):
+        pass
     root = types.ModuleType("pycocotools")
     root.__spec__ = importlib.machinery.ModuleSpec("pycocotools", None, is_package=True)
     root.__path__ = []

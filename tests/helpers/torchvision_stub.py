@@ -37,8 +37,15 @@ def box_convert(boxes, in_fmt, out_fmt):
 
 
 def install_stub() -> None:
+    import importlib.util
+
     if "torchvision" in sys.modules:
         return
+    try:  # prefer the real package when it exists — never shadow it
+        if importlib.util.find_spec("torchvision") is not None:
+            return
+    except (ImportError, ValueError):
+        pass
     root = types.ModuleType("torchvision")
     root.__spec__ = importlib.machinery.ModuleSpec("torchvision", None, is_package=True)
     root.__path__ = []

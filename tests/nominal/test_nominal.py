@@ -90,21 +90,21 @@ def np_pearson_cc(p, t):
 
 
 def np_theils_u(p, t):
-    # U(X|Y): fraction of entropy of X (target) explained by Y (preds)
+    # reference convention (theils_u.py): the confusion table has target as
+    # rows, so U = (H(preds) - H(preds|target)) / H(preds)
     def entropy(labels):
         _, counts = np.unique(labels, return_counts=True)
         pr = counts / counts.sum()
         return -np.sum(pr * np.log(pr))
 
-    s_x = entropy(t)
+    s_x = entropy(p)
     if s_x == 0:
         return 0.0
-    # conditional entropy H(X|Y)
-    s_xy = 0.0
-    for y in np.unique(p):
-        sel = p == y
+    s_xy = 0.0  # conditional entropy H(preds|target)
+    for y in np.unique(t):
+        sel = t == y
         w = sel.mean()
-        s_xy += w * entropy(t[sel])
+        s_xy += w * entropy(p[sel])
     return (s_x - s_xy) / s_x
 
 

@@ -1,0 +1,193 @@
+"""Reference-equivalence for the MODULAR class layer: multi-batch update
+loops on both implementations, plus wrapper and additional functional
+families not covered by the single-shot sweep."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "helpers"))
+from lightning_utilities_stub import install_stub  # noqa: E402
+
+install_stub()
+sys.path.insert(0, "/root/reference/src")
+torch = pytest.importorskip("torch")
+
+import torchmetrics as RT  # noqa: E402
+
+import torchmetrics_tpu as tm  # noqa: E402
+
+RNG = np.random.RandomState(99)
+N, NC = 64, 4
+
+
+def _t(x):
+    return torch.from_numpy(np.asarray(x))
+
+
+def _j(x):
+    return jnp.asarray(x)
+
+
+def _run_pair(ours, ref, batches):
+    for args in batches:
+        ours.update(*[_j(a) for a in args])
+        ref.update(*[_t(a) for a in args])
+    return np.asarray(ours.compute()), np.asarray(ref.compute().detach().numpy()
+                                                  if hasattr(ref.compute(), "detach") else ref.compute())
+
+
+def _cls_batches(k=3):
+    out = []
+    for _ in range(k):
+        p = RNG.rand(N, NC).astype(np.float32)
+        p /= p.sum(-1, keepdims=True)
+        out.append((p, RNG.randint(0, NC, N)))
+    return out
+
+
+def _reg_batches(k=3):
+    out = []
+    for _ in range(k):
+        x = RNG.randn(N).astype(np.float32)
+        out.append((x, (0.7 * x + 0.2 * RNG.randn(N)).astype(np.float32)))
+    return out
+
+
+CLASS_CASES = [
+    ("MulticlassAccuracy", lambda: tm.classification.MulticlassAccuracy(num_classes=NC),
+     lambda: RT.classification.MulticlassAccuracy(num_classes=NC), _cls_batches, 1e-6),
+    ("MulticlassF1_weighted", lambda: tm.classification.MulticlassF1Score(num_classes=NC, average="weighted"),
+     lambda: RT.classification.MulticlassF1Score(num_classes=NC, average="weighted"), _cls_batches, 1e-6),
+    ("MulticlassAUROC", lambda: tm.classification.MulticlassAUROC(num_classes=NC),
+     lambda: RT.classification.MulticlassAUROC(num_classes=NC), _cls_batches, 1e-6),
+    ("MulticlassAveragePrecision", lambda: tm.classification.MulticlassAveragePrecision(num_classes=NC),
+     lambda: RT.classification.MulticlassAveragePrecision(num_classes=NC), _cls_batches, 1e-6),
+    ("MulticlassStatScores_none", lambda: tm.classification.MulticlassStatScores(num_classes=NC, average=None),
+     lambda: RT.classification.MulticlassStatScores(num_classes=NC, average=None), _cls_batches, 0),
+    ("PearsonCorrCoef", lambda: tm.PearsonCorrCoef(), lambda: RT.PearsonCorrCoef(), _reg_batches, 1e-4),
+    ("SpearmanCorrCoef", lambda: tm.SpearmanCorrCoef(), lambda: RT.SpearmanCorrCoef(), _reg_batches, 1e-4),
+    ("R2Score", lambda: tm.R2Score(), lambda: RT.R2Score(), _reg_batches, 1e-4),
+    ("MeanSquaredError", lambda: tm.MeanSquaredError(), lambda: RT.MeanSquaredError(), _reg_batches, 1e-5),
+    ("ExplainedVariance", lambda: tm.ExplainedVariance(), lambda: RT.ExplainedVariance(), _reg_batches, 1e-4),
+    ("ConcordanceCorrCoef", lambda: tm.ConcordanceCorrCoef(), lambda: RT.ConcordanceCorrCoef(), _reg_batches, 1e-4),
+    ("KendallRankCorrCoef", lambda: tm.KendallRankCorrCoef(), lambda: RT.KendallRankCorrCoef(), _reg_batches, 1e-4),
+    ("CosineSimilarity", lambda: tm.CosineSimilarity(),
+     lambda: RT.CosineSimilarity(),
+     lambda: [(RNG.rand(8, 16).astype(np.float32), RNG.rand(8, 16).astype(np.float32)) for _ in range(2)], 1e-5),
+]
+
+
+@pytest.mark.parametrize("name,ours_f,ref_f,batches_f,atol", CLASS_CASES, ids=[c[0] for c in CLASS_CASES])
+def test_class_parity_multibatch(name, ours_f, ref_f, batches_f, atol):
+    a, b = _run_pair(ours_f(), ref_f(), batches_f())
+    np.testing.assert_allclose(a, b, atol=atol, rtol=1e-4, err_msg=name)
+
+
+def test_minmax_wrapper_parity():
+    ours = tm.wrappers.MinMaxMetric(tm.classification.MulticlassAccuracy(num_classes=NC))
+    ref = RT.MinMaxMetric(RT.classification.MulticlassAccuracy(num_classes=NC))
+    for p, t in _cls_batches(4):
+        ours.update(_j(p), _j(t))
+        ref.update(_t(p), _t(t))
+        ours.compute()  # min/max track per-compute
+        ref.compute()
+    r_ours, r_ref = ours.compute(), ref.compute()
+    for k in ("raw", "min", "max"):
+        assert np.isclose(float(r_ours[k]), float(r_ref[k]), atol=1e-6), k
+
+
+def test_classwise_wrapper_parity():
+    ours = tm.wrappers.ClasswiseWrapper(tm.classification.MulticlassAccuracy(num_classes=NC, average=None))
+    ref = RT.ClasswiseWrapper(RT.classification.MulticlassAccuracy(num_classes=NC, average=None))
+    p, t = _cls_batches(1)[0]
+    ours.update(_j(p), _j(t))
+    ref.update(_t(p), _t(t))
+    r_ours, r_ref = ours.compute(), ref.compute()
+    assert set(r_ours) == set(r_ref)
+    for k in r_ours:
+        assert np.isclose(float(r_ours[k]), float(r_ref[k]), atol=1e-6), k
+
+
+def test_multioutput_wrapper_parity():
+    ours = tm.wrappers.MultioutputWrapper(tm.MeanSquaredError(), num_outputs=3)
+    ref = RT.MultioutputWrapper(RT.MeanSquaredError(), num_outputs=3)
+    for _ in range(2):
+        x = RNG.randn(N, 3).astype(np.float32)
+        y = (x + 0.1 * RNG.randn(N, 3)).astype(np.float32)
+        ours.update(_j(x), _j(y))
+        ref.update(_t(x), _t(y))
+    np.testing.assert_allclose(np.asarray(ours.compute()),
+                               np.asarray(torch.stack(list(ref.compute())) if isinstance(ref.compute(), (list, tuple))
+                                          else ref.compute()), atol=1e-5)
+
+
+def test_sacrebleu_parity():
+    import torchmetrics.functional.text as RFT
+
+    import torchmetrics_tpu.functional.text as FT
+
+    preds = ["the cat is on the mat", "hello there big world"]
+    target = [["the cat is on a mat"], ["hello there world"]]
+    for tokenize in ("13a", "char", "intl"):
+        try:
+            r = float(RFT.sacre_bleu_score(preds, target, tokenize=tokenize))
+        except Exception:
+            pytest.skip(f"reference sacrebleu tokenizer {tokenize} unavailable")
+        o = float(FT.sacre_bleu_score(preds, target, tokenize=tokenize))
+        assert np.isclose(o, r, atol=1e-5), tokenize
+
+
+def test_pit_parity():
+    import torchmetrics.functional.audio as RFA
+
+    import torchmetrics_tpu.functional.audio as FA
+
+    p = RNG.randn(3, 2, 120).astype(np.float32)
+    t = RNG.randn(3, 2, 120).astype(np.float32)
+    o_val, o_perm = FA.permutation_invariant_training(
+        _j(p), _j(t), FA.scale_invariant_signal_noise_ratio, eval_func="max")
+    r_val, r_perm = RFA.permutation_invariant_training(
+        _t(p), _t(t), RFA.scale_invariant_signal_noise_ratio, eval_func="max")
+    np.testing.assert_allclose(np.asarray(o_val), r_val.numpy(), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(o_perm), r_perm.numpy())
+
+
+def test_clustering_intrinsic_parity():
+    import torchmetrics.functional.clustering as RFC
+
+    import torchmetrics_tpu.functional.clustering as FC
+
+    data = RNG.randn(80, 5).astype(np.float32)
+    labels = RNG.randint(0, 4, 80)
+    for name, of, rf in [("calinski", FC.calinski_harabasz_score, RFC.calinski_harabasz_score),
+                         ("davies", FC.davies_bouldin_score, RFC.davies_bouldin_score),
+                         ("dunn", FC.dunn_index, RFC.dunn_index)]:
+        o = float(of(_j(data), _j(labels)))
+        r = float(rf(_t(data), _t(labels)))
+        assert np.isclose(o, r, rtol=1e-4), (name, o, r)
+
+
+def test_nominal_parity():
+    import torchmetrics.functional.nominal as RFN
+
+    import torchmetrics_tpu.functional.nominal as FN
+
+    a = RNG.randint(0, 4, 150)
+    # correlate b with a so the entropy ratios are well away from 0 (tiny
+    # U values amplify float32 noise past any fixed tolerance)
+    b = np.where(RNG.rand(150) < 0.5, a, RNG.randint(0, 4, 150))
+    for name, of, rf in [("tschuprows", FN.tschuprows_t, RFN.tschuprows_t),
+                         ("pearsons", FN.pearsons_contingency_coefficient, RFN.pearsons_contingency_coefficient),
+                         ("theils", FN.theils_u, RFN.theils_u)]:
+        o = float(of(_j(a), _j(b)))
+        r = float(rf(_t(a), _t(b)))
+        assert np.isclose(o, r, atol=1e-4), (name, o, r)
+    # fleiss takes an (n_subjects, n_categories) count matrix in counts mode
+    counts = RNG.multinomial(6, [0.25, 0.25, 0.3, 0.2], size=30)
+    o = float(FN.fleiss_kappa(_j(counts)))
+    r = float(RFN.fleiss_kappa(_t(counts)))
+    assert np.isclose(o, r, atol=1e-4), ("fleiss", o, r)

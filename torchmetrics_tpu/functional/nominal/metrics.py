@@ -147,9 +147,14 @@ def theils_u(
     nan_strategy: str = "replace",
     nan_replace_value: Optional[float] = 0.0,
 ) -> Array:
-    """Theil's U (uncertainty coefficient) in [0, 1]. Parity: ``theils_u.py``."""
+    """Theil's U (uncertainty coefficient) in [0, 1]. Parity: ``theils_u.py``.
+
+    U is asymmetric; the reference builds its table with target as rows
+    (``_multiclass_confusion_matrix_update``), ours with preds as rows — the
+    transpose aligns the conditional-entropy roles.
+    """
     _nominal_input_validation(nan_strategy, nan_replace_value)
-    return _theils_u_compute(_nominal_confmat(preds, target, nan_strategy, nan_replace_value))
+    return _theils_u_compute(_nominal_confmat(preds, target, nan_strategy, nan_replace_value).T)
 
 
 def _fleiss_kappa_update(ratings: Array, mode: str = "counts") -> Array:
@@ -223,6 +228,19 @@ def pearsons_contingency_coefficient_matrix(matrix: Array, nan_strategy: str = "
 
 def theils_u_matrix(matrix: Array, nan_strategy: str = "replace",
                     nan_replace_value: Optional[float] = 0.0) -> Array:
-    """Pairwise Theil's U over table columns (asymmetric in general)."""
-    return _pairwise_matrix(theils_u, matrix, nan_strategy=nan_strategy,
-                            nan_replace_value=nan_replace_value)
+    """Pairwise Theil's U over table columns.
+
+    U is asymmetric — the reference fills [i, j] and [j, i] from the table
+    and its transpose separately (``theils_u.py:193-194``); both cells are
+    computed here too.
+    """
+    matrix = jnp.asarray(matrix)
+    num_vars = matrix.shape[1]
+    out = np.ones((num_vars, num_vars), dtype=np.float32)
+    for i in range(num_vars):
+        for j in range(i + 1, num_vars):
+            out[i, j] = float(theils_u(matrix[:, i], matrix[:, j],
+                                       nan_strategy=nan_strategy, nan_replace_value=nan_replace_value))
+            out[j, i] = float(theils_u(matrix[:, j], matrix[:, i],
+                                       nan_strategy=nan_strategy, nan_replace_value=nan_replace_value))
+    return jnp.asarray(out)

@@ -16,8 +16,9 @@ from ..functional.image.d_lambda import (
 )
 from ..functional.image.rmse_sw import (
     _ergas_update,
+    _rase_compute,
+    _rase_update,
     _rmse_sw_update,
-    relative_average_spectral_error as _rase_fn,
 )
 from ..functional.image.sam import _sam_compute, _sam_update
 from ..functional.image.scc import spatial_correlation_coefficient as _scc_fn
@@ -147,16 +148,12 @@ class RelativeAverageSpectralError(Metric):
         self.add_state("total_images", jnp.asarray(0.0), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
-        from ..functional.image.rmse_sw import _rase_update
-
         rmse_map_sum, target_sum, total = _rase_update(preds, target, self.window_size)
         self.rmse_map = self.rmse_map + rmse_map_sum
         self.target_sum = self.target_sum + target_sum
         self.total_images = self.total_images + total
 
     def compute(self) -> Array:
-        from ..functional.image.rmse_sw import _rase_compute
-
         return _rase_compute(self.rmse_map, self.target_sum, self.total_images, self.window_size)
 
 

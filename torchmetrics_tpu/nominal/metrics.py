@@ -98,7 +98,9 @@ class TheilsU(_ConfmatNominalMetric):
     """Parity: reference ``nominal/theils_u.py:30``."""
 
     def compute(self) -> Array:
-        return _theils_u_compute(np.asarray(self.confmat))
+        # U is asymmetric; transpose aligns with the reference's
+        # target-as-rows table (see functional theils_u)
+        return _theils_u_compute(np.asarray(self.confmat).T)
 
 
 class FleissKappa(Metric):
