@@ -1,0 +1,109 @@
+"""Training-loop integration (the reference's tests/integrations/
+test_lightning.py analog): metrics logged through a real optimization loop —
+forward per step, epoch compute/reset, collection logging, SPMD eval step —
+all inside one optax-trained flax model."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import torchmetrics_tpu as tm
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(8, 3)
+    x = rng.randn(256, 8).astype(np.float32)
+    logits = x @ w_true
+    y = logits.argmax(-1)
+    return x, y.astype(np.int32)
+
+
+def test_metrics_through_training_loop(dataset):
+    import optax
+
+    x, y = dataset
+    coll = tm.MetricCollection({
+        "acc": tm.classification.MulticlassAccuracy(num_classes=3, average="micro"),
+        "f1": tm.classification.MulticlassF1Score(num_classes=3, average="macro"),
+    })
+    loss_metric = tm.MeanMetric()
+
+    params = jnp.zeros((8, 3))
+    opt = optax.adam(0.1)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, xb, yb):
+        def loss_fn(p):
+            logits = xb @ p
+            return optax.softmax_cross_entropy_with_integer_labels(logits, yb).mean(), logits
+
+        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss, logits
+
+    epoch_accs = []
+    for epoch in range(3):
+        for i in range(0, 256, 64):
+            xb, yb = jnp.asarray(x[i:i + 64]), jnp.asarray(y[i:i + 64])
+            params, opt_state, loss, logits = step(params, opt_state, xb, yb)
+            batch_vals = coll(jax.nn.softmax(logits), yb)  # forward: batch values
+            assert set(batch_vals) == {"acc", "f1"}
+            loss_metric.update(loss)
+        epoch_accs.append(float(coll.compute()["acc"]))
+        coll.reset()
+        loss_metric.reset()
+    # training must improve accuracy; final epoch should be near-perfect
+    assert epoch_accs[-1] > epoch_accs[0]
+    assert epoch_accs[-1] > 0.9
+
+
+def test_spmd_eval_step_integration(dataset):
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    x, y = dataset
+    devices = jax.devices("cpu")[:8]
+    mesh = Mesh(np.array(devices), ("dp",))
+    coll = tm.MetricCollection({
+        "acc": tm.classification.MulticlassAccuracy(num_classes=3, average="micro"),
+        "auroc": tm.classification.MulticlassAUROC(num_classes=3, thresholds=32),
+    })
+    w = jnp.asarray(np.random.RandomState(1).randn(8, 3), jnp.float32)
+
+    def eval_shard(xb, yb):
+        states = coll.update_state(coll.init_state(), jax.nn.softmax(xb @ w), yb)
+        return coll.reduce_state(states, "dp")
+
+    fn = jax.jit(shard_map(eval_shard, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P()))
+    states = fn(jnp.asarray(x), jnp.asarray(y))
+    dist_result = coll.compute_state(states)
+
+    # must equal the single-device run on the full batch
+    coll.update(jax.nn.softmax(jnp.asarray(x) @ w), jnp.asarray(y))
+    local_result = coll.compute()
+    for k in dist_result:
+        assert np.isclose(float(dist_result[k]), float(local_result[k]), atol=1e-6), k
+
+
+def test_metric_state_checkpoint_mid_training(dataset, tmp_path):
+    from torchmetrics_tpu.utils.checkpoint import restore_metric_state, save_metric_state
+
+    x, y = dataset
+    m = tm.classification.MulticlassAccuracy(num_classes=3)
+    logits = jnp.asarray(x[:128]) @ jnp.zeros((8, 3))
+    m.update(jax.nn.softmax(logits), jnp.asarray(y[:128]))
+    path = save_metric_state(str(tmp_path / "mid_epoch"), m)
+
+    resumed = tm.classification.MulticlassAccuracy(num_classes=3)
+    restore_metric_state(path, resumed)
+    resumed.update(jax.nn.softmax(jnp.asarray(x[128:]) @ jnp.zeros((8, 3))), jnp.asarray(y[128:]))
+    m.update(jax.nn.softmax(jnp.asarray(x[128:]) @ jnp.zeros((8, 3))), jnp.asarray(y[128:]))
+    assert np.isclose(float(resumed.compute()), float(m.compute()), atol=1e-7)

@@ -270,3 +270,22 @@ def test_reference_parity_retrieval_grouped():
         ref.update(_t(preds), _t(target), indexes=_t(idx))
         o, r = float(ours.compute()), float(ref.compute())
         assert np.isclose(o, r, atol=1e-5), (ours_cls.__name__, o, r)
+
+
+def test_reference_parity_squad_eed():
+    import torchmetrics.functional.text as RFT
+
+    import torchmetrics_tpu.functional.text as FT
+
+    preds = [{"prediction_text": "the cat sat", "id": "1"},
+             {"prediction_text": "a dog", "id": "2"}]
+    target = [{"answers": {"answer_start": [0], "text": ["the cat sat on the mat"]}, "id": "1"},
+              {"answers": {"answer_start": [0], "text": ["a dog", "the dog"]}, "id": "2"}]
+    r = RFT.squad(preds, target)
+    o = FT.squad(preds, target)
+    for k in ("exact_match", "f1"):
+        assert np.isclose(float(o[k]), float(r[k]), atol=1e-4), k
+
+    r2 = float(RFT.extended_edit_distance(["the cat sat down"], ["the big cat sat"]))
+    o2 = float(FT.extended_edit_distance(["the cat sat down"], ["the big cat sat"]))
+    assert np.isclose(o2, r2, atol=1e-6)

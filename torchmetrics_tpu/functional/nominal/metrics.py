@@ -124,7 +124,11 @@ def pearsons_contingency_coefficient(
 
 
 def _conditional_entropy(confmat: Array) -> Array:
-    """H(X|Y) where rows index Y (preds) and columns index X (target)."""
+    """H(X|Y) over a table whose rows index the conditioning variable Y.
+
+    Callers pass the table in the reference orientation (rows = target,
+    cols = preds — note ``_confmat_update`` builds the transpose of this).
+    """
     n = jnp.sum(confmat)
     p_xy = confmat / jnp.maximum(n, 1.0)
     p_y = jnp.sum(confmat, axis=1) / jnp.maximum(n, 1.0)
@@ -239,8 +243,9 @@ def theils_u_matrix(matrix: Array, nan_strategy: str = "replace",
     out = np.ones((num_vars, num_vars), dtype=np.float32)
     for i in range(num_vars):
         for j in range(i + 1, num_vars):
-            out[i, j] = float(theils_u(matrix[:, i], matrix[:, j],
-                                       nan_strategy=nan_strategy, nan_replace_value=nan_replace_value))
-            out[j, i] = float(theils_u(matrix[:, j], matrix[:, i],
-                                       nan_strategy=nan_strategy, nan_replace_value=nan_replace_value))
+            # one confmat per pair; both directions from it and its
+            # transpose (reference theils_u.py:192-194)
+            cm = _nominal_confmat(matrix[:, i], matrix[:, j], nan_strategy, nan_replace_value)
+            out[i, j] = float(_theils_u_compute(cm.T))
+            out[j, i] = float(_theils_u_compute(cm))
     return jnp.asarray(out)
