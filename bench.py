@@ -21,7 +21,7 @@ import time
 
 BATCH = 1024
 NUM_CLASSES = 100
-STEPS = 200
+STEPS = 1000
 
 
 def _ensure_working_backend() -> None:
@@ -57,21 +57,24 @@ def bench_ours() -> float:
     preds.block_until_ready()
 
     @jax.jit
-    def epoch(preds, target):
+    def epoch(preds, target, salt):
         # vmap over steps + associative tree-merge: one XLA program, no
         # sequential per-step kernels (updates are independent)
+        preds = preds + salt  # per-rep input variation (see note below)
         state = metric.update_state_batched(metric.init_state(), preds, target)
         return state, metric.compute_state(state)
 
     # warmup / compile
-    state, acc = epoch(preds, target)
+    state, acc = epoch(preds, target, jnp.float32(0))
     jax.block_until_ready(state)
 
+    # NOTE: inputs must differ per rep — remote-TPU execution layers can
+    # memoize identical (executable, args) dispatches, which would make
+    # repeat timings of the same call measure the cache, not the chip.
     reps = 5
     t0 = time.perf_counter()
-    for _ in range(reps):
-        state, acc = epoch(preds, target)
-    jax.block_until_ready(state)
+    states = [epoch(preds, target, jnp.float32((r + 1) * 1e-9))[0] for r in range(reps)]
+    jax.block_until_ready(states)
     dt = time.perf_counter() - t0
     return reps * STEPS / dt
 

@@ -171,18 +171,21 @@ def test_pit_class():
     np.testing.assert_allclose(float(metric.compute()), float(jnp.mean(best)), rtol=1e-5)
 
 
-def test_gated_metrics_raise():
-    # PESQ stays gated on the ITU P.862 C backend; STOI/SRMR are first-party
-    from torchmetrics_tpu.functional.audio.gated import _PESQ_AVAILABLE
+def test_first_party_audio_construct_without_backends():
+    # PESQ/STOI/SRMR are first-party now — all construct without any of the
+    # reference's third-party backends (pesq / pystoi / gammatone) installed
+    from torchmetrics_tpu.audio import (
+        PerceptualEvaluationSpeechQuality,
+        ShortTimeObjectiveIntelligibility,
+    )
 
-    if not _PESQ_AVAILABLE:
-        from torchmetrics_tpu.audio import PerceptualEvaluationSpeechQuality
-
-        with pytest.raises(ModuleNotFoundError, match="PESQ"):
-            PerceptualEvaluationSpeechQuality(16000, "wb")
-    from torchmetrics_tpu.audio import ShortTimeObjectiveIntelligibility
-
-    ShortTimeObjectiveIntelligibility(16000)  # constructs without pystoi
+    PerceptualEvaluationSpeechQuality(16000, "wb")
+    ShortTimeObjectiveIntelligibility(16000)
+    # requesting the exact ITU backend without the package still raises
+    with pytest.raises(ModuleNotFoundError, match="itu"):
+        PerceptualEvaluationSpeechQuality(16000, "wb", implementation="itu").update(
+            jnp.zeros(16000), jnp.zeros(16000)
+        )
 
 
 def test_ddp_merge_states_audio():

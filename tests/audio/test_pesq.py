@@ -1,0 +1,91 @@
+"""First-party PESQ (P.862-structured) invariant tests.
+
+No ITU oracle is installable here (the ``pesq`` C package is absent), so
+these tests pin the properties the implementation guarantees: exact
+P.862.1/.2 ceilings on identical inputs, monotone degradation under additive
+noise and clipping, delay tolerance, batching, and the reference's argument
+validation (reference ``functional/audio/pesq.py``).
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.audio import PerceptualEvaluationSpeechQuality
+from torchmetrics_tpu.functional.audio import perceptual_evaluation_speech_quality as pesq_fn
+
+rng = np.random.RandomState(0)
+FS = 8000
+_t = np.arange(FS * 2) / FS
+CLEAN = (
+    (np.sin(2 * np.pi * 220 * _t) + 0.5 * np.sin(2 * np.pi * 440 * _t) + 0.3 * np.sin(2 * np.pi * 880 * _t))
+    * (0.5 + 0.5 * np.sin(2 * np.pi * 3 * _t))
+).astype(np.float32)
+
+_t16 = np.arange(16000 * 2) / 16000
+CLEAN16 = (
+    (np.sin(2 * np.pi * 220 * _t16) + 0.5 * np.sin(2 * np.pi * 440 * _t16))
+    * (0.5 + 0.5 * np.sin(2 * np.pi * 3 * _t16))
+).astype(np.float32)
+
+
+def _noisy(clean, snr_db, seed=1):
+    r = np.random.RandomState(seed)
+    noise = r.randn(len(clean)).astype(np.float32)
+    noise *= np.sqrt((clean**2).mean() / (noise**2).mean() / 10 ** (snr_db / 10))
+    return clean + noise
+
+
+def test_identical_hits_p862_ceilings():
+    nb = float(pesq_fn(jnp.asarray(CLEAN), jnp.asarray(CLEAN), FS, "nb"))
+    np.testing.assert_allclose(nb, 4.5486, atol=2e-3)  # P.862.1 max
+    wb = float(pesq_fn(jnp.asarray(CLEAN16), jnp.asarray(CLEAN16), 16000, "wb"))
+    np.testing.assert_allclose(wb, 4.6439, atol=2e-3)  # P.862.2 max
+
+
+def test_monotone_under_noise_and_clipping():
+    scores = [float(pesq_fn(jnp.asarray(_noisy(CLEAN, s)), jnp.asarray(CLEAN), FS, "nb")) for s in (40, 20, 0)]
+    assert scores[0] > scores[1] > scores[2], scores
+    assert all(-0.5 <= s <= 4.55 for s in scores)
+
+    peak = float(np.abs(CLEAN).max())
+    clipped = [
+        float(pesq_fn(jnp.asarray(np.clip(CLEAN, -c * peak, c * peak)), jnp.asarray(CLEAN), FS, "nb"))
+        for c in (0.9, 0.5, 0.2)
+    ]
+    assert clipped[0] > clipped[1] > clipped[2], clipped
+
+
+def test_delay_tolerance():
+    delayed = np.concatenate([np.zeros(400, np.float32), CLEAN])[: len(CLEAN)]
+    score = float(pesq_fn(jnp.asarray(delayed), jnp.asarray(CLEAN), FS, "nb"))
+    assert score > 4.0, score  # global alignment recovers most of the ceiling
+
+
+def test_batch_and_class_wrapper():
+    preds = jnp.stack([jnp.asarray(CLEAN), jnp.asarray(_noisy(CLEAN, 10))])
+    target = jnp.stack([jnp.asarray(CLEAN)] * 2)
+    scores = pesq_fn(preds, target, FS, "nb")
+    assert scores.shape == (2,)
+    assert float(scores[0]) > float(scores[1])
+
+    m = PerceptualEvaluationSpeechQuality(fs=FS, mode="nb")
+    m.update(preds, target)
+    out = float(m.compute())
+    np.testing.assert_allclose(out, float(scores.mean()), atol=1e-5)
+
+
+def test_argument_validation():
+    x = jnp.asarray(CLEAN)
+    with pytest.raises(ValueError, match="fs"):
+        pesq_fn(x, x, 44100, "nb")
+    with pytest.raises(ValueError, match="mode"):
+        pesq_fn(x, x, FS, "fullband")
+    with pytest.raises(ValueError, match="Wideband"):
+        pesq_fn(x, x, 8000, "wb")
+    with pytest.raises(ModuleNotFoundError):
+        pesq_fn(x, x, FS, "nb", implementation="itu")
+    with pytest.raises(RuntimeError, match="same shape"):
+        pesq_fn(x, x[:-1], FS, "nb")
+    with pytest.raises(ValueError, match="too short"):
+        pesq_fn(x[:100], x[:100], FS, "nb")

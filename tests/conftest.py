@@ -7,13 +7,19 @@ no process spawn at all (SURVEY.md §4 "TPU-framework translation").
 import os
 import random
 
-# must happen before jax import anywhere in the test session; force CPU even
-# when the environment preset JAX_PLATFORMS (e.g. an attached TPU via axon) —
-# tests are numerics-parity checks and must run fp32, not bf16 matmuls
+# must happen before any backend is initialized; force CPU even when the
+# environment presets a TPU platform plugin (e.g. axon) — tests are
+# numerics-parity checks and must run fp32, not bf16 matmuls. The env var
+# alone is NOT enough: a platform plugin can override it on import, so we
+# also set the config flag, which is read last at backend-init time.
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -9,7 +9,7 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 
-from ..functional.audio.gated import perceptual_evaluation_speech_quality
+from ..functional.audio.pesq import perceptual_evaluation_speech_quality
 from ..functional.audio.pit import permutation_invariant_training
 from ..functional.audio.srmr import speech_reverberation_modulation_energy_ratio
 from ..functional.audio.stoi import short_time_objective_intelligibility
@@ -172,7 +172,14 @@ class PermutationInvariantTraining(_MeanAudioMetric):
 
 
 class PerceptualEvaluationSpeechQuality(_MeanAudioMetric):
-    """Parity: reference ``audio/pesq.py`` (gated host C backend)."""
+    """Parity: reference ``audio/pesq.py``.
+
+    The reference gates on the third-party ITU C backend; this build ships a
+    first-party P.862-structured implementation
+    (``functional/audio/pesq.py``) and works out of the box — the ITU C
+    backend is still preferred automatically when installed
+    (``implementation="auto"``).
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -180,21 +187,26 @@ class PerceptualEvaluationSpeechQuality(_MeanAudioMetric):
     plot_lower_bound = -0.5
     plot_upper_bound = 4.5
 
-    def __init__(self, fs: int, mode: str, n_processes: int = 1, **kwargs: Any) -> None:
+    def __init__(self, fs: int, mode: str, n_processes: int = 1,
+                 implementation: str = "auto", **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        from ..functional.audio.gated import _PESQ_AVAILABLE
-
-        if not _PESQ_AVAILABLE:
-            raise ModuleNotFoundError(
-                "PESQ metric requires that `pesq` is installed. Install as `pip install pesq`."
+        if fs not in (8000, 16000):
+            raise ValueError(f"Expected argument `fs` to either be 8000 or 16000 but got {fs}")
+        if mode not in ("wb", "nb"):
+            raise ValueError(f"Expected argument `mode` to either be 'wb' or 'nb' but got {mode}")
+        if implementation not in ("auto", "itu", "native"):
+            raise ValueError(
+                f"Expected argument `implementation` in ('auto','itu','native'), got {implementation}"
             )
         self.fs = fs
         self.mode = mode
         self.n_processes = n_processes
+        self.implementation = implementation
 
     def _values(self, preds: Array, target: Array) -> Array:
         return perceptual_evaluation_speech_quality(preds, target, self.fs, self.mode,
-                                                    n_processes=self.n_processes)
+                                                    n_processes=self.n_processes,
+                                                    implementation=self.implementation)
 
 
 class ShortTimeObjectiveIntelligibility(_MeanAudioMetric):

@@ -1,5 +1,5 @@
 """Functional audio kernels (L3). Parity: reference ``functional/audio/``."""
-from .gated import perceptual_evaluation_speech_quality
+from .pesq import perceptual_evaluation_speech_quality
 from .pit import permutation_invariant_training, pit_permutate
 from .srmr import speech_reverberation_modulation_energy_ratio
 from .stoi import short_time_objective_intelligibility

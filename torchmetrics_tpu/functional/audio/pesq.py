@@ -1,0 +1,321 @@
+"""Perceptual Evaluation of Speech Quality (PESQ, ITU-T P.862) — first-party.
+
+Parity target: reference ``functional/audio/pesq.py`` + ``audio/pesq.py``
+(173 LoC), which *wrap* the third-party ITU C library per-sample on CPU and
+raise ``ModuleNotFoundError`` without it. This build owns the algorithm
+instead (SURVEY.md §2.9 "TPU-native plan" row `pesq`): the P.862 pipeline —
+level alignment, time alignment, Bark-domain perceptual transform, Zwicker
+loudness, asymmetric disturbance aggregation, and the P.862.1/.2 MOS-LQO
+mapping — implemented in JAX (the heavy stages are FFT/filterbank math and
+run vectorized over frames; batching loops on host like the reference).
+
+Exactness: the ITU tables are reproduced *formulaically* (uniform division
+of the 7·asinh(f/650) Bark warp into 49 bands; Terhardt absolute-threshold
+curve) rather than copied, and time alignment is global crude+fine rather
+than per-utterance splitting, so scores are P.862-structured but not
+bit-exact against the ITU executable. Identical inputs map to the exact
+P.862.1/.2 ceiling (4.549 nb / 4.644 wb) and degradations reduce the score
+monotonically. When the exact ITU C backend (``pesq`` package) is installed
+it is preferred automatically (``implementation="auto"``); force ours with
+``implementation="native"``.
+"""
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+__all__ = ["perceptual_evaluation_speech_quality"]
+
+NB_BANDS = 49
+POWER_TARGET = 1e7  # P.862 level-alignment target band power
+SL = 1.866055e-1  # loudness scaling (P.862)
+ZWICKER_POWER = 0.23
+# disturbance aggregation constants (P.862 cognitive model)
+DEAD_ZONE_FACTOR = 0.25
+ASYM_EXPONENT = 1.2
+ASYM_CAP = 12.0
+ASYM_FLOOR = 3.0
+FRAME_CAP = 45.0
+INTERVAL_FRAMES = 20  # ~320 ms aggregation intervals (L6 inside, L2 across)
+
+
+def _module_available(name: str) -> bool:
+    import importlib.util
+
+    return importlib.util.find_spec(name) is not None
+
+
+@functools.lru_cache(maxsize=4)
+def _perceptual_constants(fs: int):
+    """Bark filterbank + thresholds for a sample rate (host, one-time).
+
+    49 bands uniform in ``bark(f) = 7 asinh(f / 650)`` over [0, fs/2], FFT
+    bin membership weights, per-band absolute hearing threshold (Terhardt),
+    and band widths (for the Lp norms' width weighting).
+    """
+    nfft = 256 if fs == 8000 else 512  # 32 ms frames
+    freqs = np.fft.rfftfreq(nfft, 1.0 / fs)
+
+    def bark(f):
+        return 7.0 * np.arcsinh(f / 650.0)
+
+    max_bark = bark(fs / 2.0)
+    edges_bark = np.linspace(0.0, max_bark, NB_BANDS + 1)
+    edges_hz = 650.0 * np.sinh(edges_bark / 7.0)
+    centers_hz = 0.5 * (edges_hz[:-1] + edges_hz[1:])
+    width_bark = float(edges_bark[1] - edges_bark[0])
+
+    # (NB_BANDS, nfft//2+1) membership of each FFT bin
+    fb = np.zeros((NB_BANDS, len(freqs)))
+    band_idx = np.clip(np.searchsorted(edges_hz, freqs, side="right") - 1, 0, NB_BANDS - 1)
+    for j, b in enumerate(band_idx):
+        fb[b, j] = 1.0
+
+    # absolute hearing threshold (Terhardt), converted to the digital power
+    # scale via P.862's calibration: level alignment targets 1e7 <=> 79 dB
+    # SPL, so a band power of 10^((dB_SPL - 79)/10) * 1e7 sits at threshold
+    f_khz = np.maximum(centers_hz, 20.0) / 1000.0
+    thresh_db_spl = (
+        3.64 * f_khz**-0.8
+        - 6.5 * np.exp(-0.6 * (f_khz - 3.3) ** 2)
+        + 1e-3 * f_khz**4
+    )
+    thresh_db_spl = np.clip(thresh_db_spl, -10.0, 96.0)
+    abs_thresh_power = 10.0 ** ((thresh_db_spl - 79.0) / 10.0) * POWER_TARGET
+
+    win = np.hanning(nfft)
+    # Parseval factor mapping one-sided |X_k|^2 sums to windowed mean-square
+    spec_norm = 2.0 / (nfft * np.sum(win**2))
+
+    return {
+        "nfft": nfft,
+        "freqs": freqs,
+        "fb": fb,
+        "spec_norm": spec_norm,
+        "centers_hz": centers_hz,
+        "width_bark": width_bark,
+        "abs_thresh": abs_thresh_power,
+    }
+
+
+def _frame_signal(x: Array, nfft: int) -> Array:
+    """(T, nfft) 50%-overlap Hann frames."""
+    hop = nfft // 2
+    n_frames = max((x.shape[-1] - nfft) // hop + 1, 1)
+    idx = jnp.arange(n_frames)[:, None] * hop + jnp.arange(nfft)[None, :]
+    win = jnp.asarray(np.hanning(nfft))
+    return x[idx] * win
+
+
+def _bark_spectrum(x: Array, c: dict) -> Array:
+    """(T, NB_BANDS) Bark band powers in per-sample mean-square units."""
+    frames = _frame_signal(x, c["nfft"])
+    spec = jnp.abs(jnp.fft.rfft(frames, axis=-1)) ** 2 * c["spec_norm"]
+    fb = jnp.asarray(c["fb"])
+    return spec @ fb.T  # (T, NB)
+
+
+def _align_level(x: Array, fs: int) -> Array:
+    """Scale so 350-3250 Hz mean-square power hits POWER_TARGET (P.862)."""
+    n = x.shape[-1]
+    spec = 2.0 * jnp.abs(jnp.fft.rfft(x)) ** 2 / (n * n)
+    freqs = jnp.asarray(np.fft.rfftfreq(n, 1.0 / fs))
+    band = (freqs >= 350.0) & (freqs <= 3250.0)
+    p = jnp.sum(jnp.where(band, spec, 0.0))
+    return x * jnp.sqrt(POWER_TARGET / jnp.maximum(p, 1e-20))
+
+
+def _estimate_delay(ref: np.ndarray, deg: np.ndarray, fs: int) -> int:
+    """Global crude alignment via envelope cross-correlation (host).
+
+    P.862 does per-utterance splitting + fine histogram alignment; a single
+    global delay covers the fixed-offset case and keeps compute in one pass.
+    """
+    hop = fs // 250  # 4 ms envelope resolution
+    n = min(len(ref), len(deg)) // hop * hop
+    if n == 0:
+        return 0  # too short to estimate; the frame check below rejects it
+    env_r = np.abs(ref[:n]).reshape(-1, hop).sum(axis=1)
+    env_d = np.abs(deg[:n]).reshape(-1, hop).sum(axis=1)
+    env_r = env_r - env_r.mean()
+    env_d = env_d - env_d.mean()
+    size = 1 << int(np.ceil(np.log2(2 * len(env_r))))
+    xc = np.fft.irfft(np.fft.rfft(env_r, size).conj() * np.fft.rfft(env_d, size))
+    lag = int(np.argmax(np.abs(xc)))
+    if lag > size // 2:
+        lag -= size
+    return lag * hop
+
+
+def _loudness(bark_pow: Array, c: dict) -> Array:
+    """Zwicker loudness density per band (T, NB)."""
+    p0 = jnp.asarray(c["abs_thresh"])
+    ratio = bark_pow / p0
+    s = SL * (p0 / 0.5) ** ZWICKER_POWER * ((0.5 + 0.5 * ratio) ** ZWICKER_POWER - 1.0)
+    return jnp.where(ratio >= 1.0, s, 0.0) + jnp.where(ratio < 1.0, s * ratio, 0.0)
+
+
+def _lp_norm(x: Array, p: float, axis: int = -1) -> Array:
+    return jnp.sum(jnp.abs(x) ** p, axis=axis) ** (1.0 / p)
+
+
+def _pesq_raw(ref: np.ndarray, deg: np.ndarray, fs: int) -> float:
+    """Raw P.862 score for one (ref, deg) pair at native fs."""
+    c = _perceptual_constants(fs)
+
+    delay = _estimate_delay(ref, deg, fs)
+    if delay > 0:
+        deg = deg[delay:]
+    elif delay < 0:
+        ref = ref[-delay:]
+    n = min(len(ref), len(deg))
+    if n < c["nfft"]:
+        raise ValueError(f"Audio too short for PESQ: {n} samples < one {c['nfft']}-sample frame")
+    r = _align_level(jnp.asarray(ref[:n], jnp.float32), fs)
+    d = _align_level(jnp.asarray(deg[:n], jnp.float32), fs)
+
+    bark_r = _bark_spectrum(r, c)  # (T, NB)
+    bark_d = _bark_spectrum(d, c)
+
+    # speech-active frames: above 1e4 total power (30 dB below target)
+    frame_pow = jnp.sum(bark_r, axis=1)
+    active = frame_pow > 1e4
+
+    # frequency (transfer-function) compensation: per-band ratio over active
+    # frames, clipped to [0.01, 100], applied to the reference
+    act = active[:, None]
+    num = jnp.sum(jnp.where(act, bark_d, 0.0), axis=0) + 1e3
+    den = jnp.sum(jnp.where(act, bark_r, 0.0), axis=0) + 1e3
+    band_gain = jnp.clip(num / den, 0.01, 100.0)
+    bark_r_eq = bark_r * band_gain[None, :]
+
+    # per-frame gain compensation: smoothed total-power ratio on the degraded
+    ratio_t = (jnp.sum(bark_r_eq, axis=1) + 5e3) / (jnp.sum(bark_d, axis=1) + 5e3)
+    ratio_t = jnp.clip(ratio_t, 3e-4, 5.0)
+
+    def smooth(carry, x):
+        y = 0.8 * carry + 0.2 * x
+        return y, y
+
+    _, gain_t = jax.lax.scan(smooth, jnp.float32(1.0), ratio_t)
+    bark_d_eq = bark_d * gain_t[:, None]
+
+    loud_r = _loudness(bark_r_eq, c)
+    loud_d = _loudness(bark_d_eq, c)
+
+    # disturbance with masking dead zone
+    diff = loud_d - loud_r
+    m = DEAD_ZONE_FACTOR * jnp.minimum(loud_d, loud_r)
+    disturb = jnp.sign(diff) * jnp.maximum(jnp.abs(diff) - m, 0.0)
+
+    # asymmetry factor: additive (coding) noise counts more than omission
+    asym = ((bark_d_eq + 50.0) / (bark_r_eq + 50.0)) ** ASYM_EXPONENT
+    asym = jnp.where(asym < ASYM_FLOOR, 0.0, jnp.minimum(asym, ASYM_CAP))
+
+    w = jnp.full((NB_BANDS,), c["width_bark"])
+    d_frame = _lp_norm(disturb * w, 2.0, axis=1)
+    da_frame = jnp.sum(jnp.abs(disturb * asym) * w, axis=1)
+
+    # frame-energy weighting and cap
+    weight = ((frame_pow + 1e5) / 1e7) ** 0.04
+    d_frame = jnp.minimum(d_frame / weight, FRAME_CAP)
+    da_frame = jnp.minimum(da_frame / weight, FRAME_CAP)
+
+    # only active frames contribute
+    d_frame = jnp.where(active, d_frame, 0.0)
+    da_frame = jnp.where(active, da_frame, 0.0)
+
+    # time aggregation: L6 within ~320 ms intervals, L2 across intervals
+    t = d_frame.shape[0]
+    pad = (-t) % INTERVAL_FRAMES
+
+    def agg(x):
+        xp = jnp.pad(x, (0, pad)).reshape(-1, INTERVAL_FRAMES)
+        ap = jnp.pad(active, (0, pad)).reshape(-1, INTERVAL_FRAMES)
+        per_int_cnt = jnp.maximum(jnp.sum(ap, axis=1), 1)
+        l6 = (jnp.sum(xp**6.0, axis=1) / per_int_cnt) ** (1.0 / 6.0)
+        n_int = jnp.maximum(jnp.sum(jnp.any(ap, axis=1)), 1)
+        return jnp.sqrt(jnp.sum(l6**2) / n_int)
+
+    d_total = agg(d_frame)
+    da_total = agg(da_frame)
+    return float(4.5 - 0.1 * d_total - 0.0309 * da_total)
+
+
+def _mos_lqo(raw: float, mode: str) -> float:
+    """P.862.1 (nb) / P.862.2 (wb) mapping to MOS-LQO."""
+    if mode == "wb":
+        return 0.999 + 4.0 / (1.0 + math.exp(-1.3669 * raw + 3.8224))
+    return 0.999 + 4.0 / (1.0 + math.exp(-1.4945 * raw + 4.6607))
+
+
+def _pesq_native(ref: np.ndarray, deg: np.ndarray, fs: int, mode: str) -> float:
+    return _mos_lqo(_pesq_raw(ref, deg, fs), mode)
+
+
+def perceptual_evaluation_speech_quality(
+    preds: Array,
+    target: Array,
+    fs: int,
+    mode: str,
+    keep_same_device: bool = False,
+    n_processes: int = 1,
+    implementation: str = "auto",
+) -> Array:
+    """PESQ MOS-LQO. Parity: reference ``functional/audio/pesq.py``.
+
+    Args:
+        preds: degraded signal ``(..., time)``
+        target: reference signal ``(..., time)``
+        fs: 8000 (nb) or 16000 (nb/wb)
+        mode: ``"nb"`` or ``"wb"``
+        keep_same_device: kept for API parity (outputs are jax arrays)
+        n_processes: parallel host processes for the ITU backend batch path
+        implementation: ``"auto"`` (ITU C backend if installed, else ours),
+            ``"itu"`` (require the ``pesq`` package), or ``"native"``
+            (this module's P.862-structured implementation)
+    """
+    if fs not in (8000, 16000):
+        raise ValueError(f"Expected argument `fs` to either be 8000 or 16000 but got {fs}")
+    if mode not in ("wb", "nb"):
+        raise ValueError(f"Expected argument `mode` to either be 'wb' or 'nb' but got {mode}")
+    if mode == "wb" and fs == 8000:
+        raise ValueError("Wideband PESQ requires fs=16000")
+    if implementation not in ("auto", "itu", "native"):
+        raise ValueError(f"Expected argument `implementation` in ('auto','itu','native'), got {implementation}")
+    use_itu = implementation == "itu" or (implementation == "auto" and _module_available("pesq"))
+    if implementation == "itu" and not _module_available("pesq"):
+        raise ModuleNotFoundError(
+            "implementation='itu' requires that `pesq` is installed. Install as `pip install pesq` "
+            "or use implementation='native'."
+        )
+
+    p = np.asarray(preds, dtype=np.float32)
+    t = np.asarray(target, dtype=np.float32)
+    if p.shape != t.shape:
+        raise RuntimeError(f"preds and target must have the same shape, got {p.shape} vs {t.shape}")
+
+    if use_itu:
+        import pesq as pesq_backend
+
+        if p.ndim == 1:
+            return jnp.asarray(pesq_backend.pesq(fs, t, p, mode))
+        flat_p = p.reshape(-1, p.shape[-1])
+        flat_t = t.reshape(-1, t.shape[-1])
+        if n_processes > 1:
+            scores = pesq_backend.pesq_batch(fs, list(flat_t), list(flat_p), mode, n_processor=n_processes)
+        else:
+            scores = [pesq_backend.pesq(fs, ti, pi, mode) for ti, pi in zip(flat_t, flat_p)]
+        return jnp.asarray(np.asarray(scores, dtype=np.float32).reshape(p.shape[:-1]))
+
+    if p.ndim == 1:
+        return jnp.asarray(np.float32(_pesq_native(t, p, fs, mode)))
+    flat_p = p.reshape(-1, p.shape[-1])
+    flat_t = t.reshape(-1, t.shape[-1])
+    scores = [_pesq_native(ti, pi, fs, mode) for ti, pi in zip(flat_t, flat_p)]
+    return jnp.asarray(np.asarray(scores, dtype=np.float32).reshape(p.shape[:-1]))

@@ -49,7 +49,12 @@ from .specificity import (
 )
 from .calibration_error import binary_calibration_error, calibration_error, multiclass_calibration_error
 from .dice import dice
-from .group_fairness import binary_fairness, binary_groups_stat_rates
+from .group_fairness import (
+    binary_fairness,
+    binary_groups_stat_rates,
+    demographic_parity,
+    equal_opportunity,
+)
 from .hinge import binary_hinge_loss, hinge_loss, multiclass_hinge_loss
 from .ranking import (
     multilabel_coverage_error,
@@ -92,7 +97,7 @@ from .stat_scores import binary_stat_scores, multiclass_stat_scores, multilabel_
 
 __all__ = [
     "calibration_error", "binary_calibration_error", "multiclass_calibration_error",
-    "dice", "binary_fairness", "binary_groups_stat_rates",
+    "dice", "binary_fairness", "binary_groups_stat_rates", "demographic_parity", "equal_opportunity",
     "hinge_loss", "binary_hinge_loss", "multiclass_hinge_loss",
     "multilabel_coverage_error", "multilabel_ranking_average_precision", "multilabel_ranking_loss",
     "binary_recall_at_fixed_precision", "binary_precision_at_fixed_recall",

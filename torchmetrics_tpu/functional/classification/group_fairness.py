@@ -62,6 +62,39 @@ def _compute_binary_equal_opportunity(group_stats: Array) -> Tuple[Array, Array]
     return jnp.min(tprs), jnp.max(tprs)
 
 
+def demographic_parity(
+    preds: Array, groups: Array, threshold: float = 0.5,
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Dict[str, Array]:
+    """Positivity-rate disparity min/max ratio across groups.
+
+    Parity: reference ``group_fairness.py:177`` — implemented as
+    ``binary_fairness(task="demographic_parity")`` exactly as the reference
+    delegates (``group_fairness.py:246-255``).
+    """
+    # target is ignored for DP — binary_fairness substitutes zeros itself
+    return binary_fairness(
+        preds, preds, groups,
+        task="demographic_parity", threshold=threshold,
+        ignore_index=ignore_index, validate_args=validate_args,
+    )
+
+
+def equal_opportunity(
+    preds: Array, target: Array, groups: Array, threshold: float = 0.5,
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Dict[str, Array]:
+    """True-positive-rate disparity min/max ratio across groups.
+
+    Parity: reference ``group_fairness.py:258`` — delegates to
+    ``binary_fairness(task="equal_opportunity")`` (``group_fairness.py:327-336``).
+    """
+    return binary_fairness(
+        preds, target, groups, task="equal_opportunity", threshold=threshold,
+        ignore_index=ignore_index, validate_args=validate_args,
+    )
+
+
 def binary_fairness(
     preds: Array, target: Array, groups: Array, task: str = "all", num_groups: Optional[int] = None,
     threshold: float = 0.5, ignore_index: Optional[int] = None, validate_args: bool = True,

@@ -5,6 +5,8 @@ from .d_lambda import (
     spectral_distortion_index,
 )
 from .gradients import image_gradients
+from .lpips import learned_perceptual_image_patch_similarity
+from .perceptual_path_length import perceptual_path_length
 from .psnr import peak_signal_noise_ratio
 from .psnrb import peak_signal_noise_ratio_with_blocked_effect
 from .rmse_sw import (
@@ -25,6 +27,8 @@ from .vif import visual_information_fidelity
 __all__ = [
     "error_relative_global_dimensionless_synthesis",
     "image_gradients",
+    "learned_perceptual_image_patch_similarity",
+    "perceptual_path_length",
     "multiscale_structural_similarity_index_measure",
     "peak_signal_noise_ratio",
     "peak_signal_noise_ratio_with_blocked_effect",

@@ -1,0 +1,51 @@
+"""Learned perceptual image patch similarity (functional).
+
+Parity: reference ``src/torchmetrics/functional/image/lpips.py:399``
+(``learned_perceptual_image_patch_similarity``).
+
+Offline-TPU note: the reference downloads torchvision backbone weights; in
+this environment the string presets cannot fetch them, so ``net_type`` also
+accepts a *callable* ``(img1, img2) -> (N,) distances`` (e.g. a Flax LPIPS
+net from ``torchmetrics_tpu.models.lpips`` with converted weights). The
+string presets raise with guidance, matching the class-layer behavior
+(``torchmetrics_tpu/image/lpip.py``).
+"""
+from typing import Callable, Union
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+__all__ = ["learned_perceptual_image_patch_similarity"]
+
+
+def learned_perceptual_image_patch_similarity(
+    img1: Array,
+    img2: Array,
+    net_type: Union[str, Callable] = "alex",
+    reduction: str = "mean",
+    normalize: bool = False,
+) -> Array:
+    """One-shot LPIPS between two image batches ``(N, 3, H, W)``."""
+    if isinstance(net_type, str):
+        valid_net_type = ("vgg", "alex", "squeeze")
+        if net_type not in valid_net_type:
+            raise ValueError(f"Argument `net_type` must be one of {valid_net_type}, but got {net_type}.")
+        raise ModuleNotFoundError(
+            f"LPIPS with the pretrained `{net_type}` backbone requires torchvision weights that cannot be "
+            "downloaded in this offline environment. Pass a callable `(img1, img2) -> distances` instead "
+            "(see torchmetrics_tpu.models.lpips for the network definition and weight conversion)."
+        )
+    if not callable(net_type):
+        raise ValueError("Argument `net_type` must be a string preset or a callable")
+    valid_reduction = ("mean", "sum")
+    if reduction not in valid_reduction:
+        raise ValueError(f"Argument `reduction` must be one of {valid_reduction}, but got {reduction}")
+    if not isinstance(normalize, bool):
+        raise ValueError(f"Argument `normalize` should be a bool but got {normalize}")
+    if normalize:  # [0,1] -> [-1,1]
+        img1 = 2 * img1 - 1
+        img2 = 2 * img2 - 1
+    loss = jnp.asarray(net_type(img1, img2)).reshape(-1)
+    return jnp.mean(loss) if reduction == "mean" else jnp.sum(loss)

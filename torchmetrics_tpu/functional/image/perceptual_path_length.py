@@ -1,0 +1,114 @@
+"""Perceptual path length (functional).
+
+Parity: reference
+``src/torchmetrics/functional/image/perceptual_path_length.py``
+(``GeneratorType`` protocol ``:27``, ``_interpolate`` ``:110-175``, driver
+``:153-260``): sample two latent batches, nudge the first toward the second
+by ``epsilon`` (lerp / slerp_any / slerp_unit), and average the perceptual
+distance between the generated image pairs divided by ``epsilon**2``.
+
+TPU note: the generator and distance network run as ordinary jitted JAX
+calls; the driver loop stays on host (data-dependent batch count), matching
+the reference's host-side batching at ``perceptual_path_length.py:236-252``.
+"""
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+__all__ = ["perceptual_path_length"]
+
+_EPS = 1e-7
+
+
+def _interpolate(latents1: Array, latents2: Array, epsilon: float, interpolation_method: str) -> Array:
+    """Nudge ``latents1`` toward ``latents2`` by ``epsilon``.
+
+    Reference ``perceptual_path_length.py:110-175``; zero / collinear latent
+    pairs fall back to lerp via masking (``jnp.where`` replaces the
+    reference's boolean indexing — static shapes under jit).
+    """
+    lerp = latents1 + (latents2 - latents1) * epsilon
+    if interpolation_method == "lerp":
+        return lerp
+    norm1 = jnp.sqrt(jnp.sum(latents1**2, axis=-1, keepdims=True))
+    norm2 = jnp.sqrt(jnp.sum(latents2**2, axis=-1, keepdims=True))
+    l1n = latents1 / jnp.clip(norm1, _EPS)
+    l2n = latents2 / jnp.clip(norm2, _EPS)
+    d = jnp.sum(l1n * l2n, axis=-1, keepdims=True)
+    mask_zero = (norm1 < _EPS) | (norm2 < _EPS)
+    mask_collinear = (d > 1 - _EPS) | (d < -1 + _EPS)
+    mask_lerp = mask_zero | mask_collinear
+    omega = jnp.arccos(jnp.clip(d, -1.0, 1.0))
+    denom = jnp.clip(jnp.sin(omega), _EPS)
+    out = (jnp.sin((1 - epsilon) * omega) / denom) * latents1 + (jnp.sin(epsilon * omega) / denom) * latents2
+    out = jnp.where(mask_lerp, lerp, out)
+    if interpolation_method == "slerp_unit":
+        out = out / jnp.clip(jnp.sqrt(jnp.sum(out**2, axis=-1, keepdims=True)), _EPS)
+    return out
+
+
+def perceptual_path_length(
+    generator: Any,
+    distance_fn: Callable[[Array, Array], Array],
+    num_samples: int = 10_000,
+    conditional: bool = False,
+    batch_size: int = 64,
+    interpolation_method: str = "lerp",
+    epsilon: float = 1e-4,
+    resize: Optional[int] = 64,
+    lower_discard: Optional[float] = 0.01,
+    upper_discard: Optional[float] = 0.99,
+    seed: int = 42,
+) -> Tuple[Array, Array, Array]:
+    """Returns (mean, std, distances). Parity: reference ``perceptual_path_length.py:153``.
+
+    ``generator`` must provide ``sample(num_samples) -> latents`` and be
+    callable on latents returning images ``(N, C, H, W)`` (the reference
+    ``GeneratorType`` protocol); when ``conditional=True`` it must expose an
+    integer ``num_classes`` and accept ``generator(latents, labels)``.
+    ``distance_fn`` is a perceptual distance (e.g. an LPIPS callable).
+    ``resize`` bilinearly resizes generated images to ``(resize, resize)``
+    before the distance (the reference threads it into its LPIPS net).
+    """
+    if not hasattr(generator, "sample"):
+        raise NotImplementedError(
+            "The generator must have a `sample` method returning latents (GeneratorType protocol)."
+        )
+    if interpolation_method not in ("lerp", "slerp_any", "slerp_unit"):
+        raise ValueError(f"Interpolation method {interpolation_method} not supported.")
+    if conditional and not isinstance(getattr(generator, "num_classes", None), int):
+        raise AttributeError("The generator must have an integer `num_classes` attribute when `conditional=True`.")
+
+    rng = np.random.RandomState(seed)
+    distances = []
+    remaining = num_samples
+    while remaining > 0:
+        bsz = min(batch_size, remaining)
+        latents1 = jnp.asarray(generator.sample(bsz))
+        latents2 = jnp.asarray(generator.sample(bsz))
+        latents2 = _interpolate(latents1, latents2, epsilon, interpolation_method)
+        if conditional:
+            labels = jnp.asarray(rng.randint(0, generator.num_classes, (bsz,)))
+            imgs1 = jnp.asarray(generator(latents1, labels))
+            imgs2 = jnp.asarray(generator(latents2, labels))
+        else:
+            imgs1 = jnp.asarray(generator(latents1))
+            imgs2 = jnp.asarray(generator(latents2))
+        if resize is not None:
+            shape = (*imgs1.shape[:-2], resize, resize)
+            imgs1 = jax.image.resize(imgs1, shape, method="bilinear")
+            imgs2 = jax.image.resize(imgs2, shape, method="bilinear")
+        d = jnp.asarray(distance_fn(imgs1, imgs2)).reshape(-1) / (epsilon**2)
+        distances.append(d)
+        remaining -= bsz
+    dist = jnp.concatenate(distances)
+    if lower_discard is not None or upper_discard is not None:
+        lo = jnp.quantile(dist, lower_discard or 0.0)
+        hi = jnp.quantile(dist, upper_discard or 1.0)
+        keep = (dist >= lo) & (dist <= hi)
+        dist = dist[keep]
+    return jnp.mean(dist), jnp.std(dist, ddof=1), dist

@@ -5,77 +5,14 @@ Parity: reference
 (``GeneratorType`` protocol, latent interpolation lerp/slerp, LPIPS distance
 between epsilon-jittered latent pairs).
 """
-from typing import Any, Callable, Optional, Tuple
+from typing import Any, Callable, Optional
 
 import jax
-import jax.numpy as jnp
-import numpy as np
 
+from ..functional.image.perceptual_path_length import perceptual_path_length
 from ..metric import Metric
 
 Array = jax.Array
-
-
-def _interpolate(latents1: Array, latents2: Array, epsilon: float, interpolation_method: str) -> Array:
-    """lerp / slerp between latent batches."""
-    if interpolation_method == "lerp":
-        return latents1 + (latents2 - latents1) * epsilon
-    # spherical
-    l1 = latents1 / jnp.linalg.norm(latents1, axis=-1, keepdims=True)
-    l2 = latents2 / jnp.linalg.norm(latents2, axis=-1, keepdims=True)
-    omega = jnp.arccos(jnp.clip(jnp.sum(l1 * l2, axis=-1, keepdims=True), -1 + 1e-7, 1 - 1e-7))
-    so = jnp.sin(omega)
-    return (jnp.sin((1 - epsilon) * omega) / so) * latents1 + (jnp.sin(epsilon * omega) / so) * latents2
-
-
-def perceptual_path_length(
-    generator: Any,
-    distance_fn: Callable[[Array, Array], Array],
-    num_samples: int = 10_000,
-    conditional: bool = False,
-    batch_size: int = 64,
-    interpolation_method: str = "lerp",
-    epsilon: float = 1e-4,
-    resize: Optional[int] = 64,
-    lower_discard: Optional[float] = 0.01,
-    upper_discard: Optional[float] = 0.99,
-    seed: int = 42,
-) -> Tuple[Array, Array, Array]:
-    """Returns (mean, std, distances). Parity: reference ``perceptual_path_length.py:72``.
-
-    ``generator`` must provide ``sample(num_samples) -> latents`` and be
-    callable on latents returning images (the reference ``GeneratorType``
-    protocol). ``distance_fn`` is a perceptual distance (e.g. LPIPS callable).
-    """
-    if not hasattr(generator, "sample"):
-        raise NotImplementedError(
-            "The generator must have a `sample` method returning latents (GeneratorType protocol)."
-        )
-    if interpolation_method not in ("lerp", "slerp_any", "slerp_unit"):
-        raise ValueError(f"Interpolation method {interpolation_method} not supported.")
-    method = "lerp" if interpolation_method == "lerp" else "slerp"
-
-    distances = []
-    rng = np.random.RandomState(seed)
-    remaining = num_samples
-    while remaining > 0:
-        bsz = min(batch_size, remaining)
-        latents1 = jnp.asarray(generator.sample(bsz))
-        latents2 = jnp.asarray(generator.sample(bsz))
-        inter1 = _interpolate(latents1, latents2, float(rng.rand()), method)
-        inter2 = _interpolate(latents1, latents2, float(rng.rand()) + epsilon, method)
-        imgs1 = jnp.asarray(generator(inter1))
-        imgs2 = jnp.asarray(generator(inter2))
-        d = jnp.asarray(distance_fn(imgs1, imgs2)).reshape(-1) / (epsilon**2)
-        distances.append(d)
-        remaining -= bsz
-    dist = jnp.concatenate(distances)
-    if lower_discard is not None or upper_discard is not None:
-        lo = jnp.quantile(dist, lower_discard or 0.0)
-        hi = jnp.quantile(dist, upper_discard or 1.0)
-        keep = (dist >= lo) & (dist <= hi)
-        dist = dist[keep]
-    return jnp.mean(dist), jnp.std(dist, ddof=1), dist
 
 
 class PerceptualPathLength(Metric):

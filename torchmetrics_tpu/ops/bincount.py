@@ -29,7 +29,10 @@ import numpy as np
 Array = jax.Array
 
 TILE_N = 2048
-TILE_B = 512
+# 1024 matches XLA's 1D f32 layout tiling T(1024) for large arrays — a
+# smaller block makes Mosaic's operand layout disagree with XLA's and fail
+# verification ("XLA layout {0:T(1024)} does not match Mosaic layout")
+TILE_B = 1024
 
 
 def _kernel(idx_ref, w_ref, out_ref):
@@ -49,11 +52,38 @@ def _kernel(idx_ref, w_ref, out_ref):
     out_ref[:] += jnp.sum(w[:, None].astype(out_ref.dtype) * eq, axis=0)
 
 
+@functools.lru_cache(maxsize=None)
+def _pallas_call_cached(padded_bins: int, padded_n: int, interpret: bool, out_dtype_name: str):
+    """Build the pallas_call for a (padded_bins, padded_n) problem size.
+
+    Wrapped in ``sequential_vmap`` so ``vmap`` (e.g. the epoch-fused
+    ``update_state_batched`` path) lowers to an in-graph ``lax.map`` over the
+    kernel instead of producing an un-tileable (1, TILE) block shape.
+    """
+    import jax.experimental.pallas as pl
+
+    out_dtype = jnp.dtype(out_dtype_name)
+
+    @jax.custom_batching.sequential_vmap
+    def call(idx_p: Array, w_p: Array) -> Array:
+        return pl.pallas_call(
+            _kernel,
+            out_shape=jax.ShapeDtypeStruct((padded_bins,), out_dtype),
+            grid=(padded_bins // TILE_B, padded_n // TILE_N),
+            in_specs=[
+                pl.BlockSpec((TILE_N,), lambda b, i: (i,)),
+                pl.BlockSpec((TILE_N,), lambda b, i: (i,)),
+            ],
+            out_specs=pl.BlockSpec((TILE_B,), lambda b, i: (b,)),
+            interpret=interpret,
+        )(idx_p, w_p)
+
+    return call
+
+
 @functools.partial(jax.jit, static_argnames=("num_bins", "interpret", "out_dtype"))
 def _bincount_pallas(idx: Array, weights: Array, num_bins: int, interpret: bool = False,
                      out_dtype=jnp.float32) -> Array:
-    import jax.experimental.pallas as pl
-
     n = idx.shape[0]
     if n == 0:  # zero-length grid would skip the output zero-init
         return jnp.zeros((num_bins,), out_dtype)
@@ -64,18 +94,8 @@ def _bincount_pallas(idx: Array, weights: Array, num_bins: int, interpret: bool 
     w_p = jnp.concatenate([weights, jnp.zeros((n_pad,), weights.dtype)])
     padded_bins = num_bins + b_pad
 
-    out = pl.pallas_call(
-        _kernel,
-        out_shape=jax.ShapeDtypeStruct((padded_bins,), out_dtype),
-        grid=(padded_bins // TILE_B, (n + n_pad) // TILE_N),
-        in_specs=[
-            pl.BlockSpec((TILE_N,), lambda b, i: (i,)),
-            pl.BlockSpec((TILE_N,), lambda b, i: (i,)),
-        ],
-        out_specs=pl.BlockSpec((TILE_B,), lambda b, i: (b,)),
-        interpret=interpret,
-    )(idx_p, w_p)
-    return out[:num_bins]
+    call = _pallas_call_cached(padded_bins, n + n_pad, bool(interpret), jnp.dtype(out_dtype).name)
+    return call(idx_p, w_p)[:num_bins]
 
 
 def _on_tpu() -> bool:
@@ -100,7 +120,10 @@ def weighted_bincount(idx: Array, weights: Array = None, num_bins: int = 0,
     unweighted = weights is None
     dtype = jnp.int32 if unweighted else jnp.float32
     w = jnp.ones(idx.shape, dtype) if unweighted else weights.reshape(-1).astype(jnp.float32)
-    if force_pallas or _on_tpu():
+    # the compare-reduce kernel does O(N * num_bins) VPU work — a win over
+    # the serialized scatter only while all bins fit one TILE_B block (one
+    # vectorized pass per element); beyond that XLA's scatter is preferred
+    if force_pallas or (_on_tpu() and num_bins <= TILE_B):
         return _bincount_pallas(idx, w, num_bins, interpret=interpret or not _on_tpu(), out_dtype=dtype)
     valid = (idx >= 0) & (idx < num_bins)
     safe = jnp.where(valid, idx, 0)
