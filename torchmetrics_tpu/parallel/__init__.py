@@ -1,5 +1,9 @@
-"""Distributed / parallelism layer: reduction tags, sync backends, mesh helpers."""
+"""Distributed / parallelism layer: reduction tags, sync backends, sequence/
+context parallelism (ring attention, expert all-to-all), and a reference
+dp x pp x tp (+ep) train-step template."""
 from .reduction import Reduction, resolve_reduction
+from .ring import expert_all_to_all, ring_attention
+from .train_demo import demo_param_shardings, init_demo_params, make_demo_train_step
 from .sync import (
     FakeSync,
     HostSync,
@@ -11,6 +15,11 @@ from .sync import (
 )
 
 __all__ = [
+    "ring_attention",
+    "expert_all_to_all",
+    "init_demo_params",
+    "demo_param_shardings",
+    "make_demo_train_step",
     "Reduction",
     "resolve_reduction",
     "SyncBackend",

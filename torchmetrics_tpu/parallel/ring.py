@@ -1,0 +1,118 @@
+"""Sequence/context parallelism primitives: ring attention + expert all-to-all.
+
+The reference library has **no** long-context machinery (SURVEY.md §2.10: SP/
+CP/ring-attention absent — its only long-input strategies are binned curve
+states and ``compute_on_cpu`` offload). For the TPU build, sequence
+parallelism is first-class: embedding-network metrics (BERTScore, InfoLM,
+Perplexity) and user models evaluate sequences no single chip could hold by
+sharding the sequence axis over the mesh and exchanging KV blocks around a
+ring (one ``lax.ppermute`` hop per step — traffic rides ICI neighbor links,
+never DCN).
+
+``ring_attention`` is exact (not windowed): blockwise softmax with running
+max/normalizer (the log-sum-exp streaming trick), so the result is
+bit-comparable to full attention up to float addition order.
+
+``expert_all_to_all`` is the dispatch/combine primitive for expert-parallel
+(MoE) layers: tokens routed to experts that live on other shards of an axis
+via ``lax.all_to_all``.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Array = jax.Array
+
+__all__ = ["ring_attention", "expert_all_to_all"]
+
+
+def ring_attention(
+    q: Array,
+    k: Array,
+    v: Array,
+    axis_name: str,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> Array:
+    """Exact attention over a sequence sharded along a mesh axis.
+
+    Args:
+        q, k, v: per-shard blocks ``(..., T_local, D)``; the global sequence
+            is the concatenation of shards in axis order.
+        axis_name: mesh axis the sequence is sharded over (call inside
+            ``shard_map``).
+        causal: apply a causal mask over *global* positions.
+        scale: logit scale; default ``D ** -0.5``.
+
+    Returns:
+        Attention output ``(..., T_local, D)`` for the local query block.
+    """
+    n = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    t_loc = q.shape[-2]
+    d = q.shape[-1]
+    if scale is None:
+        scale = d ** -0.5
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    in_dtype = q.dtype
+    q_pos = my_idx * t_loc + jnp.arange(t_loc)  # global query positions
+
+    def block_update(stats, k_blk, v_blk, src):
+        """Fold one KV block into the running (m, l, o) softmax stats (f32)."""
+        m, l, o = stats
+        s = jnp.einsum("...td,...sd->...ts", q, k_blk).astype(jnp.float32) * scale
+        if causal:
+            k_pos = src * t_loc + jnp.arange(t_loc)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask, s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        # guard fully-masked rows: exp(-inf - -inf) -> use where
+        shift = jnp.where(jnp.isneginf(m_new)[..., None], 0.0, s - m_new[..., None])
+        p = jnp.where(jnp.isneginf(s), 0.0, jnp.exp(shift))
+        corr = jnp.where(
+            jnp.isneginf(m) | jnp.isneginf(m_new), (m <= m_new).astype(jnp.float32), jnp.exp(m - m_new)
+        )
+        l = l * corr + jnp.sum(p, axis=-1)
+        o = o * corr[..., None] + jnp.einsum(
+            "...ts,...sd->...td", p, v_blk.astype(jnp.float32)
+        )
+        return m_new, l, o
+
+    # running stats accumulate in f32 regardless of input dtype (bf16-safe),
+    # derived from q so they carry q's varying-axes set (shard_map VMA typing)
+    qf = q[..., 0].astype(jnp.float32)
+    m0 = jnp.full_like(qf, -jnp.inf)
+    l0 = jnp.zeros_like(qf)
+    o0 = jnp.zeros_like(q, dtype=jnp.float32)
+
+    # fold the local block, then n-1 (rotate, fold) rounds — the last KV
+    # exchange of a rotate-every-step loop would be computed and discarded
+    stats = block_update((m0, l0, o0), k, v, my_idx)
+
+    def step(carry, _):
+        k_blk, v_blk, src, stats = carry
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        src = lax.ppermute(src, axis_name, perm)
+        stats = block_update(stats, k_blk, v_blk, src)
+        return (k_blk, v_blk, src, stats), None
+
+    if n > 1:
+        (_, _, _, stats), _ = lax.scan(step, (k, v, my_idx, stats), None, length=n - 1)
+    _, l_f, o_f = stats
+    return (o_f / jnp.maximum(l_f, 1e-30)[..., None]).astype(in_dtype)
+
+
+def expert_all_to_all(tokens: Array, axis_name: str, split_axis: int = 0, concat_axis: int = 0) -> Array:
+    """Dispatch token groups to the experts that own them (and back).
+
+    ``tokens`` has a leading grouping axis of size ``num_experts_global =
+    axis_size * experts_per_shard`` (… reshaped so ``split_axis`` has one
+    group per destination shard). A second call with the same arguments
+    performs the inverse (combine) — ``all_to_all`` is an involution for a
+    symmetric layout.
+    """
+    return lax.all_to_all(tokens, axis_name, split_axis=split_axis, concat_axis=concat_axis, tiled=True)

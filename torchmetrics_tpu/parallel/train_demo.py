@@ -1,0 +1,170 @@
+"""Reference distributed train step: dp x pp x tp (+ep on tp) + metrics.
+
+This module exists to prove — and to give users a template for — metrics
+composing with a *fully sharded* training step (SURVEY.md §2.10: the
+reference's only parallelism is DP state replication; TP/PP/EP are new
+TPU-first design). The model is deliberately tiny; the sharding patterns are
+real:
+
+- **top level**: ``jit`` + GSPMD — params placed with ``NamedSharding``
+  (the scaling-book recipe: pick a mesh, annotate shardings, let XLA insert
+  collectives). Autodiff through the inner ``shard_map`` inserts the correct
+  psums for replicated operands via its transpose rule.
+- **pp**: GPipe schedule inside ``shard_map`` — each rank owns one stage's
+  params (leading stage axis sharded over pp); microbatch activations hop
+  rank-to-rank via ``lax.ppermute``; the static tick loop is a ``lax.scan``.
+- **tp**: MLP hidden dim sharded; partial matmul outputs ``psum`` over tp.
+- **ep**: one expert per tp shard; tokens routed by static round-robin via
+  ``lax.all_to_all`` (``parallel/ring.py``) — real dispatch/combine traffic
+  with fixed shapes (a learned router adds gating on top, same comms).
+- **dp**: batch sharded over dp inside the same shard_map; the loss mean
+  outside is global (GSPMD), so grads aggregate over dp automatically.
+"""
+from functools import partial
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map as _shard_map
+
+    _SHARD_MAP_KW = {"check_vma": False}
+except ImportError:  # older jax: experimental API with the check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _SHARD_MAP_KW = {"check_rep": False}
+
+from .ring import expert_all_to_all
+
+Array = jax.Array
+
+__all__ = ["init_demo_params", "demo_param_shardings", "make_demo_train_step"]
+
+_STAGE_KEYS = ("w1", "w2", "we1", "we2")
+
+
+def init_demo_params(key: Array, vocab: int, d_model: int, d_hidden: int,
+                     pp: int, tp: int) -> Dict[str, Array]:
+    """Param pytree: stage params carry a leading pp axis and a tp-sharded hidden dim."""
+    ks = jax.random.split(key, 6)
+    se = d_model ** -0.5
+    s = 0.5 * d_hidden ** -0.5
+    return {
+        "embed": jax.random.normal(ks[0], (vocab, d_model)) * se,       # replicated
+        "w1": jax.random.normal(ks[1], (pp, d_model, d_hidden)) * s,    # pp x tp sharded
+        "w2": jax.random.normal(ks[2], (pp, d_hidden, d_model)) * s,
+        "we1": jax.random.normal(ks[3], (pp, d_model, d_hidden)) * s,   # experts: one per tp shard
+        "we2": jax.random.normal(ks[4], (pp, d_hidden, d_model)) * s,
+        "out": jax.random.normal(ks[5], (d_model, vocab)) * se,         # replicated
+    }
+
+
+def demo_param_shardings(mesh: Mesh) -> Dict[str, NamedSharding]:
+    """NamedShardings to ``device_put`` the params with before training."""
+    return {
+        "embed": NamedSharding(mesh, P()),
+        "w1": NamedSharding(mesh, P("pp", None, "tp")),
+        "w2": NamedSharding(mesh, P("pp", "tp", None)),
+        "we1": NamedSharding(mesh, P("pp", None, "tp")),
+        "we2": NamedSharding(mesh, P("pp", "tp", None)),
+        "out": NamedSharding(mesh, P()),
+    }
+
+
+def _stage(stage_params: Dict[str, Array], x: Array, tp_axis: str) -> Array:
+    """One pipeline stage: tensor-parallel MLP + expert-parallel MoE block.
+
+    x: (mb, t, d_model) microbatch activations; stage_params hold the local
+    tp slice (hidden dim already divided by tp under shard_map).
+    """
+    # tensor-parallel MLP: hidden sharded over tp, psum the partial output
+    h = jax.nn.gelu(x @ stage_params["w1"])
+    x = x + lax.psum(h @ stage_params["w2"], tp_axis)
+
+    # expert-parallel MoE: each tp shard hosts ONE expert (its local we1/we2
+    # slice); static round-robin routing by token position keeps shapes fixed
+    ep = lax.axis_size(tp_axis)
+    mb, t, d = x.shape
+    groups = x.reshape(mb, ep, t // ep, d).transpose(1, 0, 2, 3)  # (ep, mb, t/ep, d)
+    dispatched = expert_all_to_all(groups, tp_axis)               # tokens for MY expert
+    eh = jax.nn.gelu(dispatched @ stage_params["we1"])
+    eo = eh @ stage_params["we2"]                                 # local expert output
+    combined = expert_all_to_all(eo, tp_axis)                     # route back
+    moe = combined.transpose(1, 0, 2, 3).reshape(mb, t, d)
+    return x + moe
+
+
+def _pipeline(stage_params: Dict[str, Array], inputs: Array, pp_axis: str, tp_axis: str) -> Array:
+    """GPipe over microbatches: inputs (M, mb, t, d) -> outputs (M, mb, t, d).
+
+    Rank 0 injects microbatch ``m`` at tick ``m``; rank ``p`` processes
+    microbatch ``m`` at tick ``m + p``; the last rank collects finished
+    microbatches. ``M + pp - 1`` ticks total (the pipeline bubble).
+    """
+    pp = lax.axis_size(pp_axis)
+    idx = lax.axis_index(pp_axis)
+    m_count = inputs.shape[0]
+    perm = [(i, (i + 1) % pp) for i in range(pp)]
+    # local stage axis has size 1 under shard_map; select my stage
+    my_stage = {k: v[0] for k, v in stage_params.items()}
+
+    def tick(carry, t):
+        act, outbuf = carry
+        recv = lax.ppermute(act, pp_axis, perm)
+        inj = lax.dynamic_index_in_dim(inputs, jnp.clip(t, 0, m_count - 1), 0, keepdims=False)
+        x = jnp.where(idx == 0, jnp.where(t < m_count, inj, jnp.zeros_like(inj)), recv)
+        y = _stage(my_stage, x, tp_axis)
+        m = t - (pp - 1)
+        write = (idx == pp - 1) & (m >= 0)
+        outbuf = jnp.where(
+            write,
+            lax.dynamic_update_index_in_dim(outbuf, y, jnp.clip(m, 0, m_count - 1), 0),
+            outbuf,
+        )
+        return (y, outbuf), None
+
+    act0 = jnp.zeros_like(inputs[0])
+    (_, outbuf), _ = lax.scan(tick, (act0, jnp.zeros_like(inputs)), jnp.arange(m_count + pp - 1))
+    # finished activations live on the last pp rank; replicate over the axis
+    return lax.psum(jnp.where(idx == pp - 1, outbuf, jnp.zeros_like(outbuf)), pp_axis)
+
+
+def make_demo_train_step(mesh: Mesh, *, microbatches: int = 2, lr: float = 0.1):
+    """Build the jitted train step ``(params, tokens, targets) -> (params, loss, logits)``.
+
+    tokens/targets: (B, T) int ids, globally shaped (GSPMD shards them over dp).
+    """
+
+    pipeline = _shard_map(
+        partial(_pipeline, pp_axis="pp", tp_axis="tp"),
+        mesh=mesh,
+        in_specs=(
+            {k: P("pp", None, "tp") if k in ("w1", "we1") else P("pp", "tp", None) for k in _STAGE_KEYS},
+            P(None, "dp", None, None),  # (M, mb, t, d): microbatches over dp
+        ),
+        out_specs=P(None, "dp", None, None),
+        # psum/where mix replicated + device-varying operands
+        **_SHARD_MAP_KW,
+    )
+
+    def loss_fn(params, tokens, targets):
+        x = params["embed"][tokens]  # (B, T, d) under GSPMD
+        b, t, d = x.shape
+        mb = b // microbatches
+        stages_in = x.reshape(microbatches, mb, t, d)
+        y = pipeline({k: params[k] for k in _STAGE_KEYS}, stages_in).reshape(b, t, d)
+        logits = y @ params["out"]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
+        return jnp.mean(nll), logits
+
+    @partial(jax.jit, donate_argnums=0)
+    def train_step(params, tokens, targets):
+        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, tokens, targets)
+        new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+        return new_params, loss, logits
+
+    return train_step
