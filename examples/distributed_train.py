@@ -1,0 +1,60 @@
+"""Metrics inside a fully sharded training step (pp x dp x tp, ep on tp).
+
+Runs on simulated devices:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/distributed_train.py
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from torchmetrics_tpu.classification import MulticlassAccuracy
+from torchmetrics_tpu.parallel import (
+    demo_param_shardings,
+    init_demo_params,
+    make_demo_train_step,
+)
+from torchmetrics_tpu.text.perplexity import Perplexity
+
+
+def main() -> None:
+    devs = jax.devices()
+    assert len(devs) >= 8, "run with XLA_FLAGS=--xla_force_host_platform_device_count=8"
+    mesh = Mesh(np.array(devs[:8]).reshape(2, 2, 2), ("pp", "dp", "tp"))
+
+    vocab, d_model, d_hidden = 32, 16, 32
+    params = init_demo_params(jax.random.PRNGKey(0), vocab, d_model, d_hidden, pp=2, tp=2)
+    sh = demo_param_shardings(mesh)
+    params = {k: jax.device_put(v, sh[k]) for k, v in params.items()}
+    step = make_demo_train_step(mesh, microbatches=2, lr=1.0)
+
+    rng = np.random.RandomState(0)
+    tokens = jax.device_put(jnp.asarray(rng.randint(0, vocab, (8, 8))), NamedSharding(mesh, P("dp", None)))
+    targets = jax.device_put(jnp.asarray(rng.randint(0, vocab, (8, 8))), NamedSharding(mesh, P("dp", None)))
+
+    acc, ppl = MulticlassAccuracy(num_classes=vocab, average="micro"), Perplexity()
+    acc_state, ppl_state = acc.init_state(), ppl.init_state()
+
+    @jax.jit
+    def metrics_update(acc_state, ppl_state, logits, targets):
+        a = acc.update_state(acc_state, logits.reshape(-1, vocab), targets.reshape(-1))
+        p = ppl.update_state(ppl_state, logits, targets)
+        return a, p
+
+    for epoch in range(5):
+        for _ in range(8):
+            params, loss, logits = step(params, tokens, targets)
+            acc_state, ppl_state = metrics_update(acc_state, ppl_state, logits, targets)
+        print(
+            f"epoch {epoch}: loss={float(loss):.3f} "
+            f"acc={float(acc.compute_state(acc_state)):.3f} "
+            f"ppl={float(ppl.compute_state(ppl_state)):.2f}"
+        )
+        acc_state, ppl_state = acc.init_state(), ppl.init_state()
+
+
+if __name__ == "__main__":
+    main()

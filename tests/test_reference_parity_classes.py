@@ -81,6 +81,116 @@ CLASS_CASES = [
 ]
 
 
+def _bin_batches(k=3):
+    out = []
+    for _ in range(k):
+        p = RNG.rand(N).astype(np.float32)
+        out.append((p, (RNG.rand(N) < p).astype(np.int64)))
+    return out
+
+
+def _ml_batches(k=3):
+    return [(RNG.rand(N, NC).astype(np.float32), RNG.randint(0, 2, (N, NC))) for _ in range(k)]
+
+
+def _img_batches(k=2):
+    return [
+        (RNG.rand(2, 3, 24, 24).astype(np.float32), RNG.rand(2, 3, 24, 24).astype(np.float32))
+        for _ in range(k)
+    ]
+
+
+def _audio_batches(k=2):
+    return [
+        (RNG.randn(2, 800).astype(np.float32), RNG.randn(2, 800).astype(np.float32))
+        for _ in range(k)
+    ]
+
+
+def _ppl_batches(k=2):
+    return [
+        (RNG.rand(2, 10, 12).astype(np.float32), RNG.randint(0, 12, (2, 10)))
+        for _ in range(k)
+    ]
+
+
+def _retr_batches(k=2):
+    out = []
+    for _ in range(k):
+        idx = np.sort(RNG.randint(0, 6, N))
+        out.append((RNG.rand(N).astype(np.float32), RNG.randint(0, 2, N), idx))
+    return out
+
+
+CLASS_CASES += [
+    # classification: binary + multilabel engines, confusion-matrix consumers
+    ("BinaryAccuracy", lambda: tm.classification.BinaryAccuracy(),
+     lambda: RT.classification.BinaryAccuracy(), _bin_batches, 1e-6),
+    ("BinaryAUROC", lambda: tm.classification.BinaryAUROC(),
+     lambda: RT.classification.BinaryAUROC(), _bin_batches, 1e-6),
+    ("BinaryAveragePrecision", lambda: tm.classification.BinaryAveragePrecision(),
+     lambda: RT.classification.BinaryAveragePrecision(), _bin_batches, 1e-6),
+    ("BinaryCalibrationError", lambda: tm.classification.BinaryCalibrationError(),
+     lambda: RT.classification.BinaryCalibrationError(), _bin_batches, 1e-6),
+    ("BinaryMatthewsCorrCoef", lambda: tm.classification.BinaryMatthewsCorrCoef(),
+     lambda: RT.classification.BinaryMatthewsCorrCoef(), _bin_batches, 1e-5),
+    ("BinaryCohenKappa", lambda: tm.classification.BinaryCohenKappa(),
+     lambda: RT.classification.BinaryCohenKappa(), _bin_batches, 1e-5),
+    ("MultilabelF1_macro", lambda: tm.classification.MultilabelF1Score(num_labels=NC, average="macro"),
+     lambda: RT.classification.MultilabelF1Score(num_labels=NC, average="macro"), _ml_batches, 1e-6),
+    ("MultilabelAUROC", lambda: tm.classification.MultilabelAUROC(num_labels=NC),
+     lambda: RT.classification.MultilabelAUROC(num_labels=NC), _ml_batches, 1e-6),
+    ("MultilabelRankingLoss", lambda: tm.classification.MultilabelRankingLoss(num_labels=NC),
+     lambda: RT.classification.MultilabelRankingLoss(num_labels=NC), _ml_batches, 1e-5),
+    ("MulticlassConfusionMatrix", lambda: tm.classification.MulticlassConfusionMatrix(num_classes=NC),
+     lambda: RT.classification.MulticlassConfusionMatrix(num_classes=NC), _cls_batches, 0),
+    ("MulticlassJaccardIndex", lambda: tm.classification.MulticlassJaccardIndex(num_classes=NC),
+     lambda: RT.classification.MulticlassJaccardIndex(num_classes=NC), _cls_batches, 1e-6),
+    ("MulticlassHingeLoss", lambda: tm.classification.MulticlassHingeLoss(num_classes=NC),
+     lambda: RT.classification.MulticlassHingeLoss(num_classes=NC), _cls_batches, 1e-5),
+    # regression tail
+    ("MeanAbsoluteError", lambda: tm.MeanAbsoluteError(), lambda: RT.MeanAbsoluteError(), _reg_batches, 1e-5),
+    ("MeanAbsolutePercentageError", lambda: tm.MeanAbsolutePercentageError(),
+     lambda: RT.MeanAbsolutePercentageError(), _reg_batches, 1e-4),
+    ("SymmetricMAPE", lambda: tm.SymmetricMeanAbsolutePercentageError(),
+     lambda: RT.SymmetricMeanAbsolutePercentageError(), _reg_batches, 1e-4),
+    ("WeightedMAPE", lambda: tm.WeightedMeanAbsolutePercentageError(),
+     lambda: RT.WeightedMeanAbsolutePercentageError(), _reg_batches, 1e-4),
+    ("LogCoshError", lambda: tm.LogCoshError(), lambda: RT.LogCoshError(), _reg_batches, 1e-5),
+    ("MinkowskiDistance", lambda: tm.MinkowskiDistance(p=3.0), lambda: RT.MinkowskiDistance(p=3.0),
+     _reg_batches, 1e-4),
+    ("RelativeSquaredError", lambda: tm.RelativeSquaredError(), lambda: RT.RelativeSquaredError(),
+     _reg_batches, 1e-4),
+    ("CriticalSuccessIndex", lambda: tm.regression.CriticalSuccessIndex(threshold=0.0),
+     lambda: RT.regression.CriticalSuccessIndex(threshold=0.0), _reg_batches, 1e-6),
+    ("TweedieDevianceScore", lambda: tm.TweedieDevianceScore(power=0.0),
+     lambda: RT.TweedieDevianceScore(power=0.0), _reg_batches, 1e-4),
+    # image
+    ("PSNR", lambda: tm.PeakSignalNoiseRatio(data_range=1.0),
+     lambda: RT.PeakSignalNoiseRatio(data_range=1.0), _img_batches, 1e-4),
+    ("SSIM", lambda: tm.StructuralSimilarityIndexMeasure(data_range=1.0),
+     lambda: RT.StructuralSimilarityIndexMeasure(data_range=1.0), _img_batches, 1e-4),
+    ("UQI", lambda: tm.UniversalImageQualityIndex(), lambda: RT.UniversalImageQualityIndex(),
+     _img_batches, 1e-4),
+    ("TotalVariation", lambda: tm.TotalVariation(), lambda: RT.TotalVariation(),
+     lambda: [(b[0],) for b in _img_batches()], 1e-2),
+    # audio
+    ("SignalNoiseRatio", lambda: tm.audio.SignalNoiseRatio(), lambda: RT.audio.SignalNoiseRatio(),
+     _audio_batches, 1e-4),
+    ("SISDR", lambda: tm.audio.ScaleInvariantSignalDistortionRatio(),
+     lambda: RT.audio.ScaleInvariantSignalDistortionRatio(), _audio_batches, 1e-4),
+    # text (tensor-input)
+    ("Perplexity", lambda: tm.text.Perplexity(), lambda: RT.text.Perplexity(), _ppl_batches, 1e-4),
+    # retrieval (grouped by query index)
+    ("RetrievalMRR", lambda: tm.retrieval.RetrievalMRR(), lambda: RT.retrieval.RetrievalMRR(),
+     _retr_batches, 1e-6),
+    ("RetrievalNormalizedDCG", lambda: tm.retrieval.RetrievalNormalizedDCG(),
+     lambda: RT.retrieval.RetrievalNormalizedDCG(), _retr_batches, 1e-6),
+    ("RetrievalMAP", lambda: tm.retrieval.RetrievalMAP(), lambda: RT.retrieval.RetrievalMAP(),
+     _retr_batches, 1e-6),
+]
+
+
 @pytest.mark.parametrize("name,ours_f,ref_f,batches_f,atol", CLASS_CASES, ids=[c[0] for c in CLASS_CASES])
 def test_class_parity_multibatch(name, ours_f, ref_f, batches_f, atol):
     a, b = _run_pair(ours_f(), ref_f(), batches_f())

@@ -66,9 +66,18 @@ def _pallas_call_cached(padded_bins: int, padded_n: int, interpret: bool, out_dt
 
     @jax.custom_batching.sequential_vmap
     def call(idx_p: Array, w_p: Array) -> Array:
+        try:  # under shard_map with vma checking, the output inherits the
+            vma = jax.typeof(idx_p).vma  # inputs' varying-axes set
+        except AttributeError:
+            vma = None
+        out_shape = (
+            jax.ShapeDtypeStruct((padded_bins,), out_dtype, vma=vma)
+            if vma is not None
+            else jax.ShapeDtypeStruct((padded_bins,), out_dtype)
+        )
         return pl.pallas_call(
             _kernel,
-            out_shape=jax.ShapeDtypeStruct((padded_bins,), out_dtype),
+            out_shape=out_shape,
             grid=(padded_bins // TILE_B, padded_n // TILE_N),
             in_specs=[
                 pl.BlockSpec((TILE_N,), lambda b, i: (i,)),
@@ -105,6 +114,12 @@ def _on_tpu() -> bool:
         return False
 
 
+def _scatter_bincount(idx: Array, w: Array, num_bins: int, dtype) -> Array:
+    valid = (idx >= 0) & (idx < num_bins)
+    safe = jnp.where(valid, idx, 0)
+    return jnp.zeros((num_bins,), dtype).at[safe].add(jnp.where(valid, w, jnp.zeros((), dtype)))
+
+
 def weighted_bincount(idx: Array, weights: Array = None, num_bins: int = 0,
                       force_pallas: bool = False, interpret: bool = False) -> Array:
     """``sum of weights per bin`` over int indices in [0, num_bins).
@@ -120,11 +135,18 @@ def weighted_bincount(idx: Array, weights: Array = None, num_bins: int = 0,
     unweighted = weights is None
     dtype = jnp.int32 if unweighted else jnp.float32
     w = jnp.ones(idx.shape, dtype) if unweighted else weights.reshape(-1).astype(jnp.float32)
+    if force_pallas:
+        return _bincount_pallas(idx, w, num_bins, interpret=interpret or not _on_tpu(), out_dtype=dtype)
     # the compare-reduce kernel does O(N * num_bins) VPU work — a win over
     # the serialized scatter only while all bins fit one TILE_B block (one
-    # vectorized pass per element); beyond that XLA's scatter is preferred
-    if force_pallas or (_on_tpu() and num_bins <= TILE_B):
-        return _bincount_pallas(idx, w, num_bins, interpret=interpret or not _on_tpu(), out_dtype=dtype)
-    valid = (idx >= 0) & (idx < num_bins)
-    safe = jnp.where(valid, idx, 0)
-    return jnp.zeros((num_bins,), dtype).at[safe].add(jnp.where(valid, w, jnp.zeros((), dtype)))
+    # vectorized pass per element); beyond that XLA's scatter is preferred.
+    # platform_dependent picks the branch at LOWERING time, so a program
+    # jitted onto CPU devices takes the scatter path even when the process
+    # default backend is TPU (mixed-backend dryruns/tests).
+    if num_bins <= TILE_B:
+        return jax.lax.platform_dependent(
+            idx, w,
+            tpu=lambda i, ww: _bincount_pallas(i, ww, num_bins, interpret=False, out_dtype=dtype),
+            default=lambda i, ww: _scatter_bincount(i, ww, num_bins, dtype),
+        )
+    return _scatter_bincount(idx, w, num_bins, dtype)

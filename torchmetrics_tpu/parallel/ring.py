@@ -89,19 +89,20 @@ def ring_attention(
     o0 = jnp.zeros_like(q, dtype=jnp.float32)
 
     # fold the local block, then n-1 (rotate, fold) rounds — the last KV
-    # exchange of a rotate-every-step loop would be computed and discarded
+    # exchange of a rotate-every-step loop would be computed and discarded.
+    # The block's source shard is arithmetic (after j hops I hold the block
+    # of shard my_idx - j), so only K and V ride the ring.
     stats = block_update((m0, l0, o0), k, v, my_idx)
 
-    def step(carry, _):
-        k_blk, v_blk, src, stats = carry
+    def step(carry, j):
+        k_blk, v_blk, stats = carry
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
-        src = lax.ppermute(src, axis_name, perm)
-        stats = block_update(stats, k_blk, v_blk, src)
-        return (k_blk, v_blk, src, stats), None
+        stats = block_update(stats, k_blk, v_blk, (my_idx - j) % n)
+        return (k_blk, v_blk, stats), None
 
     if n > 1:
-        (_, _, _, stats), _ = lax.scan(step, (k, v, my_idx, stats), None, length=n - 1)
+        (_, _, stats), _ = lax.scan(step, (k, v, stats), jnp.arange(1, n))
     _, l_f, o_f = stats
     return (o_f / jnp.maximum(l_f, 1e-30)[..., None]).astype(in_dtype)
 
