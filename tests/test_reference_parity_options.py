@@ -1,0 +1,216 @@
+"""Option-surface parity: the hard argument paths the main sweeps don't hit.
+
+The reference's test matrix parametrizes heavily over ``top_k``,
+``ignore_index``, ``multidim_average``, curve modes, calibration norms, and
+kernel options (SURVEY.md §4). This module pins those combinations against
+the reference on identical inputs.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "helpers"))
+from lightning_utilities_stub import install_stub  # noqa: E402
+
+install_stub()
+sys.path.insert(0, "/root/reference/src")
+torch = pytest.importorskip("torch")
+
+import torchmetrics.functional as RF  # noqa: E402
+import torchmetrics.functional.classification as RFC  # noqa: E402
+import torchmetrics.functional.retrieval as RFR  # noqa: E402
+import torchmetrics.functional.text as RFT  # noqa: E402
+
+import torchmetrics_tpu.functional as F  # noqa: E402
+
+RNG = np.random.RandomState(0)
+N, C = 64, 5
+P_MC = RNG.rand(N, C).astype(np.float32)
+P_MC /= P_MC.sum(-1, keepdims=True)
+T_MC = RNG.randint(0, C, N)
+T_IG = T_MC.copy()
+T_IG[::7] = -1
+P3 = RNG.rand(4, C, 8).astype(np.float32)
+T3 = RNG.randint(0, C, (4, 8))
+P_BIN = RNG.rand(N).astype(np.float32)
+T_BIN = (RNG.rand(N) < P_BIN).astype(np.int64)
+
+
+def _chk(ours, ref, atol=1e-5):
+    o = np.asarray(ours)
+    r = ref.numpy() if hasattr(ref, "numpy") else np.asarray(ref)
+    np.testing.assert_allclose(o, r, atol=atol, equal_nan=True)
+
+
+@pytest.mark.parametrize("top_k", [2, 3])
+def test_topk_accuracy(top_k):
+    _chk(
+        F.classification.multiclass_accuracy(
+            jnp.asarray(P_MC), jnp.asarray(T_MC), num_classes=C, top_k=top_k, average="micro"
+        ),
+        RFC.multiclass_accuracy(torch.tensor(P_MC), torch.tensor(T_MC), num_classes=C, top_k=top_k, average="micro"),
+    )
+
+
+def test_ignore_index_and_combined_options():
+    _chk(
+        F.classification.multiclass_accuracy(
+            jnp.asarray(P_MC), jnp.asarray(T_IG), num_classes=C, ignore_index=-1, average="macro"
+        ),
+        RFC.multiclass_accuracy(torch.tensor(P_MC), torch.tensor(T_IG), num_classes=C, ignore_index=-1, average="macro"),
+    )
+    _chk(
+        F.classification.multiclass_precision(
+            jnp.asarray(P_MC), jnp.asarray(T_IG), num_classes=C, top_k=2, average="weighted", ignore_index=-1
+        ),
+        RFC.multiclass_precision(
+            torch.tensor(P_MC), torch.tensor(T_IG), num_classes=C, top_k=2, average="weighted", ignore_index=-1
+        ),
+    )
+
+
+def test_multidim_samplewise():
+    _chk(
+        F.classification.multiclass_stat_scores(
+            jnp.asarray(P3), jnp.asarray(T3), num_classes=C, multidim_average="samplewise", average=None
+        ),
+        RFC.multiclass_stat_scores(
+            torch.tensor(P3), torch.tensor(T3), num_classes=C, multidim_average="samplewise", average=None
+        ),
+        atol=0,
+    )
+    _chk(
+        F.classification.multiclass_f1_score(
+            jnp.asarray(P3), jnp.asarray(T3), num_classes=C, multidim_average="samplewise", average="macro"
+        ),
+        RFC.multiclass_f1_score(
+            torch.tensor(P3), torch.tensor(T3), num_classes=C, multidim_average="samplewise", average="macro"
+        ),
+    )
+    pb = RNG.rand(4, 16).astype(np.float32)
+    tb = RNG.randint(0, 2, (4, 16))
+    _chk(
+        F.classification.binary_stat_scores(jnp.asarray(pb), jnp.asarray(tb), multidim_average="samplewise"),
+        RFC.binary_stat_scores(torch.tensor(pb), torch.tensor(tb), multidim_average="samplewise"),
+        atol=0,
+    )
+
+
+def test_multilabel_ignore_index():
+    pl = RNG.rand(N, 4).astype(np.float32)
+    tl = RNG.randint(0, 2, (N, 4))
+    tl[::5] = -1
+    _chk(
+        F.classification.multilabel_f1_score(
+            jnp.asarray(pl), jnp.asarray(tl), num_labels=4, ignore_index=-1, average="macro"
+        ),
+        RFC.multilabel_f1_score(torch.tensor(pl), torch.tensor(tl), num_labels=4, ignore_index=-1, average="macro"),
+    )
+
+
+def test_binary_logit_autodetect():
+    logits = RNG.randn(N).astype(np.float32) * 3
+    _chk(
+        F.classification.binary_accuracy(jnp.asarray(logits), jnp.asarray(T_MC % 2)),
+        RFC.binary_accuracy(torch.tensor(logits), torch.tensor(T_MC % 2)),
+    )
+
+
+def test_auroc_max_fpr():
+    _chk(
+        F.classification.binary_auroc(jnp.asarray(P_BIN), jnp.asarray(T_BIN), max_fpr=0.3),
+        RFC.binary_auroc(torch.tensor(P_BIN), torch.tensor(T_BIN), max_fpr=0.3),
+    )
+
+
+def test_curve_exact_and_binned():
+    o = F.precision_recall_curve(jnp.asarray(P_BIN), jnp.asarray(T_BIN), task="binary")
+    r = RF.precision_recall_curve(torch.tensor(P_BIN), torch.tensor(T_BIN), task="binary")
+    for a, b in zip(o, r):
+        _chk(a, b)
+    o = F.classification.binary_precision_recall_curve(jnp.asarray(P_BIN), jnp.asarray(T_BIN), thresholds=20)
+    r = RFC.binary_precision_recall_curve(torch.tensor(P_BIN), torch.tensor(T_BIN), thresholds=20)
+    for a, b in zip(o, r):
+        _chk(a, b)
+
+
+@pytest.mark.parametrize("norm", ["l1", "l2", "max"])
+def test_calibration_norms(norm):
+    _chk(
+        F.calibration_error(jnp.asarray(P_BIN), jnp.asarray(T_BIN), task="binary", norm=norm),
+        RF.calibration_error(torch.tensor(P_BIN), torch.tensor(T_BIN), task="binary", norm=norm),
+    )
+
+
+def test_kl_log_prob():
+    p2 = RNG.rand(8, 5).astype(np.float32)
+    p2 /= p2.sum(-1, keepdims=True)
+    q2 = RNG.rand(8, 5).astype(np.float32)
+    q2 /= q2.sum(-1, keepdims=True)
+    _chk(
+        F.kl_divergence(jnp.asarray(np.log(p2)), jnp.asarray(np.log(q2)), log_prob=True),
+        RF.kl_divergence(torch.tensor(np.log(p2)), torch.tensor(np.log(q2)), log_prob=True),
+    )
+
+
+def test_ssim_uniform_kernel_and_msssim():
+    im1 = RNG.rand(2, 3, 32, 32).astype(np.float32)
+    im2 = RNG.rand(2, 3, 32, 32).astype(np.float32)
+    _chk(
+        F.structural_similarity_index_measure(
+            jnp.asarray(im1), jnp.asarray(im2), gaussian_kernel=False, kernel_size=7, data_range=1.0
+        ),
+        RF.structural_similarity_index_measure(
+            torch.tensor(im1), torch.tensor(im2), gaussian_kernel=False, kernel_size=7, data_range=1.0
+        ),
+        atol=1e-4,
+    )
+    a = RNG.rand(2, 3, 180, 180).astype(np.float32)
+    b = RNG.rand(2, 3, 180, 180).astype(np.float32)
+    _chk(
+        F.multiscale_structural_similarity_index_measure(jnp.asarray(a), jnp.asarray(b), data_range=1.0),
+        RF.multiscale_structural_similarity_index_measure(torch.tensor(a), torch.tensor(b), data_range=1.0),
+        atol=1e-4,
+    )
+
+
+def test_retrieval_top_k():
+    pr = RNG.rand(10).astype(np.float32)
+    tr = RNG.randint(0, 2, 10)
+    _chk(
+        F.retrieval_precision(jnp.asarray(pr), jnp.asarray(tr), top_k=3),
+        RFR.retrieval_precision(torch.tensor(pr), torch.tensor(tr), top_k=3),
+    )
+    _chk(
+        F.retrieval_normalized_dcg(jnp.asarray(pr), jnp.asarray(tr), top_k=5),
+        RFR.retrieval_normalized_dcg(torch.tensor(pr), torch.tensor(tr), top_k=5),
+    )
+
+
+def test_text_options():
+    preds = ["the cat is on the mat", "a quick brown fox"]
+    tgts = [["there is a cat on the mat"], ["the quick brown fox jumps"]]
+    _chk(F.bleu_score(preds, tgts, n_gram=2, smooth=True), RFT.bleu_score(preds, tgts, n_gram=2, smooth=True))
+    _chk(F.chrf_score(preds, tgts), RFT.chrf_score(preds, tgts))
+    _chk(F.translation_edit_rate(preds, tgts), RFT.translation_edit_rate(preds, tgts))
+
+
+def test_out_of_range_target_drops_pair():
+    """Targets outside [0, C) drop the whole pair (historical bincount
+    semantics; both implementations' eager validation rejects such inputs,
+    but under jit / ``validate_args=False`` they must not corrupt counters).
+    The result must equal feeding only the in-range pairs."""
+    preds = np.array([0, 1, 2, 3, 0], np.int64)
+    target = np.array([0, 1, C, 3, C + 2], np.int64)  # two OOB entries
+    ours = F.classification.multiclass_stat_scores(
+        jnp.asarray(preds), jnp.asarray(target), num_classes=C, average=None, validate_args=False
+    )
+    in_range = target < C
+    expected = F.classification.multiclass_stat_scores(
+        jnp.asarray(preds[in_range]), jnp.asarray(target[in_range]), num_classes=C, average=None
+    )
+    _chk(ours, expected, atol=0)

@@ -53,18 +53,29 @@ def _kernel(idx_ref, w_ref, out_ref):
 
 
 @functools.lru_cache(maxsize=None)
-def _pallas_call_cached(padded_bins: int, padded_n: int, interpret: bool, out_dtype_name: str):
+def _pallas_call_cached(padded_bins: int, padded_n: int, interpret: bool, out_dtype_name: str,
+                        batch_rule: str = "scatter"):
     """Build the pallas_call for a (padded_bins, padded_n) problem size.
 
-    Wrapped in ``sequential_vmap`` so ``vmap`` (e.g. the epoch-fused
-    ``update_state_batched`` path) lowers to an in-graph ``lax.map`` over the
-    kernel instead of producing an un-tileable (1, TILE) block shape.
+    Under ``vmap`` the kernel's 1D block shape would become an un-tileable
+    (1, TILE), so a batching rule is attached. ``batch_rule="scatter"``
+    (production) switches the whole batch to the scatter path, which vmaps
+    natively and runs in parallel; ``"sequential"`` (``force_pallas`` tests)
+    lowers to an in-graph ``lax.map`` over the kernel so vmapped tests still
+    exercise the kernel itself.
     """
     import jax.experimental.pallas as pl
 
     out_dtype = jnp.dtype(out_dtype_name)
 
-    @jax.custom_batching.sequential_vmap
+    if batch_rule == "sequential":
+        def make(f):
+            return jax.custom_batching.sequential_vmap(f)
+    else:
+        def make(f):
+            return jax.custom_batching.custom_vmap(f)
+
+    @make
     def call(idx_p: Array, w_p: Array) -> Array:
         try:  # under shard_map with vma checking, the output inherits the
             vma = jax.typeof(idx_p).vma  # inputs' varying-axes set
@@ -87,12 +98,24 @@ def _pallas_call_cached(padded_bins: int, padded_n: int, interpret: bool, out_dt
             interpret=interpret,
         )(idx_p, w_p)
 
+    if batch_rule != "sequential":
+
+        @call.def_vmap
+        def _batched(axis_size, in_batched, idx_b, w_b):
+            idx_bat, w_bat = in_batched
+            if not idx_bat:
+                idx_b = jnp.broadcast_to(idx_b, (axis_size,) + idx_b.shape)
+            if not w_bat:
+                w_b = jnp.broadcast_to(w_b, (axis_size,) + w_b.shape)
+            out = jax.vmap(lambda i, ww: _scatter_bincount(i, ww, padded_bins, out_dtype))(idx_b, w_b)
+            return out, True
+
     return call
 
 
-@functools.partial(jax.jit, static_argnames=("num_bins", "interpret", "out_dtype"))
+@functools.partial(jax.jit, static_argnames=("num_bins", "interpret", "out_dtype", "batch_rule"))
 def _bincount_pallas(idx: Array, weights: Array, num_bins: int, interpret: bool = False,
-                     out_dtype=jnp.float32) -> Array:
+                     out_dtype=jnp.float32, batch_rule: str = "scatter") -> Array:
     n = idx.shape[0]
     if n == 0:  # zero-length grid would skip the output zero-init
         return jnp.zeros((num_bins,), out_dtype)
@@ -103,7 +126,7 @@ def _bincount_pallas(idx: Array, weights: Array, num_bins: int, interpret: bool 
     w_p = jnp.concatenate([weights, jnp.zeros((n_pad,), weights.dtype)])
     padded_bins = num_bins + b_pad
 
-    call = _pallas_call_cached(padded_bins, n + n_pad, bool(interpret), jnp.dtype(out_dtype).name)
+    call = _pallas_call_cached(padded_bins, n + n_pad, bool(interpret), jnp.dtype(out_dtype).name, batch_rule)
     return call(idx_p, w_p)[:num_bins]
 
 
@@ -136,7 +159,9 @@ def weighted_bincount(idx: Array, weights: Array = None, num_bins: int = 0,
     dtype = jnp.int32 if unweighted else jnp.float32
     w = jnp.ones(idx.shape, dtype) if unweighted else weights.reshape(-1).astype(jnp.float32)
     if force_pallas:
-        return _bincount_pallas(idx, w, num_bins, interpret=interpret or not _on_tpu(), out_dtype=dtype)
+        # sequential batching rule so vmapped tests exercise the kernel
+        return _bincount_pallas(idx, w, num_bins, interpret=interpret or not _on_tpu(),
+                                out_dtype=dtype, batch_rule="sequential")
     # the compare-reduce kernel does O(N * num_bins) VPU work — a win over
     # the serialized scatter only while all bins fit one TILE_B block (one
     # vectorized pass per element); beyond that XLA's scatter is preferred.
