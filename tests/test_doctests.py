@@ -5,15 +5,35 @@ import doctest
 import pytest
 
 import torchmetrics_tpu.aggregation
+import torchmetrics_tpu.audio.metrics
 import torchmetrics_tpu.classification.accuracy
+import torchmetrics_tpu.classification.auroc
+import torchmetrics_tpu.classification.confusion_matrix
+import torchmetrics_tpu.classification.f_beta
 import torchmetrics_tpu.collections
+import torchmetrics_tpu.image.psnr
+import torchmetrics_tpu.nominal.metrics
 import torchmetrics_tpu.regression.mse
+import torchmetrics_tpu.regression.pearson
+import torchmetrics_tpu.retrieval.metrics
+import torchmetrics_tpu.text.perplexity
+import torchmetrics_tpu.wrappers.tracker
 
 MODULES = [
     torchmetrics_tpu.aggregation,
+    torchmetrics_tpu.audio.metrics,
     torchmetrics_tpu.classification.accuracy,
+    torchmetrics_tpu.classification.auroc,
+    torchmetrics_tpu.classification.confusion_matrix,
+    torchmetrics_tpu.classification.f_beta,
     torchmetrics_tpu.collections,
+    torchmetrics_tpu.image.psnr,
+    torchmetrics_tpu.nominal.metrics,
     torchmetrics_tpu.regression.mse,
+    torchmetrics_tpu.regression.pearson,
+    torchmetrics_tpu.retrieval.metrics,
+    torchmetrics_tpu.text.perplexity,
+    torchmetrics_tpu.wrappers.tracker,
 ]
 
 

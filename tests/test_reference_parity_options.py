@@ -214,3 +214,90 @@ def test_out_of_range_target_drops_pair():
         jnp.asarray(preds[in_range]), jnp.asarray(target[in_range]), num_classes=C, average=None
     )
     _chk(ours, expected, atol=0)
+
+
+def test_audio_sdr_options():
+    import torchmetrics.functional.audio as RFA
+
+    import torchmetrics_tpu.functional.audio as FA
+
+    rng = np.random.RandomState(3)
+    p = rng.randn(2, 2000).astype(np.float32)
+    t = rng.randn(2, 2000).astype(np.float32)
+    _chk(
+        FA.signal_distortion_ratio(jnp.asarray(p), jnp.asarray(t)),
+        RFA.signal_distortion_ratio(torch.tensor(p), torch.tensor(t)),
+        atol=1e-3,
+    )
+    _chk(
+        FA.signal_distortion_ratio(jnp.asarray(p), jnp.asarray(t), zero_mean=True, load_diag=1e-5),
+        RFA.signal_distortion_ratio(torch.tensor(p), torch.tensor(t), zero_mean=True, load_diag=1e-5),
+        atol=1e-3,
+    )
+    _chk(
+        FA.source_aggregated_signal_distortion_ratio(jnp.asarray(p)[None], jnp.asarray(t)[None]),
+        RFA.source_aggregated_signal_distortion_ratio(torch.tensor(p)[None], torch.tensor(t)[None]),
+        atol=1e-4,
+    )
+
+
+@pytest.mark.parametrize("method", ["arithmetic", "max", "min", "geometric"])
+def test_clustering_ami_average_methods(method):
+    import torchmetrics.functional.clustering as RFCL
+
+    import torchmetrics_tpu.functional.clustering as FCL
+
+    rng = np.random.RandomState(3)
+    a = rng.randint(0, 4, 80)
+    b = rng.randint(0, 4, 80)
+    _chk(
+        FCL.adjusted_mutual_info_score(jnp.asarray(a), jnp.asarray(b), average_method=method),
+        RFCL.adjusted_mutual_info_score(torch.tensor(a), torch.tensor(b), average_method=method),
+    )
+
+
+def test_clustering_intrinsic_and_vmeasure_beta():
+    import torchmetrics.functional.clustering as RFCL
+
+    import torchmetrics_tpu.functional.clustering as FCL
+
+    rng = np.random.RandomState(3)
+    a = rng.randint(0, 4, 80)
+    b = rng.randint(0, 4, 80)
+    _chk(
+        FCL.v_measure_score(jnp.asarray(a), jnp.asarray(b), beta=0.5),
+        RFCL.v_measure_score(torch.tensor(a), torch.tensor(b), beta=0.5),
+    )
+    x = rng.randn(60, 3).astype(np.float32)
+    lab = rng.randint(0, 3, 60)
+    _chk(
+        FCL.calinski_harabasz_score(jnp.asarray(x), jnp.asarray(lab)),
+        RFCL.calinski_harabasz_score(torch.tensor(x), torch.tensor(lab)),
+        atol=1e-3,
+    )
+    _chk(
+        FCL.davies_bouldin_score(jnp.asarray(x), jnp.asarray(lab)),
+        RFCL.davies_bouldin_score(torch.tensor(x), torch.tensor(lab)),
+        atol=1e-4,
+    )
+
+
+def test_stat_scores_scatter_fallback_branch(monkeypatch):
+    """Shrinking the one-hot gate must not change results (both global
+    branches share the OOB-drop and counter semantics)."""
+    import importlib
+
+    # attribute access resolves to the re-exported stat_scores *function*;
+    # fetch the module itself
+    SS = importlib.import_module("torchmetrics_tpu.functional.classification.stat_scores")
+
+    preds = np.array([0, 1, 2, 3, 0], np.int64)
+    target = np.array([0, 1, C, 3, C + 2], np.int64)
+    expected = F.classification.multiclass_stat_scores(
+        jnp.asarray(preds), jnp.asarray(target), num_classes=C, average=None, validate_args=False
+    )
+    monkeypatch.setattr(SS, "_ONEHOT_MATMUL_MAX_ELEMENTS", 0)
+    fallback = F.classification.multiclass_stat_scores(
+        jnp.asarray(preds), jnp.asarray(target), num_classes=C, average=None, validate_args=False
+    )
+    _chk(fallback, expected, atol=0)

@@ -51,7 +51,16 @@ class _MeanAudioMetric(Metric):
 
 
 class SignalNoiseRatio(_MeanAudioMetric):
-    """Parity: reference ``audio/snr.py:SignalNoiseRatio``."""
+    """Parity: reference ``audio/snr.py:SignalNoiseRatio``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.audio import SignalNoiseRatio
+        >>> metric = SignalNoiseRatio()
+        >>> metric.update(jnp.asarray([3.0, -0.5, 2.0, 7.0]), jnp.asarray([3.0, -0.5, 2.0, 8.0]))
+        >>> print(f"{float(metric.compute()):.4f}")
+        18.8790
+    """
 
     is_differentiable = True
     higher_is_better = True

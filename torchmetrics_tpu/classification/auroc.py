@@ -48,7 +48,18 @@ class BinaryAUROC(BinaryPrecisionRecallCurve):
 
 
 class MulticlassAUROC(MulticlassPrecisionRecallCurve):
-    """Parity: reference ``classification/auroc.py:146``."""
+    """Parity: reference ``classification/auroc.py:146``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MulticlassAUROC
+        >>> metric = MulticlassAUROC(num_classes=3, thresholds=None)
+        >>> metric.update(jnp.asarray([[0.8, 0.1, 0.1], [0.2, 0.7, 0.1],
+        ...                            [0.3, 0.3, 0.4], [0.1, 0.2, 0.7]]),
+        ...               jnp.asarray([0, 1, 2, 2]))
+        >>> print(f"{float(metric.compute()):.4f}")
+        1.0000
+    """
 
     is_differentiable = False
     higher_is_better = True

@@ -52,6 +52,16 @@ class BinaryConfusionMatrix(Metric):
 
 
 class MulticlassConfusionMatrix(Metric):
+    """Confusion matrix for multiclass tasks. Parity: reference ``classification/confusion_matrix.py``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+        >>> metric = MulticlassConfusionMatrix(num_classes=3)
+        >>> metric.update(jnp.asarray([0, 1, 2, 2]), jnp.asarray([0, 1, 1, 2]))
+        >>> metric.compute().tolist()
+        [[1, 0, 0], [0, 1, 1], [0, 0, 1]]
+    """
     is_differentiable = False
     higher_is_better = None
     full_state_update = False

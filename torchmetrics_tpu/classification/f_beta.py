@@ -84,6 +84,16 @@ class MultilabelFBetaScore(MultilabelStatScores):
 
 
 class BinaryF1Score(BinaryFBetaScore):
+    """F1 score for binary classification. Parity: reference ``classification/f_beta.py``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryF1Score
+        >>> metric = BinaryF1Score()
+        >>> metric.update(jnp.asarray([0.2, 0.8, 0.6, 0.3]), jnp.asarray([0, 1, 1, 0]))
+        >>> print(f"{float(metric.compute()):.4f}")
+        1.0000
+    """
     def __init__(self, threshold: float = 0.5, multidim_average: str = "global",
                  ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
         super().__init__(1.0, threshold, multidim_average, ignore_index, validate_args, **kwargs)

@@ -29,6 +29,11 @@ from ...utils.data import _bincount, select_topk, to_onehot
 
 Array = jax.Array
 
+# one-hot footprint gate for the MXU stat-scores path (elements per one-hot;
+# ~128 MiB bf16 each); module-level so tests can shrink it to exercise the
+# scatter-histogram fallback branch
+_ONEHOT_MATMUL_MAX_ELEMENTS = 64 * 1024 * 1024
+
 
 # ---------------------------------------------------------------------------
 # shared validation helpers (host-side; skipped while tracing)
@@ -284,7 +289,7 @@ def _multiclass_stat_scores_update(
         # histograms at C=100 on v5e); 0/1 weights are exact in bf16 with
         # f32 accumulation. Gated by the O(n*C) one-hot footprint (~128 MiB
         # bf16), beyond which the O(n) scatter histograms win on memory.
-        if tgt.shape[0] * num_classes <= 64 * 1024 * 1024:
+        if tgt.shape[0] * num_classes <= _ONEHOT_MATMUL_MAX_ELEMENTS:
             oh_t = jax.nn.one_hot(tgt, num_classes, dtype=jnp.bfloat16)
             oh_p = jax.nn.one_hot(prd, num_classes, dtype=jnp.bfloat16)
             lhs_t = jnp.stack([correct, wf]).astype(jnp.bfloat16)  # (2, n)

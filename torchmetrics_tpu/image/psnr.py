@@ -12,6 +12,17 @@ Array = jax.Array
 
 
 class PeakSignalNoiseRatio(Metric):
+    """Peak signal-to-noise ratio. Parity: reference ``image/psnr.py``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.image import PeakSignalNoiseRatio
+        >>> metric = PeakSignalNoiseRatio(data_range=1.0)
+        >>> pred = jnp.clip(jnp.linspace(0, 1, 48).reshape(1, 3, 4, 4), 0, 1)
+        >>> metric.update(pred, jnp.clip(pred + 0.1, 0, 1))
+        >>> print(f"{float(metric.compute()):.4f}")
+        20.3427
+    """
     is_differentiable = True
     higher_is_better = True
     full_state_update = False

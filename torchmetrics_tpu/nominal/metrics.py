@@ -62,7 +62,16 @@ class _ConfmatNominalMetric(Metric):
 
 
 class CramersV(_ConfmatNominalMetric):
-    """Parity: reference ``nominal/cramers.py:30``."""
+    """Parity: reference ``nominal/cramers.py:30``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.nominal import CramersV
+        >>> metric = CramersV(num_classes=3)
+        >>> metric.update(jnp.asarray([0, 1, 2, 0, 1, 2]), jnp.asarray([0, 1, 2, 0, 2, 1]))
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.4082
+    """
 
     def __init__(self, num_classes: int, bias_correction: bool = True,
                  nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0,

@@ -22,6 +22,17 @@ Array = jax.Array
 
 
 class PearsonCorrCoef(Metric):
+    """Pearson correlation with device-mergeable running moments.
+    Parity: reference ``regression/pearson.py:73`` (moment merge ``:28``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import PearsonCorrCoef
+        >>> metric = PearsonCorrCoef()
+        >>> metric.update(jnp.asarray([1.0, 2.0, 3.0, 4.0]), jnp.asarray([1.1, 2.1, 2.9, 4.2]))
+        >>> print(f"{float(metric.compute()):.4f}")
+        0.9954
+    """
     is_differentiable = True
     higher_is_better = None
     full_state_update = True

@@ -48,7 +48,17 @@ class RetrievalMAP(RetrievalMetric):
 
 
 class RetrievalMRR(RetrievalMetric):
-    """Mean Reciprocal Rank. Parity: reference ``retrieval/reciprocal_rank.py:28``."""
+    """Mean Reciprocal Rank. Parity: reference ``retrieval/reciprocal_rank.py:28``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.retrieval import RetrievalMRR
+        >>> metric = RetrievalMRR()
+        >>> metric.update(jnp.asarray([0.2, 0.6, 0.3, 0.9]), jnp.asarray([0, 1, 0, 1]),
+        ...               indexes=jnp.asarray([0, 0, 1, 1]))
+        >>> print(f"{float(metric.compute()):.4f}")
+        1.0000
+    """
 
     plot_lower_bound = 0.0
     plot_upper_bound = 1.0

@@ -14,6 +14,18 @@ Array = jax.Array
 
 
 class Perplexity(Metric):
+    """Perplexity over token logits (sequence-shardable: sums reduce over the
+    sequence axis like a data axis). Parity: reference ``text/perplexity.py``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.text import Perplexity
+        >>> metric = Perplexity()
+        >>> logits = jnp.log(jnp.asarray([[[0.7, 0.2, 0.1], [0.2, 0.7, 0.1]]]))
+        >>> metric.update(logits, jnp.asarray([[0, 1]]))
+        >>> print(f"{float(metric.compute()):.4f}")
+        1.4286
+    """
     is_differentiable = True
     higher_is_better = False
     full_state_update = False

@@ -19,6 +19,21 @@ Array = jax.Array
 
 
 class MetricTracker(WrapperMetric):
+    """Tracks a metric (or collection) over increments/epochs.
+    Parity: reference ``wrappers/tracker.py:31`` (``best_metric`` ``:186``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.aggregation import MeanMetric
+        >>> from torchmetrics_tpu.wrappers import MetricTracker
+        >>> tracker = MetricTracker(MeanMetric())
+        >>> for epoch in range(2):
+        ...     tracker.increment()
+        ...     tracker.update(jnp.asarray(float(epoch + 1)))
+        >>> best, step = tracker.best_metric(return_step=True)
+        >>> print(f"{float(best):.1f}", step)
+        2.0 1
+    """
     def __init__(self, metric: Union[Metric, MetricCollection], maximize: Union[bool, List[bool], None] = True,
                  **kwargs: Any) -> None:
         super().__init__(**kwargs)
