@@ -1,0 +1,138 @@
+"""Seeded randomized parity sweep: degenerate and adversarial inputs.
+
+Deterministic "fuzz" against the reference on the input classes that break
+naive implementations: all-tied scores, one-hot-saturated probabilities,
+raw logits, targets missing a class entirely. This suite caught the
+average-precision empty-class semantics divergence (exact mode excludes
+nan classes from macro/weighted averages; binned mode includes them as 0 —
+reference ``functional/classification/average_precision.py:56-66`` vs its
+``_safe_divide`` binned recall).
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "helpers"))
+from lightning_utilities_stub import install_stub  # noqa: E402
+
+install_stub()
+sys.path.insert(0, "/root/reference/src")
+torch = pytest.importorskip("torch")
+
+import torchmetrics.functional.classification as RFC  # noqa: E402
+
+import torchmetrics_tpu.functional.classification as FC  # noqa: E402
+
+
+def _case(trial):
+    rng = np.random.RandomState(1000 + trial)
+    n = int(rng.randint(4, 40))
+    c = int(rng.randint(2, 7))
+    kind = trial % 4
+    if kind == 0:  # all-tied scores
+        p = np.full((n, c), 1.0 / c, np.float32)
+    elif kind == 1:  # saturated one-hot probs
+        p = np.eye(c, dtype=np.float32)[rng.randint(0, c, n)]
+    elif kind == 2:  # raw logits
+        p = (rng.randn(n, c) * 5).astype(np.float32)
+    else:  # a class absent from target
+        p = rng.rand(n, c).astype(np.float32)
+        p /= p.sum(-1, keepdims=True)
+    t = rng.randint(0, max(1, c - (1 if kind == 3 else 0)), n)
+    return p, t, c
+
+
+@pytest.mark.parametrize("trial", range(12))
+def test_fuzz_classification_families(trial):
+    p, t, c = _case(trial)
+    jp, jt = jnp.asarray(p), jnp.asarray(t)
+    tp, tt = torch.tensor(p), torch.tensor(t)
+    for avg in ("micro", "macro", "weighted", "none"):
+        np.testing.assert_allclose(
+            np.asarray(FC.multiclass_accuracy(jp, jt, num_classes=c, average=avg)),
+            RFC.multiclass_accuracy(tp, tt, num_classes=c, average=avg).numpy(),
+            atol=1e-5, equal_nan=True, err_msg=f"accuracy {avg}",
+        )
+    np.testing.assert_allclose(
+        np.asarray(FC.multiclass_f1_score(jp, jt, num_classes=c, average="macro")),
+        RFC.multiclass_f1_score(tp, tt, num_classes=c, average="macro").numpy(),
+        atol=1e-5, equal_nan=True, err_msg="f1 macro",
+    )
+    np.testing.assert_allclose(
+        np.asarray(FC.multiclass_auroc(jp, jt, num_classes=c)),
+        RFC.multiclass_auroc(tp, tt, num_classes=c).numpy(),
+        atol=1e-4, equal_nan=True, err_msg="auroc",
+    )
+    for thr in (None, 10):
+        np.testing.assert_allclose(
+            np.asarray(FC.multiclass_average_precision(jp, jt, num_classes=c, thresholds=thr)),
+            RFC.multiclass_average_precision(tp, tt, num_classes=c, thresholds=thr).numpy(),
+            atol=1e-4, equal_nan=True, err_msg=f"ap thr={thr}",
+        )
+
+
+def test_average_precision_empty_class_semantics():
+    """Exact mode: nan per-class, excluded from macro; binned mode: 0,
+    included — the reference's (asymmetric) behavior, mirrored exactly."""
+    n, c = 6, 3
+    p = np.full((n, c), 1.0 / c, np.float32)
+    t = np.array([0, 0, 1, 1, 0, 0])  # class 2 absent
+    jp, jt, tp, tt = jnp.asarray(p), jnp.asarray(t), torch.tensor(p), torch.tensor(t)
+    for thr in (None, 10):
+        for avg in ("none", "macro", "weighted"):
+            np.testing.assert_allclose(
+                np.asarray(FC.multiclass_average_precision(jp, jt, num_classes=c, average=avg, thresholds=thr)),
+                RFC.multiclass_average_precision(tp, tt, num_classes=c, average=avg, thresholds=thr).numpy(),
+                atol=1e-5, equal_nan=True, err_msg=f"thr={thr} avg={avg}",
+            )
+    # binary: exact nan / binned 0 with no positives
+    zeros = np.zeros(n, np.int64)
+    assert np.isnan(float(FC.binary_average_precision(jp[:, 0], jnp.asarray(zeros))))
+    assert float(FC.binary_average_precision(jp[:, 0], jnp.asarray(zeros), thresholds=10)) == 0.0
+    # class layer takes the same path
+    from torchmetrics_tpu.classification import MulticlassAveragePrecision
+
+    m = MulticlassAveragePrecision(num_classes=c)
+    m.update(jp, jt)
+    np.testing.assert_allclose(float(m.compute()), 0.5, atol=1e-6)
+
+
+def test_multilabel_ap_empty_label():
+    rng = np.random.RandomState(5)
+    pl = rng.rand(12, 3).astype(np.float32)
+    tl = np.random.RandomState(6).randint(0, 2, (12, 3))
+    tl[:, 2] = 0  # label never positive
+    for thr in (None, 10):
+        np.testing.assert_allclose(
+            np.asarray(FC.multilabel_average_precision(
+                jnp.asarray(pl), jnp.asarray(tl), num_labels=3, average="macro", thresholds=thr)),
+            RFC.multilabel_average_precision(
+                torch.tensor(pl), torch.tensor(tl), num_labels=3, average="macro", thresholds=thr).numpy(),
+            atol=1e-5, equal_nan=True, err_msg=f"thr={thr}",
+        )
+
+
+def test_ap_all_classes_empty():
+    """Every class/label without positives: macro -> nan (reference's empty
+    mean); weighted -> 0.0 (reference's empty weighted sum); micro class
+    path -> nan."""
+    p = np.random.RandomState(2).rand(8, 3).astype(np.float32)
+    t = np.zeros((8, 3), np.int64)
+    for avg in ("macro", "weighted"):
+        ours = FC.multilabel_average_precision(
+            jnp.asarray(p), jnp.asarray(t), num_labels=3, average=avg
+        )
+        ref = RFC.multilabel_average_precision(
+            torch.tensor(p), torch.tensor(t), num_labels=3, average=avg
+        )
+        np.testing.assert_allclose(np.asarray(ours), ref.numpy(), atol=1e-6, equal_nan=True, err_msg=avg)
+
+    from torchmetrics_tpu.classification import MultilabelAveragePrecision
+
+    m = MultilabelAveragePrecision(num_labels=3, average="micro")
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    assert np.isnan(float(m.compute()))

@@ -39,7 +39,10 @@ class BinaryAveragePrecision(BinaryPrecisionRecallCurve):
 
     def compute(self) -> Array:
         if self.thresholds is None:
-            return _binary_average_precision_compute(self._exact_state(), None)
+            preds, target = self._exact_state()
+            ap = _binary_average_precision_compute((preds, target), None)
+            # no positives -> nan in exact mode (reference recall is 0/0)
+            return jnp.where(jnp.sum(target == 1) > 0, ap, jnp.nan)
         return _binary_average_precision_compute(self.confmat, self.thresholds)
 
 
@@ -65,11 +68,12 @@ class MulticlassAveragePrecision(MulticlassPrecisionRecallCurve):
                 (preds, target), self.num_classes, None
             )
             support = jnp.sum(jax.nn.one_hot(target, self.num_classes), axis=0)
-        else:
-            precision, recall, _ = _multiclass_precision_recall_curve_compute(
-                self.confmat, self.num_classes, self.thresholds
-            )
-            support = (self.confmat[0, :, 1, 1] + self.confmat[0, :, 1, 0]).astype(jnp.float32)
+            return _reduce_average_precision(precision, recall, self.average, weights=support,
+                                             exclude_empty=True)
+        precision, recall, _ = _multiclass_precision_recall_curve_compute(
+            self.confmat, self.num_classes, self.thresholds
+        )
+        support = (self.confmat[0, :, 1, 1] + self.confmat[0, :, 1, 0]).astype(jnp.float32)
         return _reduce_average_precision(precision, recall, self.average, weights=support)
 
 
@@ -92,16 +96,19 @@ class MultilabelAveragePrecision(MultilabelPrecisionRecallCurve):
         if self.thresholds is None:
             preds, target = self._exact_state()
             if self.average == "micro":
-                return _binary_average_precision_compute((preds.reshape(-1), target.reshape(-1)), None)
+                ap = _binary_average_precision_compute((preds.reshape(-1), target.reshape(-1)), None)
+                # same no-positives nan guard as binary_average_precision
+                return jnp.where(jnp.sum(target == 1) > 0, ap, jnp.nan)
             precision, recall, _ = _multilabel_precision_recall_curve_compute(
                 (preds, target), self.num_labels, None, self.ignore_index
             )
             support = jnp.sum(target == 1, axis=0).astype(jnp.float32)
-        else:
-            precision, recall, _ = _multilabel_precision_recall_curve_compute(
-                self.confmat, self.num_labels, self.thresholds
-            )
-            support = (self.confmat[0, :, 1, 1] + self.confmat[0, :, 1, 0]).astype(jnp.float32)
+            return _reduce_average_precision(precision, recall, self.average, weights=support,
+                                             exclude_empty=True)
+        precision, recall, _ = _multilabel_precision_recall_curve_compute(
+            self.confmat, self.num_labels, self.thresholds
+        )
+        support = (self.confmat[0, :, 1, 1] + self.confmat[0, :, 1, 0]).astype(jnp.float32)
         return _reduce_average_precision(precision, recall, self.average, weights=support)
 
 

@@ -26,8 +26,10 @@ Array = jax.Array
 
 
 def _ap_from_curve(precision: Array, recall: Array) -> Array:
-    # recall is decreasing toward 0 along the curve order
-    return -jnp.sum(jnp.diff(recall) * precision[:-1], axis=-1)
+    # recall is decreasing toward 0 along the curve order; curves are 1D
+    # (binary / exact-mode per class) or (C, T+1) in binned mode — slice the
+    # threshold axis, not the class axis (reference ``:50-53``)
+    return -jnp.sum(jnp.diff(recall, axis=-1) * precision[..., :-1], axis=-1)
 
 
 def _binary_average_precision_compute(
@@ -42,29 +44,54 @@ def binary_average_precision(
     preds: Array, target: Array, thresholds: Thresholds = None, ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """Parity: reference ``average_precision.py:77``."""
+    """Parity: reference ``average_precision.py:77``.
+
+    With no positive samples the reference's recall is 0/0 and the result is
+    ``nan``; reproduced explicitly here since our curve substitutes the
+    modern-sklearn "recall = 1" convention.
+    """
     preds, target, thr, mask = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
     if thr is None:
         if mask is not None:
             preds, target = preds[mask], target[mask]
-        return _binary_average_precision_compute((preds, target), None)
+        support = jnp.sum(target == 1)
+        ap = _binary_average_precision_compute((preds, target), None)
+        return jnp.where(support > 0, ap, jnp.nan)
+    # binned mode: the reference's _safe_divide gives recall 0 with no
+    # positives, so the result is 0, not nan — reproduced for parity
     state = _binary_precision_recall_curve_update(preds, target, thr, mask)
     return _binary_average_precision_compute(state, thr)
 
 
-def _reduce_average_precision(precision, recall, average: Optional[str] = "macro", weights=None) -> Array:
+def _reduce_average_precision(precision, recall, average: Optional[str] = "macro", weights=None,
+                              exclude_empty: bool = False) -> Array:
     if isinstance(precision, (list, tuple)):
         scores = jnp.stack([_ap_from_curve(p, r) for p, r in zip(precision, recall)])
     else:
         scores = _ap_from_curve(precision, recall)
-    scores = jnp.nan_to_num(scores, nan=0.0)
+    if exclude_empty and weights is not None:
+        # EXACT mode only: classes with no positive samples have undefined
+        # AP (the reference's recall is 0/0 -> nan) and are excluded from
+        # macro/weighted averages (reference ``average_precision.py:56-66``).
+        # In BINNED mode the reference's ``_safe_divide`` yields recall 0,
+        # so empty classes contribute AP 0 and stay IN the average — that
+        # asymmetry is reproduced deliberately. jnp.where keeps it jit-safe.
+        scores = jnp.where(weights > 0, jnp.nan_to_num(scores, nan=0.0), jnp.nan)
+    else:
+        scores = jnp.nan_to_num(scores, nan=0.0)
     if average in (None, "none"):
         return scores
+    valid = ~jnp.isnan(scores)
+    s0 = jnp.where(valid, scores, 0.0)
     if average == "macro":
-        return jnp.mean(scores)
+        # all-nan (no class has positives) -> nan, the reference's mean of
+        # an empty tensor — NOT 0.0 (nan is load-bearing for e.g. Tracker)
+        n_valid = jnp.sum(valid)
+        return jnp.where(n_valid > 0, jnp.sum(s0) / jnp.maximum(n_valid, 1), jnp.nan)
     if average == "weighted":
-        w = _safe_divide(weights, jnp.sum(weights))
-        return jnp.sum(scores * w)
+        w = jnp.where(valid, weights, 0.0)
+        w = _safe_divide(w, jnp.sum(w))
+        return jnp.sum(s0 * w)
     raise ValueError(f"Received invalid `average` {average}")
 
 
@@ -81,10 +108,10 @@ def multiclass_average_precision(
             preds, target = preds[mask], target[mask]
         precision, recall, _ = _multiclass_precision_recall_curve_compute((preds, target), num_classes, None)
         support = jnp.sum(jax.nn.one_hot(target, num_classes), axis=0)
-    else:
-        state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thr, mask)
-        precision, recall, _ = _multiclass_precision_recall_curve_compute(state, num_classes, thr)
-        support = (state[0, :, 1, 1] + state[0, :, 1, 0]).astype(jnp.float32)
+        return _reduce_average_precision(precision, recall, average, weights=support, exclude_empty=True)
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thr, mask)
+    precision, recall, _ = _multiclass_precision_recall_curve_compute(state, num_classes, thr)
+    support = (state[0, :, 1, 1] + state[0, :, 1, 0]).astype(jnp.float32)
     return _reduce_average_precision(precision, recall, average, weights=support)
 
 
@@ -104,10 +131,10 @@ def multilabel_average_precision(
             (preds_f, target_f), num_labels, None, ignore_index
         )
         support = jnp.sum(target_f == 1, axis=0).astype(jnp.float32)
-    else:
-        state = _multilabel_precision_recall_curve_update(preds_f, target_f, num_labels, thr, mask)
-        precision, recall, _ = _multilabel_precision_recall_curve_compute(state, num_labels, thr)
-        support = (state[0, :, 1, 1] + state[0, :, 1, 0]).astype(jnp.float32)
+        return _reduce_average_precision(precision, recall, average, weights=support, exclude_empty=True)
+    state = _multilabel_precision_recall_curve_update(preds_f, target_f, num_labels, thr, mask)
+    precision, recall, _ = _multilabel_precision_recall_curve_compute(state, num_labels, thr)
+    support = (state[0, :, 1, 1] + state[0, :, 1, 0]).astype(jnp.float32)
     return _reduce_average_precision(precision, recall, average, weights=support)
 
 
