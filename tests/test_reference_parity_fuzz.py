@@ -136,3 +136,97 @@ def test_ap_all_classes_empty():
     m = MultilabelAveragePrecision(num_labels=3, average="micro")
     m.update(jnp.asarray(p), jnp.asarray(t))
     assert np.isnan(float(m.compute()))
+
+
+def test_regression_degenerate_inputs():
+    """Constant inputs, zero targets — the reference's epsilon-guard paths."""
+    import torchmetrics.functional.regression as RFR
+
+    import torchmetrics_tpu.functional.regression as FR
+
+    const = np.full(10, 3.0, np.float32)
+    var = np.arange(10, dtype=np.float32)
+    cases = [
+        ("pearson const-x", FR.pearson_corrcoef, RFR.pearson_corrcoef, (const, var)),
+        ("spearman const", FR.spearman_corrcoef, RFR.spearman_corrcoef, (const, const)),
+        ("r2 const-target", FR.r2_score, RFR.r2_score, (var, const)),
+        ("r2 perfect-const", FR.r2_score, RFR.r2_score, (const, const)),
+        ("explained_var const", FR.explained_variance, RFR.explained_variance, (var, const)),
+        ("mape zero-target", FR.mean_absolute_percentage_error, RFR.mean_absolute_percentage_error,
+         (var, np.zeros(10, np.float32))),
+    ]
+    for name, ours_fn, ref_fn, (a, b) in cases:
+        np.testing.assert_allclose(
+            np.asarray(ours_fn(jnp.asarray(a), jnp.asarray(b))),
+            ref_fn(torch.tensor(a), torch.tensor(b)).numpy(),
+            atol=1e-5, equal_nan=True, err_msg=name,
+        )
+
+
+def test_retrieval_all_negative_query():
+    import torchmetrics.functional.retrieval as RFRet
+
+    import torchmetrics_tpu.functional.retrieval as FRet
+
+    p = np.array([0.9, 0.2, 0.4], np.float32)
+    tneg = np.zeros(3, np.int64)
+    for fn in ("retrieval_average_precision", "retrieval_reciprocal_rank", "retrieval_normalized_dcg",
+               "retrieval_hit_rate", "retrieval_fall_out", "retrieval_r_precision"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(FRet, fn)(jnp.asarray(p), jnp.asarray(tneg))),
+            getattr(RFRet, fn)(torch.tensor(p), torch.tensor(tneg)).numpy(),
+            atol=1e-6, equal_nan=True, err_msg=fn,
+        )
+
+
+def test_at_fixed_metrics_on_ties():
+    pt = np.full(8, 0.5, np.float32)
+    tt = np.array([0, 1, 0, 1, 0, 1, 0, 1])
+    o = FC.binary_precision_at_fixed_recall(jnp.asarray(pt), jnp.asarray(tt), min_recall=0.5)
+    r = RFC.binary_precision_at_fixed_recall(torch.tensor(pt), torch.tensor(tt), min_recall=0.5)
+    np.testing.assert_allclose(np.asarray(o[0]), r[0].numpy(), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(o[1]), r[1].numpy(), atol=1e-6)
+    o = FC.binary_recall_at_fixed_precision(jnp.asarray(pt), jnp.asarray(tt), min_precision=0.5)
+    r = RFC.binary_recall_at_fixed_precision(torch.tensor(pt), torch.tensor(tt), min_precision=0.5)
+    np.testing.assert_allclose(np.asarray(o[0]), r[0].numpy(), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(o[1]), r[1].numpy(), atol=1e-6)
+
+
+def test_text_empty_strings():
+    import torchmetrics.functional.text as RFT
+
+    import torchmetrics_tpu.functional.text as FT
+
+    np.testing.assert_allclose(
+        np.asarray(FT.word_error_rate([""], ["hello world"])),
+        RFT.word_error_rate([""], ["hello world"]).numpy(), atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(FT.char_error_rate(["abc"], ["abc"])),
+        RFT.char_error_rate(["abc"], ["abc"]).numpy(), atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(FT.bleu_score([""], [["the cat"]])),
+        RFT.bleu_score([""], [["the cat"]]).numpy(), atol=1e-6)
+
+
+def test_multilabel_class_micro_paths():
+    """Class-layer MultilabelAveragePrecision micro: exact + binned, with and
+    without ignore_index — must match the reference class exactly."""
+    from torchmetrics.classification import MultilabelAveragePrecision as RML
+
+    from torchmetrics_tpu.classification import MultilabelAveragePrecision as OML
+
+    rng = np.random.RandomState(4)
+    p = rng.rand(16, 3).astype(np.float32)
+    t = rng.randint(0, 2, (16, 3))
+    t_ig = t.copy()
+    t_ig[::5] = -1
+    for thr in (None, 10):
+        for ig, tt in ((None, t), (-1, t_ig)):
+            ours = OML(num_labels=3, average="micro", thresholds=thr, ignore_index=ig)
+            ours.update(jnp.asarray(p), jnp.asarray(tt))
+            ref = RML(num_labels=3, average="micro", thresholds=thr, ignore_index=ig)
+            ref.update(torch.tensor(p), torch.tensor(tt))
+            np.testing.assert_allclose(
+                float(ours.compute()), float(ref.compute()), atol=1e-5,
+                err_msg=f"thr={thr} ignore_index={ig}",
+            )

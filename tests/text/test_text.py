@@ -326,3 +326,37 @@ def test_ddp_merge_states_text():
     r1.update(PREDS[2:], TARGETS_SINGLE[2:])
     merged = r0.merge_states([r0.metric_state, r1.metric_state])
     np.testing.assert_allclose(float(r0.compute_state(merged)), ref, atol=1e-6)
+
+
+def test_infolm_end_to_end_with_user_model():
+    """Full InfoLM pipeline with an offline user tokenizer + forward fn
+    (the reference's user_tokenizer/user_forward_fn escape hatch)."""
+    from torchmetrics_tpu.text import InfoLM
+
+    vocab = 32
+
+    def tok(texts, max_length):
+        rows = [[1 + (hash(w) % (vocab - 1)) for w in t.split()][:max_length] for t in texts]
+        maxlen = max(len(r) for r in rows)
+        ids = np.zeros((len(rows), maxlen), np.int32)
+        attn = np.zeros((len(rows), maxlen), np.int32)
+        for i, r in enumerate(rows):
+            ids[i, : len(r)] = r
+            attn[i, : len(r)] = 1
+        return {"input_ids": ids, "attention_mask": attn}
+
+    def fwd(input_ids, attention_mask):
+        ids = np.asarray(input_ids)
+        rng2 = np.random.RandomState(ids.sum() % 1000)
+        return rng2.rand(*ids.shape, vocab).astype(np.float32)
+
+    m = InfoLM(user_tokenizer=tok, user_forward_fn=fwd, idf=False)
+    m.update(["the cat sat"], ["the cat sat"])
+    m.update(["a dog ran fast"], ["a cow ran slow"])
+    val = float(m.compute())
+    assert np.isfinite(val) and val >= 0
+
+    # identical inputs under the same deterministic LM -> zero divergence
+    m2 = InfoLM(user_tokenizer=tok, user_forward_fn=fwd, idf=False)
+    m2.update(["the cat sat"], ["the cat sat"])
+    np.testing.assert_allclose(float(m2.compute()), 0.0, atol=1e-5)

@@ -9,6 +9,7 @@ import jax.numpy as jnp
 
 from ..functional.classification.average_precision import (
     _binary_average_precision_compute,
+    _binary_average_precision_exact,
     _reduce_average_precision,
 )
 from ..functional.classification.precision_recall_curve import (
@@ -39,10 +40,7 @@ class BinaryAveragePrecision(BinaryPrecisionRecallCurve):
 
     def compute(self) -> Array:
         if self.thresholds is None:
-            preds, target = self._exact_state()
-            ap = _binary_average_precision_compute((preds, target), None)
-            # no positives -> nan in exact mode (reference recall is 0/0)
-            return jnp.where(jnp.sum(target == 1) > 0, ap, jnp.nan)
+            return _binary_average_precision_exact(*self._exact_state())
         return _binary_average_precision_compute(self.confmat, self.thresholds)
 
 
@@ -93,12 +91,19 @@ class MultilabelAveragePrecision(MultilabelPrecisionRecallCurve):
         self.average = average
 
     def compute(self) -> Array:
+        if self.average == "micro" and self.thresholds is not None:
+            # binned micro: per-label binary confusions sum to the flattened
+            # binary confusion (states are additive over (sample, label)
+            # pairs; ignore-masked pairs carry weight 0 in both layouts)
+            return _binary_average_precision_compute(jnp.sum(self.confmat, axis=1), self.thresholds)
         if self.thresholds is None:
             preds, target = self._exact_state()
             if self.average == "micro":
-                ap = _binary_average_precision_compute((preds.reshape(-1), target.reshape(-1)), None)
-                # same no-positives nan guard as binary_average_precision
-                return jnp.where(jnp.sum(target == 1) > 0, ap, jnp.nan)
+                preds, target = preds.reshape(-1), target.reshape(-1)
+                if self.ignore_index is not None:
+                    keep = target != self.ignore_index
+                    preds, target = preds[keep], target[keep]
+                return _binary_average_precision_exact(preds, target)
             precision, recall, _ = _multilabel_precision_recall_curve_compute(
                 (preds, target), self.num_labels, None, self.ignore_index
             )

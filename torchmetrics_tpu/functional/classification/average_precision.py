@@ -40,6 +40,19 @@ def _binary_average_precision_compute(
     return _ap_from_curve(precision, recall)
 
 
+def _binary_average_precision_exact(preds: Array, target: Array) -> Array:
+    """Exact-mode binary AP with the no-positives nan guard.
+
+    The reference's recall is 0/0 -> nan with no positive samples; our curve
+    substitutes the modern-sklearn "recall = 1" convention, so the guard is
+    explicit. ``target`` must already be ignore-filtered (values in {0, 1}).
+    The single shared helper keeps the functional and class layers from
+    drifting (binned mode deliberately returns 0 instead — _safe_divide).
+    """
+    ap = _binary_average_precision_compute((preds, target), None)
+    return jnp.where(jnp.sum(target == 1) > 0, ap, jnp.nan)
+
+
 def binary_average_precision(
     preds: Array, target: Array, thresholds: Thresholds = None, ignore_index: Optional[int] = None,
     validate_args: bool = True,
@@ -54,9 +67,7 @@ def binary_average_precision(
     if thr is None:
         if mask is not None:
             preds, target = preds[mask], target[mask]
-        support = jnp.sum(target == 1)
-        ap = _binary_average_precision_compute((preds, target), None)
-        return jnp.where(support > 0, ap, jnp.nan)
+        return _binary_average_precision_exact(preds, target)
     # binned mode: the reference's _safe_divide gives recall 0 with no
     # positives, so the result is 0, not nan — reproduced for parity
     state = _binary_precision_recall_curve_update(preds, target, thr, mask)

@@ -38,7 +38,13 @@ def _r2_score_compute(
     """Parity: reference ``r2.py:46``."""
     mean_obs = sum_obs / num_obs
     tss = sum_squared_obs - sum_obs * mean_obs
-    raw_scores = 1 - (rss / tss)
+    # near-constant targets (reference ``r2.py:83-90``): perfect constant
+    # fit -> 1, imperfect fit of a constant target -> 0, else 1 - rss/tss
+    cond_rss = ~jnp.isclose(rss, 0.0, atol=1e-4)
+    cond_tss = ~jnp.isclose(tss, 0.0, atol=1e-4)
+    cond = cond_rss & cond_tss
+    raw_scores = jnp.where(cond, 1 - rss / jnp.where(cond, tss, 1.0), 1.0)
+    raw_scores = jnp.where(cond_rss & ~cond_tss, 0.0, raw_scores)
     if multioutput == "raw_values":
         r2 = raw_scores
     elif multioutput == "uniform_average":
