@@ -86,8 +86,12 @@ def test_multiclass_calibration_error():
 
 
 def test_binary_hinge():
+    # the reference (and we) sigmoid raw scores before the margin
+    # (reference hinge.py:118); sklearn computes on the values as given,
+    # so feed it the sigmoided scores for the oracle
     scores = rng.randn(N).astype(np.float32)
-    ref = skm.hinge_loss(BT, scores, labels=[0, 1])
+    sig = 1.0 / (1.0 + np.exp(-scores))
+    ref = skm.hinge_loss(BT, sig, labels=[0, 1])
     got = float(binary_hinge_loss(jnp.asarray(scores), jnp.asarray(BT)))
     np.testing.assert_allclose(got, ref, atol=1e-5)
     m = BinaryHingeLoss()
@@ -97,8 +101,10 @@ def test_binary_hinge():
 
 
 def test_multiclass_hinge():
+    # reference softmaxes out-of-range scores first (hinge.py:156)
     scores = rng.randn(N, C).astype(np.float32)
-    ref = skm.hinge_loss(MCT, scores, labels=list(range(C)))
+    soft = np.exp(scores) / np.exp(scores).sum(-1, keepdims=True)
+    ref = skm.hinge_loss(MCT, soft, labels=list(range(C)))
     got = float(multiclass_hinge_loss(jnp.asarray(scores), jnp.asarray(MCT), C))
     np.testing.assert_allclose(got, ref, atol=1e-5)
     m = MulticlassHingeLoss(num_classes=C)

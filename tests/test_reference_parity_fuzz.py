@@ -230,3 +230,115 @@ def test_multilabel_class_micro_paths():
                 float(ours.compute()), float(ref.compute()), atol=1e-5,
                 err_msg=f"thr={thr} ignore_index={ig}",
             )
+
+
+def test_mcc_degenerate_cases():
+    """Binary +-1 shortcuts, eps-substituted zero-denominator cases, and
+    absent-class multiclass/multilabel MCC (reference matthews_corrcoef.py:36-63)."""
+    cases = [
+        (np.array([1, 1, 1, 1]), np.array([1, 1, 1, 1])),  # perfect positives
+        (np.array([0, 0, 0, 0]), np.array([0, 0, 0, 0])),  # perfect negatives
+        (np.array([1, 1, 1, 1]), np.array([0, 0, 0, 0])),  # all wrong
+        (np.array([0, 0, 1, 1]), np.array([0, 0, 0, 0])),  # no true positives
+        (np.array([1, 1, 0, 0]), np.array([1, 1, 1, 1])),  # no true negatives
+    ]
+    for pr, tg in cases:
+        np.testing.assert_allclose(
+            np.asarray(FC.binary_matthews_corrcoef(jnp.asarray(pr.astype(np.float32)), jnp.asarray(tg))),
+            RFC.binary_matthews_corrcoef(torch.tensor(pr.astype(np.float32)), torch.tensor(tg)).numpy(),
+            atol=1e-5, equal_nan=True, err_msg=f"{pr} vs {tg}",
+        )
+    rng = np.random.RandomState(9)
+    for _ in range(10):
+        n, c = int(rng.randint(3, 30)), int(rng.randint(2, 6))
+        p = rng.rand(n, c).astype(np.float32)
+        p /= p.sum(-1, keepdims=True)
+        t = rng.randint(0, max(1, c - 1), n)  # last class absent
+        np.testing.assert_allclose(
+            np.asarray(FC.multiclass_matthews_corrcoef(jnp.asarray(p), jnp.asarray(t), num_classes=c)),
+            RFC.multiclass_matthews_corrcoef(torch.tensor(p), torch.tensor(t), num_classes=c).numpy(),
+            atol=1e-5, equal_nan=True,
+        )
+
+
+def test_hinge_loss_on_logits():
+    """The reference sigmoids (binary) / softmaxes (multiclass) inputs
+    outside [0,1] before the margin computation (hinge.py:118,156) —
+    raw-logit inputs must match it, not the unnormalized-margin formula."""
+    rng = np.random.RandomState(11)
+    n, c = 24, 4
+    p = (rng.randn(n, c) * 2).astype(np.float32)
+    t = rng.randint(0, c, n)
+    for mode in ("crammer-singer", "one-vs-all"):
+        for sq in (False, True):
+            np.testing.assert_allclose(
+                np.asarray(FC.multiclass_hinge_loss(
+                    jnp.asarray(p), jnp.asarray(t), num_classes=c, multiclass_mode=mode, squared=sq)),
+                RFC.multiclass_hinge_loss(
+                    torch.tensor(p), torch.tensor(t), num_classes=c, multiclass_mode=mode, squared=sq).numpy(),
+                atol=1e-4, err_msg=f"{mode} squared={sq}",
+            )
+    pb = (rng.randn(n) * 2).astype(np.float32)
+    tb = rng.randint(0, 2, n)
+    for sq in (False, True):
+        np.testing.assert_allclose(
+            np.asarray(FC.binary_hinge_loss(jnp.asarray(pb), jnp.asarray(tb), squared=sq)),
+            RFC.binary_hinge_loss(torch.tensor(pb), torch.tensor(tb), squared=sq).numpy(),
+            atol=1e-4, err_msg=f"binary squared={sq}",
+        )
+
+
+def test_logit_detection_with_ignored_outlier():
+    """An out-of-range pred at an ignore_index position must not flip the
+    sigmoid/softmax decision for the rest of the batch — except where the
+    reference itself normalizes before masking (stat-scores / multilabel
+    confusion-and-curve formats), which we mirror. One probe per format
+    family."""
+    rng = np.random.RandomState(13)
+    p = rng.rand(30).astype(np.float32)
+    p[0] = -7.5  # logit at an ignored position
+    t = rng.randint(0, 2, 30)
+    t[0] = -1
+    cases = [
+        ("mcc", lambda: (FC.binary_matthews_corrcoef(jnp.asarray(p), jnp.asarray(t), ignore_index=-1),
+                         RFC.binary_matthews_corrcoef(torch.tensor(p), torch.tensor(t), ignore_index=-1))),
+        ("acc", lambda: (FC.binary_accuracy(jnp.asarray(p), jnp.asarray(t), ignore_index=-1),
+                         RFC.binary_accuracy(torch.tensor(p), torch.tensor(t), ignore_index=-1))),
+        ("auroc", lambda: (FC.binary_auroc(jnp.asarray(p), jnp.asarray(t), ignore_index=-1),
+                           RFC.binary_auroc(torch.tensor(p), torch.tensor(t), ignore_index=-1))),
+        ("calibration", lambda: (FC.binary_calibration_error(jnp.asarray(p), jnp.asarray(t), ignore_index=-1),
+                                 RFC.binary_calibration_error(torch.tensor(p), torch.tensor(t), ignore_index=-1))),
+        ("ap", lambda: (FC.binary_average_precision(jnp.asarray(p), jnp.asarray(t), ignore_index=-1),
+                        RFC.binary_average_precision(torch.tensor(p), torch.tensor(t), ignore_index=-1))),
+    ]
+    for name, fn in cases:
+        ours, ref = fn()
+        np.testing.assert_allclose(np.asarray(ours), ref.numpy(), atol=1e-5, equal_nan=True, err_msg=name)
+
+    pm = rng.rand(20, 4).astype(np.float32)
+    pm[0] = np.array([5.0, -3, 0.5, 0.2])
+    tm = rng.randint(0, 4, 20)
+    tm[0] = -1
+    for name, of, rf in [
+        ("mc-auroc", FC.multiclass_auroc, RFC.multiclass_auroc),
+        ("mc-calibration", FC.multiclass_calibration_error, RFC.multiclass_calibration_error),
+        ("mc-acc", FC.multiclass_accuracy, RFC.multiclass_accuracy),
+    ]:
+        np.testing.assert_allclose(
+            np.asarray(of(jnp.asarray(pm), jnp.asarray(tm), num_classes=4, ignore_index=-1)),
+            rf(torch.tensor(pm), torch.tensor(tm), num_classes=4, ignore_index=-1).numpy(),
+            atol=1e-5, equal_nan=True, err_msg=name)
+
+    pl = rng.rand(20, 3).astype(np.float32)
+    pl[0, 0] = 9.0
+    tl = rng.randint(0, 2, (20, 3))
+    tl[0, 0] = -1
+    for name, of, rf in [
+        ("ml-f1", FC.multilabel_f1_score, RFC.multilabel_f1_score),
+        ("ml-ranking", FC.multilabel_ranking_loss, RFC.multilabel_ranking_loss),
+        ("ml-auroc", FC.multilabel_auroc, RFC.multilabel_auroc),
+    ]:
+        np.testing.assert_allclose(
+            np.asarray(of(jnp.asarray(pl), jnp.asarray(tl), num_labels=3, ignore_index=-1)),
+            rf(torch.tensor(pl), torch.tensor(tl), num_labels=3, ignore_index=-1).numpy(),
+            atol=1e-5, equal_nan=True, err_msg=name)

@@ -55,7 +55,8 @@ def _binary_calibration_error_update(
 ) -> Tuple[Array, Array]:
     preds = preds.reshape(-1)
     target = target.reshape(-1)
-    preds = normalize_logits_if_needed(preds.astype(jnp.float32), "sigmoid")
+    valid = None if ignore_index is None else (target != ignore_index)
+    preds = normalize_logits_if_needed(preds.astype(jnp.float32), "sigmoid", valid)
     if ignore_index is not None:
         keep = target != ignore_index
         preds, target = preds[keep], jnp.clip(target[keep], 0, 1)
@@ -84,11 +85,10 @@ def _multiclass_calibration_error_update(
 ) -> Tuple[Array, Array]:
     if preds.ndim == target.ndim + 1:
         pass
-    preds = normalize_logits_if_needed(
-        jnp.moveaxis(preds, 1, -1).reshape(-1, num_classes) if preds.ndim > 2 else preds.reshape(-1, num_classes),
-        "softmax",
-    )
+    preds = jnp.moveaxis(preds, 1, -1).reshape(-1, num_classes) if preds.ndim > 2 else preds.reshape(-1, num_classes)
     target = target.reshape(-1)
+    valid = None if ignore_index is None else (target != ignore_index)[:, None]
+    preds = normalize_logits_if_needed(preds, "softmax", valid)
     if ignore_index is not None:
         keep = target != ignore_index
         preds, target = preds[keep], jnp.clip(target[keep], 0, num_classes - 1)

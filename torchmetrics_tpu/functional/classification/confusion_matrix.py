@@ -44,7 +44,8 @@ def _binary_confusion_matrix_format(
     preds = preds.reshape(-1)
     target = target.reshape(-1)
     if jnp.issubdtype(preds.dtype, jnp.floating):
-        preds = normalize_logits_if_needed(preds, "sigmoid")
+        valid = None if ignore_index is None else (target != ignore_index)
+        preds = normalize_logits_if_needed(preds, "sigmoid", valid)
         if convert_to_labels:
             preds = (preds > threshold).astype(jnp.int32)
     if ignore_index is not None:
@@ -113,6 +114,7 @@ def _multilabel_confusion_matrix_format(
     preds: Array, target: Array, num_labels: int, threshold: float = 0.5, ignore_index: Optional[int] = None,
 ) -> Tuple[Array, Array, Array]:
     if jnp.issubdtype(preds.dtype, jnp.floating):
+        # reference sigmoids before masking (confusion_matrix.py:503-509)
         preds = normalize_logits_if_needed(preds, "sigmoid")
         preds = (preds > threshold).astype(jnp.int32)
     preds = preds.reshape(-1, num_labels)

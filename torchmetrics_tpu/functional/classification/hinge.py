@@ -13,7 +13,9 @@ Array = jax.Array
 
 
 def _binary_hinge_loss_update(preds: Array, target: Array, squared: bool) -> Tuple[Array, Array]:
-    preds = preds.reshape(-1).astype(jnp.float32)
+    # the reference routes preds through the confusion-matrix format stage
+    # (hinge.py:118), which sigmoids inputs outside [0,1]
+    preds = normalize_logits_if_needed(preds.reshape(-1).astype(jnp.float32), "sigmoid")
     target = target.reshape(-1)
     target_s = target * 2 - 1  # {0,1} → {-1,1}
     margin = 1 - target_s * preds
@@ -39,7 +41,8 @@ def binary_hinge_loss(
 def _multiclass_hinge_loss_update(
     preds: Array, target: Array, num_classes: int, squared: bool, multiclass_mode: str
 ) -> Tuple[Array, Array]:
-    preds = preds.reshape(-1, num_classes).astype(jnp.float32)
+    # softmax inputs outside [0,1], like the reference (hinge.py:156-157)
+    preds = normalize_logits_if_needed(preds.reshape(-1, num_classes).astype(jnp.float32), "softmax")
     target = target.reshape(-1)
     tgt_oh = jax.nn.one_hot(target, num_classes)
     if multiclass_mode == "crammer-singer":

@@ -36,14 +36,22 @@ def _matthews_corrcoef_reduce(confmat: Array) -> Array:
     cov_ytyt = s**2 - jnp.sum(tk * tk)
 
     denom = cov_ypyp * cov_ytyt
-    # degenerate single-row/col cases (reference handles via eps substitution)
-    num_nonzero_rows = jnp.sum((tk != 0).astype(jnp.int32))
-    num_nonzero_cols = jnp.sum((pk != 0).astype(jnp.int32))
-    degenerate = jnp.logical_or(
-        jnp.logical_and(num_nonzero_rows == 1, num_nonzero_cols == 1),
-        denom == 0,
-    )
-    mcc = jnp.where(degenerate, 0.0, cov_ytyp / jnp.sqrt(jnp.where(denom == 0, 1.0, denom)))
+    mcc = jnp.where(denom == 0, 0.0, cov_ytyp / jnp.sqrt(jnp.where(denom == 0, 1.0, denom)))
+
+    if confmat.size == 4:
+        # binary special cases (reference ``matthews_corrcoef.py:36-63``):
+        # perfect -> 1, all-wrong -> -1, and the zero-denominator eps
+        # substitution (numerator sqrt(eps)*(a-b) over the marginal product)
+        # — all as jnp.where so the reduction stays jit-safe
+        tn, fp, fn, tp = confmat.reshape(-1)
+        eps = jnp.float32(jnp.finfo(jnp.float32).eps)
+        a = jnp.where((tp == 0) | (tn == 0), tp + tn, 0.0)
+        b = jnp.where((fp == 0) | (fn == 0), fp + fn, 0.0)
+        den_deg = (tp + fp + eps) * (tp + fn + eps) * (tn + fp + eps) * (tn + fn + eps)
+        mcc_deg = jnp.sqrt(eps) * (a - b) / jnp.sqrt(den_deg)
+        mcc = jnp.where(denom == 0, mcc_deg, mcc)
+        mcc = jnp.where((tp + tn != 0) & (fp + fn == 0), 1.0, mcc)
+        mcc = jnp.where((tp + tn == 0) & (fp + fn != 0), -1.0, mcc)
     return mcc
 
 

@@ -71,7 +71,8 @@ def _binary_precision_recall_curve_format(
     """Returns (preds, target, thresholds, mask); mask is None w/o ignore_index."""
     preds = preds.reshape(-1)
     target = target.reshape(-1)
-    preds = normalize_logits_if_needed(preds.astype(jnp.float32), "sigmoid")
+    valid = None if ignore_index is None else (target != ignore_index)
+    preds = normalize_logits_if_needed(preds.astype(jnp.float32), "sigmoid", valid)
     mask = None
     if ignore_index is not None:
         mask = (target != ignore_index)
@@ -154,7 +155,8 @@ def _multiclass_precision_recall_curve_format(
         preds, 1, -1
     ).reshape(-1, num_classes)
     target = target.reshape(-1)
-    preds = normalize_logits_if_needed(preds.astype(jnp.float32), "softmax")
+    valid = None if ignore_index is None else (target != ignore_index)[:, None]
+    preds = normalize_logits_if_needed(preds.astype(jnp.float32), "softmax", valid)
     mask = None
     if ignore_index is not None:
         mask = (target != ignore_index)
@@ -237,6 +239,7 @@ def _multilabel_precision_recall_curve_format(
 ) -> Tuple[Array, Array, Optional[Array], Optional[Array]]:
     preds = preds.reshape(-1, num_labels)
     target = target.reshape(-1, num_labels)
+    # reference sigmoids before masking (precision_recall_curve.py:754-757)
     preds = normalize_logits_if_needed(preds.astype(jnp.float32), "sigmoid")
     thr = _adjust_threshold_arg(thresholds)
     mask = None

@@ -24,8 +24,10 @@ def _rank_data(x: Array) -> Array:
 
 
 def _format_ml(preds: Array, target: Array, num_labels: int, ignore_index: Optional[int]):
-    preds = normalize_logits_if_needed(preds.reshape(-1, num_labels).astype(jnp.float32), "sigmoid")
+    # reference routes through the multilabel confusion format, which
+    # sigmoids before masking (confusion_matrix.py:503-509)
     target = target.reshape(-1, num_labels)
+    preds = normalize_logits_if_needed(preds.reshape(-1, num_labels).astype(jnp.float32), "sigmoid")
     if ignore_index is not None:
         mask = target != ignore_index
         target = jnp.clip(target, 0, 1)

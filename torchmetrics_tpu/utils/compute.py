@@ -60,16 +60,24 @@ def interp(x: Array, xp: Array, fp: Array) -> Array:
     return jnp.interp(x, xp, fp)
 
 
-def normalize_logits_if_needed(tensor: Array, normalization: Optional[str]) -> Array:
+def normalize_logits_if_needed(tensor: Array, normalization: Optional[str],
+                               valid_mask: Optional[Array] = None) -> Array:
     """Apply sigmoid/softmax only when input looks like logits (outside [0,1]).
 
     Parity: reference ``utilities/compute.py`` logit handling used by the
     classification ``_format`` stages. The any-outside-[0,1] test is a traced
     reduction, so this stays jittable via ``jnp.where``.
+
+    ``valid_mask`` (broadcastable to ``tensor``) restricts the is-logit test
+    to kept entries: the reference filters ``ignore_index`` rows *before*
+    deciding, so an out-of-range value at an ignored position must not flip
+    the decision for the whole batch (our masked static-shape design keeps
+    ignored entries in the array).
     """
     if normalization is None:
         return tensor
-    is_logit = jnp.logical_or(jnp.any(tensor < 0), jnp.any(tensor > 1))
+    probe = tensor if valid_mask is None else jnp.where(valid_mask, tensor, 0.5)
+    is_logit = jnp.logical_or(jnp.any(probe < 0), jnp.any(probe > 1))
     if normalization == "sigmoid":
         return jnp.where(is_logit, jax.nn.sigmoid(tensor), tensor)
     if normalization == "softmax":
