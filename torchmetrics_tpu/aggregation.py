@@ -160,9 +160,23 @@ class MeanMetric(BaseAggregator):
         self.add_state("weight", default=jnp.asarray(0.0, dtype=jnp.float32), dist_reduce_fx="sum")
 
     def update(self, value: Array, weight: Union[Array, float] = 1.0) -> None:
-        value = self._impute(jnp.asarray(value, dtype=jnp.float32))
+        value = jnp.asarray(value, dtype=jnp.float32)
         weight = jnp.broadcast_to(jnp.asarray(weight, dtype=jnp.float32), value.shape)
-        mask = self._nan_mask(value)
+        nans = jnp.isnan(value) | jnp.isnan(weight)
+        if isinstance(self.nan_strategy, (int, float)) and not isinstance(self.nan_strategy, bool):
+            # float impute substitutes BOTH the value and its weight
+            # (reference ``aggregation.py:101-102`` intent; its in-place
+            # write hits a torch expanded-tensor aliasing bug, so the
+            # reference can emit nan here — we implement the documented
+            # semantics, not the aliasing accident)
+            fill = jnp.float32(float(self.nan_strategy))
+            value = jnp.where(nans, fill, value)
+            weight = jnp.where(nans, fill, weight)
+            mask = jnp.ones_like(nans)
+        elif self.nan_strategy in ("ignore", "warn"):
+            mask = ~nans
+        else:  # "disable"/"error": propagate (error already raised eagerly)
+            mask = jnp.ones_like(nans)
         self.value = self.value + jnp.sum(value * weight, where=mask)
         self.weight = self.weight + jnp.sum(weight, where=mask)
 

@@ -58,8 +58,7 @@ def _binary_calibration_error_update(
     valid = None if ignore_index is None else (target != ignore_index)
     preds = normalize_logits_if_needed(preds.astype(jnp.float32), "sigmoid", valid)
     if ignore_index is not None:
-        keep = target != ignore_index
-        preds, target = preds[keep], jnp.clip(target[keep], 0, 1)
+        preds, target = preds[valid], jnp.clip(target[valid], 0, 1)
     # reference semantics (calibration_error.py:136-138): the confidence is
     # the raw positive-class probability and the "accuracy" is the target
     # itself — NOT legacy top-1-confidence binning
@@ -83,15 +82,12 @@ def binary_calibration_error(
 def _multiclass_calibration_error_update(
     preds: Array, target: Array, num_classes: int, ignore_index: Optional[int] = None
 ) -> Tuple[Array, Array]:
-    if preds.ndim == target.ndim + 1:
-        pass
     preds = jnp.moveaxis(preds, 1, -1).reshape(-1, num_classes) if preds.ndim > 2 else preds.reshape(-1, num_classes)
     target = target.reshape(-1)
-    valid = None if ignore_index is None else (target != ignore_index)[:, None]
-    preds = normalize_logits_if_needed(preds, "softmax", valid)
+    valid = None if ignore_index is None else (target != ignore_index)
+    preds = normalize_logits_if_needed(preds, "softmax", None if valid is None else valid[:, None])
     if ignore_index is not None:
-        keep = target != ignore_index
-        preds, target = preds[keep], jnp.clip(target[keep], 0, num_classes - 1)
+        preds, target = preds[valid], jnp.clip(target[valid], 0, num_classes - 1)
     confidences = jnp.max(preds, axis=-1)
     accuracies = (jnp.argmax(preds, axis=-1) == target).astype(jnp.float32)
     return confidences, accuracies

@@ -43,13 +43,13 @@ def _binary_confusion_matrix_format(
 ) -> Tuple[Array, Array, Array]:
     preds = preds.reshape(-1)
     target = target.reshape(-1)
+    valid = None if ignore_index is None else (target != ignore_index)
     if jnp.issubdtype(preds.dtype, jnp.floating):
-        valid = None if ignore_index is None else (target != ignore_index)
         preds = normalize_logits_if_needed(preds, "sigmoid", valid)
         if convert_to_labels:
             preds = (preds > threshold).astype(jnp.int32)
     if ignore_index is not None:
-        mask = (target != ignore_index).astype(jnp.float32)
+        mask = valid.astype(jnp.float32)
         target = jnp.clip(target, 0, 1)
     else:
         mask = jnp.ones(target.shape, dtype=jnp.float32)

@@ -73,9 +73,8 @@ def _binary_precision_recall_curve_format(
     target = target.reshape(-1)
     valid = None if ignore_index is None else (target != ignore_index)
     preds = normalize_logits_if_needed(preds.astype(jnp.float32), "sigmoid", valid)
-    mask = None
+    mask = valid
     if ignore_index is not None:
-        mask = (target != ignore_index)
         target = jnp.clip(target, 0, 1)
     return preds, target.astype(jnp.int32), _adjust_threshold_arg(thresholds), mask
 
@@ -155,11 +154,11 @@ def _multiclass_precision_recall_curve_format(
         preds, 1, -1
     ).reshape(-1, num_classes)
     target = target.reshape(-1)
-    valid = None if ignore_index is None else (target != ignore_index)[:, None]
-    preds = normalize_logits_if_needed(preds.astype(jnp.float32), "softmax", valid)
-    mask = None
+    valid = None if ignore_index is None else (target != ignore_index)
+    preds = normalize_logits_if_needed(preds.astype(jnp.float32), "softmax",
+                                       None if valid is None else valid[:, None])
+    mask = valid
     if ignore_index is not None:
-        mask = (target != ignore_index)
         target = jnp.clip(target, 0, num_classes - 1)
     return preds, target.astype(jnp.int32), _adjust_threshold_arg(thresholds), mask
 
