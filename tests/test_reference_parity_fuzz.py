@@ -342,3 +342,24 @@ def test_logit_detection_with_ignored_outlier():
             np.asarray(of(jnp.asarray(pl), jnp.asarray(tl), num_labels=3, ignore_index=-1)),
             rf(torch.tensor(pl), torch.tensor(tl), num_labels=3, ignore_index=-1).numpy(),
             atol=1e-5, equal_nan=True, err_msg=name)
+
+
+def test_chrf_word_ngrams_with_punctuation():
+    """CHRF word n-grams separate single leading/trailing punctuation into
+    its own token (reference chrf.py:98-131, after sacrebleu) — plain
+    whitespace splitting diverges whenever punctuation touches a word."""
+    import torchmetrics.functional.text as RFT
+
+    import torchmetrics_tpu.functional.text as FT
+
+    preds = ["hello there general kenobi", "punct! mid-dle, (wrapped)"]
+    tgts = [["hello there!"], ["punct! mid-dle (wrapped)"]]
+    for nw in (0, 2, 3):
+        np.testing.assert_allclose(
+            np.asarray(FT.chrf_score(preds, tgts, n_word_order=nw)),
+            RFT.chrf_score(preds, tgts, n_word_order=nw).numpy(),
+            atol=1e-5, err_msg=f"n_word_order={nw}",
+        )
+    np.testing.assert_allclose(
+        np.asarray(FT.chrf_score(preds, tgts, whitespace=True)),
+        RFT.chrf_score(preds, tgts, whitespace=True).numpy(), atol=1e-5)

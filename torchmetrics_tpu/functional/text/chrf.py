@@ -19,13 +19,32 @@ Array = jax.Array
 _EPS = 1e-16
 
 
+_PUNCTUATIONS = set("!\"#$%&'()*+,-./:;<=>?@[\\]^_`{|}~")
+
+
+def _word_tokens(sentence: str) -> List[str]:
+    """Whitespace split with single leading/trailing punctuation separated
+    into its own token (reference ``chrf.py:98-131``, after sacrebleu)."""
+    out: List[str] = []
+    for word in sentence.strip().split():
+        if len(word) == 1:
+            out.append(word)
+        elif word[-1] in _PUNCTUATIONS:
+            out.extend([word[:-1], word[-1]])
+        elif word[0] in _PUNCTUATIONS:
+            out.extend([word[0], word[1:]])
+        else:
+            out.append(word)
+    return out
+
+
 def _chrf_tokens(sentence: str, lowercase: bool, whitespace: bool) -> Tuple[List[str], List[str]]:
     """(char tokens, word tokens) for one sentence."""
     if lowercase:
         sentence = sentence.lower()
-    chars = list(sentence) if whitespace else list(sentence.replace(" ", ""))
-    words = sentence.split()
-    return chars, words
+    # reference strips the sentence before dropping spaces (chrf.py:93-95)
+    chars = list(sentence) if whitespace else list(sentence.strip().replace(" ", ""))
+    return chars, _word_tokens(sentence)
 
 
 def _pair_stats(
