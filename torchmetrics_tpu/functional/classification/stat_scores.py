@@ -168,6 +168,9 @@ def _binary_stat_scores_format(
     """Sigmoid-if-logits → threshold → flatten-to-(N, -1); returns a sample
     mask instead of dropping ignored entries (static shapes under jit)."""
     if jnp.issubdtype(preds.dtype, jnp.floating):
+        # the reference sigmoids BEFORE masking ignore_index here
+        # (stat_scores.py:103-107) — unlike its confusion-matrix/curve
+        # formats, which filter first; both asymmetries are mirrored
         preds = normalize_logits_if_needed(preds, "sigmoid")
         preds = (preds > threshold).astype(jnp.int32)
     preds = preds.reshape(preds.shape[0], -1) if preds.ndim > 1 else preds.reshape(-1, 1)
@@ -364,6 +367,7 @@ def _multilabel_stat_scores_format(
     ignore_index: Optional[int] = None,
 ) -> Tuple[Array, Array, Array]:
     if jnp.issubdtype(preds.dtype, jnp.floating):
+        # reference sigmoids before masking (stat_scores.py:657-660)
         preds = normalize_logits_if_needed(preds, "sigmoid")
         preds = (preds > threshold).astype(jnp.int32)
     preds = preds.reshape(preds.shape[0], num_labels, -1)
