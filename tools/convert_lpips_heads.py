@@ -1,0 +1,41 @@
+"""Convert the reference's in-repo LPIPS head checkpoints to a vendored npz.
+
+The reference ships its trained NetLinLayer weights at
+``src/torchmetrics/functional/image/lpips_models/{alex,vgg,squeeze}.pth``
+(torch state dicts with keys ``lin<i>.model.1.weight`` of shape
+(1, C_i, 1, 1)). This one-shot script converts them to Flax 1x1-conv kernels
+(1, 1, C_i, 1) and stores all three nets in
+``torchmetrics_tpu/models/lpips_heads.npz`` with keys ``<net>/lin<i>``.
+
+Run from the repo root:  python tools/convert_lpips_heads.py [<lpips_models_dir>]
+"""
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_SRC = "/root/reference/src/torchmetrics/functional/image/lpips_models"
+OUT = os.path.join(REPO, "torchmetrics_tpu", "models", "lpips_heads.npz")
+
+
+def main() -> None:
+    import torch
+
+    src = sys.argv[1] if len(sys.argv) > 1 else DEFAULT_SRC
+    out = {}
+    for net in ("alex", "vgg", "squeeze"):
+        state = torch.load(os.path.join(src, f"{net}.pth"), map_location="cpu")
+        for key, value in state.items():
+            if not key.endswith("weight"):
+                continue
+            lin = key.split(".")[0]  # "lin0" .. "lin6"
+            arr = np.asarray(value.detach().numpy(), dtype=np.float32)  # (1, C, 1, 1)
+            out[f"{net}/{lin}"] = arr.transpose(2, 3, 1, 0)  # -> (1, 1, C, 1) OIHW->HWIO
+        print(net, sorted(k for k in out if k.startswith(net)))
+    np.savez_compressed(OUT, **out)
+    print("wrote", OUT, os.path.getsize(OUT), "bytes")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,6 +1,14 @@
 """Flax feature-extractor architectures for embedding-network metrics
 (SURVEY.md §2.9: FID-InceptionV3, LPIPS backbones) + weight conversion."""
 from .inception import FIDInceptionV3, convert_torch_state_dict, make_fid_inception
-from .lpips import LPIPSNet, make_lpips
+from .lpips import LPIPSNet, convert_lpips_torch, lpips_head_params, make_lpips
 
-__all__ = ["FIDInceptionV3", "LPIPSNet", "convert_torch_state_dict", "make_fid_inception", "make_lpips"]
+__all__ = [
+    "FIDInceptionV3",
+    "LPIPSNet",
+    "convert_lpips_torch",
+    "convert_torch_state_dict",
+    "lpips_head_params",
+    "make_fid_inception",
+    "make_lpips",
+]

@@ -26,6 +26,12 @@ _SCALE = (0.458, 0.448, 0.450)
 _ALEX_CFG = ((64, 11, 4, 2), (192, 5, 1, 2), (384, 3, 1, 1), (256, 3, 1, 1), (256, 3, 1, 1))
 # VGG16 conv plan: taps after relu1_2, relu2_2, relu3_3, relu4_3, relu5_3
 _VGG_PLAN = ((64, 64), (128, 128), (256, 256, 256), (512, 512, 512), (512, 512, 512))
+# SqueezeNet-1.1 Fire plan: (squeeze, expand) channel pairs for the 8 Fire
+# modules (features[3,4,6,7,9,10,11,12] in torchvision numbering). Taps per
+# reference ``lpips.py:74`` feature_ranges — after the stem relu and after
+# Fire modules #2,#4,#5,#6,#7,#8 (1-based; fire_i 1,3,4,5,6,7 below) —
+# 7 taps, channels 64/128/256/384/384/512/512.
+_SQUEEZE_FIRES = ((16, 64), (16, 64), (32, 128), (32, 128), (48, 192), (48, 192), (64, 256), (64, 256))
 
 
 class AlexFeatures(nn.Module):
@@ -61,14 +67,65 @@ class VGG16Features(nn.Module):
         return tuple(taps)
 
 
-def _unit_normalize(x: Array, eps: float = 1e-10) -> Array:
-    return x / jnp.sqrt(jnp.sum(x**2, axis=-1, keepdims=True) + eps)
+def _ceil_max_pool(x: Array, window: int = 3, stride: int = 2) -> Array:
+    """Max pool with torch ``ceil_mode=True`` semantics (pad right/bottom with
+    -inf so the last partial window is kept). Shapes are static under trace."""
+    h, w = x.shape[1], x.shape[2]
+    pad_h = (-(h - window)) % stride if h > window else 0
+    pad_w = (-(w - window)) % stride if w > window else 0
+    if pad_h or pad_w:
+        x = jnp.pad(x, ((0, 0), (0, pad_h), (0, pad_w), (0, 0)), constant_values=-jnp.inf)
+    return nn.max_pool(x, (window, window), (stride, stride))
+
+
+class SqueezeFeatures(nn.Module):
+    """SqueezeNet-1.1 feature trunk with the reference's 7 LPIPS taps.
+
+    Conv order (and hence :func:`convert_lpips_torch` kernel order) matches
+    the torchvision ``squeezenet1_1().features`` state dict: the stem conv,
+    then per Fire module squeeze → expand1x1 → expand3x3
+    (reference ``functional/image/lpips.py:65-102``).
+    """
+
+    @nn.compact
+    def __call__(self, x: Array) -> Tuple[Array, ...]:
+        taps = []
+        idx = 0
+
+        def conv(x, feats, k, stride=1, pad=0):
+            nonlocal idx
+            y = nn.Conv(feats, (k, k), (stride, stride), padding=((pad, pad), (pad, pad)), name=f"conv{idx}")(x)
+            idx += 1
+            return y
+
+        x = nn.relu(conv(x, 64, 3, stride=2))  # features[0:2]
+        taps.append(x)  # relu1
+        for fire_i, (sq, ex) in enumerate(_SQUEEZE_FIRES):
+            if fire_i in (0, 2, 4):  # maxpools at features[2]/[5]/[8] precede these fires
+                x = _ceil_max_pool(x)
+            s = nn.relu(conv(x, sq, 1))
+            e1 = nn.relu(conv(s, ex, 1))
+            e3 = nn.relu(conv(s, ex, 3, pad=1))
+            x = jnp.concatenate([e1, e3], axis=-1)
+            # reference feature_ranges end at features[4,7,9,10,11,12] — the
+            # 2nd,4th,5th,6th,7th,8th Fire modules (0-based fire_i below)
+            if fire_i in (1, 3, 4, 5, 6, 7):
+                taps.append(x)
+        return tuple(taps)
+
+
+def _unit_normalize(x: Array, eps: float = 1e-8) -> Array:
+    # eps inside the sqrt, matching reference ``lpips.py:215`` (_normalize_tensor)
+    return x / jnp.sqrt(eps + jnp.sum(x**2, axis=-1, keepdims=True))
+
+
+_TRUNKS = {"alex": AlexFeatures, "vgg": VGG16Features, "squeeze": SqueezeFeatures}
 
 
 class LPIPSNet(nn.Module):
     """Full LPIPS distance network. Input: two (N, 3, H, W) images in [-1, 1]."""
 
-    net_type: str = "alex"  # "alex" | "vgg"
+    net_type: str = "alex"  # "alex" | "vgg" | "squeeze"
 
     @nn.compact
     def __call__(self, img0: Array, img1: Array, normalize: bool = False) -> Array:
@@ -79,7 +136,7 @@ class LPIPSNet(nn.Module):
         scale = jnp.asarray(_SCALE).reshape(1, 3, 1, 1)
         img0 = jnp.transpose((img0 - shift) / scale, (0, 2, 3, 1))
         img1 = jnp.transpose((img1 - shift) / scale, (0, 2, 3, 1))
-        trunk = AlexFeatures(name="net") if self.net_type == "alex" else VGG16Features(name="net")
+        trunk = _TRUNKS[self.net_type](name="net")
         f0 = trunk(img0)
         f1 = trunk(img1)
         total = 0.0
@@ -90,13 +147,48 @@ class LPIPSNet(nn.Module):
         return total
 
 
-def make_lpips(net_type: str = "alex", rng_seed: int = 0):
-    """(module, params, distance_fn) with random init; ``distance_fn(x, y)``
-    maps two (N, 3, H, W) [-1, 1] image batches to (N,) distances — directly
-    usable as the ``net_type=`` callable of
-    ``LearnedPerceptualImagePatchSimilarity``."""
+def lpips_head_params(net_type: str = "alex") -> Dict:
+    """The reference's trained NetLinLayer head weights, vendored.
+
+    Converted once from the checkpoints the reference ships in-repo
+    (``/root/reference/src/torchmetrics/functional/image/lpips_models/
+    {alex,vgg,squeeze}.pth``) via :func:`convert_lpips_torch` and stored as
+    ``lpips_heads.npz`` next to this module. Returns ``{"lin<i>": {"kernel":
+    (1, 1, C_i, 1)}}`` ready to merge over an :func:`LPIPSNet.init` pytree.
+    """
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "lpips_heads.npz")
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"vendored LPIPS head weights not found at {path}; regenerate with tools/convert_lpips_heads.py"
+        )
+    with np.load(path) as data:
+        heads = {}
+        prefix = f"{net_type}/"
+        for key in data.files:
+            if key.startswith(prefix):
+                heads[key[len(prefix):]] = {"kernel": jnp.asarray(data[key])}
+    if not heads:
+        raise KeyError(f"no heads for net_type={net_type!r} in {path}")
+    return heads
+
+
+def make_lpips(net_type: str = "alex", rng_seed: int = 0, pretrained_heads: bool = True):
+    """(module, params, distance_fn); ``distance_fn(x, y)`` maps two
+    (N, 3, H, W) [-1, 1] image batches to (N,) distances — directly usable as
+    the ``net_type=`` callable of ``LearnedPerceptualImagePatchSimilarity``.
+
+    The backbone is random-init (torchvision's ImageNet weights are not
+    fetchable offline); ``pretrained_heads=True`` overlays the reference's
+    trained NetLinLayer weights from :func:`lpips_head_params`.
+    """
     mod = LPIPSNet(net_type=net_type)
     params = mod.init(jax.random.PRNGKey(rng_seed), jnp.zeros((1, 3, 64, 64)), jnp.zeros((1, 3, 64, 64)))
+    if pretrained_heads:
+        inner = dict(params["params"])
+        inner.update(lpips_head_params(net_type))
+        params = {"params": inner}
 
     @jax.jit
     def distance(x: Array, y: Array) -> Array:
@@ -105,16 +197,27 @@ def make_lpips(net_type: str = "alex", rng_seed: int = 0):
     return mod, params, distance
 
 
+_EXPECTED_CONVS = {"alex": 5, "vgg": 13, "squeeze": 1 + 3 * len(_SQUEEZE_FIRES)}
+
+
 def convert_lpips_torch(backbone_state: Dict, heads_state: Dict, net_type: str = "alex") -> Dict:
     """Convert torchvision backbone + reference in-repo head weights
-    (``lpips_models/{alex,vgg}.pth``) to this module's params pytree.
+    (``lpips_models/{alex,vgg,squeeze}.pth``) to this module's params pytree.
 
-    Backbone conv ``weight`` (O, I, kH, kW) → kernel (kH, kW, I, O); head
-    entries ``lin<k>.model.1.weight`` (1, C, 1, 1) → ``lin<k>`` kernel.
+    Backbone conv ``weight`` (O, I, kH, kW) → kernel (kH, kW, I, O) in state
+    -dict order (which matches the trunk modules' conv numbering); head
+    entries ``lin<k>.model.1.weight`` (1, C, 1, 1) → ``lin<k>`` kernel
+    (5 heads for alex/vgg, 7 for squeeze). ``net_type`` validates that the
+    backbone's conv count matches the corresponding trunk plan.
     """
     params: Dict = {"net": {}}
     conv_idx = 0
     items = [(k, v) for k, v in backbone_state.items() if k.endswith("weight") and np.asarray(v).ndim == 4]
+    expected = _EXPECTED_CONVS.get(net_type)
+    if expected is not None and len(items) != expected:
+        raise ValueError(
+            f"backbone_state has {len(items)} conv kernels but the {net_type!r} trunk expects {expected}"
+        )
     for (k, v) in items:
         arr = np.asarray(v)
         params["net"][f"conv{conv_idx}"] = {"kernel": jnp.asarray(arr.transpose(2, 3, 1, 0))}
