@@ -157,7 +157,10 @@ class FIDInceptionV3(nn.Module):
         x = jnp.asarray(x, jnp.float32)
         # (N, 3, H, W) -> resize -> normalize to [-1, 1] -> NHWC
         n, c, h, w = x.shape
-        x = jax.image.resize(x, (n, c, 299, 299), jax.image.ResizeMethod.LINEAR)
+        # antialias=False: torch-fidelity resizes with F.interpolate(bilinear,
+        # align_corners=False), which never antialiases — with the default
+        # antialias=True, downscaling >299px inputs would diverge from it
+        x = jax.image.resize(x, (n, c, 299, 299), jax.image.ResizeMethod.LINEAR, antialias=False)
         x = (x - 128.0) / 128.0
         x = jnp.transpose(x, (0, 2, 3, 1))
 
