@@ -343,6 +343,56 @@ def test_logit_detection_with_ignored_outlier():
             rf(torch.tensor(pl), torch.tensor(tl), num_labels=3, ignore_index=-1).numpy(),
             atol=1e-5, equal_nan=True, err_msg=name)
 
+    # micro AP: the reference routes micro through the MULTILABEL format
+    # (sigmoid-if-logits BEFORE the ignore mask) then flattens to the binary
+    # compute — the out-of-[0,1] pred at the ignored position must still
+    # trigger sigmoid for the whole batch (reference avg_precision.py:291-301)
+    for thresholds in (None, 16):
+        np.testing.assert_allclose(
+            np.asarray(FC.multilabel_average_precision(
+                jnp.asarray(pl), jnp.asarray(tl), num_labels=3, average="micro",
+                thresholds=thresholds, ignore_index=-1)),
+            RFC.multilabel_average_precision(
+                torch.tensor(pl), torch.tensor(tl), num_labels=3, average="micro",
+                thresholds=thresholds, ignore_index=-1).numpy(),
+            atol=1e-5, equal_nan=True, err_msg=f"ml-ap-micro thr={thresholds}")
+
+
+def test_image_constant_degenerates():
+    """Constant / zero images through UQI and SAM must match the reference's
+    degenerate outputs exactly: the reference's torch conv cancels
+    E[x^2]-E[x]^2 exactly on constant windows (score 0), and its
+    acos-of-ratio rounds to exactly 0 for parallel spectra. Our kernels pin
+    these via a relative variance noise-floor (uqi.py) and the Kahan
+    2*atan2(|u-v|,|u+v|) angle (sam.py)."""
+    import torchmetrics.functional.image as RFI
+
+    import torchmetrics_tpu.functional.image as FI
+
+    rng = np.random.RandomState(0)
+    const = np.full((2, 3, 16, 16), 0.5, np.float32)
+    const2 = np.full((2, 3, 16, 16), 0.7, np.float32)
+    zeros = np.zeros((2, 3, 16, 16), np.float32)
+    rand = rng.rand(2, 3, 16, 16).astype(np.float32)
+    near = const + rng.randn(2, 3, 16, 16).astype(np.float32) * 0.01
+    cases = [
+        ("const-same", const, const.copy()),
+        ("const-diff", const, const2),
+        ("const-rand", const, rand),
+        ("zero-zero", zeros, zeros.copy()),
+        ("zero-rand", zeros, rand),
+        ("near-const", near, rand),
+    ]
+    for name, a, b in cases:
+        np.testing.assert_allclose(
+            np.asarray(FI.universal_image_quality_index(jnp.asarray(a), jnp.asarray(b))),
+            RFI.universal_image_quality_index(torch.tensor(a), torch.tensor(b)).numpy(),
+            atol=1e-5, equal_nan=True, err_msg=f"uqi {name}")
+        np.testing.assert_allclose(
+            np.asarray(FI.spectral_angle_mapper(jnp.asarray(a), jnp.asarray(b))),
+            RFI.spectral_angle_mapper(torch.tensor(a), torch.tensor(b)).numpy(),
+            atol=1e-5, equal_nan=True, err_msg=f"sam {name}")
+
 
 def test_chrf_word_ngrams_with_punctuation():
     """CHRF word n-grams separate single leading/trailing punctuation into

@@ -50,6 +50,19 @@ def _module_available(name: str) -> bool:
     return importlib.util.find_spec(name) is not None
 
 
+@functools.lru_cache(maxsize=1)
+def _warn_native_pesq_once() -> None:
+    import warnings
+
+    warnings.warn(
+        "Using the first-party P.862-structured PESQ implementation, which is not "
+        "bit-exact with the ITU reference; install the `pesq` package for ITU-exact "
+        "scores, or pass implementation='native' to silence this warning.",
+        UserWarning,
+        stacklevel=3,
+    )
+
+
 @functools.lru_cache(maxsize=4)
 def _perceptual_constants(fs: int):
     """Bark filterbank + thresholds for a sample rate (host, one-time).
@@ -146,7 +159,11 @@ def _estimate_delay(ref: np.ndarray, deg: np.ndarray, fs: int) -> int:
     env_d = env_d - env_d.mean()
     size = 1 << int(np.ceil(np.log2(2 * len(env_r))))
     xc = np.fft.irfft(np.fft.rfft(env_r, size).conj() * np.fft.rfft(env_d, size))
-    lag = int(np.argmax(np.abs(xc)))
+    # signed peak: envelopes are non-negative, so the true alignment peak is
+    # positive; |xc| could lock onto an anticorrelated lag (e.g. for a
+    # polarity-inverted degraded signal the envelope is unchanged, but noise
+    # shaping can still produce a spurious negative extremum)
+    lag = int(np.argmax(xc))
     if lag > size // 2:
         lag -= size
     return lag * hop
@@ -294,6 +311,8 @@ def perceptual_evaluation_speech_quality(
             "implementation='itu' requires that `pesq` is installed. Install as `pip install pesq` "
             "or use implementation='native'."
         )
+    if implementation == "auto" and not use_itu:
+        _warn_native_pesq_once()
 
     p = np.asarray(preds, dtype=np.float32)
     t = np.asarray(target, dtype=np.float32)

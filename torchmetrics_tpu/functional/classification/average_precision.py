@@ -132,8 +132,22 @@ def multilabel_average_precision(
 ) -> Array:
     """Parity: reference ``average_precision.py:275``."""
     if average == "micro":
-        return binary_average_precision(preds.reshape(-1), target.reshape(-1), thresholds, ignore_index,
-                                        validate_args)
+        # Route through the MULTILABEL format first (sigmoid-if-logits before
+        # ignore-masking), then flatten to the binary compute — the reference
+        # order (``average_precision.py:291-301``). Delegating to
+        # binary_average_precision would let an out-of-[0,1] pred at an
+        # *ignored* position flip the logit-detection decision differently.
+        preds_f, target_f, thr, mask = _multilabel_precision_recall_curve_format(
+            preds, target, num_labels, thresholds, ignore_index
+        )
+        if thr is None:
+            p, t = preds_f.reshape(-1), target_f.reshape(-1)
+            if mask is not None:
+                m = mask.reshape(-1)
+                p, t = p[m], t[m]
+            return _binary_average_precision_exact(p, t)
+        state = _multilabel_precision_recall_curve_update(preds_f, target_f, num_labels, thr, mask)
+        return _binary_average_precision_compute(state.sum(axis=1), thr)
     preds_f, target_f, thr, mask = _multilabel_precision_recall_curve_format(
         preds, target, num_labels, thresholds, ignore_index
     )

@@ -289,10 +289,16 @@ def _multiclass_stat_scores_update(
         correct = wf * (prd == tgt)
         # one-hot matmul rides the MXU and vmaps natively under the
         # epoch-fused update path (measured ~5x faster than scatter
-        # histograms at C=100 on v5e); 0/1 weights are exact in bf16 with
-        # f32 accumulation. Gated by the O(n*C) one-hot footprint (~128 MiB
-        # bf16), beyond which the O(n) scatter histograms win on memory.
-        if tgt.shape[0] * num_classes <= _ONEHOT_MATMUL_MAX_ELEMENTS:
+        # histograms at C=100 on v5e); 0/1 weights accumulate exactly in f32
+        # only while every count stays <= 2^24, so n is bounded too — beyond
+        # that (or beyond the ~128 MiB bf16 one-hot footprint) the O(n)
+        # scatter histograms take over. (The scatter path shares the f32
+        # integer-precision ceiling per *bin*, but single-update batches
+        # putting >16.7M samples in one class are past both gates here.)
+        if (
+            tgt.shape[0] * num_classes <= _ONEHOT_MATMUL_MAX_ELEMENTS
+            and tgt.shape[0] <= 2**24
+        ):
             oh_t = jax.nn.one_hot(tgt, num_classes, dtype=jnp.bfloat16)
             oh_p = jax.nn.one_hot(prd, num_classes, dtype=jnp.bfloat16)
             lhs_t = jnp.stack([correct, wf]).astype(jnp.bfloat16)  # (2, n)
