@@ -20,10 +20,20 @@ def _sam_update(preds: Array, target: Array):
         raise ValueError("Expected channel dimension of `preds` and `target` to be larger than 1.")
     preds = preds.astype(jnp.float32)
     target = target.astype(jnp.float32)
-    dot_product = jnp.sum(preds * target, axis=1)
-    preds_norm = jnp.linalg.norm(preds, axis=1)
-    target_norm = jnp.linalg.norm(target, axis=1)
-    return jnp.arccos(jnp.clip(dot_product / (preds_norm * target_norm), -1.0, 1.0))
+    # Kahan's well-conditioned angle: 2*atan2(|u-v|, |u+v|) on unit vectors.
+    # The reference's acos(dot/(|p||t|)) (sam.py:49) is mathematically equal
+    # but catastrophically ill-conditioned near 0°: for parallel constant
+    # images float noise in the ratio gives acos(1-1e-7) ~ 5e-4 rad, where
+    # torch's rounding happens to produce exactly 0. This form agrees with
+    # the reference to ~1e-7 everywhere, including the degenerate cases
+    # (divergence note: docs/migrating_from_torchmetrics.md).
+    preds_norm = jnp.linalg.norm(preds, axis=1, keepdims=True)
+    target_norm = jnp.linalg.norm(target, axis=1, keepdims=True)
+    u = preds / preds_norm  # zero vectors -> nan, matching the reference
+    v = target / target_norm
+    diff = jnp.linalg.norm(u - v, axis=1)
+    summ = jnp.linalg.norm(u + v, axis=1)
+    return 2.0 * jnp.arctan2(diff, summ)
 
 
 def _sam_compute(sam_score: Array, reduction: Optional[str] = "elementwise_mean") -> Array:

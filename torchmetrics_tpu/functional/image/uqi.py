@@ -34,18 +34,32 @@ def _uqi_update(
     kernel = gaussian_kernel_2d(channel, kernel_size, sigma)
 
     n = preds.shape[0]
-    input_list = jnp.concatenate(
-        [preds_p, target_p, preds_p * preds_p, target_p * target_p, preds_p * target_p], axis=0
-    )
+    # Center by the global per-image means before filtering: the
+    # E[x^2]-E[x]^2 form cancels catastrophically on near-constant windows
+    # (conv float noise ~3*eps of the mean power becomes the whole variance
+    # estimate, which the eps-guarded ratio amplifies to arbitrary scores).
+    # On centered data the products are O(|x-m|^2), so the absolute error is
+    # proportional to the *variance* scale, not the mean-power scale — for
+    # constant images the sigma terms come out ~eps^2, reproducing the
+    # reference's exact-0 windows through its own formula with no special
+    # casing (docs/migrating_from_torchmetrics.md).
+    mean_p = jnp.mean(preds, axis=(1, 2, 3), keepdims=True)
+    mean_t = jnp.mean(target, axis=(1, 2, 3), keepdims=True)
+    dp = preds_p - mean_p
+    dt = target_p - mean_t
+    input_list = jnp.concatenate([dp, dt, dp * dp, dt * dt, dp * dt], axis=0)
     outputs = depthwise_conv2d(input_list, kernel)
-    mu_pred = outputs[:n]
-    mu_target = outputs[n : 2 * n]
+    mu_dp = outputs[:n]
+    mu_dt = outputs[n : 2 * n]
+    mu_pred = mu_dp + mean_p
+    mu_target = mu_dt + mean_t
     mu_pred_sq = mu_pred**2
     mu_target_sq = mu_target**2
     mu_pred_target = mu_pred * mu_target
-    sigma_pred_sq = outputs[2 * n : 3 * n] - mu_pred_sq
-    sigma_target_sq = outputs[3 * n : 4 * n] - mu_target_sq
-    sigma_pred_target = outputs[4 * n :] - mu_pred_target
+    # variances clamped at 0, matching reference ``uqi.py:106-107``
+    sigma_pred_sq = jnp.maximum(outputs[2 * n : 3 * n] - mu_dp**2, 0.0)
+    sigma_target_sq = jnp.maximum(outputs[3 * n : 4 * n] - mu_dt**2, 0.0)
+    sigma_pred_target = outputs[4 * n :] - mu_dp * mu_dt
 
     upper = 2 * sigma_pred_target
     lower = sigma_pred_sq + sigma_target_sq
