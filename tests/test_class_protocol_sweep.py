@@ -7,95 +7,18 @@ empty ``state_dict`` by default. This sweep applies those invariants to the
 whole L6 surface at once, so adding a class that breaks the core protocol
 fails CI even before a domain test exists for it.
 """
-import inspect
+import os
 import pickle
+import sys
 
 import numpy as np
 import pytest
 
-import jax.numpy as jnp
-
 import torchmetrics_tpu as M
-import torchmetrics_tpu.classification as MC
 from torchmetrics_tpu.metric import Metric
 
-# default values for common required constructor params
-COMMON = {
-    "num_classes": 5,
-    "num_labels": 4,
-    "num_groups": 2,
-    "num_outputs": 2,
-    "fs": 8000,
-    "mode": "nb",
-    "task": "multiclass",
-    "min_recall": 0.5,
-    "min_precision": 0.5,
-    "min_specificity": 0.5,
-    "min_sensitivity": 0.5,
-    "p": 2.0,
-}
-
-
-def _dummy_feature_net(imgs):
-    return jnp.mean(jnp.asarray(imgs, jnp.float32).reshape(imgs.shape[0], -1), axis=-1, keepdims=True) * jnp.ones((1, 8))
-
-
-def _dummy_distance(a, b):
-    return jnp.mean((jnp.asarray(a, jnp.float32) - jnp.asarray(b, jnp.float32)) ** 2, axis=tuple(range(1, a.ndim)))
-
-
-def _dummy_logits_net(imgs):
-    return jnp.ones((imgs.shape[0], 10)) / 10
-
-
-# lazy factories: each entry constructs its own helper metrics so one bad
-# constructor can't poison every parametrized case
-EXTRA = {
-    "FrechetInceptionDistance": lambda: {"feature": _dummy_feature_net},
-    "KernelInceptionDistance": lambda: {"feature": _dummy_feature_net, "subset_size": 4},
-    "MemorizationInformedFrechetInceptionDistance": lambda: {"feature": _dummy_feature_net},
-    "InceptionScore": lambda: {"feature": _dummy_logits_net},
-    "LearnedPerceptualImagePatchSimilarity": lambda: {"net_type": _dummy_distance},
-    "PerceptualPathLength": lambda: {"distance_fn": _dummy_distance},
-    "PermutationInvariantTraining": lambda: {"metric_func": _dummy_distance},
-    "MetricCollection": lambda: {"metrics": {"mse": M.MeanSquaredError()}},
-    "MetricTracker": lambda: {"metric": M.MeanSquaredError()},
-    "MinMaxMetric": lambda: {"base_metric": M.MeanSquaredError()},
-    "MultioutputWrapper": lambda: {"base_metric": M.MeanSquaredError(), "num_outputs": 2},
-    "MultitaskWrapper": lambda: {"task_metrics": {"t": M.MeanSquaredError()}},
-    "Running": lambda: {"base_metric": M.SumMetric(), "window": 3},
-    "BootStrapper": lambda: {"base_metric": M.MeanSquaredError(), "num_bootstraps": 3},
-    "ClasswiseWrapper": lambda: {"metric": MC.MulticlassAccuracy(num_classes=5, average="none")},
-    "ModifiedPanopticQuality": lambda: {"things": {0, 1}, "stuffs": {2}},
-    "PanopticQuality": lambda: {"things": {0, 1}, "stuffs": {2}},
-    "MinkowskiDistance": lambda: {"p": 2.0},
-    "Dice": lambda: {"num_classes": 5},
-    "FeatureShare": lambda: {"metrics": [M.MeanSquaredError()]},
-}
-
-
-def _build(name):
-    obj = getattr(M, name)
-    extra = EXTRA.get(name)
-    if extra is not None:
-        return obj(**extra())
-    target = obj.__new__ if obj.__new__ is not object.__new__ else obj.__init__
-    try:
-        sig = inspect.signature(target)
-    except (ValueError, TypeError):
-        return obj()
-    kwargs = {}
-    params = list(sig.parameters.values())[1:]
-    for p in params:
-        if p.default is not inspect.Parameter.empty or p.kind in (p.VAR_POSITIONAL, p.VAR_KEYWORD):
-            continue
-        if p.name in COMMON:
-            kwargs[p.name] = COMMON[p.name]
-        else:
-            pytest.skip(f"{name}: no default for required arg {p.name!r}")
-    if kwargs.get("task") == "multiclass" and any(p.name == "num_classes" for p in params):
-        kwargs["num_classes"] = COMMON["num_classes"]  # task facades default it to None
-    return obj(**kwargs)
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "helpers"))
+from example_inputs import build as _build  # noqa: E402  (shared registry)
 
 
 CLASS_NAMES = sorted(n for n in M.__all__ if isinstance(getattr(M, n), type))

@@ -1,0 +1,157 @@
+"""Universal dtype / differentiability / multi-device sweeps over the class
+surface, driven by the ``tests/helpers/example_inputs.py`` registry.
+
+Parity targets (reference ``tests/unittests/_helpers/testers.py``):
+
+- ``run_precision_test_cpu/gpu`` (:463-529): every device metric must accept
+  bf16/f16 inputs — the TPU-native dtype — produce finite results, and stay
+  near its f32 value (accumulator states are f32 by design; what is being
+  bounded here is input-rounding effects).
+- ``run_differentiability_test`` (:531-566): ``is_differentiable=True``
+  classes must yield finite gradients through a real ``jax.grad`` trace of
+  update→compute, not just carry the flag.
+- per-metric ``ddp=True`` runs (:398): every array-input metric must produce
+  the same result from an 8-device ``shard_map`` update + ``reduce_state``
+  as from a single-device update on the full batch.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "helpers"))
+from example_inputs import CASES  # noqa: E402
+from testers import _assert_allclose, _shard_map, sim_devices  # noqa: E402
+
+# curve-shaped outputs: low-precision inputs legitimately change tie
+# structure / threshold grids (and ROC thresholds start at +inf by design),
+# so only nan-freedom is checked there
+CURVE_OUTPUT = {"ROC", "PrecisionRecallCurve", "RetrievalPrecisionRecallCurve"}
+
+# value drift under half precision is expected to be large (ratio-of-small-
+# numbers metrics, incl. the covariance ratios behind the dummy-net MiFID);
+# finiteness-only
+FINITE_ONLY = CURVE_OUTPUT | {
+    "MatthewsCorrCoef",
+    "VisualInformationFidelity",
+    "MemorizationInformedFrechetInceptionDistance",
+}
+
+
+def _cast_tree(x, dtype):
+    if isinstance(x, dict):
+        return {k: _cast_tree(v, dtype) for k, v in x.items()}
+    if isinstance(x, (tuple, list)):
+        return type(x)(_cast_tree(v, dtype) for v in x)
+    if isinstance(x, jax.Array) and jnp.issubdtype(x.dtype, jnp.floating):
+        return x.astype(dtype)
+    return x
+
+
+def _finite(tree, allow_inf: bool = False) -> bool:
+    ok = True
+    for leaf in jax.tree_util.tree_leaves(tree):
+        arr = np.asarray(leaf, dtype=np.float64)
+        good = ~np.isnan(arr) if allow_inf else np.isfinite(arr)
+        ok = ok and bool(good.all())
+    return ok
+
+
+DEVICE_CASES = sorted(n for n, c in CASES.items() if c.device)
+
+
+@pytest.mark.parametrize("dtype_name", ["bfloat16", "float16"])
+@pytest.mark.parametrize("name", DEVICE_CASES)
+def test_low_precision_inputs(name, dtype_name):
+    """bf16/f16 inputs: runs, finite, and near the f32 result."""
+    case = CASES[name]
+    dtype = jnp.dtype(dtype_name)
+
+    calls32 = case.make_inputs(np.random.RandomState(42), 16)
+    m32 = case.build(name)
+    for c in calls32:
+        m32.update(*c)
+    r32 = m32.compute()
+
+    calls_lp = case.make_inputs(np.random.RandomState(42), 16)
+    mlp = case.build(name)
+    for c in calls_lp:
+        mlp.update(*_cast_tree(c, dtype))
+    rlp = mlp.compute()
+
+    assert _finite(rlp, allow_inf=name in CURVE_OUTPUT), \
+        f"{name}: non-finite result with {dtype_name} inputs"
+    if name in FINITE_ONLY:
+        return
+    # generous bound: input rounding only — accumulation stays f32
+    tol = max(case.tol, 0.1 if dtype == jnp.float16 else 0.0)
+    _assert_allclose(rlp, r32, atol=tol, rtol=tol, msg=f"{name} {dtype_name} drift")
+
+
+GRAD_CASES = sorted(n for n, c in CASES.items() if c.device and c.grad_arg is not None)
+
+
+@pytest.mark.parametrize("name", GRAD_CASES)
+def test_differentiability_flag(name):
+    """is_differentiable=True ⇒ finite grads through update→compute."""
+    case = CASES[name]
+    m = case.build(name)
+    args = list(case.make_inputs(np.random.RandomState(0), 8)[0])
+    gi = case.grad_arg
+
+    def loss(x):
+        a = list(args)
+        a[gi] = x
+        state = m.init_state()
+        state = m.update_state(state, *a)
+        result = m.compute_state(state)
+        total = 0.0
+        for leaf in jax.tree_util.tree_leaves(result):
+            if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
+                total = total + jnp.sum(jnp.nan_to_num(jnp.asarray(leaf)))
+        return total
+
+    if not m.is_differentiable:
+        pytest.skip(f"{name}: is_differentiable=False (cannot be falsified mechanically)")
+    grads = jax.grad(loss)(args[gi])
+    arr = np.asarray(grads, dtype=np.float64)
+    assert np.isfinite(arr).all(), f"{name}: non-finite gradient but is_differentiable=True"
+
+
+SHARD_CASES = sorted(n for n, c in CASES.items() if c.device and c.batch_axis)
+
+
+@pytest.mark.parametrize("name", SHARD_CASES)
+def test_shard_map_state_sync(name):
+    """8-device shard_map update + reduce_state == single-device update."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    devs = sim_devices(8)
+    if len(devs) < 8:
+        pytest.skip("needs 8 devices")
+    case = CASES[name]
+    m = case.build(name)
+    if not getattr(m, "jittable", True):
+        pytest.skip(f"{name}: not jittable")
+    args = case.make_inputs(np.random.RandomState(7), 16)[0]
+
+    state = m.init_state()
+    state = m.update_state(state, *args)
+    expected = m.compute_state(state)
+
+    mesh = Mesh(np.array(devs), ("dp",))
+    shard_map = _shard_map()
+
+    def step(*a):
+        st = m.init_state()
+        st = m.update_state(st, *a)
+        return m.reduce_state(st, "dp")
+
+    fn = shard_map(step, mesh=mesh, in_specs=tuple(P("dp") for _ in args), out_specs=P())
+    synced = jax.jit(fn)(*args)
+    result = m.compute_state(synced)
+    _assert_allclose(result, expected, atol=1e-4, rtol=1e-4, msg=f"{name} sharded vs single")

@@ -54,6 +54,10 @@ def signal_distortion_ratio(
     always used (XLA batches it onto the MXU, so CG offers no win here).
     """
     _check_same_shape(preds, target)
+    # the Toeplitz solve is precision-sensitive (the reference recommends
+    # float64 for torch); low-precision inputs compute in f32 here
+    preds = preds.astype(jnp.promote_types(preds.dtype, jnp.float32))
+    target = target.astype(preds.dtype)
     if zero_mean:
         preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
         target = target - jnp.mean(target, axis=-1, keepdims=True)
@@ -77,6 +81,9 @@ def source_aggregated_signal_distortion_ratio(
     _check_same_shape(preds, target)
     if preds.ndim < 2:
         raise RuntimeError(f"The preds and target should have the shape (..., spk, time), but {preds.shape} found")
+    # f16 sums of squares over the time axis overflow; accumulate in f32
+    preds = preds.astype(jnp.promote_types(preds.dtype, jnp.float32))
+    target = target.astype(preds.dtype)
     if zero_mean:
         target = target - jnp.mean(target, axis=-1, keepdims=True)
         preds = preds - jnp.mean(preds, axis=-1, keepdims=True)

@@ -21,6 +21,19 @@ def _validate_intrinsic(data: Array, labels: Array) -> None:
         raise ValueError("Expected 1D labels with one entry per data row")
 
 
+def _safe_norm(x: Array, axis: int = -1, ord: float = 2.0) -> Array:
+    """p-norm with finite gradients at 0 (double-where; the plain
+    ``linalg.norm`` backprops ``0 * inf = nan`` through the zero diagonals of
+    pairwise centroid distances, making is_differentiable=True a lie)."""
+    if ord == 2.0:
+        sumsq = jnp.sum(x * x, axis=axis)
+        safe = jnp.sqrt(jnp.where(sumsq > 0, sumsq, 1.0))
+        return jnp.where(sumsq > 0, safe, 0.0)
+    powsum = jnp.sum(jnp.abs(x) ** ord, axis=axis)
+    safe = jnp.where(powsum > 0, powsum, 1.0) ** (1.0 / ord)
+    return jnp.where(powsum > 0, safe, 0.0)
+
+
 def calinski_harabasz_score(data: Array, labels: Array) -> Array:
     """Between/within dispersion ratio. Parity: ``calinski_harabasz_score.py``."""
     _validate_intrinsic(data, labels)
@@ -54,10 +67,10 @@ def davies_bouldin_score(data: Array, labels: Array) -> Array:
     sums = jax.ops.segment_sum(data, lbl, num_segments=k)
     means = sums / jnp.maximum(counts[:, None], 1.0)
     # intra-cluster mean distance to centroid (S_i)
-    dist_to_centroid = jnp.linalg.norm(data - means[lbl], axis=-1)
+    dist_to_centroid = _safe_norm(data - means[lbl], axis=-1)
     s = jax.ops.segment_sum(dist_to_centroid, lbl, num_segments=k) / jnp.maximum(counts, 1.0)
     # centroid separations (M_ij)
-    m = jnp.linalg.norm(means[:, None, :] - means[None, :, :], axis=-1)
+    m = _safe_norm(means[:, None, :] - means[None, :, :], axis=-1)
     ratio = (s[:, None] + s[None, :]) / jnp.where(m > 0, m, jnp.inf)
     ratio = jnp.where(jnp.eye(k, dtype=bool), -jnp.inf, ratio)
     return jnp.where(k > 1, jnp.mean(jnp.max(ratio, axis=-1)), 0.0)
@@ -76,8 +89,8 @@ def dunn_index(data: Array, labels: Array, p: float = 2.0) -> Array:
     counts = jax.ops.segment_sum(jnp.ones((n,)), lbl, num_segments=k)
     sums = jax.ops.segment_sum(data, lbl, num_segments=k)
     means = sums / jnp.maximum(counts[:, None], 1.0)
-    inter = jnp.linalg.norm(means[:, None, :] - means[None, :, :], ord=p, axis=-1)
+    inter = _safe_norm(means[:, None, :] - means[None, :, :], ord=p, axis=-1)
     inter = jnp.where(jnp.eye(k, dtype=bool), jnp.inf, inter)
-    intra_dist = jnp.linalg.norm(data - means[lbl], ord=p, axis=-1)
+    intra_dist = _safe_norm(data - means[lbl], ord=p, axis=-1)
     max_intra = jax.ops.segment_max(intra_dist, lbl, num_segments=k)
     return jnp.min(inter) / jnp.maximum(jnp.max(max_intra), 1e-30)
