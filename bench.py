@@ -1,17 +1,28 @@
-"""Benchmark: MulticlassAccuracy README loop (BASELINE config 1).
+"""Benchmarks: the five BASELINE.md configs + the <5% step-overhead north star.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
+The headline (metric/value/vs_baseline) stays BASELINE config 1 — the
+MulticlassAccuracy README loop — for round-over-round comparability; the
+``extra`` object carries the other configs:
 
-value       = torchmetrics_tpu epoch throughput (updates/sec) on the default
-              JAX device: the whole update stream runs as ONE XLA program
-              (``lax.scan`` over the pure ``update_state`` + final compute) —
-              the TPU-native execution model where per-step Python dispatch
-              is amortized away (SURVEY.md §7 design decision 4).
-vs_baseline = ratio vs the reference TorchMetrics implementation imported
-              from the read-only mount processing the same stream on its
-              available backend here (torch CPU, eager per-step loop — the
-              reference has no epoch-fusion capability). Falls back to a
-              NumPy baseline if the reference can't load.
+  collection_fused   config 2: MetricCollection(Acc, F1, binned AUROC), one
+                     fused XLA epoch vs the reference's per-step torch loop
+  map_epoch          config 3: MeanAveragePrecision epoch (list states +
+                     host C++ COCOeval) vs the same pipeline on the numpy
+                     fallback (no COCO backend exists for the reference here)
+  fid_ssim           config 4: FID-InceptionV3 (random weights) + SSIM epoch
+                     on device vs a torch-primitive mirror on CPU
+  bertscore_kernel   config 5: BERTScore greedy-matching kernel on padded
+                     embeddings vs the same math in torch CPU (the reference
+                     needs a downloaded HF model, unavailable offline);
+                     ROUGE runs host-side in both libraries and is covered
+                     by parity tests instead
+  step_overhead_pct  north star: % wall-clock added to a compiled train step
+                     by updating a fused MetricCollection in-graph
+
+Methodology (see axon notes): identical dispatches are memoized by the
+remote-TPU layer, so every timed rep is salted; per-rep work is fused into
+one program (lax.scan / batched vmap) and timed around block_until_ready.
 """
 import json
 import os
@@ -42,10 +53,31 @@ def _ensure_working_backend() -> None:
         os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
 
-def bench_ours() -> float:
+def _install_reference():
+    """Make the reference torchmetrics importable (torch CPU); None if not."""
+    helpers = os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests", "helpers")
+    if helpers not in sys.path:
+        sys.path.insert(0, helpers)
+    try:
+        from lightning_utilities_stub import install_stub
+
+        install_stub()
+    except Exception:
+        return None
+    if "/root/reference/src" not in sys.path:
+        sys.path.insert(0, "/root/reference/src")
+    try:
+        import torchmetrics  # noqa: F401
+
+        return torchmetrics
+    except Exception:
+        return None
+
+
+# ---------------------------------------------------------------------- 1
+def bench_config1() -> dict:
     import jax
     import jax.numpy as jnp
-    from jax import lax
 
     from torchmetrics_tpu.classification import MulticlassAccuracy
 
@@ -60,81 +92,407 @@ def bench_ours() -> float:
     def epoch(preds, target, salt):
         # vmap over steps + associative tree-merge: one XLA program, no
         # sequential per-step kernels (updates are independent)
-        preds = preds + salt  # per-rep input variation (see note below)
+        preds = preds + salt
         state = metric.update_state_batched(metric.init_state(), preds, target)
         return state, metric.compute_state(state)
 
-    # warmup / compile
-    state, acc = epoch(preds, target, jnp.float32(0))
+    state, _ = epoch(preds, target, jnp.float32(0))
     jax.block_until_ready(state)
 
-    # NOTE: inputs must differ per rep — remote-TPU execution layers can
-    # memoize identical (executable, args) dispatches, which would make
-    # repeat timings of the same call measure the cache, not the chip.
     reps = 5
     t0 = time.perf_counter()
     states = [epoch(preds, target, jnp.float32((r + 1) * 1e-9))[0] for r in range(reps)]
     jax.block_until_ready(states)
-    dt = time.perf_counter() - t0
-    return reps * STEPS / dt
+    ours = reps * STEPS / (time.perf_counter() - t0)
+
+    ref = _ref_config1()
+    return {"value": round(ours, 2), "unit": "updates/s", "vs_baseline": round(ours / ref, 3)}
 
 
-def bench_reference() -> float:
-    """Reference TorchMetrics from the read-only mount, torch CPU."""
-    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests", "helpers"))
-    try:
-        from lightning_utilities_stub import install_stub
-
-        install_stub()  # reference imports lightning_utilities; stub it
-    except Exception:
-        pass
-    finally:
-        sys.path.pop(0)
-    sys.path.insert(0, "/root/reference/src")
-    try:
+def _ref_config1() -> float:
+    if _install_reference() is not None:
         import torch
         from torchmetrics.classification import MulticlassAccuracy as RefAccuracy
 
         torch.manual_seed(0)
-        preds = torch.softmax(torch.randn(STEPS, BATCH, NUM_CLASSES), dim=-1)
-        target = torch.randint(0, NUM_CLASSES, (STEPS, BATCH))
+        ref_steps = 200
+        preds = torch.softmax(torch.randn(ref_steps, BATCH, NUM_CLASSES), dim=-1)
+        target = torch.randint(0, NUM_CLASSES, (ref_steps, BATCH))
         metric = RefAccuracy(num_classes=NUM_CLASSES, average="micro", validate_args=False)
         for i in range(3):
             metric.update(preds[i], target[i])
         metric.reset()
         t0 = time.perf_counter()
-        for i in range(STEPS):
+        for i in range(ref_steps):
             metric.update(preds[i], target[i])
         metric.compute()
-        dt = time.perf_counter() - t0
-        return STEPS / dt
-    except Exception:
-        import numpy as np
+        return ref_steps / (time.perf_counter() - t0)
+    import numpy as np
 
-        rng = np.random.RandomState(0)
-        preds = rng.rand(STEPS, BATCH, NUM_CLASSES).astype(np.float32)
-        target = rng.randint(0, NUM_CLASSES, (STEPS, BATCH))
-        correct = 0
+    rng = np.random.RandomState(0)
+    preds = rng.rand(100, BATCH, NUM_CLASSES).astype(np.float32)
+    target = rng.randint(0, NUM_CLASSES, (100, BATCH))
+    t0 = time.perf_counter()
+    correct = 0
+    for i in range(100):
+        correct += (preds[i].argmax(-1) == target[i]).sum()
+    return 100 / (time.perf_counter() - t0)
+
+
+def _make_collection(n_cls: int):
+    """The benchmarked Acc+F1+binned-AUROC collection (configs 2 and the
+    step-overhead north star must measure the same workload)."""
+    from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassAUROC, MulticlassF1Score
+    from torchmetrics_tpu.collections import MetricCollection
+
+    return MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=n_cls, average="micro", validate_args=False),
+            "f1": MulticlassF1Score(num_classes=n_cls, average="macro", validate_args=False),
+            "auroc": MulticlassAUROC(num_classes=n_cls, thresholds=64, validate_args=False),
+        }
+    )
+
+
+# ---------------------------------------------------------------------- 2
+def bench_config2() -> dict:
+    """Fused MetricCollection(Accuracy, F1, binned AUROC) epoch."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    steps = 200
+    coll = _make_collection(NUM_CLASSES)
+
+    preds = jax.nn.softmax(jax.random.normal(jax.random.PRNGKey(0), (steps, BATCH, NUM_CLASSES)), axis=-1)
+    target = jax.random.randint(jax.random.PRNGKey(1), (steps, BATCH), 0, NUM_CLASSES)
+    preds.block_until_ready()
+
+    @jax.jit
+    def epoch(preds, target, salt):
+        def body(state, batch):
+            p, t = batch
+            return coll.update_state(state, p + salt, t), None
+
+        state, _ = lax.scan(body, coll.init_state(), (preds, target))
+        return state, coll.compute_state(state)
+
+    state, _ = epoch(preds, target, jnp.float32(0))
+    jax.block_until_ready(state)
+    reps = 3
+    t0 = time.perf_counter()
+    states = [epoch(preds, target, jnp.float32((r + 1) * 1e-9))[0] for r in range(reps)]
+    jax.block_until_ready(states)
+    ours = reps * steps / (time.perf_counter() - t0)
+
+    ref = None
+    if _install_reference() is not None:
+        import torch
+        import torchmetrics as RT
+
+        torch.manual_seed(0)
+        ref_steps = 50
+        preds_t = torch.softmax(torch.randn(ref_steps, BATCH, NUM_CLASSES), dim=-1)
+        target_t = torch.randint(0, NUM_CLASSES, (ref_steps, BATCH))
+        rcoll = RT.MetricCollection(
+            {
+                "acc": RT.classification.MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro",
+                                                            validate_args=False),
+                "f1": RT.classification.MulticlassF1Score(num_classes=NUM_CLASSES, average="macro",
+                                                          validate_args=False),
+                "auroc": RT.classification.MulticlassAUROC(num_classes=NUM_CLASSES, thresholds=64,
+                                                           validate_args=False),
+            }
+        )
+        for i in range(2):
+            rcoll.update(preds_t[i], target_t[i])
+        rcoll.reset()
         t0 = time.perf_counter()
-        for i in range(STEPS):
-            correct += (preds[i].argmax(-1) == target[i]).sum()
-        dt = time.perf_counter() - t0
-        return STEPS / dt
+        for i in range(ref_steps):
+            rcoll.update(preds_t[i], target_t[i])
+        rcoll.compute()
+        ref = ref_steps / (time.perf_counter() - t0)
+    return {"value": round(ours, 2), "unit": "updates/s",
+            "vs_baseline": round(ours / ref, 3) if ref else None}
+
+
+# ---------------------------------------------------------------------- 3
+def bench_config3() -> dict:
+    """mAP epoch: list-state accumulation + host COCOeval (C++ fast path)."""
+    ours = _map_epoch_seconds()
+    # baseline: identical pipeline on the numpy fallback (no reference COCO
+    # backend exists in this environment); child process forces the fallback
+    try:
+        env = dict(os.environ)
+        env["TM_TPU_DISABLE_NATIVE"] = "1"
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--map-child"],
+            env=env, capture_output=True, timeout=600, text=True,
+        )
+        ref_seconds = float(out.stdout.strip().splitlines()[-1])
+    except Exception:
+        ref_seconds = None
+    imgs_per_s = MAP_N_IMGS / ours
+    return {"value": round(imgs_per_s, 2), "unit": "imgs/s (epoch incl. COCOeval)",
+            "vs_baseline": round(ref_seconds / ours, 3) if ref_seconds else None}
+
+
+MAP_N_IMGS = 256
+
+
+def _map_epoch_seconds() -> float:
+    import numpy as np
+
+    from torchmetrics_tpu.detection import MeanAveragePrecision
+
+    rng = np.random.RandomState(0)
+    n_imgs, per_batch, dets, gts = MAP_N_IMGS, 32, 20, 12
+
+    def boxes(n):
+        xy = rng.rand(n, 2) * 200
+        wh = rng.rand(n, 2) * 60 + 4
+        return np.concatenate([xy, xy + wh], axis=1).astype(np.float32)
+
+    # host-resident inputs: detection states are object/list states that live
+    # on host until the compute-time gather, so the realistic eval loop feeds
+    # numpy batches (per-image device dispatches would measure tunnel RTT)
+    preds = [
+        {"boxes": boxes(dets), "scores": rng.rand(dets).astype(np.float32),
+         "labels": rng.randint(0, 5, dets)}
+        for _ in range(n_imgs)
+    ]
+    target = [
+        {"boxes": boxes(gts), "labels": rng.randint(0, 5, gts)}
+        for _ in range(n_imgs)
+    ]
+    metric = MeanAveragePrecision()
+    # warm the native build before timing
+    metric2 = MeanAveragePrecision()
+    metric2.update(preds[0:2], target[0:2])
+    metric2.compute()
+    t0 = time.perf_counter()
+    for i in range(0, n_imgs, per_batch):
+        metric.update(preds[i : i + per_batch], target[i : i + per_batch])
+    metric.compute()
+    return time.perf_counter() - t0
+
+
+# ---------------------------------------------------------------------- 4
+def bench_config4() -> dict:
+    """FID (on-device InceptionV3, random weights) + SSIM epoch."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.functional.image.ssim import structural_similarity_index_measure
+    from torchmetrics_tpu.models.inception import make_fid_inception
+
+    n_steps, batch = 4, 16
+    _, _, extract = make_fid_inception(2048)
+    rng = np.random.RandomState(0)
+    imgs = jnp.asarray(rng.randint(0, 256, (n_steps, batch, 3, 128, 128)).astype(np.float32))
+    ref_imgs = jnp.clip(imgs + 8.0, 0, 255)
+
+    @jax.jit
+    def epoch(imgs, ref_imgs, salt):
+        def one(i, acc):
+            feats = extract(imgs[i] + salt)
+            ssim = structural_similarity_index_measure(imgs[i] / 255.0, ref_imgs[i] / 255.0, data_range=1.0)
+            return acc + jnp.sum(feats) + ssim
+
+        return jax.lax.fori_loop(0, n_steps, one, jnp.float32(0))
+
+    epoch(imgs, ref_imgs, jnp.float32(0)).block_until_ready()
+    reps = 3
+    t0 = time.perf_counter()
+    vals = [epoch(imgs, ref_imgs, jnp.float32((r + 1) * 1e-6)) for r in range(reps)]
+    jax.block_until_ready(vals)
+    ours = reps * n_steps * batch / (time.perf_counter() - t0)
+
+    ref = _ref_config4(n_steps=1, batch=8)
+    return {"value": round(ours, 2), "unit": "imgs/s (InceptionV3 2048-feat + SSIM)",
+            "vs_baseline": round(ours / ref, 3) if ref else None}
+
+
+def _ref_config4(n_steps: int, batch: int):
+    """torch-primitive mirror of the same pipeline on CPU."""
+    if _install_reference() is None:
+        return None
+    try:
+        sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests", "image"))
+        from test_inception_parity import TFIDInception
+
+        import torch
+        from torchmetrics.functional.image import structural_similarity_index_measure as ref_ssim
+
+        torch.manual_seed(0)
+        net = TFIDInception().eval()
+        imgs = torch.randint(0, 256, (n_steps, batch, 3, 128, 128)).float()
+        refs = (imgs + 8.0).clamp(0, 255)
+        with torch.no_grad():
+            net(imgs[0, :2])  # warm
+            t0 = time.perf_counter()
+            for i in range(n_steps):
+                net(imgs[i])
+                ref_ssim(imgs[i] / 255.0, refs[i] / 255.0, data_range=1.0)
+            dt = time.perf_counter() - t0
+        return n_steps * batch / dt
+    except Exception:
+        return None
     finally:
         sys.path.pop(0)
 
 
+# ---------------------------------------------------------------------- 5
+def bench_config5() -> dict:
+    """BERTScore greedy-matching kernel over padded embeddings."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.functional.text.bert import bert_score_from_embeddings
+
+    b, t, d = 256, 128, 256
+    rng = np.random.RandomState(0)
+    pe = jnp.asarray(rng.randn(b, t, d).astype(np.float32))
+    te = jnp.asarray(rng.randn(b, t, d).astype(np.float32))
+    pm = jnp.ones((b, t), bool)
+    tm = jnp.ones((b, t), bool)
+
+    fn = jax.jit(lambda pe, te, salt: bert_score_from_embeddings(pe + salt, pm, te, tm))
+    jax.block_until_ready(fn(pe, te, jnp.float32(0)))
+    reps = 10
+    t0 = time.perf_counter()
+    outs = [fn(pe, te, jnp.float32((r + 1) * 1e-9)) for r in range(reps)]
+    jax.block_until_ready(outs)
+    ours = reps * b / (time.perf_counter() - t0)
+
+    ref = None
+    try:
+        import torch
+
+        tpe = torch.from_numpy(np.asarray(pe))
+        tte = torch.from_numpy(np.asarray(te))
+
+        def torch_kernel(a, bb):
+            a = a / a.norm(dim=-1, keepdim=True)
+            bb = bb / bb.norm(dim=-1, keepdim=True)
+            sim = torch.bmm(a, bb.transpose(1, 2))
+            p = sim.max(dim=2).values.mean(dim=1)
+            r = sim.max(dim=1).values.mean(dim=1)
+            return p, r, 2 * p * r / (p + r)
+
+        with torch.no_grad():
+            torch_kernel(tpe[:8], tte[:8])
+            t0 = time.perf_counter()
+            torch_kernel(tpe, tte)
+            dt = time.perf_counter() - t0
+        ref = b / dt
+    except Exception:
+        pass
+    return {"value": round(ours, 2), "unit": "pairs/s (greedy cosine matching, T=128, D=256)",
+            "vs_baseline": round(ours / ref, 3) if ref else None}
+
+
+# ---------------------------------------------------------- step overhead
+def bench_step_overhead() -> float:
+    """% step-time cost of updating a fused MetricCollection in-graph
+    inside a compiled train step (BASELINE.md north star: <5%)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    d_in, d_h, n_cls, batch, steps = 512, 2048, NUM_CLASSES, 256, 50
+
+    def init_params(key):
+        k1, k2, k3 = jax.random.split(key, 3)
+        return {
+            "w1": jax.random.normal(k1, (d_in, d_h), jnp.bfloat16) * 0.02,
+            "w2": jax.random.normal(k2, (d_h, d_h), jnp.bfloat16) * 0.02,
+            "w3": jax.random.normal(k3, (d_h, n_cls), jnp.bfloat16) * 0.02,
+        }
+
+    coll = _make_collection(n_cls)
+
+    def loss_fn(params, x, y):
+        h = jnp.tanh(x.astype(jnp.bfloat16) @ params["w1"])
+        h = jnp.tanh(h @ params["w2"])
+        logits = (h @ params["w3"]).astype(jnp.float32)
+        return -jnp.mean(jax.nn.log_softmax(logits)[jnp.arange(x.shape[0]), y]), logits
+
+    def make_epoch(with_metrics: bool):
+        @jax.jit
+        def epoch(params, xs, ys, salt):
+            def body(carry, batch_xy):
+                params, mstate = carry
+                x, y = batch_xy
+                (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, x + salt, y)
+                params = jax.tree_util.tree_map(lambda p, g: p - 0.01 * g.astype(p.dtype), params, grads)
+                if with_metrics:
+                    mstate = coll.update_state(mstate, jax.nn.softmax(logits), y)
+                return (params, mstate), loss
+
+            (params, mstate), losses = lax.scan(body, (params, coll.init_state()), (xs, ys))
+            return params, mstate, losses[-1]
+
+        return epoch
+
+    xs = jax.random.normal(jax.random.PRNGKey(0), (steps, batch, d_in))
+    ys = jax.random.randint(jax.random.PRNGKey(1), (steps, batch), 0, n_cls)
+    params = init_params(jax.random.PRNGKey(2))
+    xs.block_until_ready()
+
+    epochs = {"off": make_epoch(False), "on": make_epoch(True)}
+    for tag, epoch in epochs.items():
+        jax.block_until_ready(epoch(params, xs, ys, jnp.float32(0)))  # compile
+    # interleave variants and keep the per-variant MINIMUM: the remote-TPU
+    # tunnel adds multi-ms jitter per dispatch that otherwise swamps a <5%
+    # effect (a naive 4-rep mean once measured metrics-on as 28% *faster*)
+    best = {"off": float("inf"), "on": float("inf")}
+    for r in range(6):
+        for tag, epoch in epochs.items():
+            t0 = time.perf_counter()
+            jax.block_until_ready(epoch(params, xs, ys, jnp.float32((r + 1) * 1e-9)))
+            best[tag] = min(best[tag], time.perf_counter() - t0)
+    return 100.0 * (best["on"] - best["off"]) / best["off"]
+
+
 def main() -> None:
     _ensure_working_backend()
-    ours = bench_ours()
-    ref = bench_reference()
+    if len(sys.argv) > 1 and sys.argv[1] == "--map-child":
+        print(_map_epoch_seconds())
+        return
+    def safe(fn, retries: int = 1):
+        # the remote-TPU tunnel occasionally drops a long compile; retry
+        # once, then report the failure instead of killing the whole bench
+        for attempt in range(retries + 1):
+            try:
+                return fn()
+            except Exception as err:  # noqa: BLE001
+                if attempt == retries:
+                    return {"error": f"{type(err).__name__}: {err}"[:200]}
+
+    c1 = safe(bench_config1)
+    if "error" in c1:
+        c1 = {"value": 0.0, "unit": "updates/s", "vs_baseline": 0.0, **c1}
+    overhead = safe(bench_step_overhead)
+    extra = {
+        "collection_fused": safe(bench_config2),
+        "map_epoch": safe(bench_config3),
+        "fid_ssim": safe(bench_config4),
+        "bertscore_kernel": safe(bench_config5),
+        "step_overhead_pct": overhead if isinstance(overhead, dict) else round(overhead, 2),
+    }
     print(
         json.dumps(
             {
                 "metric": f"MulticlassAccuracy epoch throughput (batch={BATCH}, C={NUM_CLASSES}, fused vmap+merge)",
-                "value": round(ours, 2),
-                "unit": "updates/s",
-                "vs_baseline": round(ours / ref, 3),
+                "value": c1["value"],
+                "unit": c1["unit"],
+                "vs_baseline": c1["vs_baseline"],
+                "extra": extra,
             }
         )
     )

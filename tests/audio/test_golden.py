@@ -1,0 +1,103 @@
+"""Golden-value pins for the first-party PESQ / STOI / SRMR.
+
+No oracle stack (`pesq`, `pystoi`, `gammatone`) is installable in this
+offline environment, so two kinds of numeric anchors replace the
+reference's wrap-the-exact-library tests
+(`/root/reference/src/torchmetrics/functional/audio/pesq.py`):
+
+1. **ITU ceiling anchors** (external ground truth): P.862.1/P.862.2 map a
+   zero-disturbance comparison to MOS-LQO 4.549 (narrow-band) and 4.644
+   (wide-band) — the published ceilings of the ITU mapping, which any
+   conformant implementation must hit for a signal compared with itself.
+   Our pipeline reproduces both to 3 decimals.
+2. **Regression goldens**: scores of deterministic seeded signals pinned at
+   the values the current implementation produces. These do NOT certify
+   ITU-exactness (the docstring of ``functional/audio/pesq.py`` quantifies
+   the structural deviations); they freeze today's numerics so that any
+   future kernel change that shifts scores is caught and must re-justify
+   its goldens.
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import torchmetrics_tpu.functional.audio as FA
+
+FS = 16000
+
+
+def _signals():
+    rng = np.random.RandomState(0)
+    t = np.arange(FS * 2) / FS
+    clean = (
+        np.sin(2 * np.pi * 150 * t) * (1 + 0.5 * np.sin(2 * np.pi * 3 * t))
+        + 0.4 * np.sin(2 * np.pi * 450 * t)
+    ).astype(np.float32)
+    noisy = (clean + 0.1 * rng.randn(len(t))).astype(np.float32)
+    very_noisy = (clean + 0.6 * rng.randn(len(t))).astype(np.float32)
+    return clean, noisy, very_noisy
+
+
+@pytest.mark.parametrize(
+    ("mode", "fs", "ceiling"),
+    [("wb", 16000, 4.644), ("nb", 16000, 4.549), ("nb", 8000, 4.549)],
+)
+def test_pesq_itu_ceiling_anchor(mode, fs, ceiling):
+    """Identical signals must score the published ITU MOS-LQO ceiling."""
+    clean, _, _ = _signals()
+    sig = clean[:: FS // fs]
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        score = float(FA.perceptual_evaluation_speech_quality(jnp.asarray(sig), jnp.asarray(sig), fs, mode))
+    assert score == pytest.approx(ceiling, abs=2e-3)
+
+
+def test_stoi_identity_anchor():
+    clean, _, _ = _signals()
+    score = float(FA.short_time_objective_intelligibility(jnp.asarray(clean), jnp.asarray(clean), FS))
+    assert score == pytest.approx(1.0, abs=1e-6)
+
+
+# regression goldens for the current implementation (seeded signals above)
+GOLDEN = {
+    ("pesq", "wb", 16000): (2.822, 2.404),      # (noisy, very_noisy)
+    ("pesq", "nb", 16000): (2.348, 1.959),
+    ("pesq", "nb", 8000): (2.512, 2.260),
+}
+GOLDEN_STOI = (0.2319, 0.1719)                  # (noisy, very_noisy)
+GOLDEN_SRMR = 88.173                            # clean
+
+
+@pytest.mark.parametrize(("mode", "fs"), [("wb", 16000), ("nb", 16000), ("nb", 8000)])
+def test_pesq_regression_goldens(mode, fs):
+    clean, noisy, very_noisy = _signals()
+    step = FS // fs
+    exp_noisy, exp_very = GOLDEN[("pesq", mode, fs)]
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        got_noisy = float(FA.perceptual_evaluation_speech_quality(
+            jnp.asarray(noisy[::step]), jnp.asarray(clean[::step]), fs, mode))
+        got_very = float(FA.perceptual_evaluation_speech_quality(
+            jnp.asarray(very_noisy[::step]), jnp.asarray(clean[::step]), fs, mode))
+    assert got_noisy == pytest.approx(exp_noisy, abs=5e-3)
+    assert got_very == pytest.approx(exp_very, abs=5e-3)
+    # more degradation must score lower (monotonicity of the whole chain)
+    assert got_very < got_noisy < 4.5
+
+
+def test_stoi_regression_goldens():
+    clean, noisy, very_noisy = _signals()
+    got_noisy = float(FA.short_time_objective_intelligibility(jnp.asarray(noisy), jnp.asarray(clean), FS))
+    got_very = float(FA.short_time_objective_intelligibility(jnp.asarray(very_noisy), jnp.asarray(clean), FS))
+    assert got_noisy == pytest.approx(GOLDEN_STOI[0], abs=5e-3)
+    assert got_very == pytest.approx(GOLDEN_STOI[1], abs=5e-3)
+    assert got_very < got_noisy
+
+
+def test_srmr_regression_golden():
+    clean, _, _ = _signals()
+    got = float(FA.speech_reverberation_modulation_energy_ratio(jnp.asarray(clean), FS))
+    assert got == pytest.approx(GOLDEN_SRMR, rel=1e-3)
