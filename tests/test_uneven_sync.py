@@ -45,3 +45,62 @@ def test_empty_rank_cat_state():
     for k, v in merged.items():
         setattr(r0, k, list(v) if isinstance(v, tuple) else v)
     np.testing.assert_allclose(np.asarray(r0.compute()), [5.0, 6.0])
+
+
+def _merge_equals_full(metric_factory, batches, atol=1e-5):
+    """N ranks with different batch sizes must merge to the full-data result."""
+    full = metric_factory()
+    for args in batches:
+        full.update(*[jnp.asarray(a) for a in args])
+    expected = full.compute()
+
+    ranks = [metric_factory() for _ in batches]
+    for rank, args in zip(ranks, batches):
+        rank.update(*[jnp.asarray(a) for a in args])
+    merged = ranks[0].merge_states([m.metric_state for m in ranks])
+    result = ranks[0].compute_state(merged)
+    np.testing.assert_allclose(
+        np.asarray(result, dtype=np.float64), np.asarray(expected, dtype=np.float64), atol=atol
+    )
+
+
+def test_pearson_moment_merge_uneven_ranks():
+    # NONE-reduction moment states merged pairwise (reference pearson.py:28)
+    rng = np.random.RandomState(3)
+    x = rng.randn(23).astype(np.float32)
+    y = (0.7 * x + 0.2 * rng.randn(23)).astype(np.float32)
+    _merge_equals_full(tm.PearsonCorrCoef, [(x[:4], y[:4]), (x[4:19], y[4:19]), (x[19:], y[19:])])
+
+
+def test_kendall_uneven_ranks():
+    rng = np.random.RandomState(4)
+    x = rng.randn(17).astype(np.float32)
+    y = (x + rng.randn(17)).astype(np.float32)
+    _merge_equals_full(tm.KendallRankCorrCoef, [(x[:11], y[:11]), (x[11:], y[11:])])
+
+
+def test_retrieval_uneven_ranks():
+    rng = np.random.RandomState(5)
+    p = rng.rand(18).astype(np.float32)
+    t = rng.randint(0, 2, 18)
+    idx = np.sort(rng.randint(0, 5, 18))
+    _merge_equals_full(
+        tm.RetrievalMAP,
+        [(p[:5], t[:5], idx[:5]), (p[5:6], t[5:6], idx[5:6]), (p[6:], t[6:], idx[6:])],
+    )
+
+
+def test_exact_curve_uneven_ranks():
+    from torchmetrics_tpu.classification import BinaryAveragePrecision
+
+    rng = np.random.RandomState(6)
+    p = rng.rand(21).astype(np.float32)
+    t = rng.randint(0, 2, 21)
+    _merge_equals_full(lambda: BinaryAveragePrecision(thresholds=None), [(p[:2], t[:2]), (p[2:], t[2:])])
+
+
+def test_clustering_uneven_ranks():
+    rng = np.random.RandomState(7)
+    a = rng.randint(0, 3, 19)
+    b = rng.randint(0, 3, 19)
+    _merge_equals_full(tm.MutualInfoScore, [(a[:13], b[:13]), (a[13:], b[13:])])

@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from .metric import Metric
-from .utils.data import dim_zero_cat
+from .utils.data import cat_state_or_empty, dim_zero_cat
 from .utils.prints import rank_zero_warn
 
 Array = jax.Array
@@ -78,7 +78,17 @@ class BaseAggregator(Metric):
 
 
 class MaxMetric(BaseAggregator):
-    """Running maximum. Parity: reference ``aggregation.py:114``."""
+    """Running maximum. Parity: reference ``aggregation.py:114``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import MaxMetric
+        >>> metric = MaxMetric()
+        >>> metric.update(jnp.asarray([1.0, 2.0, 3.0]))
+        >>> metric.update(jnp.asarray([4.0]))
+        >>> float(metric.compute())
+        4.0
+    """
 
     higher_is_better = True
 
@@ -93,7 +103,17 @@ class MaxMetric(BaseAggregator):
 
 
 class MinMetric(BaseAggregator):
-    """Running minimum. Parity: reference ``aggregation.py:219``."""
+    """Running minimum. Parity: reference ``aggregation.py:219``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import MinMetric
+        >>> metric = MinMetric()
+        >>> metric.update(jnp.asarray([1.0, 2.0, 3.0]))
+        >>> metric.update(jnp.asarray([4.0]))
+        >>> float(metric.compute())
+        1.0
+    """
 
     higher_is_better = False
 
@@ -108,7 +128,17 @@ class MinMetric(BaseAggregator):
 
 
 class SumMetric(BaseAggregator):
-    """Running sum. Parity: reference ``aggregation.py:324``."""
+    """Running sum. Parity: reference ``aggregation.py:324``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import SumMetric
+        >>> metric = SumMetric()
+        >>> metric.update(jnp.asarray([1.0, 2.0, 3.0]))
+        >>> metric.update(jnp.asarray([4.0]))
+        >>> float(metric.compute())
+        10.0
+    """
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("sum", jnp.asarray(0.0, dtype=jnp.float32), nan_strategy, **kwargs)
@@ -124,6 +154,15 @@ class CatMetric(BaseAggregator):
 
     With nan_strategy ignore/warn the update filters values (data-dependent
     shape) and therefore runs eagerly, not under jit.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import CatMetric
+        >>> metric = CatMetric()
+        >>> metric.update(jnp.asarray([1.0, 2.0, 3.0]))
+        >>> metric.update(jnp.asarray([4.0]))
+        >>> metric.compute().tolist()
+        [1.0, 2.0, 3.0, 4.0]
     """
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
@@ -139,7 +178,7 @@ class CatMetric(BaseAggregator):
             self.value.append(value)
 
     def compute(self) -> Array:
-        return dim_zero_cat(self.value) if self.value else jnp.zeros((0,), dtype=jnp.float32)
+        return cat_state_or_empty(self.value)
 
 
 class MeanMetric(BaseAggregator):
@@ -191,6 +230,15 @@ class RunningMean(BaseAggregator):
 
     Parity: reference ``aggregation.py:616``. Window cropping is host-side
     list management, so this metric runs its update eagerly.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import RunningMean
+        >>> metric = RunningMean()
+        >>> metric.update(jnp.asarray([1.0, 2.0, 3.0]))
+        >>> metric.update(jnp.asarray([4.0]))
+        >>> float(metric.compute())
+        2.5
     """
 
     jittable = False
@@ -210,15 +258,23 @@ class RunningMean(BaseAggregator):
             self.value.pop(0)
 
     def compute(self) -> Array:
-        if not self.value:
-            return jnp.asarray(0.0, dtype=jnp.float32)
-        return jnp.mean(dim_zero_cat(self.value))
+        vals = cat_state_or_empty(self.value)
+        return jnp.mean(vals) if vals.size else jnp.asarray(0.0, dtype=jnp.float32)
 
 
 class RunningSum(RunningMean):
-    """Sum over a sliding window. Parity: reference ``aggregation.py:673``."""
+    """Sum over a sliding window. Parity: reference ``aggregation.py:673``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import RunningSum
+        >>> metric = RunningSum()
+        >>> metric.update(jnp.asarray([1.0, 2.0, 3.0]))
+        >>> metric.update(jnp.asarray([4.0]))
+        >>> float(metric.compute())
+        10.0
+    """
 
     def compute(self) -> Array:
-        if not self.value:
-            return jnp.asarray(0.0, dtype=jnp.float32)
-        return jnp.sum(dim_zero_cat(self.value))
+        vals = cat_state_or_empty(self.value)
+        return jnp.sum(vals) if vals.size else jnp.asarray(0.0, dtype=jnp.float32)

@@ -13,14 +13,23 @@ from ..functional.text.edit import _edit_distance_single
 from ..functional.text.infolm import _ALLOWED_INFORMATION_MEASURE, infolm
 from ..functional.text.rouge import ALLOWED_ACCUMULATE, ALLOWED_ROUGE_KEYS, _rouge_score_update
 from ..functional.text.squad import PREDS_TYPE, TARGETS_TYPE, _squad_compute, _squad_input_check, _squad_update
-from ..utils.data import dim_zero_cat
+from ..utils.data import cat_state_or_empty, dim_zero_cat
 from .asr import _HostTextMetric
 
 Array = jax.Array
 
 
 class ROUGEScore(_HostTextMetric):
-    """Parity: reference ``text/rouge.py:ROUGEScore`` (236 LoC)."""
+    """Parity: reference ``text/rouge.py:ROUGEScore`` (236 LoC).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import ROUGEScore
+        >>> metric = ROUGEScore()
+        >>> metric.update(["the cat is on the mat"], ["there is a cat on the mat"])
+        >>> round(float(metric.compute()["rouge1_fmeasure"]), 4)
+        0.7692
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -65,8 +74,8 @@ class ROUGEScore(_HostTextMetric):
     def compute(self) -> Dict[str, Array]:
         out: Dict[str, Array] = {}
         for key in self.rouge_keys:
-            vals = getattr(self, f"{key}_triplets")
-            arr = dim_zero_cat(vals) if vals else jnp.zeros((1, 3))
+            vals = cat_state_or_empty(getattr(self, f"{key}_triplets")).reshape(-1, 3)
+            arr = vals if vals.size else jnp.zeros((1, 3))
             out[f"{key}_precision"] = jnp.mean(arr[:, 0])
             out[f"{key}_recall"] = jnp.mean(arr[:, 1])
             out[f"{key}_fmeasure"] = jnp.mean(arr[:, 2])
@@ -74,7 +83,16 @@ class ROUGEScore(_HostTextMetric):
 
 
 class EditDistance(_HostTextMetric):
-    """Parity: reference ``text/edit.py:EditDistance``."""
+    """Parity: reference ``text/edit.py:EditDistance``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import EditDistance
+        >>> metric = EditDistance()
+        >>> metric.update(["kitten"], ["sitting"])
+        >>> float(metric.compute())
+        3.0
+    """
 
     is_differentiable = False
     higher_is_better = False
@@ -114,15 +132,26 @@ class EditDistance(_HostTextMetric):
 
     def compute(self) -> Array:
         if self.reduction in ("none", None):
-            return dim_zero_cat(self.values) if self.values else jnp.zeros((0,))
-        arr = dim_zero_cat(self.edit_scores_list) if self.edit_scores_list else jnp.zeros((0,))
+            return cat_state_or_empty(self.values)
+        arr = cat_state_or_empty(self.edit_scores_list)
         if self.reduction == "mean":
             return jnp.mean(arr) if arr.size else jnp.asarray(0.0)
         return jnp.sum(arr)
 
 
 class SQuAD(_HostTextMetric):
-    """Parity: reference ``text/squad.py:SQuAD`` (167 LoC)."""
+    """Parity: reference ``text/squad.py:SQuAD`` (167 LoC).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import SQuAD
+        >>> metric = SQuAD()
+        >>> preds = [{"prediction_text": "1976", "id": "56e10a3be3433e1400422b22"}]
+        >>> target = [{"answers": {"answer_start": [97], "text": ["1976"]}, "id": "56e10a3be3433e1400422b22"}]
+        >>> metric.update(preds, target)
+        >>> {k: float(v) for k, v in sorted(metric.compute().items())}
+        {'exact_match': 100.0, 'f1': 100.0}
+    """
 
     is_differentiable = False
     higher_is_better = True

@@ -26,6 +26,19 @@ def dim_zero_cat(x: Union[Array, List[Array], tuple]) -> Array:
     return jnp.asarray(x)
 
 
+def cat_state_or_empty(x: Union[Array, List[Array], tuple], dtype=jnp.float32) -> Array:
+    """``dim_zero_cat`` for list states that may already be synced.
+
+    A sync backend replaces a list state with the pre-concatenated gathered
+    array (metric.py sync protocol); compute() paths that would test the
+    list's truthiness must handle both forms. Empty lists yield an empty
+    array instead of raising.
+    """
+    if not isinstance(x, (list, tuple)):
+        return jnp.asarray(x)
+    return dim_zero_cat(x) if len(x) else jnp.zeros((0,), dtype=dtype)
+
+
 def dim_zero_sum(x: Array) -> Array:
     return jnp.sum(dim_zero_cat(x) if isinstance(x, (list, tuple)) else x, axis=0)
 
