@@ -1,0 +1,82 @@
+"""Plotting examples: single values, value histories, confusion matrices,
+and ROC / PR curves (parity: reference ``examples/plotting.py``).
+
+Run:  python examples/plotting.py [out_dir]
+Writes PNGs instead of showing windows, so it works headless.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # in-repo run
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+import numpy as np  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
+
+from torchmetrics_tpu import Accuracy, MeanSquaredError, MetricTracker  # noqa: E402
+from torchmetrics_tpu.classification import (  # noqa: E402
+    BinaryROC,
+    MulticlassAccuracy,
+    MulticlassConfusionMatrix,
+    MulticlassPrecisionRecallCurve,
+)
+from torchmetrics_tpu.wrappers import ClasswiseWrapper  # noqa: E402
+
+OUT = sys.argv[1] if len(sys.argv) > 1 else "plots"
+os.makedirs(OUT, exist_ok=True)
+rng = np.random.RandomState(42)
+
+
+def save(fig, name):
+    path = os.path.join(OUT, name)
+    fig.savefig(path, dpi=100, bbox_inches="tight")
+    plt.close(fig)
+    print("wrote", path)
+
+
+# 1. single scalar value
+acc = Accuracy(task="multiclass", num_classes=5)
+acc.update(jnp.asarray(rng.rand(64, 5).astype(np.float32)), jnp.asarray(rng.randint(0, 5, 64)))
+fig, _ = acc.plot()
+save(fig, "accuracy_single.png")
+
+# 2. value history across epochs via MetricTracker
+tracker = MetricTracker(MeanSquaredError())
+for epoch in range(5):
+    tracker.increment()
+    noise = 1.0 / (epoch + 1)
+    preds = jnp.asarray(rng.randn(32).astype(np.float32)) * noise
+    tracker.update(preds, jnp.zeros(32))
+fig, _ = tracker._base_metric.plot(tracker.compute_all())
+save(fig, "mse_history.png")
+
+# 3. per-class values through ClasswiseWrapper
+cw = ClasswiseWrapper(MulticlassAccuracy(num_classes=5, average="none"))
+cw.update(jnp.asarray(rng.rand(128, 5).astype(np.float32)), jnp.asarray(rng.randint(0, 5, 128)))
+fig, _ = cw.plot()
+save(fig, "classwise_accuracy.png")
+
+# 4. confusion matrix heatmap
+cm = MulticlassConfusionMatrix(num_classes=4)
+cm.update(jnp.asarray(rng.rand(256, 4).astype(np.float32)), jnp.asarray(rng.randint(0, 4, 256)))
+fig, _ = cm.plot(add_text=True)
+save(fig, "confusion_matrix.png")
+
+# 5. ROC + PR curves
+scores = jnp.asarray(rng.rand(256).astype(np.float32))
+labels = jnp.asarray((np.asarray(scores) + rng.randn(256) * 0.3 > 0.5).astype(np.int32))
+roc = BinaryROC()
+roc.update(scores, labels)
+fig, _ = roc.plot()
+save(fig, "binary_roc.png")
+
+prc = MulticlassPrecisionRecallCurve(num_classes=4, thresholds=32)
+prc.update(jnp.asarray(rng.rand(256, 4).astype(np.float32)), jnp.asarray(rng.randint(0, 4, 256)))
+fig, _ = prc.plot()
+save(fig, "multiclass_pr_curve.png")
+
+print("done")

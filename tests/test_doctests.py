@@ -1,44 +1,39 @@
-"""Docstring examples as API tests (reference test strategy §4: doctests run
-over ``src/`` as part of the suite, ``Makefile:26``)."""
+"""Docstring examples as API tests.
+
+Parity: the reference runs doctests over the whole of ``src/``
+(``/root/reference/Makefile:26``). Here every module under
+``torchmetrics_tpu`` is auto-discovered and its examples executed; a global
+floor on the number of attempted examples guards against silently losing
+coverage. Classes whose examples need unavailable pretrained networks
+(BERTScore, CLIP*, FID-family, LPIPS, PPL, InfoLM) carry no examples —
+the reference skips those via ``__doctest_skip__`` for the same reason.
+"""
 import doctest
+import importlib
+import pkgutil
 
 import pytest
 
-import torchmetrics_tpu.aggregation
-import torchmetrics_tpu.audio.metrics
-import torchmetrics_tpu.classification.accuracy
-import torchmetrics_tpu.classification.auroc
-import torchmetrics_tpu.classification.confusion_matrix
-import torchmetrics_tpu.classification.f_beta
-import torchmetrics_tpu.collections
-import torchmetrics_tpu.image.psnr
-import torchmetrics_tpu.nominal.metrics
-import torchmetrics_tpu.regression.mse
-import torchmetrics_tpu.regression.pearson
-import torchmetrics_tpu.retrieval.metrics
-import torchmetrics_tpu.text.perplexity
-import torchmetrics_tpu.wrappers.tracker
+import torchmetrics_tpu
 
-MODULES = [
-    torchmetrics_tpu.aggregation,
-    torchmetrics_tpu.audio.metrics,
-    torchmetrics_tpu.classification.accuracy,
-    torchmetrics_tpu.classification.auroc,
-    torchmetrics_tpu.classification.confusion_matrix,
-    torchmetrics_tpu.classification.f_beta,
-    torchmetrics_tpu.collections,
-    torchmetrics_tpu.image.psnr,
-    torchmetrics_tpu.nominal.metrics,
-    torchmetrics_tpu.regression.mse,
-    torchmetrics_tpu.regression.pearson,
-    torchmetrics_tpu.retrieval.metrics,
-    torchmetrics_tpu.text.perplexity,
-    torchmetrics_tpu.wrappers.tracker,
-]
+MODULES = sorted(
+    name
+    for _, name, _ in pkgutil.walk_packages(torchmetrics_tpu.__path__, prefix="torchmetrics_tpu.")
+    if not name.split(".")[-1].startswith("_")
+)
 
-
-@pytest.mark.parametrize("module", MODULES, ids=lambda m: m.__name__)
-def test_doctests(module):
+@pytest.mark.parametrize("module_name", MODULES)
+def test_doctests(module_name):
+    module = importlib.import_module(module_name)
     results = doctest.testmod(module, verbose=False)
-    assert results.attempted > 0, f"no doctests found in {module.__name__}"
-    assert results.failed == 0
+    assert results.failed == 0, f"{results.failed} doctest failures in {module_name}"
+
+
+def test_doctest_coverage_floor():
+    """The suite must keep executing a substantial example corpus."""
+    total = 0
+    for module_name in MODULES:
+        module = importlib.import_module(module_name)
+        finder = doctest.DocTestFinder(exclude_empty=True)
+        total += sum(len(t.examples) for t in finder.find(module))
+    assert total >= 500, f"doctest corpus shrank to {total} examples"

@@ -1,0 +1,129 @@
+"""plot() smoke suite over representative metrics from every domain.
+
+Parity: reference ``tests/unittests/utilities/test_plot.py`` (~100 metrics
+through ``.plot()``) — here driven by the shared example-input registry:
+every selected metric is built, updated, and plotted (single-value,
+multi-step, and the confusion/curve specializations), asserting a live
+matplotlib figure comes back.
+"""
+import os
+import sys
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "helpers"))
+from example_inputs import CASES  # noqa: E402
+
+from torchmetrics_tpu.classification import (  # noqa: E402
+    BinaryConfusionMatrix,
+    BinaryPrecisionRecallCurve,
+    BinaryROC,
+    MulticlassConfusionMatrix,
+    MulticlassROC,
+)
+
+# value-output metrics across all domains (curve/confusion handled below)
+PLOT_NAMES = [
+    # aggregation
+    "MeanMetric", "SumMetric", "MaxMetric",
+    # classification
+    "Accuracy", "F1Score", "Precision", "Recall", "Specificity", "CohenKappa",
+    "MatthewsCorrCoef", "HammingDistance", "JaccardIndex", "AUROC", "AveragePrecision",
+    "CalibrationError", "HingeLoss", "MultilabelRankingLoss",
+    # regression
+    "MeanSquaredError", "MeanAbsoluteError", "PearsonCorrCoef", "SpearmanCorrCoef",
+    "R2Score", "ExplainedVariance", "KLDivergence", "CosineSimilarity",
+    # image
+    "PeakSignalNoiseRatio", "StructuralSimilarityIndexMeasure", "TotalVariation",
+    "UniversalImageQualityIndex", "SpectralAngleMapper",
+    # audio
+    "SignalNoiseRatio", "ScaleInvariantSignalDistortionRatio",
+    # clustering / nominal
+    "MutualInfoScore", "RandScore", "CramersV", "TheilsU",
+    # retrieval / text
+    "RetrievalMRR", "RetrievalMAP", "Perplexity",
+]
+
+
+def _built_and_updated(name):
+    case = CASES[name]
+    m = case.build(name)
+    for call in case.make_inputs(np.random.RandomState(0), 8):
+        m.update(*call)
+    return m
+
+
+@pytest.mark.parametrize("name", PLOT_NAMES)
+def test_plot_single_value(name):
+    m = _built_and_updated(name)
+    fig, ax = m.plot()
+    assert fig is not None and ax is not None
+    plt.close(fig)
+
+
+@pytest.mark.parametrize("name", ["Accuracy", "MeanSquaredError", "RetrievalMRR"])
+def test_plot_multiple_values(name):
+    m = _built_and_updated(name)
+    vals = [m.compute(), m.compute() * 0.5, m.compute() * 0.25]
+    fig, ax = m.plot(vals)
+    assert fig is not None
+    plt.close(fig)
+
+
+def test_plot_classwise_dict():
+    case = CASES["Accuracy"]
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+    from torchmetrics_tpu.wrappers import ClasswiseWrapper
+
+    m = ClasswiseWrapper(MulticlassAccuracy(num_classes=5, average="none"))
+    p, t = case.make_inputs(np.random.RandomState(0), 16)[0]
+    m.update(p, t)
+    fig, _ = m.plot()
+    assert fig is not None
+    plt.close(fig)
+
+
+def test_plot_confusion_matrix():
+    rng = np.random.RandomState(0)
+    for m, args in [
+        (BinaryConfusionMatrix(), (jnp.asarray(rng.rand(32).astype(np.float32)), jnp.asarray(rng.randint(0, 2, 32)))),
+        (MulticlassConfusionMatrix(num_classes=4),
+         (jnp.asarray(rng.rand(32, 4).astype(np.float32)), jnp.asarray(rng.randint(0, 4, 32)))),
+    ]:
+        m.update(*args)
+        fig, ax = m.plot(add_text=True)
+        assert fig is not None
+        plt.close(fig)
+
+
+def test_plot_curves():
+    rng = np.random.RandomState(0)
+    bp = jnp.asarray(rng.rand(64).astype(np.float32))
+    bt = jnp.asarray(rng.randint(0, 2, 64))
+    for metric in (BinaryROC(), BinaryPrecisionRecallCurve()):
+        metric.update(bp, bt)
+        fig, ax = metric.plot()
+        assert fig is not None
+        plt.close(fig)
+    mc = MulticlassROC(num_classes=4)
+    mc.update(jnp.asarray(rng.rand(64, 4).astype(np.float32)), jnp.asarray(rng.randint(0, 4, 64)))
+    fig, _ = mc.plot()
+    assert fig is not None
+    plt.close(fig)
+
+
+def test_plot_respects_bounds_and_ax():
+    m = _built_and_updated("Accuracy")
+    fig, ax = plt.subplots()
+    fig2, ax2 = m.plot(ax=ax)
+    assert ax2 is ax and fig2 is fig
+    lo, hi = ax.get_ylim()
+    assert 0.0 >= lo - 1e-6 and hi <= 1.0 + 1e-6  # plot_lower/upper_bound applied
+    plt.close(fig)

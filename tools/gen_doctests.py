@@ -1,0 +1,601 @@
+"""Generate runnable Example blocks for metric class docstrings.
+
+For every class in CLASS_SNIPPETS that lacks a ``>>>`` example, run its
+snippet in a mini-REPL (each line compiled in 'single' mode so expression
+values print exactly as doctest expects), capture the real outputs, and
+insert an ``Example:`` section at the end of the class docstring in the
+source file. Deterministic inputs only — no RNG — so the captured outputs
+are stable across runs and platforms (doctests run on CPU via conftest).
+
+Run from the repo root:  python tools/gen_doctests.py [--check]
+"""
+import contextlib
+import io
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# force CPU before any jax backend init (an accelerator plugin can override
+# the env var, so the config update is required too)
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+PRELUDE = [
+    "import jax.numpy as jnp",
+]
+
+# ---------------------------------------------------------------- templates
+
+def agg(name, final):
+    return [
+        f"from torchmetrics_tpu import {name}",
+        f"metric = {name}()",
+        "metric.update(jnp.asarray([1.0, 2.0, 3.0]))",
+        "metric.update(jnp.asarray([4.0]))",
+        final,
+    ]
+
+
+def mc(name, ctor, final="round(float(metric.compute()), 4)"):
+    return [
+        f"from torchmetrics_tpu import {name}",
+        f"metric = {name}({ctor})",
+        "preds = jnp.asarray([[0.9, 0.05, 0.05], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.6, 0.1]])",
+        "target = jnp.asarray([0, 1, 2, 0])",
+        "metric.update(preds, target)",
+        final,
+    ]
+
+
+def binary(name, ctor, final="round(float(metric.compute()), 4)"):
+    return [
+        f"from torchmetrics_tpu import {name}",
+        f"metric = {name}({ctor})",
+        "preds = jnp.asarray([0.1, 0.8, 0.6, 0.3, 0.9, 0.4])",
+        "target = jnp.asarray([0, 1, 1, 0, 1, 0])",
+        "metric.update(preds, target)",
+        final,
+    ]
+
+
+def ml(name, ctor="num_labels=3", final="round(float(metric.compute()), 4)"):
+    return [
+        f"from torchmetrics_tpu import {name}",
+        f"metric = {name}({ctor})",
+        "preds = jnp.asarray([[0.9, 0.1, 0.6], [0.2, 0.8, 0.3], [0.7, 0.4, 0.9]])",
+        "target = jnp.asarray([[1, 0, 1], [0, 1, 0], [1, 0, 1]])",
+        "metric.update(preds, target)",
+        final,
+    ]
+
+
+def reg(name, ctor="", final="round(float(metric.compute()), 4)", positive=False):
+    p = "[0.5, 1.5, 2.5, 4.0]" if positive else "[0.5, -1.5, 2.5, -4.0]"
+    t = "[0.8, 1.0, 3.0, 3.5]" if positive else "[0.8, -1.0, 3.0, -3.5]"
+    return [
+        f"from torchmetrics_tpu import {name}",
+        f"metric = {name}({ctor})",
+        f"metric.update(jnp.asarray({p}), jnp.asarray({t}))",
+        final,
+    ]
+
+
+def img(name, ctor="", size=16, channels=3, pair=True, final="round(float(metric.compute()), 4)"):
+    lines = [
+        f"from torchmetrics_tpu import {name}",
+        f"metric = {name}({ctor})",
+        f"preds = jnp.tile(jnp.linspace(0.1, 0.9, {size}), (2, {channels}, {size}, 1))",
+    ]
+    if pair:
+        lines += [
+            "target = preds * 0.9 + 0.05",
+            "metric.update(preds, target)",
+        ]
+    else:
+        lines += ["metric.update(preds)"]
+    lines.append(final)
+    return lines
+
+
+def audio(name, ctor="", final="round(float(metric.compute()), 4)", t=1600):
+    return [
+        f"from torchmetrics_tpu import {name}",
+        f"metric = {name}({ctor})",
+        f"t = jnp.linspace(0.0, 100.0, {t})",
+        "target = jnp.sin(t)",
+        "preds = target + 0.1 * jnp.cos(3.0 * t)",
+        "metric.update(preds, target)",
+        final,
+    ]
+
+
+def cluster_ex(name):
+    return [
+        f"from torchmetrics_tpu import {name}",
+        f"metric = {name}()",
+        "metric.update(jnp.asarray([0, 0, 1, 1, 2, 2]), jnp.asarray([1, 1, 0, 0, 2, 2]))",
+        "round(float(metric.compute()), 4)",
+    ]
+
+
+def cluster_in(name):
+    return [
+        f"from torchmetrics_tpu import {name}",
+        f"metric = {name}()",
+        "data = jnp.asarray([[0.0, 0.0], [0.1, 0.2], [2.0, 2.0], [2.1, 1.9], [4.0, 4.1], [3.9, 4.0]])",
+        "labels = jnp.asarray([0, 0, 1, 1, 2, 2])",
+        "metric.update(data, labels)",
+        "round(float(metric.compute()), 4)",
+    ]
+
+
+def nominal(name, ctor=""):
+    return [
+        f"from torchmetrics_tpu import {name}",
+        f"metric = {name}({ctor})",
+        "metric.update(jnp.asarray([0, 1, 2, 0, 1, 2, 0, 1]), jnp.asarray([0, 1, 2, 1, 1, 2, 0, 0]))",
+        "round(float(metric.compute()), 4)",
+    ]
+
+
+def retrieval(name, ctor="", final="round(float(metric.compute()), 4)"):
+    return [
+        f"from torchmetrics_tpu import {name}",
+        f"metric = {name}({ctor})",
+        "preds = jnp.asarray([0.9, 0.3, 0.6, 0.1, 0.8, 0.5])",
+        "target = jnp.asarray([1, 0, 1, 0, 0, 1])",
+        "indexes = jnp.asarray([0, 0, 0, 1, 1, 1])",
+        "metric.update(preds, target, indexes=indexes)",
+        final,
+    ]
+
+
+def text_pair(name, ctor="", final="round(float(metric.compute()), 4)"):
+    return [
+        f"from torchmetrics_tpu import {name}",
+        f"metric = {name}({ctor})",
+        'metric.update(["the cat sat on the mat"], ["the cat sat on a mat"])',
+        final,
+    ]
+
+
+def text_corpus(name, ctor="", final="round(float(metric.compute()), 4)"):
+    return [
+        f"from torchmetrics_tpu import {name}",
+        f"metric = {name}({ctor})",
+        'metric.update(["the cat is on the mat"], [["there is a cat on the mat", "the cat is on the mat"]])',
+        final,
+    ]
+
+
+def boxes_iou(name):
+    return [
+        f"from torchmetrics_tpu import {name}",
+        f"metric = {name}()",
+        'preds = [{"boxes": jnp.asarray([[10.0, 10.0, 60.0, 60.0]]), "scores": jnp.asarray([0.9]), "labels": jnp.asarray([0])}]',
+        'target = [{"boxes": jnp.asarray([[12.0, ip_y := 8.0, 58.0, 62.0]]), "labels": jnp.asarray([0])}]',
+        "metric.update(preds, target)",
+        'round(float(metric.compute()["iou"]), 4)' if name == "IntersectionOverUnion" else
+        f'round(float(metric.compute()["{_iou_key(name)}"]), 4)',
+    ]
+
+
+def _iou_key(name):
+    return {
+        "IntersectionOverUnion": "iou",
+        "GeneralizedIntersectionOverUnion": "giou",
+        "DistanceIntersectionOverUnion": "diou",
+        "CompleteIntersectionOverUnion": "ciou",
+    }[name]
+
+
+CLASS_SNIPPETS = {}
+
+for n, fin in [
+    ("SumMetric", "float(metric.compute())"),
+    ("MeanMetric", "float(metric.compute())"),
+    ("MaxMetric", "float(metric.compute())"),
+    ("MinMetric", "float(metric.compute())"),
+    ("CatMetric", "metric.compute().tolist()"),
+    ("RunningMean", "float(metric.compute())"),
+    ("RunningSum", "float(metric.compute())"),
+]:
+    CLASS_SNIPPETS[n] = agg(n, fin)
+
+MC3 = 'task="multiclass", num_classes=3'
+for n, ctor in [
+    ("Accuracy", MC3), ("Precision", MC3), ("Recall", MC3),
+    ("F1Score", MC3), ("FBetaScore", MC3 + ", beta=0.5"), ("Specificity", MC3),
+    ("CohenKappa", MC3), ("MatthewsCorrCoef", MC3), ("JaccardIndex", MC3),
+    ("HammingDistance", MC3), ("CalibrationError", MC3), ("AUROC", MC3),
+    ("AveragePrecision", MC3), ("HingeLoss", MC3),
+]:
+    CLASS_SNIPPETS[n] = mc(n, ctor)
+CLASS_SNIPPETS["Dice"] = mc("Dice", "num_classes=3")
+CLASS_SNIPPETS["StatScores"] = mc("StatScores", MC3, final="metric.compute().tolist()")
+CLASS_SNIPPETS["ConfusionMatrix"] = mc("ConfusionMatrix", MC3, final="metric.compute().tolist()")
+CLASS_SNIPPETS["ROC"] = binary(
+    "ROC", 'task="binary", thresholds=5',
+    final="[[round(float(x), 4) for x in v] for v in metric.compute()]",
+)
+CLASS_SNIPPETS["PrecisionRecallCurve"] = binary(
+    "PrecisionRecallCurve", 'task="binary", thresholds=5',
+    final="[[round(float(x), 4) for x in v] for v in metric.compute()]",
+)
+for n, kw in [
+    ("PrecisionAtFixedRecall", "min_recall=0.5"),
+    ("RecallAtFixedPrecision", "min_precision=0.5"),
+    ("SensitivityAtSpecificity", "min_specificity=0.5"),
+    ("SpecificityAtSensitivity", "min_sensitivity=0.5"),
+]:
+    CLASS_SNIPPETS[n] = binary(
+        n, f'task="binary", {kw}',
+        final="tuple(round(float(v), 4) for v in metric.compute())",
+    )
+CLASS_SNIPPETS["ExactMatch"] = [
+    "from torchmetrics_tpu import ExactMatch",
+    'metric = ExactMatch(task="multiclass", num_classes=3)',
+    "preds = jnp.asarray([[0, 1, 2], [2, 1, 0]])",
+    "target = jnp.asarray([[0, 1, 2], [2, 1, 1]])",
+    "metric.update(preds, target)",
+    "round(float(metric.compute()), 4)",
+]
+CLASS_SNIPPETS["BinaryFairness"] = [
+    "from torchmetrics_tpu import BinaryFairness",
+    "metric = BinaryFairness(num_groups=2)",
+    "preds = jnp.asarray([0.9, 0.2, 0.8, 0.3, 0.6, 0.7])",
+    "target = jnp.asarray([1, 0, 1, 0, 1, 1])",
+    "groups = jnp.asarray([0, 0, 0, 1, 1, 1])",
+    "metric.update(preds, target, groups)",
+    "{k: round(float(v), 4) for k, v in sorted(metric.compute().items())}",
+]
+CLASS_SNIPPETS["BinaryGroupStatRates"] = [
+    "from torchmetrics_tpu import BinaryGroupStatRates",
+    "metric = BinaryGroupStatRates(num_groups=2)",
+    "preds = jnp.asarray([0.9, 0.2, 0.8, 0.3, 0.6, 0.7])",
+    "target = jnp.asarray([1, 0, 1, 0, 1, 1])",
+    "groups = jnp.asarray([0, 0, 0, 1, 1, 1])",
+    "metric.update(preds, target, groups)",
+    "{k: [round(float(x), 4) for x in v] for k, v in sorted(metric.compute().items())}",
+]
+for n in ["MultilabelCoverageError", "MultilabelRankingAveragePrecision", "MultilabelRankingLoss"]:
+    CLASS_SNIPPETS[n] = ml(n)
+
+for n, ctor, positive in [
+    ("MeanAbsoluteError", "", False), ("MeanSquaredLogError", "", True),
+    ("LogCoshError", "", False), ("MeanAbsolutePercentageError", "", True),
+    ("SymmetricMeanAbsolutePercentageError", "", True),
+    ("WeightedMeanAbsolutePercentageError", "", True),
+    ("ConcordanceCorrCoef", "", False), ("ExplainedVariance", "", False),
+    ("R2Score", "", False), ("SpearmanCorrCoef", "", False),
+    ("KendallRankCorrCoef", "", False), ("RelativeSquaredError", "", False),
+    ("TweedieDevianceScore", "power=1.5", True), ("CriticalSuccessIndex", "threshold=1.0", True),
+    ("MinkowskiDistance", "p=3.0", False),
+]:
+    CLASS_SNIPPETS[n] = reg(n, ctor, positive=positive)
+CLASS_SNIPPETS["KLDivergence"] = [
+    "from torchmetrics_tpu import KLDivergence",
+    "metric = KLDivergence()",
+    "p = jnp.asarray([[0.2, 0.3, 0.5], [0.1, 0.6, 0.3]])",
+    "q = jnp.asarray([[0.3, 0.3, 0.4], [0.2, 0.5, 0.3]])",
+    "metric.update(p, q)",
+    "round(float(metric.compute()), 4)",
+]
+CLASS_SNIPPETS["CosineSimilarity"] = [
+    "from torchmetrics_tpu import CosineSimilarity",
+    "metric = CosineSimilarity()",
+    "metric.update(jnp.asarray([[1.0, 2.0, 3.0]]), jnp.asarray([[1.0, 2.0, 2.0]]))",
+    "round(float(metric.compute()), 4)",
+]
+
+for n, kw in [
+    ("ErrorRelativeGlobalDimensionlessSynthesis", {}),
+    ("RelativeAverageSpectralError", {}),
+    ("RootMeanSquaredErrorUsingSlidingWindow", {}),
+    ("SpectralAngleMapper", {}),
+    ("SpectralDistortionIndex", {}),
+    ("UniversalImageQualityIndex", {}),
+    ("StructuralSimilarityIndexMeasure", {}),
+]:
+    CLASS_SNIPPETS[n] = img(n, **kw)
+# SCC needs real 2-D high-frequency content: on a linear ramp the laplacian
+# response is ~0 and the score would be platform-dependent conv noise
+CLASS_SNIPPETS["SpatialCorrelationCoefficient"] = [
+    "from torchmetrics_tpu import SpatialCorrelationCoefficient",
+    "metric = SpatialCorrelationCoefficient()",
+    "wave = jnp.sin(jnp.linspace(0.0, 9.0, 24))",
+    "preds = jnp.tile(wave[:, None] * wave[None, :], (2, 3, 1, 1)) * 0.4 + 0.5",
+    "target = preds * 0.9 + 0.03",
+    "metric.update(preds, target)",
+    "round(float(metric.compute()), 4)",
+]
+CLASS_SNIPPETS["MultiScaleStructuralSimilarityIndexMeasure"] = img(
+    "MultiScaleStructuralSimilarityIndexMeasure", ctor="kernel_size=3", size=48)
+CLASS_SNIPPETS["VisualInformationFidelity"] = img("VisualInformationFidelity", size=48)
+CLASS_SNIPPETS["PeakSignalNoiseRatioWithBlockedEffect"] = img(
+    "PeakSignalNoiseRatioWithBlockedEffect", size=16, channels=1)
+CLASS_SNIPPETS["TotalVariation"] = img("TotalVariation", pair=False)
+for n in ["SpatialDistortionIndex", "QualityWithNoReference"]:
+    # ms must be >= 16x16: UQI's 11x11 window needs that much support, and
+    # window_size=7 must stay below the ms dims (reference d_s.py:175)
+    CLASS_SNIPPETS[n] = [
+        f"from torchmetrics_tpu import {n}",
+        f"metric = {n}()",
+        "preds = jnp.tile(jnp.sin(jnp.linspace(0.0, 6.0, 32)) * 0.4 + 0.5, (1, 3, 32, 1))",
+        "ms = jnp.tile(jnp.sin(jnp.linspace(0.0, 6.0, 16)) * 0.4 + 0.5, (1, 3, 16, 1))",
+        "pan = preds * 0.95",
+        'metric.update(preds, {"ms": ms, "pan": pan})',
+        "round(float(metric.compute()), 4)",
+    ]
+
+for n in ["SignalNoiseRatio", "ScaleInvariantSignalNoiseRatio",
+          "ScaleInvariantSignalDistortionRatio", "SignalDistortionRatio"]:
+    CLASS_SNIPPETS[n] = audio(n)
+CLASS_SNIPPETS["SourceAggregatedSignalDistortionRatio"] = [
+    "from torchmetrics_tpu import SourceAggregatedSignalDistortionRatio",
+    "metric = SourceAggregatedSignalDistortionRatio()",
+    "t = jnp.linspace(0.0, 100.0, 800)",
+    "target = jnp.stack([jnp.sin(t), jnp.cos(t)])[None]",
+    "preds = target + 0.1",
+    "metric.update(preds, target)",
+    "round(float(metric.compute()), 4)",
+]
+CLASS_SNIPPETS["ComplexScaleInvariantSignalNoiseRatio"] = [
+    "from torchmetrics_tpu import ComplexScaleInvariantSignalNoiseRatio",
+    "metric = ComplexScaleInvariantSignalNoiseRatio()",
+    "t = jnp.linspace(0.0, 6.0, 65 * 10 * 2)",
+    "target = jnp.sin(t).reshape(1, 65, 10, 2)",
+    "preds = target * 0.8 + 0.05",
+    "metric.update(preds, target)",
+    "round(float(metric.compute()), 4)",
+]
+CLASS_SNIPPETS["PermutationInvariantTraining"] = [
+    "from torchmetrics_tpu import PermutationInvariantTraining",
+    "from torchmetrics_tpu.functional.audio import scale_invariant_signal_noise_ratio",
+    "metric = PermutationInvariantTraining(scale_invariant_signal_noise_ratio)",
+    "t = jnp.linspace(0.0, 100.0, 400)",
+    "target = jnp.stack([jnp.sin(t), jnp.cos(t)])[None]",
+    "preds = target[:, ::-1, :] + 0.05",
+    "metric.update(preds, target)",
+    "round(float(metric.compute()), 4)",
+]
+CLASS_SNIPPETS["PerceptualEvaluationSpeechQuality"] = audio(
+    "PerceptualEvaluationSpeechQuality", ctor='fs=8000, mode="nb", implementation="native"', t=4096)
+CLASS_SNIPPETS["ShortTimeObjectiveIntelligibility"] = audio(
+    "ShortTimeObjectiveIntelligibility", ctor="fs=8000", t=4096)
+CLASS_SNIPPETS["SpeechReverberationModulationEnergyRatio"] = [
+    "from torchmetrics_tpu import SpeechReverberationModulationEnergyRatio",
+    "metric = SpeechReverberationModulationEnergyRatio(fs=8000)",
+    "t = jnp.linspace(0.0, 400.0, 4096)",
+    "metric.update(jnp.sin(t) * (1 + 0.5 * jnp.sin(0.05 * t)))",
+    "round(float(metric.compute()), 4)",
+]
+
+for n in ["AdjustedMutualInfoScore", "AdjustedRandScore", "CompletenessScore",
+          "FowlkesMallowsIndex", "HomogeneityScore", "MutualInfoScore",
+          "NormalizedMutualInfoScore", "RandScore", "VMeasureScore"]:
+    CLASS_SNIPPETS[n] = cluster_ex(n)
+for n in ["CalinskiHarabaszScore", "DaviesBouldinScore", "DunnIndex"]:
+    CLASS_SNIPPETS[n] = cluster_in(n)
+
+for n in ["PearsonsContingencyCoefficient", "TheilsU", "TschuprowsT"]:
+    CLASS_SNIPPETS[n] = nominal(n, "num_classes=3")
+CLASS_SNIPPETS["FleissKappa"] = [
+    "from torchmetrics_tpu import FleissKappa",
+    'metric = FleissKappa(mode="counts")',
+    "ratings = jnp.asarray([[3, 1], [2, 2], [4, 0], [1, 3], [0, 4]])",
+    "metric.update(ratings)",
+    "round(float(metric.compute()), 4)",
+]
+
+for n, ctor in [
+    ("RetrievalAUROC", ""), ("RetrievalFallOut", ""), ("RetrievalHitRate", ""),
+    ("RetrievalMAP", ""), ("RetrievalNormalizedDCG", ""), ("RetrievalPrecision", "top_k=2"),
+    ("RetrievalRPrecision", ""), ("RetrievalRecall", "top_k=2"),
+]:
+    CLASS_SNIPPETS[n] = retrieval(n, ctor)
+CLASS_SNIPPETS["RetrievalPrecisionRecallCurve"] = retrieval(
+    "RetrievalPrecisionRecallCurve", "max_k=2",
+    final="[[round(float(x), 4) for x in v] for v in metric.compute()]",
+)
+CLASS_SNIPPETS["RetrievalRecallAtFixedPrecision"] = retrieval(
+    "RetrievalRecallAtFixedPrecision", "min_precision=0.5",
+    final="tuple(round(float(v), 4) for v in metric.compute())",
+)
+
+for n in ["CharErrorRate", "MatchErrorRate", "WordErrorRate", "WordInfoLost",
+          "WordInfoPreserved", "TranslationEditRate", "ExtendedEditDistance", "CHRFScore"]:
+    CLASS_SNIPPETS[n] = text_pair(n)
+CLASS_SNIPPETS["EditDistance"] = [
+    "from torchmetrics_tpu import EditDistance",
+    "metric = EditDistance()",
+    'metric.update(["kitten"], ["sitting"])',
+    "float(metric.compute())",
+]
+for n in ["BLEUScore", "SacreBLEUScore"]:
+    CLASS_SNIPPETS[n] = text_corpus(n)
+CLASS_SNIPPETS["ROUGEScore"] = [
+    "from torchmetrics_tpu import ROUGEScore",
+    "metric = ROUGEScore()",
+    'metric.update(["the cat is on the mat"], ["there is a cat on the mat"])',
+    'round(float(metric.compute()["rouge1_fmeasure"]), 4)',
+]
+CLASS_SNIPPETS["SQuAD"] = [
+    "from torchmetrics_tpu import SQuAD",
+    "metric = SQuAD()",
+    'preds = [{"prediction_text": "1976", "id": "56e10a3be3433e1400422b22"}]',
+    'target = [{"answers": {"answer_start": [97], "text": ["1976"]}, "id": "56e10a3be3433e1400422b22"}]',
+    "metric.update(preds, target)",
+    "{k: float(v) for k, v in sorted(metric.compute().items())}",
+]
+CLASS_SNIPPETS["Perplexity"] = [
+    "from torchmetrics_tpu import Perplexity",
+    "metric = Perplexity()",
+    "logits = jnp.log(jnp.asarray([[[0.7, 0.2, 0.1], [0.2, 0.7, 0.1]]]))",
+    "tokens = jnp.asarray([[0, 1]])",
+    "metric.update(logits, tokens)",
+    "round(float(metric.compute()), 4)",
+]
+
+for n in ["IntersectionOverUnion", "GeneralizedIntersectionOverUnion",
+          "DistanceIntersectionOverUnion", "CompleteIntersectionOverUnion"]:
+    CLASS_SNIPPETS[n] = [
+        f"from torchmetrics_tpu import {n}",
+        f"metric = {n}()",
+        'preds = [{"boxes": jnp.asarray([[10.0, 10.0, 60.0, 60.0]]), "scores": jnp.asarray([0.9]), "labels": jnp.asarray([0])}]',
+        'target = [{"boxes": jnp.asarray([[12.0, 8.0, 58.0, 62.0]]), "labels": jnp.asarray([0])}]',
+        "metric.update(preds, target)",
+        f'round(float(metric.compute()["{_iou_key(n)}"]), 4)',
+    ]
+CLASS_SNIPPETS["MeanAveragePrecision"] = [
+    "from torchmetrics_tpu import MeanAveragePrecision",
+    "metric = MeanAveragePrecision()",
+    'preds = [{"boxes": jnp.asarray([[10.0, 10.0, 60.0, 60.0]]), "scores": jnp.asarray([0.9]), "labels": jnp.asarray([0])}]',
+    'target = [{"boxes": jnp.asarray([[10.0, 10.0, 60.0, 60.0]]), "labels": jnp.asarray([0])}]',
+    "metric.update(preds, target)",
+    'round(float(metric.compute()["map"]), 4)',
+]
+for n in ["PanopticQuality", "ModifiedPanopticQuality"]:
+    CLASS_SNIPPETS[n] = [
+        f"from torchmetrics_tpu import {n}",
+        f"metric = {n}(things={{0}}, stuffs={{1}})",
+        "img = jnp.asarray([[[0, 0], [0, 0], [1, 0]], [[0, 0], [1, 0], [1, 0]]])",
+        "metric.update(img[None], img[None])",
+        "round(float(metric.compute()), 4)",
+    ]
+
+CLASS_SNIPPETS["MinMaxMetric"] = [
+    "from torchmetrics_tpu import MeanSquaredError, MinMaxMetric",
+    "metric = MinMaxMetric(MeanSquaredError())",
+    "_ = metric(jnp.asarray([1.0, 2.0]), jnp.asarray([1.0, 3.0]))",
+    "_ = metric(jnp.asarray([1.0, 2.0]), jnp.asarray([1.0, 2.0]))",
+    "{k: round(float(v), 4) for k, v in sorted(metric.compute().items())}",
+]
+CLASS_SNIPPETS["MultioutputWrapper"] = [
+    "from torchmetrics_tpu import MeanSquaredError, MultioutputWrapper",
+    "metric = MultioutputWrapper(MeanSquaredError(), num_outputs=2)",
+    "metric.update(jnp.asarray([[1.0, 5.0], [2.0, 6.0]]), jnp.asarray([[1.0, 4.0], [2.0, 8.0]]))",
+    "jnp.round(metric.compute(), 4).tolist()",
+]
+CLASS_SNIPPETS["MultitaskWrapper"] = [
+    "from torchmetrics_tpu import MeanSquaredError, MultitaskWrapper",
+    "from torchmetrics_tpu.classification import BinaryAccuracy",
+    'metric = MultitaskWrapper({"reg": MeanSquaredError(), "cls": BinaryAccuracy()})',
+    'preds = {"reg": jnp.asarray([1.0, 2.0]), "cls": jnp.asarray([0.9, 0.2])}',
+    'target = {"reg": jnp.asarray([1.0, 3.0]), "cls": jnp.asarray([1, 0])}',
+    "metric.update(preds, target)",
+    "{k: round(float(v), 4) for k, v in sorted(metric.compute().items())}",
+]
+CLASS_SNIPPETS["Running"] = [
+    "from torchmetrics_tpu import Running, SumMetric",
+    "metric = Running(SumMetric(), window=2)",
+    "_ = metric(jnp.asarray([1.0]))",
+    "_ = metric(jnp.asarray([2.0]))",
+    "_ = metric(jnp.asarray([3.0]))",
+    "float(metric.compute())",
+]
+CLASS_SNIPPETS["ClasswiseWrapper"] = [
+    "from torchmetrics_tpu import ClasswiseWrapper",
+    "from torchmetrics_tpu.classification import MulticlassAccuracy",
+    'metric = ClasswiseWrapper(MulticlassAccuracy(num_classes=3, average="none"))',
+    "metric.update(jnp.asarray([[0.8, 0.1, 0.1], [0.2, 0.7, 0.1]]), jnp.asarray([0, 2]))",
+    "{k: round(float(v), 4) for k, v in sorted(metric.compute().items())}",
+]
+
+
+# ------------------------------------------------------------------ engine
+
+def run_snippet(lines):
+    """Execute lines REPL-style; return [(line, output_str), ...]."""
+    ns = {}
+    for line in PRELUDE:
+        exec(compile(line, "<doctest-gen>", "exec"), ns)
+    results = []
+    for line in lines:
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            code = compile(line, "<doctest-gen>", "single")
+            exec(code, ns)
+        results.append((line, buf.getvalue()))
+    return results
+
+
+def format_example(results, indent):
+    out = [f"{indent}Example:"]
+    pad = indent + "    "
+    out.append(f"{pad}>>> import jax.numpy as jnp")
+    for line, output in results:
+        out.append(f"{pad}>>> {line}")
+        for ol in output.rstrip("\n").splitlines():
+            out.append(f"{pad}{ol}")
+    return "\n".join(out) + "\n"
+
+
+def insert_example(cls, example_text):
+    import inspect
+
+    path = inspect.getsourcefile(cls)
+    src = open(path).read()
+    pat = re.compile(
+        rf'(class {cls.__name__}\([^)]*\):\n)((?:    plot = .*\n)?)(    """)(.*?)("""\n)', re.S
+    )
+    m = pat.search(src)
+    indent = "    "
+    if m:
+        body = m.group(4)
+        if ">>>" in body:
+            return False, path
+        closing = m.group(5)
+        sep = "\n" if body.endswith("\n") else "\n\n"
+        # keep the closing quotes on their own line after the example
+        new_body = body.rstrip() + "\n\n" + example_text + indent
+        new = src[: m.start()] + m.group(1) + m.group(2) + m.group(3) + new_body + closing + src[m.end():]
+    else:
+        pat2 = re.compile(rf"(class {cls.__name__}\([^)]*\):\n)")
+        m2 = pat2.search(src)
+        if m2:
+            # class without a docstring: add one holding just the example
+            doc = f'    """{cls.__name__}.\n\n{example_text}    """\n'
+            new = src[: m2.end()] + doc + src[m2.end():]
+        else:
+            # factory-made class (e.g. _make_facade): append a __doc__ patch
+            block = example_text.replace('"""', r'\"\"\"')
+            new = (
+                src.rstrip("\n")
+                + f'\n\n{cls.__name__}.__doc__ = ({cls.__name__}.__doc__ or "") + """\n\n{block}"""\n'
+            )
+    open(path, "w").write(new)
+    return True, path
+
+
+def main():
+    import torchmetrics_tpu as M
+
+    changed = []
+    failed = []
+    for name, lines in sorted(CLASS_SNIPPETS.items()):
+        cls = getattr(M, name)
+        if ">>>" in (cls.__doc__ or ""):
+            continue
+        try:
+            results = run_snippet(lines)
+        except Exception as err:  # noqa: BLE001
+            failed.append((name, f"{type(err).__name__}: {err}"))
+            continue
+        example = format_example(results, "    ")
+        ok, path = insert_example(cls, example)
+        if ok:
+            changed.append((name, path))
+    print(f"inserted {len(changed)} examples")
+    for name, err in failed:
+        print(f"FAILED {name}: {err}")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -74,7 +74,19 @@ class SignalNoiseRatio(_MeanAudioMetric):
 
 
 class ScaleInvariantSignalNoiseRatio(_MeanAudioMetric):
-    """Parity: reference ``audio/snr.py:ScaleInvariantSignalNoiseRatio``."""
+    """Parity: reference ``audio/snr.py:ScaleInvariantSignalNoiseRatio``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import ScaleInvariantSignalNoiseRatio
+        >>> metric = ScaleInvariantSignalNoiseRatio()
+        >>> t = jnp.linspace(0.0, 100.0, 1600)
+        >>> target = jnp.sin(t)
+        >>> preds = target + 0.1 * jnp.cos(3.0 * t)
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        20.0177
+    """
 
     is_differentiable = True
     higher_is_better = True
@@ -84,7 +96,19 @@ class ScaleInvariantSignalNoiseRatio(_MeanAudioMetric):
 
 
 class ComplexScaleInvariantSignalNoiseRatio(_MeanAudioMetric):
-    """Parity: reference ``audio/snr.py:ComplexScaleInvariantSignalNoiseRatio``."""
+    """Parity: reference ``audio/snr.py:ComplexScaleInvariantSignalNoiseRatio``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import ComplexScaleInvariantSignalNoiseRatio
+        >>> metric = ComplexScaleInvariantSignalNoiseRatio()
+        >>> t = jnp.linspace(0.0, 6.0, 65 * 10 * 2)
+        >>> target = jnp.sin(t).reshape(1, 65, 10, 2)
+        >>> preds = target * 0.8 + 0.05
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        21.2661
+    """
 
     is_differentiable = True
     higher_is_better = True
@@ -100,7 +124,19 @@ class ComplexScaleInvariantSignalNoiseRatio(_MeanAudioMetric):
 
 
 class SignalDistortionRatio(_MeanAudioMetric):
-    """Parity: reference ``audio/sdr.py:SignalDistortionRatio``."""
+    """Parity: reference ``audio/sdr.py:SignalDistortionRatio``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import SignalDistortionRatio
+        >>> metric = SignalDistortionRatio()
+        >>> t = jnp.linspace(0.0, 100.0, 1600)
+        >>> target = jnp.sin(t)
+        >>> preds = target + 0.1 * jnp.cos(3.0 * t)
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        20.3963
+    """
 
     is_differentiable = True
     higher_is_better = True
@@ -120,7 +156,19 @@ class SignalDistortionRatio(_MeanAudioMetric):
 
 
 class ScaleInvariantSignalDistortionRatio(_MeanAudioMetric):
-    """Parity: reference ``audio/sdr.py:ScaleInvariantSignalDistortionRatio``."""
+    """Parity: reference ``audio/sdr.py:ScaleInvariantSignalDistortionRatio``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import ScaleInvariantSignalDistortionRatio
+        >>> metric = ScaleInvariantSignalDistortionRatio()
+        >>> t = jnp.linspace(0.0, 100.0, 1600)
+        >>> target = jnp.sin(t)
+        >>> preds = target + 0.1 * jnp.cos(3.0 * t)
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        20.0176
+    """
 
     is_differentiable = True
     higher_is_better = True
@@ -134,7 +182,19 @@ class ScaleInvariantSignalDistortionRatio(_MeanAudioMetric):
 
 
 class SourceAggregatedSignalDistortionRatio(_MeanAudioMetric):
-    """Parity: reference ``audio/sdr.py:SourceAggregatedSignalDistortionRatio``."""
+    """Parity: reference ``audio/sdr.py:SourceAggregatedSignalDistortionRatio``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import SourceAggregatedSignalDistortionRatio
+        >>> metric = SourceAggregatedSignalDistortionRatio()
+        >>> t = jnp.linspace(0.0, 100.0, 800)
+        >>> target = jnp.stack([jnp.sin(t), jnp.cos(t)])[None]
+        >>> preds = target + 0.1
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        16.9873
+    """
 
     is_differentiable = True
     higher_is_better = True
@@ -153,7 +213,20 @@ class SourceAggregatedSignalDistortionRatio(_MeanAudioMetric):
 
 
 class PermutationInvariantTraining(_MeanAudioMetric):
-    """Parity: reference ``audio/pit.py:PermutationInvariantTraining`` (164 LoC)."""
+    """Parity: reference ``audio/pit.py:PermutationInvariantTraining`` (164 LoC).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import PermutationInvariantTraining
+        >>> from torchmetrics_tpu.functional.audio import scale_invariant_signal_noise_ratio
+        >>> metric = PermutationInvariantTraining(scale_invariant_signal_noise_ratio)
+        >>> t = jnp.linspace(0.0, 100.0, 400)
+        >>> target = jnp.stack([jnp.sin(t), jnp.cos(t)])[None]
+        >>> preds = target[:, ::-1, :] + 0.05
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        92.2472
+    """
 
     is_differentiable = True
     higher_is_better = True
@@ -188,6 +261,17 @@ class PerceptualEvaluationSpeechQuality(_MeanAudioMetric):
     (``functional/audio/pesq.py``) and works out of the box — the ITU C
     backend is still preferred automatically when installed
     (``implementation="auto"``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import PerceptualEvaluationSpeechQuality
+        >>> metric = PerceptualEvaluationSpeechQuality(fs=8000, mode="nb", implementation="native")
+        >>> t = jnp.linspace(0.0, 100.0, 4096)
+        >>> target = jnp.sin(t)
+        >>> preds = target + 0.1 * jnp.cos(3.0 * t)
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        2.4043
     """
 
     is_differentiable = False
@@ -220,7 +304,19 @@ class PerceptualEvaluationSpeechQuality(_MeanAudioMetric):
 
 class ShortTimeObjectiveIntelligibility(_MeanAudioMetric):
     """Parity: reference ``audio/stoi.py``. First-party implementation
-    (``functional/audio/stoi.py``) — no pystoi dependency."""
+    (``functional/audio/stoi.py``) — no pystoi dependency.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import ShortTimeObjectiveIntelligibility
+        >>> metric = ShortTimeObjectiveIntelligibility(fs=8000)
+        >>> t = jnp.linspace(0.0, 100.0, 4096)
+        >>> target = jnp.sin(t)
+        >>> preds = target + 0.1 * jnp.cos(3.0 * t)
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        0.793
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -239,7 +335,17 @@ class ShortTimeObjectiveIntelligibility(_MeanAudioMetric):
 
 class SpeechReverberationModulationEnergyRatio(_MeanAudioMetric):
     """Parity: reference ``audio/srmr.py``. First-party implementation
-    (``functional/audio/srmr.py``) — no gammatone/torchaudio dependency."""
+    (``functional/audio/srmr.py``) — no gammatone/torchaudio dependency.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import SpeechReverberationModulationEnergyRatio
+        >>> metric = SpeechReverberationModulationEnergyRatio(fs=8000)
+        >>> t = jnp.linspace(0.0, 400.0, 4096)
+        >>> metric.update(jnp.sin(t) * (1 + 0.5 * jnp.sin(0.05 * t)))
+        >>> round(float(metric.compute()), 4)
+        34.3532
+    """
 
     is_differentiable = False
     higher_is_better = True

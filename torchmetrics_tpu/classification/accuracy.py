@@ -72,7 +72,18 @@ class MultilabelAccuracy(MultilabelStatScores):
 
 
 class Accuracy(_ClassificationTaskWrapper):
-    """Task facade. Parity: reference ``classification/accuracy.py:491``."""
+    """Task facade. Parity: reference ``classification/accuracy.py:491``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import Accuracy
+        >>> metric = Accuracy(task="multiclass", num_classes=3)
+        >>> preds = jnp.asarray([[0.9, 0.05, 0.05], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.6, 0.1]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        0.75
+    """
 
     def __new__(
         cls,

@@ -28,6 +28,8 @@ Array = jax.Array
 class BinaryAUROC(BinaryPrecisionRecallCurve):
     """Parity: reference ``classification/auroc.py:40``."""
 
+    plot = Metric.plot  # value output, not a curve
+
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
@@ -61,6 +63,8 @@ class MulticlassAUROC(MulticlassPrecisionRecallCurve):
         1.0000
     """
 
+    plot = Metric.plot  # value output, not a curve
+
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
@@ -86,6 +90,8 @@ class MulticlassAUROC(MulticlassPrecisionRecallCurve):
 
 class MultilabelAUROC(MultilabelPrecisionRecallCurve):
     """Parity: reference ``classification/auroc.py:262``."""
+
+    plot = Metric.plot  # value output, not a curve
 
     is_differentiable = False
     higher_is_better = True
@@ -113,7 +119,18 @@ class MultilabelAUROC(MultilabelPrecisionRecallCurve):
 
 
 class AUROC(_ClassificationTaskWrapper):
-    """Task facade. Parity: reference ``classification/auroc.py:376``."""
+    """Task facade. Parity: reference ``classification/auroc.py:376``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import AUROC
+        >>> metric = AUROC(task="multiclass", num_classes=3)
+        >>> preds = jnp.asarray([[0.9, 0.05, 0.05], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.6, 0.1]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        1.0
+    """
 
     def __new__(cls, task: str, thresholds: Thresholds = None, num_classes: Optional[int] = None,
                 num_labels: Optional[int] = None, average: Optional[str] = "macro",

@@ -32,6 +32,8 @@ Array = jax.Array
 class BinaryAveragePrecision(BinaryPrecisionRecallCurve):
     """Parity: reference ``classification/average_precision.py:44``."""
 
+    plot = Metric.plot  # value output, not a curve
+
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
@@ -46,6 +48,8 @@ class BinaryAveragePrecision(BinaryPrecisionRecallCurve):
 
 class MulticlassAveragePrecision(MulticlassPrecisionRecallCurve):
     """Parity: reference ``classification/average_precision.py:151``."""
+
+    plot = Metric.plot  # value output, not a curve
 
     is_differentiable = False
     higher_is_better = True
@@ -77,6 +81,8 @@ class MulticlassAveragePrecision(MulticlassPrecisionRecallCurve):
 
 class MultilabelAveragePrecision(MultilabelPrecisionRecallCurve):
     """Parity: reference ``classification/average_precision.py:264``."""
+
+    plot = Metric.plot  # value output, not a curve
 
     is_differentiable = False
     higher_is_better = True
@@ -118,7 +124,18 @@ class MultilabelAveragePrecision(MultilabelPrecisionRecallCurve):
 
 
 class AveragePrecision(_ClassificationTaskWrapper):
-    """Task facade. Parity: reference ``classification/average_precision.py:398``."""
+    """Task facade. Parity: reference ``classification/average_precision.py:398``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import AveragePrecision
+        >>> metric = AveragePrecision(task="multiclass", num_classes=3)
+        >>> preds = jnp.asarray([[0.9, 0.05, 0.05], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.6, 0.1]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        1.0
+    """
 
     def __new__(cls, task: str, thresholds: Thresholds = None, num_classes: Optional[int] = None,
                 num_labels: Optional[int] = None, average: Optional[str] = "macro",

@@ -88,7 +88,18 @@ class MulticlassCalibrationError(Metric):
 
 
 class CalibrationError(_ClassificationTaskWrapper):
-    """Task facade. Parity: reference ``classification/calibration_error.py:259``."""
+    """Task facade. Parity: reference ``classification/calibration_error.py:259``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import CalibrationError
+        >>> metric = CalibrationError(task="multiclass", num_classes=3)
+        >>> preds = jnp.asarray([[0.9, 0.05, 0.05], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.6, 0.1]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        0.125
+    """
 
     def __new__(cls, task: str, n_bins: int = 15, norm: str = "l1", num_classes: Optional[int] = None,
                 ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> Metric:

@@ -21,6 +21,7 @@ class BinaryCohenKappa(BinaryConfusionMatrix):
     full_state_update = False
     plot_lower_bound = 0.0
     plot_upper_bound = 1.0
+    plot = Metric.plot  # scalar output, not a confusion matrix
 
     def __init__(self, threshold: float = 0.5, ignore_index: Optional[int] = None,
                  weights: Optional[str] = None, validate_args: bool = True, **kwargs: Any) -> None:
@@ -38,6 +39,7 @@ class MulticlassCohenKappa(MulticlassConfusionMatrix):
     full_state_update = False
     plot_lower_bound = 0.0
     plot_upper_bound = 1.0
+    plot = Metric.plot  # scalar output, not a confusion matrix
 
     def __init__(self, num_classes: int, ignore_index: Optional[int] = None,
                  weights: Optional[str] = None, validate_args: bool = True, **kwargs: Any) -> None:
@@ -50,7 +52,18 @@ class MulticlassCohenKappa(MulticlassConfusionMatrix):
 
 
 class CohenKappa(_ClassificationTaskWrapper):
-    """Task facade. Parity: reference ``classification/cohen_kappa.py:236``."""
+    """Task facade. Parity: reference ``classification/cohen_kappa.py:236``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import CohenKappa
+        >>> metric = CohenKappa(task="multiclass", num_classes=3)
+        >>> preds = jnp.asarray([[0.9, 0.05, 0.05], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.6, 0.1]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        0.6364
+    """
 
     def __new__(cls, task: str, threshold: float = 0.5, num_classes: Optional[int] = None,
                 weights: Optional[str] = None, ignore_index: Optional[int] = None,

@@ -115,7 +115,18 @@ class MultilabelConfusionMatrix(Metric):
 
 
 class ConfusionMatrix(_ClassificationTaskWrapper):
-    """Task facade. Parity: reference ``classification/confusion_matrix.py:376``."""
+    """Task facade. Parity: reference ``classification/confusion_matrix.py:376``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import ConfusionMatrix
+        >>> metric = ConfusionMatrix(task="multiclass", num_classes=3)
+        >>> preds = jnp.asarray([[0.9, 0.05, 0.05], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.6, 0.1]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> metric.update(preds, target)
+        >>> metric.compute().tolist()
+        [[1, 1, 0], [0, 1, 0], [0, 0, 1]]
+    """
 
     def __new__(cls, task: str, threshold: float = 0.5, num_classes: Optional[int] = None,
                 num_labels: Optional[int] = None, normalize: Optional[str] = None,

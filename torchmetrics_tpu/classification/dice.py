@@ -15,7 +15,18 @@ Array = jax.Array
 
 
 class Dice(MulticlassStatScores):
-    """Multiclass Dice (micro default, matching reference behavior)."""
+    """Multiclass Dice (micro default, matching reference behavior).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import Dice
+        >>> metric = Dice(num_classes=3)
+        >>> preds = jnp.asarray([[0.9, 0.05, 0.05], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.6, 0.1]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        0.75
+    """
 
     is_differentiable = False
     higher_is_better = True

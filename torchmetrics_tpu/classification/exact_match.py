@@ -91,7 +91,18 @@ class MultilabelExactMatch(_AbstractExactMatch):
 
 
 class ExactMatch(_ClassificationTaskWrapper):
-    """Task facade. Parity: reference ``classification/exact_match.py:305``."""
+    """Task facade. Parity: reference ``classification/exact_match.py:305``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import ExactMatch
+        >>> metric = ExactMatch(task="multiclass", num_classes=3)
+        >>> preds = jnp.asarray([[0, 1, 2], [2, 1, 0]])
+        >>> target = jnp.asarray([[0, 1, 2], [2, 1, 1]])
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        0.5
+    """
 
     def __new__(cls, task: str, threshold: float = 0.5, num_classes: Optional[int] = None,
                 num_labels: Optional[int] = None, multidim_average: str = "global",

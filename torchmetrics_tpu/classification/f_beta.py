@@ -114,7 +114,18 @@ class MultilabelF1Score(MultilabelFBetaScore):
 
 
 class FBetaScore(_ClassificationTaskWrapper):
-    """Task facade. Parity: reference ``classification/f_beta.py:976``."""
+    """Task facade. Parity: reference ``classification/f_beta.py:976``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import FBetaScore
+        >>> metric = FBetaScore(task="multiclass", num_classes=3, beta=0.5)
+        >>> preds = jnp.asarray([[0.9, 0.05, 0.05], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.6, 0.1]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        0.75
+    """
 
     def __new__(cls, task: str, beta: float = 1.0, threshold: float = 0.5, num_classes: Optional[int] = None,
                 num_labels: Optional[int] = None, average: Optional[str] = "micro",
@@ -136,7 +147,18 @@ class FBetaScore(_ClassificationTaskWrapper):
 
 
 class F1Score(_ClassificationTaskWrapper):
-    """Task facade. Parity: reference ``classification/f_beta.py:1068``."""
+    """Task facade. Parity: reference ``classification/f_beta.py:1068``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import F1Score
+        >>> metric = F1Score(task="multiclass", num_classes=3)
+        >>> preds = jnp.asarray([[0.9, 0.05, 0.05], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.6, 0.1]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        0.75
+    """
 
     def __new__(cls, task: str, threshold: float = 0.5, num_classes: Optional[int] = None,
                 num_labels: Optional[int] = None, average: Optional[str] = "micro",

@@ -23,6 +23,17 @@ class BinaryGroupStatRates(Metric):
     """tp/fp/tn/fn rates per demographic group.
 
     Parity: reference ``classification/group_fairness.py:96``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import BinaryGroupStatRates
+        >>> metric = BinaryGroupStatRates(num_groups=2)
+        >>> preds = jnp.asarray([0.9, 0.2, 0.8, 0.3, 0.6, 0.7])
+        >>> target = jnp.asarray([1, 0, 1, 0, 1, 1])
+        >>> groups = jnp.asarray([0, 0, 0, 1, 1, 1])
+        >>> metric.update(preds, target, groups)
+        >>> {k: [round(float(x), 4) for x in v] for k, v in sorted(metric.compute().items())}
+        {'group_0': [0.6667, 0.0, 0.3333, 0.0], 'group_1': [0.6667, 0.0, 0.3333, 0.0]}
     """
 
     is_differentiable = False
@@ -53,6 +64,17 @@ class BinaryFairness(BinaryGroupStatRates):
     """Demographic parity / equal opportunity ratios.
 
     Parity: reference ``classification/group_fairness.py:159``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import BinaryFairness
+        >>> metric = BinaryFairness(num_groups=2)
+        >>> preds = jnp.asarray([0.9, 0.2, 0.8, 0.3, 0.6, 0.7])
+        >>> target = jnp.asarray([1, 0, 1, 0, 1, 1])
+        >>> groups = jnp.asarray([0, 0, 0, 1, 1, 1])
+        >>> metric.update(preds, target, groups)
+        >>> {k: round(float(v), 4) for k, v in sorted(metric.compute().items())}
+        {'DP': 1.0, 'EO': 1.0}
     """
 
     def __init__(self, num_groups: int, task: str = "all", threshold: float = 0.5,

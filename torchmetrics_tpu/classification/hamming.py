@@ -56,7 +56,18 @@ class MultilabelHammingDistance(MultilabelStatScores):
 
 
 class HammingDistance(_ClassificationTaskWrapper):
-    """Task facade. Parity: reference ``classification/hamming.py:377``."""
+    """Task facade. Parity: reference ``classification/hamming.py:377``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import HammingDistance
+        >>> metric = HammingDistance(task="multiclass", num_classes=3)
+        >>> preds = jnp.asarray([[0.9, 0.05, 0.05], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.6, 0.1]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        0.25
+    """
 
     def __new__(cls, task: str, threshold: float = 0.5, num_classes: Optional[int] = None,
                 num_labels: Optional[int] = None, average: Optional[str] = "micro",

@@ -95,7 +95,18 @@ class MulticlassHingeLoss(Metric):
 
 
 class HingeLoss(_ClassificationTaskWrapper):
-    """Task facade. Parity: reference ``classification/hinge.py:222``."""
+    """Task facade. Parity: reference ``classification/hinge.py:222``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import HingeLoss
+        >>> metric = HingeLoss(task="multiclass", num_classes=3)
+        >>> preds = jnp.asarray([[0.9, 0.05, 0.05], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.6, 0.1]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        0.5875
+    """
 
     def __new__(cls, task: str, num_classes: Optional[int] = None, squared: bool = False,
                 multiclass_mode: str = "crammer-singer", ignore_index: Optional[int] = None,

@@ -21,6 +21,7 @@ class BinaryJaccardIndex(BinaryConfusionMatrix):
     full_state_update = False
     plot_lower_bound = 0.0
     plot_upper_bound = 1.0
+    plot = Metric.plot  # scalar output, not a confusion matrix
 
     def __init__(self, threshold: float = 0.5, ignore_index: Optional[int] = None,
                  validate_args: bool = True, zero_division: float = 0.0, **kwargs: Any) -> None:
@@ -37,6 +38,7 @@ class MulticlassJaccardIndex(MulticlassConfusionMatrix):
     full_state_update = False
     plot_lower_bound = 0.0
     plot_upper_bound = 1.0
+    plot = Metric.plot  # scalar output, not a confusion matrix
     plot_legend_name = "Class"
 
     def __init__(self, num_classes: int, average: Optional[str] = "macro", ignore_index: Optional[int] = None,
@@ -55,6 +57,7 @@ class MultilabelJaccardIndex(MultilabelConfusionMatrix):
     full_state_update = False
     plot_lower_bound = 0.0
     plot_upper_bound = 1.0
+    plot = Metric.plot  # scalar output, not a confusion matrix
     plot_legend_name = "Label"
 
     def __init__(self, num_labels: int, threshold: float = 0.5, average: Optional[str] = "macro",
@@ -70,7 +73,18 @@ class MultilabelJaccardIndex(MultilabelConfusionMatrix):
 
 
 class JaccardIndex(_ClassificationTaskWrapper):
-    """Task facade. Parity: reference ``classification/jaccard.py:260``."""
+    """Task facade. Parity: reference ``classification/jaccard.py:260``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import JaccardIndex
+        >>> metric = JaccardIndex(task="multiclass", num_classes=3)
+        >>> preds = jnp.asarray([[0.9, 0.05, 0.05], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.6, 0.1]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        0.6667
+    """
 
     def __new__(cls, task: str, threshold: float = 0.5, num_classes: Optional[int] = None,
                 num_labels: Optional[int] = None, average: Optional[str] = "macro",

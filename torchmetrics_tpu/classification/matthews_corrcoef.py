@@ -21,6 +21,7 @@ class BinaryMatthewsCorrCoef(BinaryConfusionMatrix):
     full_state_update = False
     plot_lower_bound = -1.0
     plot_upper_bound = 1.0
+    plot = Metric.plot  # scalar output, not a confusion matrix
 
     def __init__(self, threshold: float = 0.5, ignore_index: Optional[int] = None,
                  validate_args: bool = True, **kwargs: Any) -> None:
@@ -36,6 +37,7 @@ class MulticlassMatthewsCorrCoef(MulticlassConfusionMatrix):
     full_state_update = False
     plot_lower_bound = -1.0
     plot_upper_bound = 1.0
+    plot = Metric.plot  # scalar output, not a confusion matrix
 
     def __init__(self, num_classes: int, ignore_index: Optional[int] = None,
                  validate_args: bool = True, **kwargs: Any) -> None:
@@ -51,6 +53,7 @@ class MultilabelMatthewsCorrCoef(MultilabelConfusionMatrix):
     full_state_update = False
     plot_lower_bound = -1.0
     plot_upper_bound = 1.0
+    plot = Metric.plot  # scalar output, not a confusion matrix
 
     def __init__(self, num_labels: int, threshold: float = 0.5, ignore_index: Optional[int] = None,
                  validate_args: bool = True, **kwargs: Any) -> None:
@@ -61,7 +64,18 @@ class MultilabelMatthewsCorrCoef(MultilabelConfusionMatrix):
 
 
 class MatthewsCorrCoef(_ClassificationTaskWrapper):
-    """Task facade. Parity: reference ``classification/matthews_corrcoef.py:251``."""
+    """Task facade. Parity: reference ``classification/matthews_corrcoef.py:251``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import MatthewsCorrCoef
+        >>> metric = MatthewsCorrCoef(task="multiclass", num_classes=3)
+        >>> preds = jnp.asarray([[0.9, 0.05, 0.05], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.6, 0.1]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        0.7
+    """
 
     def __new__(cls, task: str, threshold: float = 0.5, num_classes: Optional[int] = None,
                 num_labels: Optional[int] = None, ignore_index: Optional[int] = None,

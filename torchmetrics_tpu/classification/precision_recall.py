@@ -134,3 +134,29 @@ def _make_facade(binary_cls, multiclass_cls, multilabel_cls, name):
 
 Precision = _make_facade(BinaryPrecision, MulticlassPrecision, MultilabelPrecision, "Precision")
 Recall = _make_facade(BinaryRecall, MulticlassRecall, MultilabelRecall, "Recall")
+
+Precision.__doc__ = (Precision.__doc__ or "") + """
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import Precision
+        >>> metric = Precision(task="multiclass", num_classes=3)
+        >>> preds = jnp.asarray([[0.9, 0.05, 0.05], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.6, 0.1]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        0.75
+"""
+
+Recall.__doc__ = (Recall.__doc__ or "") + """
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import Recall
+        >>> metric = Recall(task="multiclass", num_classes=3)
+        >>> preds = jnp.asarray([[0.9, 0.05, 0.05], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.6, 0.1]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        0.75
+"""

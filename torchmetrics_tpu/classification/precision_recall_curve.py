@@ -137,6 +137,8 @@ class MulticlassPrecisionRecallCurve(Metric):
             return _multiclass_precision_recall_curve_compute(self._exact_state(), self.num_classes, None)
         return _multiclass_precision_recall_curve_compute(self.confmat, self.num_classes, self.thresholds)
 
+    plot = BinaryPrecisionRecallCurve.plot
+
 
 class MultilabelPrecisionRecallCurve(Metric):
     """Parity: reference ``classification/precision_recall_curve.py:327``."""
@@ -183,9 +185,22 @@ class MultilabelPrecisionRecallCurve(Metric):
             )
         return _multilabel_precision_recall_curve_compute(self.confmat, self.num_labels, self.thresholds)
 
+    plot = BinaryPrecisionRecallCurve.plot
+
 
 class PrecisionRecallCurve(_ClassificationTaskWrapper):
-    """Task facade. Parity: reference ``classification/precision_recall_curve.py:472``."""
+    """Task facade. Parity: reference ``classification/precision_recall_curve.py:472``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import PrecisionRecallCurve
+        >>> metric = PrecisionRecallCurve(task="binary", thresholds=5)
+        >>> preds = jnp.asarray([0.1, 0.8, 0.6, 0.3, 0.9, 0.4])
+        >>> target = jnp.asarray([0, 1, 1, 0, 1, 0])
+        >>> metric.update(preds, target)
+        >>> [[round(float(x), 4) for x in v] for v in metric.compute()]
+        [[0.5, 0.6, 1.0, 1.0, 0.0, 1.0], [1.0, 1.0, 1.0, 0.6667, 0.0, 0.0], [0.0, 0.25, 0.5, 0.75, 1.0]]
+    """
 
     def __new__(cls, task: str, thresholds: Thresholds = None, num_classes: Optional[int] = None,
                 num_labels: Optional[int] = None, ignore_index: Optional[int] = None,

@@ -36,7 +36,18 @@ class _AbstractRanking(Metric):
 
 
 class MultilabelCoverageError(_AbstractRanking):
-    """Parity: reference ``classification/ranking.py:32``."""
+    """Parity: reference ``classification/ranking.py:32``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import MultilabelCoverageError
+        >>> metric = MultilabelCoverageError(num_labels=3)
+        >>> preds = jnp.asarray([[0.9, 0.1, 0.6], [0.2, 0.8, 0.3], [0.7, 0.4, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [1, 0, 1]])
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        1.6667
+    """
 
     higher_is_better = False
     plot_lower_bound = 0.0
@@ -49,7 +60,18 @@ class MultilabelCoverageError(_AbstractRanking):
 
 
 class MultilabelRankingAveragePrecision(_AbstractRanking):
-    """Parity: reference ``classification/ranking.py:127``."""
+    """Parity: reference ``classification/ranking.py:127``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import MultilabelRankingAveragePrecision
+        >>> metric = MultilabelRankingAveragePrecision(num_labels=3)
+        >>> preds = jnp.asarray([[0.9, 0.1, 0.6], [0.2, 0.8, 0.3], [0.7, 0.4, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [1, 0, 1]])
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        1.0
+    """
 
     higher_is_better = True
     plot_lower_bound = 0.0
@@ -63,7 +85,18 @@ class MultilabelRankingAveragePrecision(_AbstractRanking):
 
 
 class MultilabelRankingLoss(_AbstractRanking):
-    """Parity: reference ``classification/ranking.py:221``."""
+    """Parity: reference ``classification/ranking.py:221``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import MultilabelRankingLoss
+        >>> metric = MultilabelRankingLoss(num_labels=3)
+        >>> preds = jnp.asarray([[0.9, 0.1, 0.6], [0.2, 0.8, 0.3], [0.7, 0.4, 0.9]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0], [1, 0, 1]])
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        0.0
+    """
 
     higher_is_better = False
     plot_lower_bound = 0.0

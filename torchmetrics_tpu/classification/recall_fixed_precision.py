@@ -31,6 +31,8 @@ Array = jax.Array
 class BinaryRecallAtFixedPrecision(BinaryPrecisionRecallCurve):
     """Parity: reference ``classification/recall_fixed_precision.py:40``."""
 
+    plot = Metric.plot  # value output, not a curve
+
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
@@ -102,6 +104,8 @@ class BinarySpecificityAtSensitivity(BinaryRecallAtFixedPrecision):
 class _PerClassAtFixed(MulticlassPrecisionRecallCurve):
     """Shared multiclass scanner (objective/constraint chosen by subclass)."""
 
+    plot = Metric.plot  # value output, not a curve
+
     _objective_is_recall = True
 
     def __init__(self, num_classes: int, min_value: float, thresholds: Thresholds = None,
@@ -127,6 +131,7 @@ class MulticlassPrecisionAtFixedRecall(_PerClassAtFixed):
 
 
 class MultilabelRecallAtFixedPrecision(MultilabelPrecisionRecallCurve):
+    plot = Metric.plot  # value output, not a curve
     def __init__(self, num_labels: int, min_precision: float, thresholds: Thresholds = None,
                  ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
         super().__init__(num_labels, thresholds, ignore_index, validate_args, **kwargs)
@@ -145,6 +150,8 @@ class MultilabelRecallAtFixedPrecision(MultilabelPrecisionRecallCurve):
 
 class _PerClassRocScan(MulticlassPrecisionRecallCurve):
     """Multiclass ROC-curve scanner (sensitivity/specificity pairs)."""
+
+    plot = Metric.plot  # value output, not a curve
 
     _objective_is_tpr = True  # True: sensitivity@specificity, False: reverse
 
@@ -180,6 +187,8 @@ class MulticlassSpecificityAtSensitivity(_PerClassRocScan):
 
 class _PerLabelScan(MultilabelPrecisionRecallCurve):
     """Multilabel curve scanner (PR or ROC picked by subclass)."""
+
+    plot = Metric.plot  # value output, not a curve
 
     _use_roc = False
     _pick = staticmethod(lambda a, b: (a, b))
@@ -222,7 +231,18 @@ class MultilabelSpecificityAtSensitivity(_PerLabelScan):
 
 
 class RecallAtFixedPrecision(_ClassificationTaskWrapper):
-    """Task facade. Parity: reference ``classification/recall_fixed_precision.py:320``."""
+    """Task facade. Parity: reference ``classification/recall_fixed_precision.py:320``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import RecallAtFixedPrecision
+        >>> metric = RecallAtFixedPrecision(task="binary", min_precision=0.5)
+        >>> preds = jnp.asarray([0.1, 0.8, 0.6, 0.3, 0.9, 0.4])
+        >>> target = jnp.asarray([0, 1, 1, 0, 1, 0])
+        >>> metric.update(preds, target)
+        >>> tuple(round(float(v), 4) for v in metric.compute())
+        (1.0, 0.1)
+    """
 
     def __new__(cls, task: str, min_precision: float, thresholds: Thresholds = None,
                 num_classes: Optional[int] = None, num_labels: Optional[int] = None,
@@ -241,7 +261,18 @@ class RecallAtFixedPrecision(_ClassificationTaskWrapper):
 
 
 class PrecisionAtFixedRecall(_ClassificationTaskWrapper):
-    """Task facade. Parity: reference ``classification/precision_fixed_recall.py``."""
+    """Task facade. Parity: reference ``classification/precision_fixed_recall.py``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import PrecisionAtFixedRecall
+        >>> metric = PrecisionAtFixedRecall(task="binary", min_recall=0.5)
+        >>> preds = jnp.asarray([0.1, 0.8, 0.6, 0.3, 0.9, 0.4])
+        >>> target = jnp.asarray([0, 1, 1, 0, 1, 0])
+        >>> metric.update(preds, target)
+        >>> tuple(round(float(v), 4) for v in metric.compute())
+        (1.0, 0.6)
+    """
 
     def __new__(cls, task: str, min_recall: float, thresholds: Thresholds = None,
                 num_classes: Optional[int] = None, num_labels: Optional[int] = None,
@@ -260,7 +291,18 @@ class PrecisionAtFixedRecall(_ClassificationTaskWrapper):
 
 
 class SensitivityAtSpecificity(_ClassificationTaskWrapper):
-    """Task facade. Parity: reference ``classification/sensitivity_specificity.py``."""
+    """Task facade. Parity: reference ``classification/sensitivity_specificity.py``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import SensitivityAtSpecificity
+        >>> metric = SensitivityAtSpecificity(task="binary", min_specificity=0.5)
+        >>> preds = jnp.asarray([0.1, 0.8, 0.6, 0.3, 0.9, 0.4])
+        >>> target = jnp.asarray([0, 1, 1, 0, 1, 0])
+        >>> metric.update(preds, target)
+        >>> tuple(round(float(v), 4) for v in metric.compute())
+        (1.0, 0.6)
+    """
 
     def __new__(cls, task: str, min_specificity: float, thresholds: Thresholds = None,
                 num_classes: Optional[int] = None, num_labels: Optional[int] = None,
@@ -279,7 +321,18 @@ class SensitivityAtSpecificity(_ClassificationTaskWrapper):
 
 
 class SpecificityAtSensitivity(_ClassificationTaskWrapper):
-    """Task facade. Parity: reference ``classification/specificity_sensitivity.py``."""
+    """Task facade. Parity: reference ``classification/specificity_sensitivity.py``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import SpecificityAtSensitivity
+        >>> metric = SpecificityAtSensitivity(task="binary", min_sensitivity=0.5)
+        >>> preds = jnp.asarray([0.1, 0.8, 0.6, 0.3, 0.9, 0.4])
+        >>> target = jnp.asarray([0, 1, 1, 0, 1, 0])
+        >>> metric.update(preds, target)
+        >>> tuple(round(float(v), 4) for v in metric.compute())
+        (1.0, 0.8)
+    """
 
     def __new__(cls, task: str, min_sensitivity: float, thresholds: Thresholds = None,
                 num_classes: Optional[int] = None, num_labels: Optional[int] = None,

@@ -43,6 +43,8 @@ class MulticlassROC(MulticlassPrecisionRecallCurve):
             return _multiclass_roc_compute(self._exact_state(), self.num_classes, None)
         return _multiclass_roc_compute(self.confmat, self.num_classes, self.thresholds)
 
+    plot = BinaryROC.plot
+
 
 class MultilabelROC(MultilabelPrecisionRecallCurve):
     def compute(self):
@@ -50,9 +52,22 @@ class MultilabelROC(MultilabelPrecisionRecallCurve):
             return _multilabel_roc_compute(self._exact_state(), self.num_labels, None, self.ignore_index)
         return _multilabel_roc_compute(self.confmat, self.num_labels, self.thresholds)
 
+    plot = BinaryROC.plot
+
 
 class ROC(_ClassificationTaskWrapper):
-    """Task facade. Parity: reference ``classification/roc.py:411``."""
+    """Task facade. Parity: reference ``classification/roc.py:411``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import ROC
+        >>> metric = ROC(task="binary", thresholds=5)
+        >>> preds = jnp.asarray([0.1, 0.8, 0.6, 0.3, 0.9, 0.4])
+        >>> target = jnp.asarray([0, 1, 1, 0, 1, 0])
+        >>> metric.update(preds, target)
+        >>> [[round(float(x), 4) for x in v] for v in metric.compute()]
+        [[0.0, 0.0, 0.0, 0.6667, 1.0], [0.0, 0.6667, 1.0, 1.0, 1.0], [1.0, 0.75, 0.5, 0.25, 0.0]]
+    """
 
     def __new__(cls, task: str, thresholds: Thresholds = None, num_classes: Optional[int] = None,
                 num_labels: Optional[int] = None, ignore_index: Optional[int] = None,

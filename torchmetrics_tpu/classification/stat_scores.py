@@ -202,7 +202,18 @@ class MultilabelStatScores(_AbstractStatScores):
 
 
 class StatScores(_ClassificationTaskWrapper):
-    """Task facade. Parity: reference ``classification/stat_scores.py:425``."""
+    """Task facade. Parity: reference ``classification/stat_scores.py:425``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import StatScores
+        >>> metric = StatScores(task="multiclass", num_classes=3)
+        >>> preds = jnp.asarray([[0.9, 0.05, 0.05], [0.1, 0.8, 0.1], [0.2, 0.2, 0.6], [0.3, 0.6, 0.1]])
+        >>> target = jnp.asarray([0, 1, 2, 0])
+        >>> metric.update(preds, target)
+        >>> metric.compute().tolist()
+        [3, 1, 7, 1, 4]
+    """
 
     def __new__(
         cls,

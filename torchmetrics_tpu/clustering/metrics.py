@@ -57,7 +57,16 @@ class _LabelClusteringMetric(Metric):
 
 
 class MutualInfoScore(_LabelClusteringMetric):
-    """Parity: reference ``clustering/mutual_info_score.py``."""
+    """Parity: reference ``clustering/mutual_info_score.py``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import MutualInfoScore
+        >>> metric = MutualInfoScore()
+        >>> metric.update(jnp.asarray([0, 0, 1, 1, 2, 2]), jnp.asarray([1, 1, 0, 0, 2, 2]))
+        >>> round(float(metric.compute()), 4)
+        1.0986
+    """
 
     plot_lower_bound = 0.0
 
@@ -66,7 +75,16 @@ class MutualInfoScore(_LabelClusteringMetric):
 
 
 class AdjustedMutualInfoScore(_LabelClusteringMetric):
-    """Parity: reference ``clustering/adjusted_mutual_info_score.py``."""
+    """Parity: reference ``clustering/adjusted_mutual_info_score.py``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import AdjustedMutualInfoScore
+        >>> metric = AdjustedMutualInfoScore()
+        >>> metric.update(jnp.asarray([0, 0, 1, 1, 2, 2]), jnp.asarray([1, 1, 0, 0, 2, 2]))
+        >>> round(float(metric.compute()), 4)
+        1.0
+    """
 
     plot_lower_bound = 0.0
     plot_upper_bound = 1.0
@@ -85,7 +103,16 @@ class AdjustedMutualInfoScore(_LabelClusteringMetric):
 
 
 class NormalizedMutualInfoScore(_LabelClusteringMetric):
-    """Parity: reference ``clustering/normalized_mutual_info_score.py``."""
+    """Parity: reference ``clustering/normalized_mutual_info_score.py``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import NormalizedMutualInfoScore
+        >>> metric = NormalizedMutualInfoScore()
+        >>> metric.update(jnp.asarray([0, 0, 1, 1, 2, 2]), jnp.asarray([1, 1, 0, 0, 2, 2]))
+        >>> round(float(metric.compute()), 4)
+        1.0
+    """
 
     plot_lower_bound = 0.0
     plot_upper_bound = 1.0
@@ -104,7 +131,16 @@ class NormalizedMutualInfoScore(_LabelClusteringMetric):
 
 
 class RandScore(_LabelClusteringMetric):
-    """Parity: reference ``clustering/rand_score.py``."""
+    """Parity: reference ``clustering/rand_score.py``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import RandScore
+        >>> metric = RandScore()
+        >>> metric.update(jnp.asarray([0, 0, 1, 1, 2, 2]), jnp.asarray([1, 1, 0, 0, 2, 2]))
+        >>> round(float(metric.compute()), 4)
+        1.0
+    """
 
     plot_lower_bound = 0.0
     plot_upper_bound = 1.0
@@ -114,7 +150,16 @@ class RandScore(_LabelClusteringMetric):
 
 
 class AdjustedRandScore(_LabelClusteringMetric):
-    """Parity: reference ``clustering/adjusted_rand_score.py``."""
+    """Parity: reference ``clustering/adjusted_rand_score.py``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import AdjustedRandScore
+        >>> metric = AdjustedRandScore()
+        >>> metric.update(jnp.asarray([0, 0, 1, 1, 2, 2]), jnp.asarray([1, 1, 0, 0, 2, 2]))
+        >>> round(float(metric.compute()), 4)
+        1.0
+    """
 
     plot_lower_bound = -0.5
     plot_upper_bound = 1.0
@@ -124,7 +169,16 @@ class AdjustedRandScore(_LabelClusteringMetric):
 
 
 class FowlkesMallowsIndex(_LabelClusteringMetric):
-    """Parity: reference ``clustering/fowlkes_mallows_index.py``."""
+    """Parity: reference ``clustering/fowlkes_mallows_index.py``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import FowlkesMallowsIndex
+        >>> metric = FowlkesMallowsIndex()
+        >>> metric.update(jnp.asarray([0, 0, 1, 1, 2, 2]), jnp.asarray([1, 1, 0, 0, 2, 2]))
+        >>> round(float(metric.compute()), 4)
+        1.0
+    """
 
     plot_lower_bound = 0.0
     plot_upper_bound = 1.0
@@ -134,7 +188,16 @@ class FowlkesMallowsIndex(_LabelClusteringMetric):
 
 
 class HomogeneityScore(_LabelClusteringMetric):
-    """Parity: reference ``clustering/homogeneity_completeness_v_measure.py``."""
+    """Parity: reference ``clustering/homogeneity_completeness_v_measure.py``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import HomogeneityScore
+        >>> metric = HomogeneityScore()
+        >>> metric.update(jnp.asarray([0, 0, 1, 1, 2, 2]), jnp.asarray([1, 1, 0, 0, 2, 2]))
+        >>> round(float(metric.compute()), 4)
+        1.0
+    """
 
     plot_lower_bound = 0.0
     plot_upper_bound = 1.0
@@ -144,7 +207,16 @@ class HomogeneityScore(_LabelClusteringMetric):
 
 
 class CompletenessScore(_LabelClusteringMetric):
-    """Parity: reference ``clustering/homogeneity_completeness_v_measure.py``."""
+    """Parity: reference ``clustering/homogeneity_completeness_v_measure.py``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import CompletenessScore
+        >>> metric = CompletenessScore()
+        >>> metric.update(jnp.asarray([0, 0, 1, 1, 2, 2]), jnp.asarray([1, 1, 0, 0, 2, 2]))
+        >>> round(float(metric.compute()), 4)
+        1.0
+    """
 
     plot_lower_bound = 0.0
     plot_upper_bound = 1.0
@@ -154,7 +226,16 @@ class CompletenessScore(_LabelClusteringMetric):
 
 
 class VMeasureScore(_LabelClusteringMetric):
-    """Parity: reference ``clustering/homogeneity_completeness_v_measure.py``."""
+    """Parity: reference ``clustering/homogeneity_completeness_v_measure.py``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import VMeasureScore
+        >>> metric = VMeasureScore()
+        >>> metric.update(jnp.asarray([0, 0, 1, 1, 2, 2]), jnp.asarray([1, 1, 0, 0, 2, 2]))
+        >>> round(float(metric.compute()), 4)
+        1.0
+    """
 
     plot_lower_bound = 0.0
     plot_upper_bound = 1.0
@@ -188,7 +269,18 @@ class _EmbeddingClusteringMetric(Metric):
 
 
 class CalinskiHarabaszScore(_EmbeddingClusteringMetric):
-    """Parity: reference ``clustering/calinski_harabasz_score.py``."""
+    """Parity: reference ``clustering/calinski_harabasz_score.py``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import CalinskiHarabaszScore
+        >>> metric = CalinskiHarabaszScore()
+        >>> data = jnp.asarray([[0.0, 0.0], [0.1, 0.2], [2.0, 2.0], [2.1, 1.9], [4.0, 4.1], [3.9, 4.0]])
+        >>> labels = jnp.asarray([0, 0, 1, 1, 2, 2])
+        >>> metric.update(data, labels)
+        >>> round(float(metric.compute()), 4)
+        1027.8895
+    """
 
     higher_is_better = True
     plot_lower_bound = 0.0
@@ -198,7 +290,18 @@ class CalinskiHarabaszScore(_EmbeddingClusteringMetric):
 
 
 class DaviesBouldinScore(_EmbeddingClusteringMetric):
-    """Parity: reference ``clustering/davies_bouldin_score.py``."""
+    """Parity: reference ``clustering/davies_bouldin_score.py``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import DaviesBouldinScore
+        >>> metric = DaviesBouldinScore()
+        >>> data = jnp.asarray([[0.0, 0.0], [0.1, 0.2], [2.0, 2.0], [2.1, 1.9], [4.0, 4.1], [3.9, 4.0]])
+        >>> labels = jnp.asarray([0, 0, 1, 1, 2, 2])
+        >>> metric.update(data, labels)
+        >>> round(float(metric.compute()), 4)
+        0.0613
+    """
 
     higher_is_better = False
     plot_lower_bound = 0.0
@@ -208,7 +311,18 @@ class DaviesBouldinScore(_EmbeddingClusteringMetric):
 
 
 class DunnIndex(_EmbeddingClusteringMetric):
-    """Parity: reference ``clustering/dunn_index.py``."""
+    """Parity: reference ``clustering/dunn_index.py``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import DunnIndex
+        >>> metric = DunnIndex()
+        >>> data = jnp.asarray([[0.0, 0.0], [0.1, 0.2], [2.0, 2.0], [2.1, 1.9], [4.0, 4.1], [3.9, 4.0]])
+        >>> labels = jnp.asarray([0, 0, 1, 1, 2, 2])
+        >>> metric.update(data, labels)
+        >>> round(float(metric.compute()), 4)
+        24.368
+    """
 
     higher_is_better = True
     plot_lower_bound = 0.0

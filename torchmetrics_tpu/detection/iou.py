@@ -81,6 +81,16 @@ class IntersectionOverUnion(Metric):
     Parity: reference ``detection/iou.py:33`` (states ``:170-176``, compute
     ``:210-225``). Accepts ``preds``/``target`` as lists of per-image dicts
     with ``boxes``/``labels`` (+``scores`` in preds, unused here).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import IntersectionOverUnion
+        >>> metric = IntersectionOverUnion()
+        >>> preds = [{"boxes": jnp.asarray([[10.0, 10.0, 60.0, 60.0]]), "scores": jnp.asarray([0.9]), "labels": jnp.asarray([0])}]
+        >>> target = [{"boxes": jnp.asarray([[12.0, 8.0, 58.0, 62.0]]), "labels": jnp.asarray([0])}]
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()["iou"]), 4)
+        0.8569
     """
 
     is_differentiable: bool = False
@@ -153,21 +163,54 @@ class IntersectionOverUnion(Metric):
 
 
 class GeneralizedIntersectionOverUnion(IntersectionOverUnion):
-    """Parity: reference ``detection/giou.py:29``."""
+    """Parity: reference ``detection/giou.py:29``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import GeneralizedIntersectionOverUnion
+        >>> metric = GeneralizedIntersectionOverUnion()
+        >>> preds = [{"boxes": jnp.asarray([[10.0, 10.0, 60.0, 60.0]]), "scores": jnp.asarray([0.9]), "labels": jnp.asarray([0])}]
+        >>> target = [{"boxes": jnp.asarray([[12.0, 8.0, 58.0, 62.0]]), "labels": jnp.asarray([0])}]
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()["giou"]), 4)
+        0.851
+    """
 
     _iou_type = "giou"
     _invalid_val = -1.0
 
 
 class DistanceIntersectionOverUnion(IntersectionOverUnion):
-    """Parity: reference ``detection/diou.py:29``."""
+    """Parity: reference ``detection/diou.py:29``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import DistanceIntersectionOverUnion
+        >>> metric = DistanceIntersectionOverUnion()
+        >>> preds = [{"boxes": jnp.asarray([[10.0, 10.0, 60.0, 60.0]]), "scores": jnp.asarray([0.9]), "labels": jnp.asarray([0])}]
+        >>> target = [{"boxes": jnp.asarray([[12.0, 8.0, 58.0, 62.0]]), "labels": jnp.asarray([0])}]
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()["diou"]), 4)
+        0.8569
+    """
 
     _iou_type = "diou"
     _invalid_val = -1.0
 
 
 class CompleteIntersectionOverUnion(IntersectionOverUnion):
-    """Parity: reference ``detection/ciou.py:29`` (invalid sentinel -2, ``:103``)."""
+    """Parity: reference ``detection/ciou.py:29`` (invalid sentinel -2, ``:103``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import CompleteIntersectionOverUnion
+        >>> metric = CompleteIntersectionOverUnion()
+        >>> preds = [{"boxes": jnp.asarray([[10.0, 10.0, 60.0, 60.0]]), "scores": jnp.asarray([0.9]), "labels": jnp.asarray([0])}]
+        >>> target = [{"boxes": jnp.asarray([[12.0, 8.0, 58.0, 62.0]]), "labels": jnp.asarray([0])}]
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()["ciou"]), 4)
+        0.8569
+    """
 
     _iou_type = "ciou"
     _invalid_val = -2.0

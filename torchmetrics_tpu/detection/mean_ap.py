@@ -43,6 +43,16 @@ class MeanAveragePrecision(Metric):
     like the reference (``detection/mean_ap.py:92-148``). Output dict keys:
     ``map, map_50, map_75, map_{small,medium,large}, mar_{maxdets...},
     mar_{small,medium,large}, map_per_class, mar_<last>_per_class, classes``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import MeanAveragePrecision
+        >>> metric = MeanAveragePrecision()
+        >>> preds = [{"boxes": jnp.asarray([[10.0, 10.0, 60.0, 60.0]]), "scores": jnp.asarray([0.9]), "labels": jnp.asarray([0])}]
+        >>> target = [{"boxes": jnp.asarray([[10.0, 10.0, 60.0, 60.0]]), "labels": jnp.asarray([0])}]
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()["map"]), 4)
+        1.0
     """
 
     is_differentiable: bool = False

@@ -24,6 +24,15 @@ class PanopticQuality(Metric):
     Parity: reference ``detection/panoptic_qualities.py:30``. Inputs are
     integer color maps ``(..., height, width, 2)`` where the last dimension
     holds ``(category_id, instance_id)``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import PanopticQuality
+        >>> metric = PanopticQuality(things={0}, stuffs={1})
+        >>> img = jnp.asarray([[[0, 0], [0, 0], [1, 0]], [[0, 0], [1, 0], [1, 0]]])
+        >>> metric.update(img[None], img[None])
+        >>> round(float(metric.compute()), 4)
+        1.0
     """
 
     is_differentiable: bool = False
@@ -85,6 +94,15 @@ class ModifiedPanopticQuality(PanopticQuality):
     """Modified PQ — stuff categories scored per-pixel (IoU > 0, one segment).
 
     Parity: reference ``detection/panoptic_qualities.py:275``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import ModifiedPanopticQuality
+        >>> metric = ModifiedPanopticQuality(things={0}, stuffs={1})
+        >>> img = jnp.asarray([[[0, 0], [0, 0], [1, 0]], [[0, 0], [1, 0], [1, 0]]])
+        >>> metric.update(img[None], img[None])
+        >>> round(float(metric.compute()), 4)
+        1.0
     """
 
     _modified = True

@@ -93,6 +93,16 @@ class PeakSignalNoiseRatioWithBlockedEffect(Metric):
 
     Parity: reference ``image/psnrb.py`` (sum states ``sum_squared_error``/
     ``total``/``bef``, running-max ``data_range``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import PeakSignalNoiseRatioWithBlockedEffect
+        >>> metric = PeakSignalNoiseRatioWithBlockedEffect()
+        >>> preds = jnp.tile(jnp.linspace(0.1, 0.9, 16), (2, 1, 16, 1))
+        >>> target = preds * 0.9 + 0.05
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        32.1864
     """
 
     is_differentiable = True

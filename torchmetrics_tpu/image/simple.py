@@ -32,6 +32,17 @@ Array = jax.Array
 
 
 class TotalVariation(Metric):
+    """TotalVariation.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import TotalVariation
+        >>> metric = TotalVariation()
+        >>> preds = jnp.tile(jnp.linspace(0.1, 0.9, 16), (2, 3, 16, 1))
+        >>> metric.update(preds)
+        >>> round(float(metric.compute()), 4)
+        76.8
+    """
     is_differentiable = True
     higher_is_better = False
     full_state_update = False
@@ -63,6 +74,18 @@ class TotalVariation(Metric):
 
 
 class UniversalImageQualityIndex(Metric):
+    """UniversalImageQualityIndex.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import UniversalImageQualityIndex
+        >>> metric = UniversalImageQualityIndex()
+        >>> preds = jnp.tile(jnp.linspace(0.1, 0.9, 16), (2, 3, 16, 1))
+        >>> target = preds * 0.9 + 0.05
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        0.9943
+    """
     is_differentiable = True
     higher_is_better = True
     full_state_update = False
@@ -85,6 +108,18 @@ class UniversalImageQualityIndex(Metric):
 
 
 class SpectralAngleMapper(Metric):
+    """SpectralAngleMapper.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import SpectralAngleMapper
+        >>> metric = SpectralAngleMapper()
+        >>> preds = jnp.tile(jnp.linspace(0.1, 0.9, 16), (2, 3, 16, 1))
+        >>> target = preds * 0.9 + 0.05
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        0.0
+    """
     is_differentiable = True
     higher_is_better = False
     full_state_update = False
@@ -106,6 +141,18 @@ class SpectralAngleMapper(Metric):
 
 
 class ErrorRelativeGlobalDimensionlessSynthesis(Metric):
+    """ErrorRelativeGlobalDimensionlessSynthesis.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import ErrorRelativeGlobalDimensionlessSynthesis
+        >>> metric = ErrorRelativeGlobalDimensionlessSynthesis()
+        >>> preds = jnp.tile(jnp.linspace(0.1, 0.9, 16), (2, 3, 16, 1))
+        >>> target = preds * 0.9 + 0.05
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        19.6684
+    """
     is_differentiable = True
     higher_is_better = False
     full_state_update = False
@@ -130,6 +177,18 @@ class ErrorRelativeGlobalDimensionlessSynthesis(Metric):
 
 
 class RelativeAverageSpectralError(Metric):
+    """RelativeAverageSpectralError.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import RelativeAverageSpectralError
+        >>> metric = RelativeAverageSpectralError()
+        >>> preds = jnp.tile(jnp.linspace(0.1, 0.9, 16), (2, 3, 16, 1))
+        >>> target = preds * 0.9 + 0.05
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        250.6194
+    """
     is_differentiable = True
     higher_is_better = False
     full_state_update = False
@@ -158,6 +217,18 @@ class RelativeAverageSpectralError(Metric):
 
 
 class RootMeanSquaredErrorUsingSlidingWindow(Metric):
+    """RootMeanSquaredErrorUsingSlidingWindow.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import RootMeanSquaredErrorUsingSlidingWindow
+        >>> metric = RootMeanSquaredErrorUsingSlidingWindow()
+        >>> preds = jnp.tile(jnp.linspace(0.1, 0.9, 16), (2, 3, 16, 1))
+        >>> target = preds * 0.9 + 0.05
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        0.017
+    """
     is_differentiable = True
     higher_is_better = False
     full_state_update = False
@@ -183,6 +254,19 @@ class RootMeanSquaredErrorUsingSlidingWindow(Metric):
 
 
 class SpatialCorrelationCoefficient(Metric):
+    """SpatialCorrelationCoefficient.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import SpatialCorrelationCoefficient
+        >>> metric = SpatialCorrelationCoefficient()
+        >>> wave = jnp.sin(jnp.linspace(0.0, 9.0, 24))
+        >>> preds = jnp.tile(wave[:, None] * wave[None, :], (2, 3, 1, 1)) * 0.4 + 0.5
+        >>> target = preds * 0.9 + 0.03
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        1.0
+    """
     is_differentiable = True
     higher_is_better = True
     full_state_update = False
@@ -201,6 +285,18 @@ class SpatialCorrelationCoefficient(Metric):
 
 
 class VisualInformationFidelity(Metric):
+    """VisualInformationFidelity.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import VisualInformationFidelity
+        >>> metric = VisualInformationFidelity()
+        >>> preds = jnp.tile(jnp.linspace(0.1, 0.9, 48), (2, 3, 48, 1))
+        >>> target = preds * 0.9 + 0.05
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        1.2344
+    """
     is_differentiable = True
     higher_is_better = True
     full_state_update = False
@@ -223,6 +319,18 @@ class VisualInformationFidelity(Metric):
 
 
 class SpectralDistortionIndex(Metric):
+    """SpectralDistortionIndex.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import SpectralDistortionIndex
+        >>> metric = SpectralDistortionIndex()
+        >>> preds = jnp.tile(jnp.linspace(0.1, 0.9, 16), (2, 3, 16, 1))
+        >>> target = preds * 0.9 + 0.05
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        0.0
+    """
     is_differentiable = True
     higher_is_better = False
     full_state_update = False
@@ -245,6 +353,19 @@ class SpectralDistortionIndex(Metric):
 
 
 class SpatialDistortionIndex(Metric):
+    """SpatialDistortionIndex.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import SpatialDistortionIndex
+        >>> metric = SpatialDistortionIndex()
+        >>> preds = jnp.tile(jnp.sin(jnp.linspace(0.0, 6.0, 32)) * 0.4 + 0.5, (1, 3, 32, 1))
+        >>> ms = jnp.tile(jnp.sin(jnp.linspace(0.0, 6.0, 16)) * 0.4 + 0.5, (1, 3, 16, 1))
+        >>> pan = preds * 0.95
+        >>> metric.update(preds, {"ms": ms, "pan": pan})
+        >>> round(float(metric.compute()), 4)
+        0.0099
+    """
     is_differentiable = True
     higher_is_better = False
     full_state_update = False
@@ -276,6 +397,19 @@ class SpatialDistortionIndex(Metric):
 
 
 class QualityWithNoReference(Metric):
+    """QualityWithNoReference.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import QualityWithNoReference
+        >>> metric = QualityWithNoReference()
+        >>> preds = jnp.tile(jnp.sin(jnp.linspace(0.0, 6.0, 32)) * 0.4 + 0.5, (1, 3, 32, 1))
+        >>> ms = jnp.tile(jnp.sin(jnp.linspace(0.0, 6.0, 16)) * 0.4 + 0.5, (1, 3, 16, 1))
+        >>> pan = preds * 0.95
+        >>> metric.update(preds, {"ms": ms, "pan": pan})
+        >>> round(float(metric.compute()), 4)
+        0.9897
+    """
     is_differentiable = True
     higher_is_better = True
     full_state_update = False

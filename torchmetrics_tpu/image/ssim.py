@@ -16,6 +16,18 @@ Array = jax.Array
 
 
 class StructuralSimilarityIndexMeasure(Metric):
+    """StructuralSimilarityIndexMeasure.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import StructuralSimilarityIndexMeasure
+        >>> metric = StructuralSimilarityIndexMeasure()
+        >>> preds = jnp.tile(jnp.linspace(0.1, 0.9, 16), (2, 3, 16, 1))
+        >>> target = preds * 0.9 + 0.05
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        0.9945
+    """
     is_differentiable = True
     higher_is_better = True
     full_state_update = False
@@ -87,6 +99,18 @@ class StructuralSimilarityIndexMeasure(Metric):
 
 
 class MultiScaleStructuralSimilarityIndexMeasure(Metric):
+    """MultiScaleStructuralSimilarityIndexMeasure.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import MultiScaleStructuralSimilarityIndexMeasure
+        >>> metric = MultiScaleStructuralSimilarityIndexMeasure(kernel_size=3)
+        >>> preds = jnp.tile(jnp.linspace(0.1, 0.9, 48), (2, 3, 48, 1))
+        >>> target = preds * 0.9 + 0.05
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        0.9953
+    """
     is_differentiable = True
     higher_is_better = True
     full_state_update = False

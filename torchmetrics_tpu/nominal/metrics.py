@@ -84,7 +84,16 @@ class CramersV(_ConfmatNominalMetric):
 
 
 class TschuprowsT(_ConfmatNominalMetric):
-    """Parity: reference ``nominal/tschuprows.py:30``."""
+    """Parity: reference ``nominal/tschuprows.py:30``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import TschuprowsT
+        >>> metric = TschuprowsT(num_classes=3)
+        >>> metric.update(jnp.asarray([0, 1, 2, 0, 1, 2, 0, 1]), jnp.asarray([0, 1, 2, 1, 1, 2, 0, 0]))
+        >>> round(float(metric.compute()), 4)
+        0.6146
+    """
 
     def __init__(self, num_classes: int, bias_correction: bool = True,
                  nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0,
@@ -97,14 +106,32 @@ class TschuprowsT(_ConfmatNominalMetric):
 
 
 class PearsonsContingencyCoefficient(_ConfmatNominalMetric):
-    """Parity: reference ``nominal/pearson.py:33``."""
+    """Parity: reference ``nominal/pearson.py:33``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import PearsonsContingencyCoefficient
+        >>> metric = PearsonsContingencyCoefficient(num_classes=3)
+        >>> metric.update(jnp.asarray([0, 1, 2, 0, 1, 2, 0, 1]), jnp.asarray([0, 1, 2, 1, 1, 2, 0, 0]))
+        >>> round(float(metric.compute()), 4)
+        0.7255
+    """
 
     def compute(self) -> Array:
         return _pearsons_contingency_coefficient_compute(np.asarray(self.confmat))
 
 
 class TheilsU(_ConfmatNominalMetric):
-    """Parity: reference ``nominal/theils_u.py:30``."""
+    """Parity: reference ``nominal/theils_u.py:30``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import TheilsU
+        >>> metric = TheilsU(num_classes=3)
+        >>> metric.update(jnp.asarray([0, 1, 2, 0, 1, 2, 0, 1]), jnp.asarray([0, 1, 2, 1, 1, 2, 0, 0]))
+        >>> round(float(metric.compute()), 4)
+        0.5589
+    """
 
     def compute(self) -> Array:
         # U is asymmetric; transpose aligns with the reference's
@@ -113,7 +140,17 @@ class TheilsU(_ConfmatNominalMetric):
 
 
 class FleissKappa(Metric):
-    """Parity: reference ``nominal/fleiss_kappa.py:29`` — cat state of counts."""
+    """Parity: reference ``nominal/fleiss_kappa.py:29`` — cat state of counts.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import FleissKappa
+        >>> metric = FleissKappa(mode="counts")
+        >>> ratings = jnp.asarray([[3, 1], [2, 2], [4, 0], [1, 3], [0, 4]])
+        >>> metric.update(ratings)
+        >>> round(float(metric.compute()), 4)
+        0.3333
+    """
 
     is_differentiable = False
     higher_is_better = True

@@ -14,6 +14,16 @@ Array = jax.Array
 
 
 class MeanSquaredLogError(Metric):
+    """MeanSquaredLogError.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import MeanSquaredLogError
+        >>> metric = MeanSquaredLogError()
+        >>> metric.update(jnp.asarray([0.5, 1.5, 2.5, 4.0]), jnp.asarray([0.8, 1.0, 3.0, 3.5]))
+        >>> round(float(metric.compute()), 4)
+        0.028
+    """
     is_differentiable = True
     higher_is_better = False
     full_state_update = False
@@ -34,6 +44,16 @@ class MeanSquaredLogError(Metric):
 
 
 class LogCoshError(Metric):
+    """LogCoshError.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import LogCoshError
+        >>> metric = LogCoshError()
+        >>> metric.update(jnp.asarray([0.5, -1.5, 2.5, -4.0]), jnp.asarray([0.8, -1.0, 3.0, -3.5]))
+        >>> round(float(metric.compute()), 4)
+        0.1012
+    """
     is_differentiable = True
     higher_is_better = False
     full_state_update = False

@@ -11,6 +11,16 @@ Array = jax.Array
 
 
 class MeanAbsoluteError(Metric):
+    """MeanAbsoluteError.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import MeanAbsoluteError
+        >>> metric = MeanAbsoluteError()
+        >>> metric.update(jnp.asarray([0.5, -1.5, 2.5, -4.0]), jnp.asarray([0.8, -1.0, 3.0, -3.5]))
+        >>> round(float(metric.compute()), 4)
+        0.45
+    """
     is_differentiable = True
     higher_is_better = False
     full_state_update = False

@@ -19,6 +19,16 @@ Array = jax.Array
 
 
 class MeanAbsolutePercentageError(Metric):
+    """MeanAbsolutePercentageError.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import MeanAbsolutePercentageError
+        >>> metric = MeanAbsolutePercentageError()
+        >>> metric.update(jnp.asarray([0.5, 1.5, 2.5, 4.0]), jnp.asarray([0.8, 1.0, 3.0, 3.5]))
+        >>> round(float(metric.compute()), 4)
+        0.2961
+    """
     is_differentiable = True
     higher_is_better = False
     full_state_update = False
@@ -39,6 +49,16 @@ class MeanAbsolutePercentageError(Metric):
 
 
 class SymmetricMeanAbsolutePercentageError(MeanAbsolutePercentageError):
+    """SymmetricMeanAbsolutePercentageError.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import SymmetricMeanAbsolutePercentageError
+        >>> metric = SymmetricMeanAbsolutePercentageError()
+        >>> metric.update(jnp.asarray([0.5, 1.5, 2.5, 4.0]), jnp.asarray([0.8, 1.0, 3.0, 3.5]))
+        >>> round(float(metric.compute()), 4)
+        0.2942
+    """
     def update(self, preds: Array, target: Array) -> None:
         s, n = _symmetric_mean_absolute_percentage_error_update(preds, target)
         self.sum_abs_per_error = self.sum_abs_per_error + s
@@ -46,6 +66,16 @@ class SymmetricMeanAbsolutePercentageError(MeanAbsolutePercentageError):
 
 
 class WeightedMeanAbsolutePercentageError(Metric):
+    """WeightedMeanAbsolutePercentageError.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import WeightedMeanAbsolutePercentageError
+        >>> metric = WeightedMeanAbsolutePercentageError()
+        >>> metric.update(jnp.asarray([0.5, 1.5, 2.5, 4.0]), jnp.asarray([0.8, 1.0, 3.0, 3.5]))
+        >>> round(float(metric.compute()), 4)
+        0.2169
+    """
     is_differentiable = True
     higher_is_better = False
     full_state_update = False

@@ -26,6 +26,16 @@ Array = jax.Array
 
 
 class MinkowskiDistance(Metric):
+    """MinkowskiDistance.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import MinkowskiDistance
+        >>> metric = MinkowskiDistance(p=3.0)
+        >>> metric.update(jnp.asarray([0.5, -1.5, 2.5, -4.0]), jnp.asarray([0.8, -1.0, 3.0, -3.5]))
+        >>> round(float(metric.compute()), 4)
+        0.738
+    """
     is_differentiable = True
     higher_is_better = False
     full_state_update = False
@@ -46,6 +56,16 @@ class MinkowskiDistance(Metric):
 
 
 class TweedieDevianceScore(Metric):
+    """TweedieDevianceScore.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import TweedieDevianceScore
+        >>> metric = TweedieDevianceScore(power=1.5)
+        >>> metric.update(jnp.asarray([0.5, 1.5, 2.5, 4.0]), jnp.asarray([0.8, 1.0, 3.0, 3.5]))
+        >>> round(float(metric.compute()), 4)
+        0.1136
+    """
     is_differentiable = True
     higher_is_better = False
     full_state_update = False
@@ -69,6 +89,16 @@ class TweedieDevianceScore(Metric):
 
 
 class CriticalSuccessIndex(Metric):
+    """CriticalSuccessIndex.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import CriticalSuccessIndex
+        >>> metric = CriticalSuccessIndex(threshold=1.0)
+        >>> metric.update(jnp.asarray([0.5, 1.5, 2.5, 4.0]), jnp.asarray([0.8, 1.0, 3.0, 3.5]))
+        >>> round(float(metric.compute()), 4)
+        1.0
+    """
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
@@ -113,6 +143,16 @@ class CriticalSuccessIndex(Metric):
 
 
 class RelativeSquaredError(Metric):
+    """RelativeSquaredError.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import RelativeSquaredError
+        >>> metric = RelativeSquaredError()
+        >>> metric.update(jnp.asarray([0.5, -1.5, 2.5, -4.0]), jnp.asarray([0.8, -1.0, 3.0, -3.5]))
+        >>> round(float(metric.compute()), 4)
+        0.0369
+    """
     is_differentiable = True
     higher_is_better = False
     full_state_update = False
@@ -140,6 +180,18 @@ class RelativeSquaredError(Metric):
 
 
 class KLDivergence(Metric):
+    """KLDivergence.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import KLDivergence
+        >>> metric = KLDivergence()
+        >>> p = jnp.asarray([[0.2, 0.3, 0.5], [0.1, 0.6, 0.3]])
+        >>> q = jnp.asarray([[0.3, 0.3, 0.4], [0.2, 0.5, 0.3]])
+        >>> metric.update(p, q)
+        >>> round(float(metric.compute()), 4)
+        0.0353
+    """
     is_differentiable = True
     higher_is_better = False
     full_state_update = False
@@ -183,6 +235,16 @@ class KLDivergence(Metric):
 
 
 class CosineSimilarity(Metric):
+    """CosineSimilarity.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import CosineSimilarity
+        >>> metric = CosineSimilarity()
+        >>> metric.update(jnp.asarray([[1.0, 2.0, 3.0]]), jnp.asarray([[1.0, 2.0, 2.0]]))
+        >>> round(float(metric.compute()), 4)
+        0.98
+    """
     is_differentiable = True
     higher_is_better = True
     full_state_update = False

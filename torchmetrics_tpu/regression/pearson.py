@@ -74,7 +74,16 @@ class PearsonCorrCoef(Metric):
 
 
 class ConcordanceCorrCoef(PearsonCorrCoef):
-    """Parity: reference ``src/torchmetrics/regression/concordance.py``."""
+    """Parity: reference ``src/torchmetrics/regression/concordance.py``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import ConcordanceCorrCoef
+        >>> metric = ConcordanceCorrCoef()
+        >>> metric.update(jnp.asarray([0.5, -1.5, 2.5, -4.0]), jnp.asarray([0.8, -1.0, 3.0, -3.5]))
+        >>> round(float(metric.compute()), 4)
+        0.982
+    """
 
     def compute(self) -> Array:
         mean_x, mean_y, var_x, var_y, corr_xy, n = self._merged_moments()

@@ -18,6 +18,16 @@ Array = jax.Array
 
 
 class R2Score(Metric):
+    """R2Score.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import R2Score
+        >>> metric = R2Score()
+        >>> metric.update(jnp.asarray([0.5, -1.5, 2.5, -4.0]), jnp.asarray([0.8, -1.0, 3.0, -3.5]))
+        >>> round(float(metric.compute()), 4)
+        0.9631
+    """
     is_differentiable = True
     higher_is_better = True
     full_state_update = False
@@ -53,6 +63,16 @@ class R2Score(Metric):
 
 
 class ExplainedVariance(Metric):
+    """ExplainedVariance.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import ExplainedVariance
+        >>> metric = ExplainedVariance()
+        >>> metric.update(jnp.asarray([0.5, -1.5, 2.5, -4.0]), jnp.asarray([0.8, -1.0, 3.0, -3.5]))
+        >>> round(float(metric.compute()), 4)
+        0.9987
+    """
     is_differentiable = True
     higher_is_better = True
     full_state_update = False

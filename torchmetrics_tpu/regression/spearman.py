@@ -16,6 +16,16 @@ Array = jax.Array
 
 
 class SpearmanCorrCoef(Metric):
+    """SpearmanCorrCoef.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import SpearmanCorrCoef
+        >>> metric = SpearmanCorrCoef()
+        >>> metric.update(jnp.asarray([0.5, -1.5, 2.5, -4.0]), jnp.asarray([0.8, -1.0, 3.0, -3.5]))
+        >>> round(float(metric.compute()), 4)
+        1.0
+    """
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
@@ -37,6 +47,16 @@ class SpearmanCorrCoef(Metric):
 
 
 class KendallRankCorrCoef(Metric):
+    """KendallRankCorrCoef.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import KendallRankCorrCoef
+        >>> metric = KendallRankCorrCoef()
+        >>> metric.update(jnp.asarray([0.5, -1.5, 2.5, -4.0]), jnp.asarray([0.8, -1.0, 3.0, -3.5]))
+        >>> round(float(metric.compute()), 4)
+        1.0
+    """
     is_differentiable = False
     higher_is_better = None
     full_state_update = False

@@ -32,7 +32,19 @@ def _check_top_k(top_k: Optional[int]) -> None:
 
 
 class RetrievalMAP(RetrievalMetric):
-    """Mean Average Precision. Parity: reference ``retrieval/average_precision.py:28``."""
+    """Mean Average Precision. Parity: reference ``retrieval/average_precision.py:28``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import RetrievalMAP
+        >>> metric = RetrievalMAP()
+        >>> preds = jnp.asarray([0.9, 0.3, 0.6, 0.1, 0.8, 0.5])
+        >>> target = jnp.asarray([1, 0, 1, 0, 0, 1])
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1])
+        >>> metric.update(preds, target, indexes=indexes)
+        >>> round(float(metric.compute()), 4)
+        0.75
+    """
 
     plot_lower_bound = 0.0
     plot_upper_bound = 1.0
@@ -74,7 +86,19 @@ class RetrievalMRR(RetrievalMetric):
 
 
 class RetrievalPrecision(RetrievalMetric):
-    """Precision@k. Parity: reference ``retrieval/precision.py:28``."""
+    """Precision@k. Parity: reference ``retrieval/precision.py:28``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import RetrievalPrecision
+        >>> metric = RetrievalPrecision(top_k=2)
+        >>> preds = jnp.asarray([0.9, 0.3, 0.6, 0.1, 0.8, 0.5])
+        >>> target = jnp.asarray([1, 0, 1, 0, 0, 1])
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1])
+        >>> metric.update(preds, target, indexes=indexes)
+        >>> round(float(metric.compute()), 4)
+        0.75
+    """
 
     plot_lower_bound = 0.0
     plot_upper_bound = 1.0
@@ -94,7 +118,19 @@ class RetrievalPrecision(RetrievalMetric):
 
 
 class RetrievalRecall(RetrievalMetric):
-    """Recall@k. Parity: reference ``retrieval/recall.py:28``."""
+    """Recall@k. Parity: reference ``retrieval/recall.py:28``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import RetrievalRecall
+        >>> metric = RetrievalRecall(top_k=2)
+        >>> preds = jnp.asarray([0.9, 0.3, 0.6, 0.1, 0.8, 0.5])
+        >>> target = jnp.asarray([1, 0, 1, 0, 0, 1])
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1])
+        >>> metric.update(preds, target, indexes=indexes)
+        >>> round(float(metric.compute()), 4)
+        1.0
+    """
 
     plot_lower_bound = 0.0
     plot_upper_bound = 1.0
@@ -114,6 +150,17 @@ class RetrievalFallOut(RetrievalMetric):
 
     The empty-query condition inverts: a query is "empty" when it has no
     NEGATIVE targets (reference ``fall_out.py:116-155``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import RetrievalFallOut
+        >>> metric = RetrievalFallOut()
+        >>> preds = jnp.asarray([0.9, 0.3, 0.6, 0.1, 0.8, 0.5])
+        >>> target = jnp.asarray([1, 0, 1, 0, 0, 1])
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1])
+        >>> metric.update(preds, target, indexes=indexes)
+        >>> round(float(metric.compute()), 4)
+        1.0
     """
 
     higher_is_better = False
@@ -135,7 +182,19 @@ class RetrievalFallOut(RetrievalMetric):
 
 
 class RetrievalHitRate(RetrievalMetric):
-    """HitRate@k. Parity: reference ``retrieval/hit_rate.py:28``."""
+    """HitRate@k. Parity: reference ``retrieval/hit_rate.py:28``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import RetrievalHitRate
+        >>> metric = RetrievalHitRate()
+        >>> preds = jnp.asarray([0.9, 0.3, 0.6, 0.1, 0.8, 0.5])
+        >>> target = jnp.asarray([1, 0, 1, 0, 0, 1])
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1])
+        >>> metric.update(preds, target, indexes=indexes)
+        >>> round(float(metric.compute()), 4)
+        1.0
+    """
 
     plot_lower_bound = 0.0
     plot_upper_bound = 1.0
@@ -151,7 +210,19 @@ class RetrievalHitRate(RetrievalMetric):
 
 
 class RetrievalNormalizedDCG(RetrievalMetric):
-    """nDCG@k with graded relevance. Parity: reference ``retrieval/ndcg.py:28``."""
+    """nDCG@k with graded relevance. Parity: reference ``retrieval/ndcg.py:28``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import RetrievalNormalizedDCG
+        >>> metric = RetrievalNormalizedDCG()
+        >>> preds = jnp.asarray([0.9, 0.3, 0.6, 0.1, 0.8, 0.5])
+        >>> target = jnp.asarray([1, 0, 1, 0, 0, 1])
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1])
+        >>> metric.update(preds, target, indexes=indexes)
+        >>> round(float(metric.compute()), 4)
+        0.8155
+    """
 
     allow_non_binary_target = True
     plot_lower_bound = 0.0
@@ -168,7 +239,19 @@ class RetrievalNormalizedDCG(RetrievalMetric):
 
 
 class RetrievalRPrecision(RetrievalMetric):
-    """R-Precision. Parity: reference ``retrieval/r_precision.py:27``."""
+    """R-Precision. Parity: reference ``retrieval/r_precision.py:27``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import RetrievalRPrecision
+        >>> metric = RetrievalRPrecision()
+        >>> preds = jnp.asarray([0.9, 0.3, 0.6, 0.1, 0.8, 0.5])
+        >>> target = jnp.asarray([1, 0, 1, 0, 0, 1])
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1])
+        >>> metric.update(preds, target, indexes=indexes)
+        >>> round(float(metric.compute()), 4)
+        0.5
+    """
 
     plot_lower_bound = 0.0
     plot_upper_bound = 1.0
@@ -178,7 +261,19 @@ class RetrievalRPrecision(RetrievalMetric):
 
 
 class RetrievalAUROC(RetrievalMetric):
-    """Per-query AUROC. Parity: reference ``retrieval/auroc.py:28``."""
+    """Per-query AUROC. Parity: reference ``retrieval/auroc.py:28``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import RetrievalAUROC
+        >>> metric = RetrievalAUROC()
+        >>> preds = jnp.asarray([0.9, 0.3, 0.6, 0.1, 0.8, 0.5])
+        >>> target = jnp.asarray([1, 0, 1, 0, 0, 1])
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1])
+        >>> metric.update(preds, target, indexes=indexes)
+        >>> round(float(metric.compute()), 4)
+        0.75
+    """
 
     plot_lower_bound = 0.0
     plot_upper_bound = 1.0

@@ -33,7 +33,19 @@ def _retrieval_recall_at_fixed_precision(
 
 
 class RetrievalPrecisionRecallCurve(Metric):
-    """Averaged precision@k / recall@k curves over queries, k = 1..max_k."""
+    """Averaged precision@k / recall@k curves over queries, k = 1..max_k.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import RetrievalPrecisionRecallCurve
+        >>> metric = RetrievalPrecisionRecallCurve(max_k=2)
+        >>> preds = jnp.asarray([0.9, 0.3, 0.6, 0.1, 0.8, 0.5])
+        >>> target = jnp.asarray([1, 0, 1, 0, 0, 1])
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1])
+        >>> metric.update(preds, target, indexes=indexes)
+        >>> [[round(float(x), 4) for x in v] for v in metric.compute()]
+        [[0.5, 0.75], [0.25, 1.0], [1.0, 2.0]]
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -109,7 +121,19 @@ class RetrievalPrecisionRecallCurve(Metric):
 
 
 class RetrievalRecallAtFixedPrecision(RetrievalPrecisionRecallCurve):
-    """Parity: reference ``retrieval/precision_recall_curve.py:296``."""
+    """Parity: reference ``retrieval/precision_recall_curve.py:296``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import RetrievalRecallAtFixedPrecision
+        >>> metric = RetrievalRecallAtFixedPrecision(min_precision=0.5)
+        >>> preds = jnp.asarray([0.9, 0.3, 0.6, 0.1, 0.8, 0.5])
+        >>> target = jnp.asarray([1, 0, 1, 0, 0, 1])
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1])
+        >>> metric.update(preds, target, indexes=indexes)
+        >>> tuple(round(float(v), 4) for v in metric.compute())
+        (1.0, 2.0)
+    """
 
     higher_is_better = True
 

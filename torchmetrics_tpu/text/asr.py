@@ -30,7 +30,16 @@ class _HostTextMetric(Metric):
 
 
 class WordErrorRate(_HostTextMetric):
-    """Parity: reference ``text/wer.py``."""
+    """Parity: reference ``text/wer.py``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import WordErrorRate
+        >>> metric = WordErrorRate()
+        >>> metric.update(["the cat sat on the mat"], ["the cat sat on a mat"])
+        >>> round(float(metric.compute()), 4)
+        0.1667
+    """
 
     is_differentiable = False
     higher_is_better = False
@@ -52,7 +61,16 @@ class WordErrorRate(_HostTextMetric):
 
 
 class CharErrorRate(_HostTextMetric):
-    """Parity: reference ``text/cer.py``."""
+    """Parity: reference ``text/cer.py``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import CharErrorRate
+        >>> metric = CharErrorRate()
+        >>> metric.update(["the cat sat on the mat"], ["the cat sat on a mat"])
+        >>> round(float(metric.compute()), 4)
+        0.15
+    """
 
     is_differentiable = False
     higher_is_better = False
@@ -74,7 +92,16 @@ class CharErrorRate(_HostTextMetric):
 
 
 class MatchErrorRate(_HostTextMetric):
-    """Parity: reference ``text/mer.py``."""
+    """Parity: reference ``text/mer.py``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import MatchErrorRate
+        >>> metric = MatchErrorRate()
+        >>> metric.update(["the cat sat on the mat"], ["the cat sat on a mat"])
+        >>> round(float(metric.compute()), 4)
+        0.1667
+    """
 
     is_differentiable = False
     higher_is_better = False
@@ -97,7 +124,16 @@ class MatchErrorRate(_HostTextMetric):
 
 
 class WordInfoLost(_HostTextMetric):
-    """Parity: reference ``text/wil.py``."""
+    """Parity: reference ``text/wil.py``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import WordInfoLost
+        >>> metric = WordInfoLost()
+        >>> metric.update(["the cat sat on the mat"], ["the cat sat on a mat"])
+        >>> round(float(metric.compute()), 4)
+        0.3056
+    """
 
     is_differentiable = False
     higher_is_better = False
@@ -122,7 +158,16 @@ class WordInfoLost(_HostTextMetric):
 
 
 class WordInfoPreserved(_HostTextMetric):
-    """Parity: reference ``text/wip.py``."""
+    """Parity: reference ``text/wip.py``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import WordInfoPreserved
+        >>> metric = WordInfoPreserved()
+        >>> metric.update(["the cat sat on the mat"], ["the cat sat on a mat"])
+        >>> round(float(metric.compute()), 4)
+        0.6944
+    """
 
     is_differentiable = False
     higher_is_better = True

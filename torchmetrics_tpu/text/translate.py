@@ -21,7 +21,16 @@ Array = jax.Array
 
 
 class BLEUScore(_HostTextMetric):
-    """Parity: reference ``text/bleu.py:BLEUScore`` (157 LoC)."""
+    """Parity: reference ``text/bleu.py:BLEUScore`` (157 LoC).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import BLEUScore
+        >>> metric = BLEUScore()
+        >>> metric.update(["the cat is on the mat"], [["there is a cat on the mat", "the cat is on the mat"]])
+        >>> round(float(metric.compute()), 4)
+        1.0
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -62,7 +71,16 @@ class BLEUScore(_HostTextMetric):
 
 
 class SacreBLEUScore(BLEUScore):
-    """Parity: reference ``text/sacre_bleu.py:SacreBLEUScore``."""
+    """Parity: reference ``text/sacre_bleu.py:SacreBLEUScore``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import SacreBLEUScore
+        >>> metric = SacreBLEUScore()
+        >>> metric.update(["the cat is on the mat"], [["there is a cat on the mat", "the cat is on the mat"]])
+        >>> round(float(metric.compute()), 4)
+        1.0
+    """
 
     def __init__(self, n_gram: int = 4, smooth: bool = False, tokenize: str = "13a",
                  lowercase: bool = False, weights: Optional[Sequence[float]] = None,
@@ -75,7 +93,16 @@ class SacreBLEUScore(BLEUScore):
 
 
 class CHRFScore(_HostTextMetric):
-    """Parity: reference ``text/chrf.py:CHRFScore`` — flat count-vector states."""
+    """Parity: reference ``text/chrf.py:CHRFScore`` — flat count-vector states.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import CHRFScore
+        >>> metric = CHRFScore()
+        >>> metric.update(["the cat sat on the mat"], ["the cat sat on a mat"])
+        >>> round(float(metric.compute()), 4)
+        0.7198
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -127,7 +154,16 @@ class CHRFScore(_HostTextMetric):
 
 
 class TranslationEditRate(_HostTextMetric):
-    """Parity: reference ``text/ter.py:TranslationEditRate``."""
+    """Parity: reference ``text/ter.py:TranslationEditRate``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import TranslationEditRate
+        >>> metric = TranslationEditRate()
+        >>> metric.update(["the cat sat on the mat"], ["the cat sat on a mat"])
+        >>> round(float(metric.compute()), 4)
+        0.1667
+    """
 
     is_differentiable = False
     higher_is_better = False
@@ -163,7 +199,16 @@ class TranslationEditRate(_HostTextMetric):
 
 
 class ExtendedEditDistance(_HostTextMetric):
-    """Parity: reference ``text/eed.py:ExtendedEditDistance``."""
+    """Parity: reference ``text/eed.py:ExtendedEditDistance``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import ExtendedEditDistance
+        >>> metric = ExtendedEditDistance()
+        >>> metric.update(["the cat sat on the mat"], ["the cat sat on a mat"])
+        >>> round(float(metric.compute()), 4)
+        0.1452
+    """
 
     is_differentiable = False
     higher_is_better = False

@@ -92,15 +92,24 @@ def plot_curve(
     legend_name: Optional[str] = None,
     name: Optional[str] = None,
 ):
-    """Plot a (x, y, thresholds) curve tuple (ROC / PR)."""
+    """Plot a (x, y, thresholds) curve tuple (ROC / PR).
+
+    Handles 1D (binary), (C, T) stacked (binned multiclass/multilabel), and
+    list-of-arrays per class (exact multiclass/multilabel, ragged lengths).
+    """
     fig, ax = _get_ax(ax)
-    x, y = np.asarray(curve[0]), np.asarray(curve[1])
-    if x.ndim == 1:
-        ax.plot(x, y, label=legend_name)
-    else:
-        for i in range(x.shape[0]):
-            ax.plot(x[i], y[i], label=f"{legend_name or 'class'} {i}")
+    if isinstance(curve[0], (list, tuple)):
+        for i, (xi, yi) in enumerate(zip(curve[0], curve[1])):
+            ax.plot(np.asarray(xi), np.asarray(yi), label=f"{legend_name or 'class'} {i}")
         ax.legend()
+    else:
+        x, y = np.asarray(curve[0]), np.asarray(curve[1])
+        if x.ndim == 1:
+            ax.plot(x, y, label=legend_name)
+        else:
+            for i in range(x.shape[0]):
+                ax.plot(x[i], y[i], label=f"{legend_name or 'class'} {i}")
+            ax.legend()
     if label_names:
         ax.set_xlabel(label_names[0])
         ax.set_ylabel(label_names[1])

@@ -13,6 +13,17 @@ Array = jax.Array
 
 
 class ClasswiseWrapper(WrapperMetric):
+    """ClasswiseWrapper.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import ClasswiseWrapper
+        >>> from torchmetrics_tpu.classification import MulticlassAccuracy
+        >>> metric = ClasswiseWrapper(MulticlassAccuracy(num_classes=3, average="none"))
+        >>> metric.update(jnp.asarray([[0.8, 0.1, 0.1], [0.2, 0.7, 0.1]]), jnp.asarray([0, 2]))
+        >>> {k: round(float(v), 4) for k, v in sorted(metric.compute().items())}
+        {'multiclassaccuracy_0': 1.0, 'multiclassaccuracy_1': 0.0, 'multiclassaccuracy_2': 0.0}
+    """
     def __init__(
         self,
         metric: Metric,

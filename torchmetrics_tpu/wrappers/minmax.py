@@ -14,6 +14,17 @@ Array = jax.Array
 
 
 class MinMaxMetric(WrapperMetric):
+    """MinMaxMetric.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import MeanSquaredError, MinMaxMetric
+        >>> metric = MinMaxMetric(MeanSquaredError())
+        >>> _ = metric(jnp.asarray([1.0, 2.0]), jnp.asarray([1.0, 3.0]))
+        >>> _ = metric(jnp.asarray([1.0, 2.0]), jnp.asarray([1.0, 2.0]))
+        >>> {k: round(float(v), 4) for k, v in sorted(metric.compute().items())}
+        {'max': 0.5, 'min': 0.25, 'raw': 0.25}
+    """
     full_state_update = True
 
     def __init__(self, base_metric: Metric, **kwargs: Any) -> None:

@@ -15,6 +15,16 @@ Array = jax.Array
 
 
 class MultioutputWrapper(WrapperMetric):
+    """MultioutputWrapper.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import MeanSquaredError, MultioutputWrapper
+        >>> metric = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
+        >>> metric.update(jnp.asarray([[1.0, 5.0], [2.0, 6.0]]), jnp.asarray([[1.0, 4.0], [2.0, 8.0]]))
+        >>> jnp.round(metric.compute(), 4).tolist()
+        [0.0, 2.5]
+    """
     is_differentiable = False
 
     def __init__(

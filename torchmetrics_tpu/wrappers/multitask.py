@@ -10,6 +10,19 @@ from .abstract import WrapperMetric
 
 
 class MultitaskWrapper(WrapperMetric):
+    """MultitaskWrapper.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import MeanSquaredError, MultitaskWrapper
+        >>> from torchmetrics_tpu.classification import BinaryAccuracy
+        >>> metric = MultitaskWrapper({"reg": MeanSquaredError(), "cls": BinaryAccuracy()})
+        >>> preds = {"reg": jnp.asarray([1.0, 2.0]), "cls": jnp.asarray([0.9, 0.2])}
+        >>> target = {"reg": jnp.asarray([1.0, 3.0]), "cls": jnp.asarray([1, 0])}
+        >>> metric.update(preds, target)
+        >>> {k: round(float(v), 4) for k, v in sorted(metric.compute().items())}
+        {'cls': 1.0, 'reg': 0.5}
+    """
     is_differentiable = False
 
     def __init__(

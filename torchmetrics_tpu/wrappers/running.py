@@ -17,6 +17,18 @@ Array = jax.Array
 
 
 class Running(WrapperMetric):
+    """Running.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import Running, SumMetric
+        >>> metric = Running(SumMetric(), window=2)
+        >>> _ = metric(jnp.asarray([1.0]))
+        >>> _ = metric(jnp.asarray([2.0]))
+        >>> _ = metric(jnp.asarray([3.0]))
+        >>> float(metric.compute())
+        5.0
+    """
     def __init__(self, base_metric: Metric, window: int = 5, **kwargs: Any) -> None:
         super().__init__(**kwargs)
         if not isinstance(base_metric, Metric):
