@@ -1,0 +1,117 @@
+"""D_lambda / D_s / QNR parity vs the reference with real low-res ms inputs.
+
+The reference (``functional/image/{d_lambda,d_s,qnr}.py``) evaluates
+spectral distortion on the LOW-RES ms directly (no upsampling), degrades the
+pan image with a ``window_size`` uniform filter + antialias-free bilinear
+resize, takes batch-mean UQI per band pair, and reduces over the band axis.
+The reference's torchvision resize is stubbed with the equivalent
+``F.interpolate`` call (that is all torchvision's resize does for tensors).
+"""
+import importlib.machinery
+import os
+import sys
+import types
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "helpers"))
+from lightning_utilities_stub import install_stub  # noqa: E402
+
+install_stub()
+sys.path.insert(0, "/root/reference/src")
+
+torch = pytest.importorskip("torch")
+
+
+@pytest.fixture(scope="module")
+def ref_image_functional():
+    tvf = types.ModuleType("torchvision.transforms.functional")
+
+    def resize(img, size, antialias=None):
+        import torch.nn.functional as F
+
+        return F.interpolate(img, size=tuple(size), mode="bilinear", align_corners=False,
+                             antialias=bool(antialias))
+
+    tvf.resize = resize
+    tvt = types.ModuleType("torchvision.transforms")
+    tvt.functional = tvf
+    tv = types.ModuleType("torchvision")
+    tv.transforms = tvt
+    tv.__spec__ = importlib.machinery.ModuleSpec("torchvision", loader=None)
+    sys.modules.update({"torchvision": tv, "torchvision.transforms": tvt,
+                        "torchvision.transforms.functional": tvf})
+    try:
+        import torchmetrics.functional.image as RFI
+
+        yield RFI
+    finally:
+        for key in ("torchvision", "torchvision.transforms", "torchvision.transforms.functional"):
+            sys.modules.pop(key, None)
+
+
+@pytest.fixture()
+def pansharpen_inputs():
+    rng = np.random.RandomState(42)
+    preds = rng.rand(8, 3, 32, 32).astype(np.float32)
+    ms = rng.rand(8, 3, 16, 16).astype(np.float32)
+    pan = rng.rand(8, 3, 32, 32).astype(np.float32)
+    return preds, ms, pan
+
+
+def test_d_lambda_low_res_target(ref_image_functional, pansharpen_inputs):
+    import torchmetrics_tpu.functional.image as FI
+
+    preds, ms, _ = pansharpen_inputs
+    expected = float(ref_image_functional.spectral_distortion_index(torch.tensor(preds), torch.tensor(ms)))
+    got = float(FI.spectral_distortion_index(jnp.asarray(preds), jnp.asarray(ms)))
+    assert got == pytest.approx(expected, abs=1e-5)
+
+
+@pytest.mark.parametrize("window_size", [3, 7])
+@pytest.mark.parametrize("norm_order", [1, 2])
+def test_d_s_window_and_norm(ref_image_functional, pansharpen_inputs, window_size, norm_order):
+    import torchmetrics_tpu.functional.image as FI
+
+    preds, ms, pan = pansharpen_inputs
+    expected = float(ref_image_functional.spatial_distortion_index(
+        torch.tensor(preds), torch.tensor(ms), torch.tensor(pan),
+        norm_order=norm_order, window_size=window_size))
+    got = float(FI.spatial_distortion_index(
+        jnp.asarray(preds), jnp.asarray(ms), jnp.asarray(pan),
+        norm_order=norm_order, window_size=window_size))
+    assert got == pytest.approx(expected, abs=1e-5)
+
+
+def test_d_s_pan_lr_provided(ref_image_functional, pansharpen_inputs):
+    import torchmetrics_tpu.functional.image as FI
+
+    preds, ms, pan = pansharpen_inputs
+    pan_lr = np.random.RandomState(1).rand(8, 3, 16, 16).astype(np.float32)
+    expected = float(ref_image_functional.spatial_distortion_index(
+        torch.tensor(preds), torch.tensor(ms), torch.tensor(pan), torch.tensor(pan_lr)))
+    got = float(FI.spatial_distortion_index(
+        jnp.asarray(preds), jnp.asarray(ms), jnp.asarray(pan), jnp.asarray(pan_lr)))
+    assert got == pytest.approx(expected, abs=1e-5)
+
+
+def test_qnr_parity(ref_image_functional, pansharpen_inputs):
+    import torchmetrics_tpu.functional.image as FI
+
+    preds, ms, pan = pansharpen_inputs
+    expected = float(ref_image_functional.quality_with_no_reference(
+        torch.tensor(preds), torch.tensor(ms), torch.tensor(pan), alpha=2.0, beta=0.5))
+    got = float(FI.quality_with_no_reference(
+        jnp.asarray(preds), jnp.asarray(ms), jnp.asarray(pan), alpha=2.0, beta=0.5))
+    assert got == pytest.approx(expected, abs=1e-5)
+
+
+def test_d_s_window_too_large_raises(pansharpen_inputs):
+    import torchmetrics_tpu.functional.image as FI
+
+    preds, ms, pan = pansharpen_inputs
+    with pytest.raises(ValueError, match="window_size"):
+        FI.spatial_distortion_index(jnp.asarray(preds), jnp.asarray(ms), jnp.asarray(pan), window_size=16)

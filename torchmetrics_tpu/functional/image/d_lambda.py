@@ -1,90 +1,139 @@
 """Pan-sharpening quality metrics: D_lambda, D_s, QNR.
 
-Parity: reference ``src/torchmetrics/functional/image/{d_lambda,d_s,qnr}.py``
-— spectral distortion (UQI between band pairs), spatial distortion (UQI
-between each band and the PAN image at two resolutions), and the combined
-quality-with-no-reference index.
+Parity: reference ``src/torchmetrics/functional/image/{d_lambda,d_s,qnr}.py``:
+
+- **D_lambda** (spectral distortion): per band-pair, the |batch-mean UQI of
+  the fused bands minus batch-mean UQI of the low-res ms bands|^p, averaged
+  over ordered pairs, ^(1/p). ``target`` is the LOW-RES ms — only batch and
+  channel counts must match ``preds`` (``d_lambda.py:41``).
+- **D_s** (spatial distortion): per band, |batch-mean UQI(ms, pan_degraded)
+  − batch-mean UQI(preds, pan)|^norm_order, reduced over the BAND axis then
+  ^(1/norm_order). ``pan_degraded`` is the pan image through a
+  ``window_size`` uniform filter (scipy-style symmetric padding) and a
+  bilinear antialias-free resize to the ms grid (``d_s.py:175-201``).
+- **QNR** = (1 − D_lambda)^alpha · (1 − D_s)^beta on the low-res ms
+  directly (``qnr.py:82``).
 """
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
-from ...utils.checks import _check_same_shape
-from .helper import avg_pool2d
+from .helper import depthwise_conv2d
 from .uqi import _uqi_update
 
 Array = jax.Array
 
 
-def _band_uqi(a: Array, b: Array) -> Array:
-    """(N,) UQI between two single-band images (N, H, W)."""
-    return _uqi_update(a[:, None], b[:, None])
+def _band_uqi_mean(a: Array, b: Array) -> Array:
+    """Scalar batch-mean UQI between two single-band (N, H, W) images."""
+    return jnp.mean(_uqi_update(a[:, None], b[:, None]))
 
 
-def _spectral_distortion_index_compute(preds: Array, target: Array, p: int = 1) -> Array:
-    length = preds.shape[1]
-    total = jnp.zeros(preds.shape[0])
-    cnt = 0
-    for k in range(length):
-        for r in range(length):
-            if k == r:
-                continue
-            q_fused = _band_uqi(preds[:, k], preds[:, r])
-            q_lr = _band_uqi(target[:, k], target[:, r])
-            total = total + jnp.abs(q_fused - q_lr) ** p
-            cnt += 1
-    return (total / cnt) ** (1.0 / p)
+def _uniform_filter_2d(x: Array, window_size: int) -> Array:
+    """Uniform filter with the reference's scipy-style symmetric padding
+    (``utils.py:112-132``): edge-inclusive reflection, asymmetric for even
+    windows, 'valid' conv back to the input size."""
+    pad_l = window_size // 2
+    pad_r = (window_size - 1) // 2
+    xp = jnp.pad(x, ((0, 0), (0, 0), (pad_l, pad_r), (pad_l, pad_r)), mode="symmetric")
+    kernel = jnp.full((x.shape[1], 1, window_size, window_size), 1.0 / window_size**2, jnp.float32)
+    return depthwise_conv2d(xp, kernel)
+
+
+def _validate_4d(name: str, x: Array) -> None:
+    if x.ndim != 4:
+        raise ValueError(f"Expected `{name}` to have BxCxHxW shape. Got {name}: {x.shape}.")
 
 
 def spectral_distortion_index(
     preds: Array, target: Array, p: int = 1, reduction: Optional[str] = "elementwise_mean"
 ) -> Array:
-    """D_lambda. Parity: reference ``d_lambda.py:84``."""
-    _check_same_shape(preds, target)
+    """D_lambda. Parity: reference ``d_lambda.py:108``."""
     if not isinstance(p, int) or p <= 0:
         raise ValueError(f"Expected `p` to be a positive integer. Got p: {p}.")
-    preds = preds.astype(jnp.float32)
-    target = target.astype(jnp.float32)
-    scores = _spectral_distortion_index_compute(preds, target, p)
-    if reduction == "elementwise_mean":
-        return jnp.mean(scores)
-    if reduction == "sum":
-        return jnp.sum(scores)
-    return scores
+    _validate_4d("preds", jnp.asarray(preds))
+    _validate_4d("target", jnp.asarray(target))
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    if preds.shape[:2] != target.shape[:2]:
+        raise ValueError(
+            "Expected `preds` and `target` to have same batch and channel sizes."
+            f"Got preds: {preds.shape} and target: {target.shape}."
+        )
+    length = preds.shape[1]
+    total = jnp.asarray(0.0)
+    for k in range(length):
+        for r in range(k + 1, length):
+            q_lr = _band_uqi_mean(target[:, k], target[:, r])
+            q_fused = _band_uqi_mean(preds[:, k], preds[:, r])
+            total = total + 2.0 * jnp.abs(q_lr - q_fused) ** p  # symmetric pair counted twice
+    if length == 1:
+        output = jnp.asarray(0.0) ** (1.0 / p)
+    else:
+        output = (total / (length * (length - 1))) ** (1.0 / p)
+    # output is a scalar; the reference's `reduce` over it is the identity
+    # for elementwise_mean/sum distinction only on non-scalars
+    return output
 
 
 def spatial_distortion_index(
     preds: Array, ms: Array, pan: Array, pan_lr: Optional[Array] = None,
     norm_order: int = 1, window_size: int = 7, reduction: Optional[str] = "elementwise_mean",
 ) -> Array:
-    """D_s. Parity: reference ``d_s.py:95``.
+    """D_s. Parity: reference ``d_s.py:205``.
 
     preds: fused high-res multispectral (N, C, H, W); ms: low-res
-    multispectral (N, C, h, w); pan: panchromatic (N, C, H, W) or (N, 1, H, W).
+    multispectral (N, C, h, w); pan: panchromatic (N, C, H, W).
     """
     if not isinstance(norm_order, int) or norm_order <= 0:
         raise ValueError(f"Expected `norm_order` to be a positive integer. Got norm_order: {norm_order}.")
-    preds = preds.astype(jnp.float32)
-    ms = ms.astype(jnp.float32)
-    pan = pan.astype(jnp.float32)
-    length = preds.shape[1]
-    ratio = preds.shape[-1] // ms.shape[-1]
+    if not isinstance(window_size, int) or window_size <= 0:
+        raise ValueError(f"Expected `window_size` to be a positive integer. Got window_size: {window_size}.")
+    for name, x in (("preds", preds), ("ms", ms), ("pan", pan)):
+        _validate_4d(name, jnp.asarray(x))
+    preds = jnp.asarray(preds, jnp.float32)
+    ms = jnp.asarray(ms, jnp.float32)
+    pan = jnp.asarray(pan, jnp.float32)
+    if preds.shape[:2] != ms.shape[:2] or preds.shape[:2] != pan.shape[:2]:
+        raise ValueError(
+            "Expected `preds`, `ms` and `pan` to have the same batch and channel sizes."
+            f" Got preds: {preds.shape}, ms: {ms.shape}, pan: {pan.shape}."
+        )
+    if preds.shape[-2:] != pan.shape[-2:]:
+        raise ValueError(
+            f"Expected `preds` and `pan` to have the same spatial size. Got {preds.shape} and {pan.shape}."
+        )
+    if preds.shape[-2] % ms.shape[-2] or preds.shape[-1] % ms.shape[-1]:
+        raise ValueError(
+            f"Expected dimensions of `preds` to be multiples of `ms`. Got preds: {preds.shape}, ms: {ms.shape}."
+        )
+    ms_h, ms_w = ms.shape[-2:]
+    if window_size >= ms_h or window_size >= ms_w:
+        raise ValueError(
+            f"Expected `window_size` to be smaller than dimension of `ms`. Got window_size: {window_size}."
+        )
     if pan_lr is None:
-        pan_lr = avg_pool2d(pan, ratio)
-    total = jnp.zeros(preds.shape[0])
-    for i in range(length):
-        pan_band = pan[:, min(i, pan.shape[1] - 1)]
-        pan_lr_band = pan_lr[:, min(i, pan_lr.shape[1] - 1)]
-        q_hr = _band_uqi(preds[:, i], pan_band)
-        q_lr = _band_uqi(ms[:, i], pan_lr_band)
-        total = total + jnp.abs(q_hr - q_lr) ** norm_order
-    scores = (total / length) ** (1.0 / norm_order)
+        degraded = _uniform_filter_2d(pan, window_size)
+        degraded = jax.image.resize(
+            degraded, degraded.shape[:2] + (ms_h, ms_w), jax.image.ResizeMethod.LINEAR, antialias=False
+        )
+    else:
+        pan_lr = jnp.asarray(pan_lr, jnp.float32)
+        if pan_lr.shape[-2:] != (ms_h, ms_w):
+            raise ValueError(
+                f"Expected `ms` and `pan_lr` to have the same spatial size. Got {ms.shape} and {pan_lr.shape}."
+            )
+        degraded = pan_lr
+    length = preds.shape[1]
+    m1 = jnp.stack([_band_uqi_mean(ms[:, i], degraded[:, i]) for i in range(length)])
+    m2 = jnp.stack([_band_uqi_mean(preds[:, i], pan[:, i]) for i in range(length)])
+    diff = jnp.abs(m1 - m2) ** norm_order  # (C,) — reduced over the band axis
     if reduction == "elementwise_mean":
-        return jnp.mean(scores)
+        return jnp.mean(diff) ** (1.0 / norm_order)
     if reduction == "sum":
-        return jnp.sum(scores)
-    return scores
+        return jnp.sum(diff) ** (1.0 / norm_order)
+    return diff ** (1.0 / norm_order)
 
 
 def quality_with_no_reference(
@@ -92,19 +141,11 @@ def quality_with_no_reference(
     alpha: float = 1.0, beta: float = 1.0, norm_order: int = 1, window_size: int = 7,
     reduction: Optional[str] = "elementwise_mean",
 ) -> Array:
-    """QNR = (1 - D_lambda)^alpha * (1 - D_s)^beta. Parity: reference ``qnr.py:71``."""
-    d_l = spectral_distortion_index(preds, _upsample_like(ms, preds), 1, reduction="none")
-    d_s_val = spatial_distortion_index(preds, ms, pan, pan_lr, norm_order, window_size, reduction="none")
-    qnr = (1 - d_l) ** alpha * (1 - d_s_val) ** beta
-    if reduction == "elementwise_mean":
-        return jnp.mean(qnr)
-    if reduction == "sum":
-        return jnp.sum(qnr)
-    return qnr
-
-
-def _upsample_like(x: Array, ref: Array) -> Array:
-    """Nearest-neighbor upsample x to ref's spatial size."""
-    factor_h = ref.shape[-2] // x.shape[-2]
-    factor_w = ref.shape[-1] // x.shape[-1]
-    return jnp.repeat(jnp.repeat(x, factor_h, axis=-2), factor_w, axis=-1)
+    """QNR = (1 - D_lambda)^alpha * (1 - D_s)^beta. Parity: reference ``qnr.py:28``."""
+    if not isinstance(alpha, (int, float)) or alpha < 0:
+        raise ValueError(f"Expected `alpha` to be a non-negative real number. Got alpha: {alpha}.")
+    if not isinstance(beta, (int, float)) or beta < 0:
+        raise ValueError(f"Expected `beta` to be a non-negative real number. Got beta: {beta}.")
+    d_l = spectral_distortion_index(preds, ms, norm_order, reduction)
+    d_s_val = spatial_distortion_index(preds, ms, pan, pan_lr, norm_order, window_size, reduction)
+    return (1 - d_l) ** alpha * (1 - d_s_val) ** beta
