@@ -55,6 +55,12 @@ def _dummy_logits_net(imgs):
     return jnp.ones((imgs.shape[0], 10)) / 10
 
 
+def _neg_mse_over_time(p, t):
+    """PIT metric contract: reduce the TIME axis only -> (..., spk_p, spk_t).
+    Module-level so PIT metrics built from it stay picklable."""
+    return -jnp.mean((p - t) ** 2, axis=-1)
+
+
 # lazy factories: each entry constructs its own helper metrics so one bad
 # constructor can't poison every parametrized case
 EXTRA = {
@@ -64,8 +70,7 @@ EXTRA = {
     "InceptionScore": lambda: {"feature": _dummy_logits_net},
     "LearnedPerceptualImagePatchSimilarity": lambda: {"net_type": _dummy_distance},
     "PerceptualPathLength": lambda: {"distance_fn": _dummy_distance},
-    # PIT contract: metric_func reduces the TIME axis only -> (..., spk_p, spk_t)
-    "PermutationInvariantTraining": lambda: {"metric_func": lambda p, t: -jnp.mean((p - t) ** 2, axis=-1)},
+    "PermutationInvariantTraining": lambda: {"metric_func": _neg_mse_over_time},
     "MetricCollection": lambda: {"metrics": {"mse": M.MeanSquaredError()}},
     "MetricTracker": lambda: {"metric": M.MeanSquaredError()},
     "MinMaxMetric": lambda: {"base_metric": M.MeanSquaredError()},

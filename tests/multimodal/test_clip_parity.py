@@ -1,0 +1,132 @@
+"""Matched-weights CLIP parity: our Flax CLIPScore path vs the reference's
+torch path, with the SAME (randomly initialized) CLIP weights on both sides.
+
+No pretrained CLIP is downloadable offline, but ``transformers`` ships both
+the torch and Flax CLIP implementations: a tiny random ``CLIPModel`` is
+saved and re-loaded as ``FlaxCLIPModel(from_pt=True)``, giving weight-exact
+twins. A stub processor (deterministic pixel passthrough + hash tokenizer)
+replaces the real CLIPProcessor (whose vocab files are also not
+downloadable). This pins our ``_clip_score_update`` — modality detection,
+L2 normalization, 100*cosine, truncation warning path — against the
+reference's (``functional/multimodal/clip_score.py:90``) numerically.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "helpers"))
+from lightning_utilities_stub import install_stub  # noqa: E402
+
+install_stub()
+sys.path.insert(0, "/root/reference/src")
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+class StubProcessor:
+    """Minimal CLIPProcessor stand-in: fixed image resize-free pixel tensor
+    (images are generated at the model's input size) + hash tokenizer."""
+
+    def __init__(self, image_size: int, vocab_size: int, seq_len: int = 12):
+        self.image_size = image_size
+        self.vocab_size = vocab_size
+        self.seq_len = seq_len
+
+    def _tokens(self, text):
+        ids = np.zeros((len(text), self.seq_len), dtype=np.int64)
+        mask = np.zeros((len(text), self.seq_len), dtype=np.int64)
+        for i, t in enumerate(text):
+            words = t.split()[: self.seq_len]
+            for j, w in enumerate(words):
+                ids[i, j] = (hash(w) % (self.vocab_size - 2)) + 1
+            mask[i, : len(words)] = 1
+        return ids, mask
+
+    def __call__(self, text=None, images=None, return_tensors="np", padding=True):
+        out = {}
+        if images is not None:
+            # images arrive CHW in [0,1]; normalize deterministically
+            arr = np.stack([np.asarray(i, dtype=np.float32) for i in images])
+            out["pixel_values"] = (arr - 0.5) / 0.25
+        if text is not None:
+            ids, mask = self._tokens(list(text))
+            out["input_ids"] = ids
+            out["attention_mask"] = mask
+        if return_tensors == "pt":
+            out = {k: torch.from_numpy(np.asarray(v)) for k, v in out.items()}
+        return out
+
+
+@pytest.fixture(scope="module")
+def matched_models(tmp_path_factory):
+    from transformers import CLIPConfig, CLIPModel, CLIPTextConfig, CLIPVisionConfig, FlaxCLIPModel
+
+    torch.manual_seed(0)
+    config = CLIPConfig(
+        text_config=CLIPTextConfig(hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+                                   num_attention_heads=2, vocab_size=99,
+                                   max_position_embeddings=16).to_dict(),
+        vision_config=CLIPVisionConfig(hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+                                       num_attention_heads=2, image_size=32, patch_size=8).to_dict(),
+        projection_dim=24,
+    )
+    pt_model = CLIPModel(config).eval()
+    path = tmp_path_factory.mktemp("clip") / "tiny"
+    pt_model.save_pretrained(path)
+    flax_model = FlaxCLIPModel.from_pretrained(str(path), from_pt=True)
+    processor = StubProcessor(image_size=32, vocab_size=99)
+    return pt_model, flax_model, processor
+
+
+def test_clip_score_matches_reference_matched_weights(matched_models):
+    from torchmetrics.functional.multimodal.clip_score import _clip_score_update as ref_update
+
+    from torchmetrics_tpu.functional.multimodal.clip_score import _clip_score_update as our_update
+
+    pt_model, flax_model, processor = matched_models
+    rng = np.random.default_rng(0)
+    images = rng.random((3, 3, 32, 32)).astype(np.float32)
+    texts = ["a photo of a cat", "a dog on grass", "blue car"]
+
+    with torch.no_grad():
+        ref_scores, ref_n, _, _ = ref_update(
+            [torch.from_numpy(i) for i in images], texts, None, None, pt_model, processor
+        )
+    our_sum, our_n = our_update(list(images), texts, flax_model, processor)
+
+    assert our_n == ref_n == 3
+    np.testing.assert_allclose(float(our_sum), float(ref_scores.sum()), rtol=1e-4, atol=1e-3)
+
+
+def test_clip_score_class_end_to_end(matched_models):
+    _, flax_model, processor = matched_models
+    from torchmetrics_tpu.multimodal import CLIPScore
+
+    metric = CLIPScore(model_name_or_path=(flax_model, processor))
+    rng = np.random.default_rng(1)
+    metric.update(list(rng.random((2, 3, 32, 32)).astype(np.float32)), ["hello world", "foo bar"])
+    metric.update(list(rng.random((2, 3, 32, 32)).astype(np.float32)), ["baz", "qux quux"])
+    val = float(metric.compute())
+    assert np.isfinite(val) and val >= 0.0
+
+
+def test_text_text_and_image_image_pairs(matched_models):
+    """Our extension beyond the reference: same-modality pairs."""
+    _, flax_model, processor = matched_models
+    from torchmetrics_tpu.functional.multimodal.clip_score import _clip_score_update
+
+    rng = np.random.default_rng(2)
+    imgs_a = list(rng.random((2, 3, 32, 32)).astype(np.float32))
+    imgs_b = list(rng.random((2, 3, 32, 32)).astype(np.float32))
+    s_ii, n = _clip_score_update(imgs_a, imgs_b, flax_model, processor)
+    assert n == 2 and np.isfinite(float(s_ii))
+    s_tt, n = _clip_score_update(["a cat", "a dog"], ["one cat", "one dog"], flax_model, processor)
+    assert n == 2 and np.isfinite(float(s_tt))
+    # self-similarity is maximal: identical image pairs score 100 each
+    s_self, n = _clip_score_update(imgs_a, imgs_a, flax_model, processor)
+    np.testing.assert_allclose(float(s_self) / n, 100.0, atol=1e-3)

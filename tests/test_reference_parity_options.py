@@ -301,3 +301,26 @@ def test_stat_scores_scatter_fallback_branch(monkeypatch):
         jnp.asarray(preds), jnp.asarray(target), num_classes=C, average=None, validate_args=False
     )
     _chk(fallback, expected, atol=0)
+
+
+def test_image_data_range_tuple():
+    """Tuple data_range (clamp-to-range semantics) for PSNR/SSIM — reference
+    ``functional/image/{psnr,ssim}.py`` data_range handling."""
+    import torchmetrics.functional.image as RFI
+
+    import torchmetrics_tpu.functional.image as FI
+
+    rng = np.random.RandomState(0)
+    a = (rng.rand(2, 3, 20, 20) * 3 - 1).astype(np.float32)  # values beyond [0, 1]
+    b = np.clip(a + rng.randn(2, 3, 20, 20).astype(np.float32) * 0.2, -1, 2).astype(np.float32)
+    for name, of, rf, kw in [
+        ("psnr-tuple", FI.peak_signal_noise_ratio, RFI.peak_signal_noise_ratio, {"data_range": (0.0, 1.0)}),
+        ("ssim-tuple", FI.structural_similarity_index_measure, RFI.structural_similarity_index_measure,
+         {"data_range": (0.0, 1.0)}),
+        ("psnr-float", FI.peak_signal_noise_ratio, RFI.peak_signal_noise_ratio, {"data_range": 3.0}),
+    ]:
+        np.testing.assert_allclose(
+            np.asarray(of(jnp.asarray(b), jnp.asarray(a), **kw)),
+            rf(torch.tensor(b), torch.tensor(a), **kw).numpy(),
+            rtol=1e-4, atol=1e-4, err_msg=name,
+        )
