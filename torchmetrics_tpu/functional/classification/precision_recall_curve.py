@@ -23,13 +23,24 @@ Thresholds = Union[int, List[float], Array, None]
 
 
 def _adjust_threshold_arg(thresholds: Thresholds) -> Optional[Array]:
-    """int → linspace(0,1,n); list/array → array; None → exact mode."""
+    """int → linspace(0,1,n); list/array → array; None → exact mode.
+
+    User-provided grids must be non-decreasing: the binned update digitizes
+    predictions with ``searchsorted`` (and curve integration assumes a
+    monotone threshold axis anyway). Checked eagerly here, outside jit.
+    """
     if thresholds is None:
         return None
     if isinstance(thresholds, int):
         return jnp.linspace(0.0, 1.0, thresholds)
-    if isinstance(thresholds, (list, tuple)):
-        return jnp.asarray(thresholds, dtype=jnp.float32)
+    if isinstance(thresholds, (list, tuple)) or type(thresholds).__module__ == "numpy":
+        # host-side validation only: no device sync, and traced jax arrays
+        # (jitted callers) are passed through untouched
+        import numpy as np
+
+        tnp = np.asarray(thresholds, dtype=np.float32)
+        if tnp.ndim != 1 or np.any(np.diff(tnp) < 0):
+            raise ValueError("Expected argument `thresholds` to be a 1d tensor of increasing values")
     return jnp.asarray(thresholds, dtype=jnp.float32)
 
 
@@ -79,6 +90,38 @@ def _binary_precision_recall_curve_format(
     return preds, target.astype(jnp.int32), _adjust_threshold_arg(thresholds), mask
 
 
+def _binned_confusion_from_bins(pos_w: Array, all_w: Array, bin_idx: Array, len_t: int) -> Array:
+    """(T, ..., 2, 2) binned confusion via digitize + MXU one-hot matmul.
+
+    ``bin_idx[i, ...] = #thresholds <= pred`` (so ``pred >= thr_t  <=>
+    bin_idx > t``). Instead of materializing the (T, N, ...) comparison
+    tensor (4 HBM-bound elementwise passes), build a (N, ..., T+1) 0/1
+    one-hot of the bin index, contract the sample axis on the MXU (exact:
+    0/1 bf16 operands, f32 accumulation), and recover per-threshold counts
+    as suffix sums over the bin axis — O(N·C·T) MACs but ~8x less memory
+    traffic than the comparison form.
+
+    pos_w/all_w: (N, C) per-sample weights for positives / all samples;
+    bin_idx: (N, C) ints in [0, T].
+    """
+    bins = len_t + 1
+    oh = jax.nn.one_hot(bin_idx, bins, dtype=jnp.bfloat16)  # (N, C, K)
+    # (callers pre-map NaN predictions to bin 0 = never predicted-positive,
+    # matching the `pred >= thr` comparison semantics where NaN is False)
+    lhs = jnp.stack([pos_w, all_w], axis=1).astype(jnp.bfloat16)  # (N, 2, C)
+    hist = jnp.einsum("nsc,nck->csk", lhs, oh, preferred_element_type=jnp.float32)  # (C, 2, K)
+    suffix = jnp.flip(jnp.cumsum(jnp.flip(hist, -1), -1), -1)  # S[k] = sum_{j >= k}
+    tp = suffix[:, 0, 1:]  # (C, T): positives with bin > t
+    pred_pos = suffix[:, 1, 1:]  # all samples with bin > t
+    pos_tot = hist[:, 0, :].sum(-1)[:, None]
+    tot = hist[:, 1, :].sum(-1)[:, None]
+    fp = pred_pos - tp
+    fn = pos_tot - tp
+    tn = tot - tp - fp - fn
+    out = jnp.stack([jnp.stack([tn, fp], -1), jnp.stack([fn, tp], -1)], -2)  # (C, T, 2, 2)
+    return jnp.moveaxis(out, 0, 1).astype(jnp.int32)  # (T, C, 2, 2)
+
+
 def _binary_precision_recall_curve_update(
     preds: Array, target: Array, thresholds: Optional[Array], mask: Optional[Array] = None
 ) -> Array:
@@ -87,13 +130,10 @@ def _binary_precision_recall_curve_update(
         raise ValueError("binned update requires thresholds")
     len_t = thresholds.shape[0]
     w = jnp.ones_like(target, dtype=jnp.float32) if mask is None else mask.astype(jnp.float32)
-    preds_t = (preds[None, :] >= thresholds[:, None]).astype(jnp.int32)  # (T, N)
-    tgt = target[None, :]
-    tp = jnp.sum(preds_t * tgt * w, axis=1)
-    fp = jnp.sum(preds_t * (1 - tgt) * w, axis=1)
-    fn = jnp.sum((1 - preds_t) * tgt * w, axis=1)
-    tn = jnp.sum((1 - preds_t) * (1 - tgt) * w, axis=1)
-    return jnp.stack([jnp.stack([tn, fp], -1), jnp.stack([fn, tp], -1)], -2).astype(jnp.int32)  # (T,2,2)
+    k = jnp.searchsorted(thresholds, preds, side="right").astype(jnp.int32)  # pred >= thr_t <=> k > t
+    k = jnp.where(jnp.isnan(preds), 0, k)  # NaN pred: never predicted-positive (matches `>=` semantics)
+    pos_w = (target.astype(jnp.float32) * w)[:, None]
+    return _binned_confusion_from_bins(pos_w, w[:, None], k[:, None], len_t)[:, 0]  # (T, 2, 2)
 
 
 def _binary_precision_recall_curve_compute(
@@ -166,17 +206,15 @@ def _multiclass_precision_recall_curve_format(
 def _multiclass_precision_recall_curve_update(
     preds: Array, target: Array, num_classes: int, thresholds: Optional[Array], mask: Optional[Array] = None
 ) -> Array:
-    """Binned state (T, C, 2, 2). Jittable."""
+    """Binned state (T, C, 2, 2). Jittable (see _binned_confusion_from_bins)."""
     len_t = thresholds.shape[0]
     w = jnp.ones_like(target, dtype=jnp.float32) if mask is None else mask.astype(jnp.float32)
-    preds_t = (preds[None, :, :] >= thresholds[:, None, None]).astype(jnp.float32)  # (T, N, C)
-    tgt_oh = jax.nn.one_hot(target, num_classes)  # (N, C)
-    wv = w[None, :, None]
-    tp = jnp.sum(preds_t * tgt_oh[None] * wv, axis=1)  # (T, C)
-    fp = jnp.sum(preds_t * (1 - tgt_oh)[None] * wv, axis=1)
-    fn = jnp.sum((1 - preds_t) * tgt_oh[None] * wv, axis=1)
-    tn = jnp.sum((1 - preds_t) * (1 - tgt_oh)[None] * wv, axis=1)
-    return jnp.stack([jnp.stack([tn, fp], -1), jnp.stack([fn, tp], -1)], -2).astype(jnp.int32)  # (T,C,2,2)
+    k = jnp.searchsorted(thresholds, preds.reshape(-1), side="right").astype(jnp.int32)
+    k = k.reshape(preds.shape)  # (N, C)
+    k = jnp.where(jnp.isnan(preds), 0, k)  # NaN pred: never predicted-positive
+    pos_w = jax.nn.one_hot(target, num_classes) * w[:, None]  # (N, C)
+    all_w = jnp.broadcast_to(w[:, None], pos_w.shape)
+    return _binned_confusion_from_bins(pos_w, all_w, k, len_t)  # (T, C, 2, 2)
 
 
 def _multiclass_precision_recall_curve_compute(
@@ -255,15 +293,13 @@ def _multilabel_precision_recall_curve_format(
 def _multilabel_precision_recall_curve_update(
     preds: Array, target: Array, num_labels: int, thresholds: Optional[Array], mask: Optional[Array] = None
 ) -> Array:
+    len_t = thresholds.shape[0]
     w = jnp.ones_like(target, dtype=jnp.float32) if mask is None else mask.astype(jnp.float32)
-    preds_t = (preds[None, :, :] >= thresholds[:, None, None]).astype(jnp.float32)  # (T, N, L)
-    tgt = target[None].astype(jnp.float32)
-    wv = w[None]
-    tp = jnp.sum(preds_t * tgt * wv, axis=1)
-    fp = jnp.sum(preds_t * (1 - tgt) * wv, axis=1)
-    fn = jnp.sum((1 - preds_t) * tgt * wv, axis=1)
-    tn = jnp.sum((1 - preds_t) * (1 - tgt) * wv, axis=1)
-    return jnp.stack([jnp.stack([tn, fp], -1), jnp.stack([fn, tp], -1)], -2).astype(jnp.int32)
+    k = jnp.searchsorted(thresholds, preds.reshape(-1), side="right").astype(jnp.int32)
+    k = k.reshape(preds.shape)  # (N, L)
+    k = jnp.where(jnp.isnan(preds), 0, k)  # NaN pred: never predicted-positive
+    pos_w = target.astype(jnp.float32) * w
+    return _binned_confusion_from_bins(pos_w, w, k, len_t)  # (T, L, 2, 2)
 
 
 def _multilabel_precision_recall_curve_compute(
