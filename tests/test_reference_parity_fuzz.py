@@ -413,3 +413,52 @@ def test_chrf_word_ngrams_with_punctuation():
     np.testing.assert_allclose(
         np.asarray(FT.chrf_score(preds, tgts, whitespace=True)),
         RFT.chrf_score(preds, tgts, whitespace=True).numpy(), atol=1e-5)
+
+
+def test_multidim_samplewise_sweep():
+    """Every stat-scores consumer x {global, samplewise} x average x
+    ignore_index on (N, C, d) multidim inputs must match the reference —
+    the samplewise state path and the macro/weighted stat-scores reductions
+    (reference stat_scores.py:422-448) are only reachable this way."""
+    rng = np.random.RandomState(7)
+    p = rng.rand(6, 5, 4).astype(np.float32)
+    p /= p.sum(1, keepdims=True)
+    t = rng.randint(0, 5, (6, 4))
+    ti = t.copy()
+    ti[0, 0] = -1
+    fns = ["multiclass_accuracy", "multiclass_precision", "multiclass_recall",
+           "multiclass_f1_score", "multiclass_specificity", "multiclass_stat_scores",
+           "multiclass_hamming_distance", "multiclass_exact_match"]
+    for name in fns:
+        for mda in ("global", "samplewise"):
+            avgs = ("micro", "macro", "weighted", "none") if "exact" not in name else (None,)
+            for avg in avgs:
+                for tgt, ii in ((t, None), (ti, -1)):
+                    kw = dict(num_classes=5, multidim_average=mda)
+                    if avg is not None:
+                        kw["average"] = avg
+                    if ii is not None:
+                        kw["ignore_index"] = ii
+                    ours = np.asarray(getattr(FC, name)(jnp.asarray(p), jnp.asarray(tgt), **kw),
+                                      dtype=np.float64)
+                    ref = np.asarray(getattr(RFC, name)(torch.tensor(p), torch.tensor(tgt), **kw).numpy(),
+                                     dtype=np.float64)
+                    assert ours.shape == ref.shape, f"{name} {mda} {avg} ii={ii}: {ours.shape} vs {ref.shape}"
+                    np.testing.assert_allclose(ours, ref, atol=1e-5, equal_nan=True,
+                                               err_msg=f"{name} {mda} {avg} ii={ii}")
+
+    # multilabel: (N, L, d) inputs through the same grid
+    pl = rng.rand(6, 4, 3).astype(np.float32)
+    tl = rng.randint(0, 2, (6, 4, 3))
+    for name in ["multilabel_f1_score", "multilabel_stat_scores", "multilabel_accuracy"]:
+        for mda in ("global", "samplewise"):
+            for avg in ("micro", "macro", "weighted", "none"):
+                ours = np.asarray(getattr(FC, name)(
+                    jnp.asarray(pl), jnp.asarray(tl), num_labels=4, multidim_average=mda, average=avg),
+                    dtype=np.float64)
+                ref = np.asarray(getattr(RFC, name)(
+                    torch.tensor(pl), torch.tensor(tl), num_labels=4, multidim_average=mda,
+                    average=avg).numpy(), dtype=np.float64)
+                assert ours.shape == ref.shape, f"{name} {mda} {avg}: {ours.shape} vs {ref.shape}"
+                np.testing.assert_allclose(ours, ref, atol=1e-5, equal_nan=True,
+                                           err_msg=f"{name} {mda} {avg}")

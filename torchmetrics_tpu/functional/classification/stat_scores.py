@@ -331,9 +331,17 @@ def _multiclass_stat_scores_update(
 def _multiclass_stat_scores_compute(
     tp: Array, fp: Array, tn: Array, fn: Array, average: Optional[str], multidim_average: str = "global"
 ) -> Array:
+    """Stack [tp, fp, tn, fn, support] and reduce the class axis per
+    ``average`` (reference ``stat_scores.py:422-448``)."""
     res = jnp.stack([tp, fp, tn, fn, tp + fn], axis=-1)
     if average == "micro":
         return jnp.sum(res, axis=-2)
+    if average == "macro":
+        return jnp.mean(res.astype(jnp.float32), axis=-2)
+    if average == "weighted":
+        weight = (tp + fn).astype(jnp.float32)
+        norm = weight / jnp.sum(weight, axis=-1, keepdims=True)
+        return jnp.sum(res.astype(jnp.float32) * norm[..., None], axis=-2)
     return res
 
 
@@ -400,9 +408,21 @@ def _multilabel_stat_scores_update(
 def _multilabel_stat_scores_compute(
     tp: Array, fp: Array, tn: Array, fn: Array, average: Optional[str], multidim_average: str = "global"
 ) -> Array:
+    """Stack [tp, fp, tn, fn, support] and reduce the label axis per
+    ``average`` (reference ``stat_scores.py:684-708``).
+
+    Deliberate reference quirk mirrored: multilabel ``weighted`` normalizes
+    by the GLOBAL support sum even under samplewise (``:705``, ``w.sum()``),
+    where the multiclass path normalizes per sample (``:445``)."""
     res = jnp.stack([tp, fp, tn, fn, tp + fn], axis=-1)
     if average == "micro":
         return jnp.sum(res, axis=-2)
+    if average == "macro":
+        return jnp.mean(res.astype(jnp.float32), axis=-2)
+    if average == "weighted":
+        weight = (tp + fn).astype(jnp.float32)
+        norm = weight / jnp.sum(weight)
+        return jnp.sum(res.astype(jnp.float32) * norm[..., None], axis=-2)
     return res
 
 
