@@ -462,3 +462,29 @@ def test_multidim_samplewise_sweep():
                 assert ours.shape == ref.shape, f"{name} {mda} {avg}: {ours.shape} vs {ref.shape}"
                 np.testing.assert_allclose(ours, ref, atol=1e-5, equal_nan=True,
                                            err_msg=f"{name} {mda} {avg}")
+
+
+def test_top_k_sweep():
+    """top_k in {2, 3} through every stat-scores consumer x average x
+    ignore_index (the one-hot top-k update path, stat_scores.py:258-272)."""
+    rng = np.random.RandomState(11)
+    p = rng.rand(40, 6).astype(np.float32)
+    p /= p.sum(1, keepdims=True)
+    t = rng.randint(0, 6, 40)
+    ti = t.copy()
+    ti[:3] = -1
+    for name in ["multiclass_accuracy", "multiclass_precision", "multiclass_recall",
+                 "multiclass_f1_score", "multiclass_specificity", "multiclass_stat_scores"]:
+        for k in (2, 3):
+            for avg in ("micro", "macro", "weighted", "none"):
+                for tgt, ii in ((t, None), (ti, -1)):
+                    kw = dict(num_classes=6, top_k=k, average=avg)
+                    if ii is not None:
+                        kw["ignore_index"] = ii
+                    ours = np.asarray(getattr(FC, name)(jnp.asarray(p), jnp.asarray(tgt), **kw),
+                                      dtype=np.float64)
+                    ref = np.asarray(getattr(RFC, name)(torch.tensor(p), torch.tensor(tgt), **kw).numpy(),
+                                     dtype=np.float64)
+                    assert ours.shape == ref.shape, f"{name} k={k} {avg} ii={ii}"
+                    np.testing.assert_allclose(ours, ref, atol=1e-5, equal_nan=True,
+                                               err_msg=f"{name} k={k} {avg} ii={ii}")
