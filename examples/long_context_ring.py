@@ -6,6 +6,11 @@ sequence. Runs on simulated devices:
     XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
         python examples/long_context_ring.py
 """
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))  # in-repo run
+
 import numpy as np
 
 import jax
@@ -18,6 +23,11 @@ from torchmetrics_tpu.text.perplexity import Perplexity
 
 def main() -> None:
     devs = jax.devices()
+    if len(devs) < 8:  # accelerator plugin active: fall back to the CPU mesh
+        try:
+            devs = jax.devices("cpu")
+        except RuntimeError:
+            pass
     assert len(devs) >= 8, "run with XLA_FLAGS=--xla_force_host_platform_device_count=8"
     mesh = Mesh(np.array(devs[:8]).reshape(8), ("sp",))
 
