@@ -38,6 +38,17 @@ class BinaryPrecisionRecallCurve(Metric):
     is_differentiable = False
     higher_is_better = None
     full_state_update = False
+    _signature_key = "binary_prc"
+
+    def _engine_signature(self):
+        thr = self.thresholds
+        # np conversion, not iteration: indexing a concrete array inside a
+        # jit trace lifts the elements to tracers
+        import numpy as np
+
+        thr_key = None if thr is None else tuple(np.asarray(thr, dtype=np.float64).tolist())
+        return (self._signature_key, getattr(self, "num_classes", None),
+                getattr(self, "num_labels", None), thr_key, self.ignore_index)
 
     def __init__(self, thresholds: Thresholds = None, ignore_index: Optional[int] = None,
                  validate_args: bool = True, **kwargs: Any) -> None:
@@ -92,6 +103,8 @@ class MulticlassPrecisionRecallCurve(Metric):
     is_differentiable = False
     higher_is_better = None
     full_state_update = False
+    _signature_key = "multiclass_prc"
+    _engine_signature = BinaryPrecisionRecallCurve._engine_signature
 
     def __init__(self, num_classes: int, thresholds: Thresholds = None, ignore_index: Optional[int] = None,
                  validate_args: bool = True, **kwargs: Any) -> None:
@@ -146,6 +159,8 @@ class MultilabelPrecisionRecallCurve(Metric):
     is_differentiable = False
     higher_is_better = None
     full_state_update = False
+    _signature_key = "multilabel_prc"
+    _engine_signature = BinaryPrecisionRecallCurve._engine_signature
 
     def __init__(self, num_labels: int, thresholds: Thresholds = None, ignore_index: Optional[int] = None,
                  validate_args: bool = True, **kwargs: Any) -> None:
@@ -186,6 +201,11 @@ class MultilabelPrecisionRecallCurve(Metric):
         return _multilabel_precision_recall_curve_compute(self.confmat, self.num_labels, self.thresholds)
 
     plot = BinaryPrecisionRecallCurve.plot
+
+
+BinaryPrecisionRecallCurve._signature_base = BinaryPrecisionRecallCurve
+MulticlassPrecisionRecallCurve._signature_base = MulticlassPrecisionRecallCurve
+MultilabelPrecisionRecallCurve._signature_base = MultilabelPrecisionRecallCurve
 
 
 class PrecisionRecallCurve(_ClassificationTaskWrapper):

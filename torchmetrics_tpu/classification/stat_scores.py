@@ -40,7 +40,13 @@ Array = jax.Array
 
 
 class _AbstractStatScores(Metric):
-    """Owns tp/fp/tn/fn state registration + accumulation."""
+    """Owns tp/fp/tn/fn state registration + accumulation.
+
+    Each task base sets ``_signature_base`` (see ``Metric.update_signature``)
+    and provides ``_engine_signature()`` — ``average`` is deliberately
+    excluded from the signatures: it only affects ``compute``, never the
+    state, so e.g. Accuracy/F1/Precision over one engine share updates.
+    """
 
     def _create_state(self, size: int, multidim_average: str = "global") -> None:
         if multidim_average == "samplewise":
@@ -104,6 +110,9 @@ class BinaryStatScores(_AbstractStatScores):
         tp, fp, tn, fn = _binary_stat_scores_update(preds, target, mask, self.multidim_average)
         self._update_state(tp, fp, tn, fn)
 
+    def _engine_signature(self):
+        return ("binary_stat_scores", self.threshold, self.multidim_average, self.ignore_index)
+
     def compute(self) -> Array:
         tp, fp, tn, fn = self._final_state()
         return _binary_stat_scores_compute(tp, fp, tn, fn, self.multidim_average)
@@ -149,6 +158,9 @@ class MulticlassStatScores(_AbstractStatScores):
             preds, target, self.num_classes, self.top_k, self.multidim_average, self.ignore_index
         )
         self._update_state(tp, fp, tn, fn)
+
+    def _engine_signature(self):
+        return ("multiclass_stat_scores", self.num_classes, self.top_k, self.multidim_average, self.ignore_index)
 
     def compute(self) -> Array:
         tp, fp, tn, fn = self._final_state()
@@ -196,9 +208,18 @@ class MultilabelStatScores(_AbstractStatScores):
         tp, fp, tn, fn = _multilabel_stat_scores_update(preds, target, mask, self.multidim_average)
         self._update_state(tp, fp, tn, fn)
 
+    def _engine_signature(self):
+        return ("multilabel_stat_scores", self.num_labels, self.threshold, self.multidim_average,
+                self.ignore_index)
+
     def compute(self) -> Array:
         tp, fp, tn, fn = self._final_state()
         return _multilabel_stat_scores_compute(tp, fp, tn, fn, self.average, self.multidim_average)
+
+
+BinaryStatScores._signature_base = BinaryStatScores
+MulticlassStatScores._signature_base = MulticlassStatScores
+MultilabelStatScores._signature_base = MultilabelStatScores
 
 
 class StatScores(_ClassificationTaskWrapper):

@@ -356,18 +356,63 @@ class MetricCollection:
     # ------------------------------------------------------------------
     # pure-functional SPMD API: one pytree for the whole collection
     # ------------------------------------------------------------------
+    def _grouped_apply(self, states: Dict[str, Any], fn) -> Dict[str, Any]:
+        """Apply ``fn(metric, state)`` per member, sharing one result across
+        members with equal ``update_signature`` AND identical input state
+        leaves. The leaf-identity guard makes hand-mixed per-member states
+        (the per-metric pure API is public) fall back to independent
+        application instead of silently inheriting a peer's counts —
+        the trace-safe analogue of the reference compute groups' post-update
+        state comparison (``collections.py:264``).
+        """
+        import jax.tree_util as jtu
+
+        out: Dict[str, Any] = {}
+        shared: Dict[Any, Tuple[tuple, Any]] = {}
+        for name, m in self._metrics.items():
+            sig = m.update_signature
+            leaf_ids = None
+            if sig is not None:
+                leaf_ids = tuple(id(leaf) for leaf in jtu.tree_leaves(states[name]))
+                cached = shared.get(sig)
+                if cached is not None and cached[0] == leaf_ids:
+                    out[name] = cached[1]
+                    continue
+            out[name] = fn(m, states[name])
+            if sig is not None:
+                shared[sig] = (leaf_ids, out[name])
+        return out
+
     def init_state(self) -> Dict[str, Any]:
-        return {name: m.init_state() for name, m in self._metrics.items()}
+        """Per-member initial states; signature groups ALIAS one subtree so
+        the sharing guard in :meth:`_grouped_apply` engages from the start."""
+        out: Dict[str, Any] = {}
+        shared: Dict[Any, Any] = {}
+        for name, m in self._metrics.items():
+            sig = m.update_signature
+            if sig is not None and sig in shared:
+                out[name] = shared[sig]
+                continue
+            out[name] = m.init_state()
+            if sig is not None:
+                shared[sig] = out[name]
+        return out
 
     def update_state(self, states: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
-        """Pure fused update over all members — trace under one jit/shard_map."""
-        out = {}
-        for name, m in self._metrics.items():
-            out[name] = m.update_state(states[name], *args, **_filter_kwargs(m._update_impl, **kwargs))
-        return out
+        """Pure fused update over all members — trace under one jit/shard_map.
+
+        Members with equal ``update_signature`` (same engine, same
+        state-affecting parameters — e.g. Accuracy/Precision/F1 over one
+        stat-scores engine) run ONE update and share the resulting subtree
+        (see :meth:`_grouped_apply`).
+        """
+        return self._grouped_apply(
+            states, lambda m, s: m.update_state(s, *args, **_filter_kwargs(m._update_impl, **kwargs))
+        )
 
     def compute_state(self, states: Dict[str, Any]) -> Dict[str, Any]:
         return {self._set_name(name): m.compute_state(states[name]) for name, m in self._metrics.items()}
 
     def reduce_state(self, states: Dict[str, Any], axis_name: str) -> Dict[str, Any]:
-        return {name: m.reduce_state(states[name], axis_name) for name, m in self._metrics.items()}
+        """Per-member collective reduction; signature groups reduce once."""
+        return self._grouped_apply(states, lambda m, s: m.reduce_state(s, axis_name))

@@ -122,6 +122,32 @@ class Metric:
     plot_upper_bound: Optional[float] = None
     plot_legend_name: Optional[str] = None
 
+    _signature_base: Optional[type] = None  # engine base whose update must be unoverridden
+
+    @property
+    def update_signature(self):
+        """Hashable key identifying this metric's update semantics, or None.
+
+        Two metrics with equal signatures produce identical states from
+        identical inputs (same engine, same parameters) — the trace-safe
+        analogue of the reference's post-update state comparison for
+        compute groups (``collections.py:264``). ``MetricCollection``'s pure
+        ``update_state``/``reduce_state`` run one update per distinct
+        signature and share the resulting state subtree across members whose
+        input states are identical.
+
+        Engine base classes set ``_signature_base`` to themselves and
+        implement ``_engine_signature()`` returning the key; the guard here
+        disables sharing for any subclass that overrides ``update``.
+        """
+        base = self._signature_base
+        if base is None or type(self).update is not base.update:
+            return None
+        return self._engine_signature()
+
+    def _engine_signature(self):
+        raise NotImplementedError  # pragma: no cover - only reached via _signature_base
+
     def __init__(
         self,
         *,
