@@ -3,6 +3,8 @@
 Mirrors the reference's strategy of checking ``functional/segmentation/utils``
 against scipy (``tests/unittests`` use scipy.ndimage as the oracle)."""
 import numpy as np
+
+import jax.numpy as jnp
 import pytest
 from scipy import ndimage
 
@@ -118,3 +120,41 @@ def test_distance_transform_no_background():
     img = np.ones((5, 5), np.int32)
     out = np.asarray(distance_transform(img))
     assert np.isinf(out).all()
+
+
+def test_parity_vs_reference_torch():
+    """binary_erosion + distance_transform (all 3 metrics, with sampling)
+    against the reference's torch implementations on random masks."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "helpers"))
+    from lightning_utilities_stub import install_stub
+
+    install_stub()
+    sys.path.insert(0, "/root/reference/src")
+    try:
+        import torch
+        import torchmetrics.functional.segmentation.utils as RU
+    except ImportError:
+        pytest.skip("reference not importable")
+    finally:
+        sys.path.remove("/root/reference/src")
+
+    import torchmetrics_tpu.functional.segmentation.utils as OU
+
+    rng = np.random.RandomState(0)
+    for trial in range(4):
+        mask = rng.rand(24, 24) > 0.4
+        ref = RU.binary_erosion(torch.tensor(mask[None, None].astype(np.float32))).numpy()[0, 0]
+        got = np.asarray(OU.binary_erosion(jnp.asarray(mask[None, None].astype(np.int32))))[0, 0]
+        np.testing.assert_array_equal(got.astype(bool), ref.astype(bool), err_msg=f"erosion {trial}")
+        for metric in ("euclidean", "chessboard", "taxicab"):
+            ref = RU.distance_transform(torch.tensor(mask.astype(np.float32)), metric=metric).numpy()
+            got = np.asarray(OU.distance_transform(jnp.asarray(mask.astype(np.float32)), metric=metric))
+            np.testing.assert_allclose(got, ref, atol=1e-4, err_msg=f"dt {metric} {trial}")
+        ref = RU.distance_transform(
+            torch.tensor(mask.astype(np.float32)), sampling=[2, 1], metric="euclidean").numpy()
+        got = np.asarray(OU.distance_transform(
+            jnp.asarray(mask.astype(np.float32)), sampling=[2, 1], metric="euclidean"))
+        np.testing.assert_allclose(got, ref, atol=1e-4, err_msg=f"dt sampling {trial}")
