@@ -324,3 +324,56 @@ def test_image_data_range_tuple():
             rf(torch.tensor(b), torch.tensor(a), **kw).numpy(),
             rtol=1e-4, atol=1e-4, err_msg=name,
         )
+
+
+def test_nominal_bias_correction_and_nan_strategy():
+    """bias_correction on CramersV/TschuprowsT and nan_strategy=replace."""
+    import torchmetrics.functional.nominal as RFN
+
+    import torchmetrics_tpu.functional.nominal as FN
+
+    rng = np.random.RandomState(4)
+    a = rng.randint(0, 4, 60)
+    b = rng.randint(0, 3, 60)
+    for fn_name in ("cramers_v", "tschuprows_t"):
+        for bias in (True, False):
+            ours = float(getattr(FN, fn_name)(jnp.asarray(a), jnp.asarray(b), bias_correction=bias))
+            ref = float(getattr(RFN, fn_name)(torch.tensor(a), torch.tensor(b), bias_correction=bias))
+            assert ours == pytest.approx(ref, abs=1e-5) or (np.isnan(ours) and np.isnan(ref)), \
+                f"{fn_name} bias={bias}"
+    an = a.astype(np.float32)
+    an[0] = np.nan
+    ours = float(FN.cramers_v(jnp.asarray(an), jnp.asarray(b.astype(np.float32)),
+                              nan_strategy="replace", nan_replace_value=0.0))
+    ref = float(RFN.cramers_v(torch.tensor(an), torch.tensor(b.astype(np.float32)),
+                              nan_strategy="replace", nan_replace_value=0.0))
+    assert ours == pytest.approx(ref, abs=1e-5)
+
+
+def test_retrieval_class_option_surfaces():
+    """aggregation modes + ignore_index through the class layer vs the
+    reference (empty_target_action is covered across 8 classes by
+    tests/test_reference_parity_wrappers.py)."""
+    import torchmetrics.retrieval as RRet
+
+    import torchmetrics_tpu.retrieval as ORet
+
+    rng = np.random.RandomState(9)
+    n = 30
+    p = rng.rand(n).astype(np.float32)
+    t = rng.randint(0, 2, n)
+    idx = np.sort(rng.randint(0, 5, n))
+    t[idx == 0] = 1  # ensure no all-negative query: isolate the options under test
+    for agg in ("median", "min", "max"):
+        ours = ORet.RetrievalMAP(aggregation=agg)
+        ref = RRet.RetrievalMAP(aggregation=agg)
+        ours.update(jnp.asarray(p), jnp.asarray(t), indexes=jnp.asarray(idx))
+        ref.update(torch.tensor(p), torch.tensor(t), indexes=torch.tensor(idx))
+        assert float(ours.compute()) == pytest.approx(float(ref.compute()), abs=1e-5), f"agg={agg}"
+    ti = t.copy()
+    ti[7] = -1
+    ours = ORet.RetrievalMAP(ignore_index=-1)
+    ref = RRet.RetrievalMAP(ignore_index=-1)
+    ours.update(jnp.asarray(p), jnp.asarray(ti), indexes=jnp.asarray(idx))
+    ref.update(torch.tensor(p), torch.tensor(ti), indexes=torch.tensor(idx))
+    assert float(ours.compute()) == pytest.approx(float(ref.compute()), abs=1e-5)
