@@ -377,3 +377,55 @@ def test_retrieval_class_option_surfaces():
     ours.update(jnp.asarray(p), jnp.asarray(ti), indexes=jnp.asarray(idx))
     ref.update(torch.tensor(p), torch.tensor(ti), indexes=torch.tensor(idx))
     assert float(ours.compute()) == pytest.approx(float(ref.compute()), abs=1e-5)
+
+
+def test_kendall_variants_and_t_test():
+    """Kendall tau-b/tau-c with and without the t-test p-value, with ties."""
+    import torchmetrics.functional.regression as RFR
+
+    import torchmetrics_tpu.functional.regression as FR
+
+    rng = np.random.RandomState(2)
+    x = rng.randn(40).astype(np.float32)
+    y = (x + rng.randn(40)).astype(np.float32)
+    x[5] = x[6]  # ties
+    for variant in ("b", "c"):
+        for alt in (None, "two-sided", "less", "greater"):
+            kw = {"variant": variant}
+            if alt:
+                kw.update(t_test=True, alternative=alt)
+            ours = FR.kendall_rank_corrcoef(jnp.asarray(x), jnp.asarray(y), **kw)
+            ref = RFR.kendall_rank_corrcoef(torch.tensor(x), torch.tensor(y), **kw)
+            ours = np.atleast_1d(np.asarray(ours, dtype=np.float64)).ravel()
+            ref_np = np.asarray([t.numpy() for t in ref] if isinstance(ref, tuple) else ref.numpy(),
+                                dtype=np.float64).ravel()
+            np.testing.assert_allclose(ours, ref_np, atol=1e-4, err_msg=f"kendall {variant} {alt}")
+
+
+def test_ssim_msssim_option_surfaces():
+    """MS-SSIM normalize/kernel/sigma options + SSIM uniform kernel, custom
+    k1/k2, and wide sigma (1e-4 tolerance there: conv accumulation-order
+    noise with the wider kernel; the gaussian kernels themselves match the
+    reference to ~1e-7)."""
+    import torchmetrics.functional.image as RFI
+
+    import torchmetrics_tpu.functional.image as FI
+
+    rng = np.random.RandomState(2)
+    a = np.clip(rng.rand(1, 1, 192, 192).astype(np.float32), 0, 1)
+    b = np.clip(a + rng.randn(1, 1, 192, 192).astype(np.float32) * 0.05, 0, 1)
+    # norm=None uses sigma 1.0: with sigma>=2 the reference's contrast
+    # sensitivity dips float-negative at some scale and its unguarded
+    # fractional power returns nan (ours stays finite on the same inputs)
+    for kernel, sigma, norm in ((7, 1.0, "relu"), (11, 1.5, "simple"), (9, 1.0, None)):
+        ours = float(FI.multiscale_structural_similarity_index_measure(
+            jnp.asarray(b), jnp.asarray(a), data_range=1.0, kernel_size=kernel, sigma=sigma, normalize=norm))
+        ref = float(RFI.multiscale_structural_similarity_index_measure(
+            torch.tensor(b), torch.tensor(a), data_range=1.0, kernel_size=kernel, sigma=sigma, normalize=norm))
+        assert ours == pytest.approx(ref, abs=1e-4), f"msssim k={kernel} sigma={sigma} norm={norm}"
+    for kw, tol in (({"gaussian_kernel": False, "kernel_size": 9}, 1e-5),
+                    ({"k1": 0.02, "k2": 0.05}, 1e-5),
+                    ({"sigma": 2.5}, 1e-4)):
+        ours = float(FI.structural_similarity_index_measure(jnp.asarray(b), jnp.asarray(a), data_range=1.0, **kw))
+        ref = float(RFI.structural_similarity_index_measure(torch.tensor(b), torch.tensor(a), data_range=1.0, **kw))
+        assert ours == pytest.approx(ref, abs=tol), f"ssim {kw}"

@@ -151,12 +151,21 @@ def _multiscale_ssim_update(
     sim_list: List[Array] = []
     cs_list: List[Array] = []
     h, w = preds.shape[-2], preds.shape[-1]
-    k0 = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
-    min_size = (k0 - 1) * max(1, (len(betas) - 1)) ** 2
-    if h < min_size or w < min_size:
+    kh = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    kw = kernel_size if isinstance(kernel_size, int) else kernel_size[1]
+    # reference ``ssim.py:388-399``: after the len(betas)-1 halvings the
+    # deepest pyramid level must still be larger than the kernel, checked
+    # per dimension with the reference's floor-division form
+    betas_div = max(1, 2 ** (len(betas) - 1))
+    if h // betas_div <= kh - 1:
         raise ValueError(
-            f"For a given number of `betas` parameters {len(betas)} and kernel size {k0}, the image height and "
-            f"width should be larger than {min_size}, but got height={h} and width={w}."
+            f"For a given number of `betas` parameters {len(betas)} and kernel size {kh},"
+            f" the image height must be larger than {(kh - 1) * betas_div}."
+        )
+    if w // betas_div <= kw - 1:
+        raise ValueError(
+            f"For a given number of `betas` parameters {len(betas)} and kernel size {kw},"
+            f" the image width must be larger than {(kw - 1) * betas_div}."
         )
     for i in range(len(betas)):
         sim, cs = _ssim_update(
@@ -175,6 +184,9 @@ def _multiscale_ssim_update(
         cs_stack = jax.nn.relu(cs_stack)
     betas_arr = jnp.asarray(betas)[:, None]
     mcs_and_ssim = jnp.concatenate([cs_stack[:-1], sim_stack[-1:]], axis=0)
+    if normalize == "simple":
+        # reference ``ssim.py:419``: shift the stacked values into [0, 1]
+        mcs_and_ssim = (mcs_and_ssim + 1) / 2
     return jnp.prod(mcs_and_ssim ** betas_arr, axis=0)
 
 
@@ -191,7 +203,11 @@ def multiscale_structural_similarity_index_measure(
     betas: Sequence[float] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
     normalize: Optional[str] = "relu",
 ) -> Array:
-    """Parity: reference ``ssim.py:533``."""
+    """Parity: reference ``ssim.py:533`` (incl. its betas/normalize validation, :512-522)."""
+    if not isinstance(betas, (tuple, list)) or not all(isinstance(b, float) for b in betas):
+        raise ValueError("Argument `betas` is expected to be of a type tuple or list of floats")
+    if normalize is not None and normalize not in ("relu", "simple"):
+        raise ValueError("Argument `normalize` to be expected either `None` or one of 'relu' or 'simple'")
     preds, target = _ssim_check_inputs(preds, target)
     vals = _multiscale_ssim_update(
         preds, target, gaussian_kernel, sigma, kernel_size, data_range, k1, k2, betas, normalize
