@@ -429,3 +429,46 @@ def test_ssim_msssim_option_surfaces():
         ours = float(FI.structural_similarity_index_measure(jnp.asarray(b), jnp.asarray(a), data_range=1.0, **kw))
         ref = float(RFI.structural_similarity_index_measure(torch.tensor(b), torch.tensor(a), data_range=1.0, **kw))
         assert ours == pytest.approx(ref, abs=tol), f"ssim {kw}"
+
+
+def test_audio_text_option_surfaces():
+    """zero_mean/load_diag/filter_length on SNR/SI-SDR/SDR; BLEU n_gram/
+    smooth/weights; CHRF order/beta/case/whitespace/sentence-level; TER
+    normalize/punctuation/case/asian_support."""
+    import torchmetrics.functional.audio as RFA
+    import torchmetrics.functional.text as RFT
+
+    import torchmetrics_tpu.functional.audio as FA
+    import torchmetrics_tpu.functional.text as FT
+
+    rng = np.random.RandomState(1)
+    t = rng.randn(2, 2000).astype(np.float32)
+    p = (t + rng.randn(2, 2000).astype(np.float32) * 0.2).astype(np.float32)
+    for kw in ({"zero_mean": True}, {"zero_mean": False}):
+        for fn in ("signal_noise_ratio", "scale_invariant_signal_distortion_ratio"):
+            np.testing.assert_allclose(
+                np.asarray(getattr(FA, fn)(jnp.asarray(p), jnp.asarray(t), **kw)),
+                getattr(RFA, fn)(torch.tensor(p), torch.tensor(t), **kw).numpy(),
+                atol=1e-3, rtol=1e-4, err_msg=f"{fn} {kw}")
+    for kw in ({"zero_mean": True}, {"load_diag": 1e-5}, {"filter_length": 256}):
+        np.testing.assert_allclose(
+            np.asarray(FA.signal_distortion_ratio(jnp.asarray(p), jnp.asarray(t), **kw)),
+            RFA.signal_distortion_ratio(torch.tensor(p), torch.tensor(t), **kw).numpy(),
+            atol=2e-2, rtol=1e-3, err_msg=f"sdr {kw}")
+
+    preds = ["the cat sat on the mat tonight", "hello there general kenobi"]
+    tgts = [["a cat sat on the mat", "the cat sat on a mat"], ["hello there general kenobi", "hello there"]]
+    for kw in ({"n_gram": 2}, {"n_gram": 4, "smooth": True}, {"n_gram": 2, "weights": [0.6, 0.4]}):
+        assert float(FT.bleu_score(preds, tgts, **kw)) == pytest.approx(
+            float(RFT.bleu_score(preds, tgts, **kw)), abs=1e-5), f"bleu {kw}"
+    for kw in ({"n_char_order": 4}, {"n_word_order": 0}, {"lowercase": True}, {"whitespace": True},
+               {"return_sentence_level_score": True}, {"beta": 1.0}):
+        ours = FT.chrf_score(preds, tgts, **kw)
+        ref = RFT.chrf_score(preds, tgts, **kw)
+        if isinstance(ref, tuple):
+            np.testing.assert_allclose(np.asarray(ours[1]), ref[1].numpy(), atol=1e-5)
+            ours, ref = ours[0], ref[0]
+        assert float(ours) == pytest.approx(float(ref), abs=1e-5), f"chrf {kw}"
+    for kw in ({"normalize": True}, {"no_punctuation": True}, {"lowercase": False}, {"asian_support": True}):
+        assert float(FT.translation_edit_rate(preds, tgts, **kw)) == pytest.approx(
+            float(RFT.translation_edit_rate(preds, tgts, **kw)), abs=1e-5), f"ter {kw}"
