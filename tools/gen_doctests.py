@@ -173,27 +173,6 @@ def text_corpus(name, ctor="", final="round(float(metric.compute()), 4)"):
     ]
 
 
-def boxes_iou(name):
-    return [
-        f"from torchmetrics_tpu import {name}",
-        f"metric = {name}()",
-        'preds = [{"boxes": jnp.asarray([[10.0, 10.0, 60.0, 60.0]]), "scores": jnp.asarray([0.9]), "labels": jnp.asarray([0])}]',
-        'target = [{"boxes": jnp.asarray([[12.0, ip_y := 8.0, 58.0, 62.0]]), "labels": jnp.asarray([0])}]',
-        "metric.update(preds, target)",
-        'round(float(metric.compute()["iou"]), 4)' if name == "IntersectionOverUnion" else
-        f'round(float(metric.compute()["{_iou_key(name)}"]), 4)',
-    ]
-
-
-def _iou_key(name):
-    return {
-        "IntersectionOverUnion": "iou",
-        "GeneralizedIntersectionOverUnion": "giou",
-        "DistanceIntersectionOverUnion": "diou",
-        "CompleteIntersectionOverUnion": "ciou",
-    }[name]
-
-
 CLASS_SNIPPETS = {}
 
 for n, fin in [
@@ -442,15 +421,20 @@ CLASS_SNIPPETS["Perplexity"] = [
     "round(float(metric.compute()), 4)",
 ]
 
-for n in ["IntersectionOverUnion", "GeneralizedIntersectionOverUnion",
-          "DistanceIntersectionOverUnion", "CompleteIntersectionOverUnion"]:
+_IOU_KEYS = {
+    "IntersectionOverUnion": "iou",
+    "GeneralizedIntersectionOverUnion": "giou",
+    "DistanceIntersectionOverUnion": "diou",
+    "CompleteIntersectionOverUnion": "ciou",
+}
+for n in _IOU_KEYS:
     CLASS_SNIPPETS[n] = [
         f"from torchmetrics_tpu import {n}",
         f"metric = {n}()",
         'preds = [{"boxes": jnp.asarray([[10.0, 10.0, 60.0, 60.0]]), "scores": jnp.asarray([0.9]), "labels": jnp.asarray([0])}]',
         'target = [{"boxes": jnp.asarray([[12.0, 8.0, 58.0, 62.0]]), "labels": jnp.asarray([0])}]',
         "metric.update(preds, target)",
-        f'round(float(metric.compute()["{_iou_key(n)}"]), 4)',
+        f'round(float(metric.compute()["{_IOU_KEYS[n]}"]), 4)',
     ]
 CLASS_SNIPPETS["MeanAveragePrecision"] = [
     "from torchmetrics_tpu import MeanAveragePrecision",

@@ -101,6 +101,13 @@ def _binned_confusion_from_bins(pos_w: Array, all_w: Array, bin_idx: Array, len_
     as suffix sums over the bin axis — O(N·C·T) MACs but ~8x less memory
     traffic than the comparison form.
 
+    Exactness bound: counts accumulate in f32, so a single update is
+    integer-exact only up to 2**24 samples per (class, bin) cell — the
+    same ceiling the previous comparison-based form had (and the same
+    per-bin f32 ceiling ``_multiclass_stat_scores_update`` documents for
+    its own paths). Exceeding it within one update silently loses
+    low-order counts; split such updates into <2**24-sample chunks.
+
     pos_w/all_w: (N, C) per-sample weights for positives / all samples;
     bin_idx: (N, C) ints in [0, T].
     """

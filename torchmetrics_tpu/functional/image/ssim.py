@@ -153,10 +153,16 @@ def _multiscale_ssim_update(
     h, w = preds.shape[-2], preds.shape[-1]
     kh = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
     kw = kernel_size if isinstance(kernel_size, int) else kernel_size[1]
-    # reference ``ssim.py:388-399``: after the len(betas)-1 halvings the
-    # deepest pyramid level must still be larger than the kernel, checked
-    # per dimension with the reference's floor-division form
-    betas_div = max(1, 2 ** (len(betas) - 1))
+    # reference ``ssim.py:383-399``: both size gates mirrored exactly,
+    # including the reference's (len(betas)-1)**2 divisor (NOT the
+    # 2**(len(betas)-1) pyramid factor — they coincide only for 1/3/5
+    # betas, and reference-exact validation means matching its form)
+    if h < 2 ** len(betas) or w < 2 ** len(betas):
+        raise ValueError(
+            f"For a given number of `betas` parameters {len(betas)}, the image height and width dimensions must be"
+            f" larger than or equal to {2 ** len(betas)}."
+        )
+    betas_div = max(1, len(betas) - 1) ** 2
     if h // betas_div <= kh - 1:
         raise ValueError(
             f"For a given number of `betas` parameters {len(betas)} and kernel size {kh},"

@@ -10,6 +10,7 @@ Offline build: the architecture + weight converter live here; pretrained
 tensors (torch ``state_dict``) convert via :func:`convert_lpips_torch` when
 available locally. Random init exercises the full pipeline for tests.
 """
+import warnings
 from typing import Dict, Sequence, Tuple
 
 import jax
@@ -186,6 +187,14 @@ def make_lpips(net_type: str = "alex", rng_seed: int = 0, pretrained_heads: bool
     mod = LPIPSNet(net_type=net_type)
     params = mod.init(jax.random.PRNGKey(rng_seed), jnp.zeros((1, 3, 64, 64)), jnp.zeros((1, 3, 64, 64)))
     if pretrained_heads:
+        warnings.warn(
+            "make_lpips: trained LPIPS heads are overlaid on a RANDOM-init backbone;"
+            " distances are self-consistent but not comparable to reference LPIPS"
+            " until converted torchvision backbone weights are loaded via"
+            " convert_lpips_torch().",
+            UserWarning,
+            stacklevel=2,
+        )
         inner = dict(params["params"])
         inner.update(lpips_head_params(net_type))
         params = {"params": inner}
