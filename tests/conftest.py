@@ -7,22 +7,45 @@ no process spawn at all (SURVEY.md §4 "TPU-framework translation").
 import os
 import random
 
+# TM_TPU_TESTS=1 switches the session into on-chip mode: the real TPU stays
+# the default backend (for kernels under test) and x64 is enabled so the CPU
+# backend can compute float64 oracles in the same process. Only tests marked
+# ``tpu`` run in that mode; everything else runs in the default CPU-forced
+# mode below.
+TPU_MODE = os.environ.get("TM_TPU_TESTS") == "1"
+
 # must happen before any backend is initialized; force CPU even when the
 # environment presets a TPU platform plugin (e.g. axon) — tests are
 # numerics-parity checks and must run fp32, not bf16 matmuls. The env var
 # alone is NOT enough: a platform plugin can override it on import, so we
 # also set the config flag, which is read last at backend-init time.
-os.environ["JAX_PLATFORMS"] = "cpu"
+if not TPU_MODE:
+    os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if TPU_MODE:
+    # f64 CPU oracles; explicit-f32 inputs keep the TPU side f32
+    jax.config.update("jax_enable_x64", True)
+else:
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+def pytest_collection_modifyitems(config, items):
+    skip_needs_tpu = pytest.mark.skip(reason="on-chip test: run with TM_TPU_TESTS=1 pytest tests/tpu -q")
+    skip_cpu_only = pytest.mark.skip(reason="CPU-parity test: not valid under TM_TPU_TESTS=1 (x64 + TPU backend)")
+    for item in items:
+        if "tpu" in item.keywords:
+            if not TPU_MODE:
+                item.add_marker(skip_needs_tpu)
+        elif TPU_MODE:
+            item.add_marker(skip_cpu_only)
 
 NUM_PROCESSES = 2  # emulated ranks for DDP-style tests
 NUM_BATCHES = 4    # needs to be a multiple of NUM_PROCESSES

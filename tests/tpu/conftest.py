@@ -1,0 +1,26 @@
+"""Fixtures for the on-chip parity suite (run: ``TM_TPU_TESTS=1 pytest tests/tpu -q``).
+
+Each test runs a metric kernel on the real TPU with explicit float32 inputs
+and the same kernel (or a float64 recast of it) on the CPU backend as oracle.
+The whole session runs with ``jax_enable_x64`` so CPU arrays can be float64
+while the TPU side stays float32 via explicit dtypes.
+"""
+import os
+
+import jax
+import pytest
+
+TPU_MODE = os.environ.get("TM_TPU_TESTS") == "1"
+
+if TPU_MODE and jax.default_backend() in ("cpu",):
+    pytest.skip("TM_TPU_TESTS=1 but no TPU backend available", allow_module_level=True)
+
+
+@pytest.fixture(scope="session")
+def tpu_device():
+    return jax.devices()[0]
+
+
+@pytest.fixture(scope="session")
+def cpu_device():
+    return jax.devices("cpu")[0]

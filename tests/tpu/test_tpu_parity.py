@@ -1,0 +1,272 @@
+"""On-chip numeric parity: representative kernels on the real TPU vs CPU-f64.
+
+The bug class this guards: XLA lowers f32 matmuls/convs to bfloat16 multiplies
+on TPU unless ``precision=HIGHEST`` is pinned (~1e-3 relative noise — found
+the hard way in round 2 in ``functional/image/helper.py``). Every family here
+asserts TPU-f32 vs CPU-float64 oracle within a stated tolerance roughly 10x
+above observed f32 roundoff and 10x below the bf16 failure signature, so a
+dropped pin anywhere in these code paths turns the suite red.
+
+Run: ``TM_TPU_TESTS=1 python -m pytest tests/tpu -q`` (the default CPU-forced
+session skips these; see tests/conftest.py).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.tpu
+
+RNG = np.random.default_rng(20260731)
+
+
+def run_on(device, fn, *args):
+    """Place array args on ``device``, run ``fn`` under it as default, return numpy."""
+    with jax.default_device(device):
+        placed = jax.tree.map(
+            lambda a: jax.device_put(a, device) if hasattr(a, "dtype") else a, args
+        )
+        out = fn(*placed)
+    return jax.tree.map(np.asarray, out)
+
+
+def rel_err(x, oracle):
+    """Scale-relative max abs error (denominator: max |oracle|)."""
+    x = np.asarray(x, dtype=np.float64)
+    oracle = np.asarray(oracle, dtype=np.float64)
+    denom = np.max(np.abs(oracle))
+    if denom == 0.0:
+        return float(np.max(np.abs(x)))
+    return float(np.max(np.abs(x - oracle)) / denom)
+
+
+def _f32(x):
+    return jnp.asarray(np.asarray(x), dtype=jnp.float32)
+
+
+def _f64(x):
+    return jnp.asarray(np.asarray(x), dtype=jnp.float64)
+
+
+# ---------------------------------------------------------------- image convs
+
+IMG_A = RNG.random((2, 3, 64, 64)).astype(np.float32)
+IMG_B = np.clip(IMG_A + 0.1 * RNG.standard_normal((2, 3, 64, 64)).astype(np.float32), 0, 1)
+
+
+@pytest.mark.parametrize(
+    ("name", "tol"),
+    [("ssim", 1e-4), ("ms_ssim", 1e-4), ("uqi", 1e-4), ("psnr", 1e-5)],
+)
+def test_image_conv_family(tpu_device, cpu_device, name, tol):
+    from torchmetrics_tpu.functional import (
+        multiscale_structural_similarity_index_measure,
+        peak_signal_noise_ratio,
+        structural_similarity_index_measure,
+        universal_image_quality_index,
+    )
+
+    fns = {
+        "ssim": lambda p, t: structural_similarity_index_measure(p, t, data_range=1.0),
+        "ms_ssim": lambda p, t: multiscale_structural_similarity_index_measure(p, t, data_range=1.0),
+        "uqi": universal_image_quality_index,
+        "psnr": lambda p, t: peak_signal_noise_ratio(p, t, data_range=1.0),
+    }
+    fn = fns[name]
+    if name == "ms_ssim":  # 5-beta pyramid requires >160 px per side
+        a = RNG.random((2, 3, 192, 192)).astype(np.float32)
+        b = np.clip(a + 0.1 * RNG.standard_normal(a.shape).astype(np.float32), 0, 1)
+    else:
+        a, b = IMG_A, IMG_B
+    got = run_on(tpu_device, fn, _f32(a), _f32(b))
+    oracle = run_on(cpu_device, fn, _f64(a), _f64(b))
+    assert rel_err(got, oracle) < tol, f"{name}: rel_err={rel_err(got, oracle):.2e}"
+
+
+# ------------------------------------------------- stat scores (one-hot MXU)
+
+def test_multiclass_stat_scores_exact(tpu_device, cpu_device):
+    from torchmetrics_tpu.functional.classification import multiclass_stat_scores
+
+    n, c = 4096, 100
+    preds = RNG.integers(0, c, n)
+    target = RNG.integers(0, c, n)
+    fn = lambda p, t: multiclass_stat_scores(p, t, num_classes=c, average=None)
+    got = run_on(tpu_device, fn, jnp.asarray(preds, jnp.int32), jnp.asarray(target, jnp.int32))
+    oracle = run_on(cpu_device, fn, jnp.asarray(preds, jnp.int32), jnp.asarray(target, jnp.int32))
+    # counts are integers: the MXU one-hot contraction must be bit-exact
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(oracle))
+
+
+def test_confusion_matrix_exact(tpu_device, cpu_device):
+    from torchmetrics_tpu.functional.classification import multiclass_confusion_matrix
+
+    n, c = 2048, 37
+    preds = RNG.integers(0, c, n)
+    target = RNG.integers(0, c, n)
+    fn = lambda p, t: multiclass_confusion_matrix(p, t, num_classes=c)
+    got = run_on(tpu_device, fn, jnp.asarray(preds, jnp.int32), jnp.asarray(target, jnp.int32))
+    oracle = run_on(cpu_device, fn, jnp.asarray(preds, jnp.int32), jnp.asarray(target, jnp.int32))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(oracle))
+
+
+# ------------------------------------------------------------- binned curves
+
+def test_binned_precision_recall_curve(tpu_device, cpu_device):
+    from torchmetrics_tpu.functional.classification import binary_precision_recall_curve
+
+    n = 8192
+    preds = RNG.random(n).astype(np.float32)
+    target = RNG.integers(0, 2, n)
+    fn = lambda p, t: binary_precision_recall_curve(p, t, thresholds=101)
+    got = run_on(tpu_device, fn, _f32(preds), jnp.asarray(target, jnp.int32))
+    oracle = run_on(cpu_device, fn, _f32(preds), jnp.asarray(target, jnp.int32))
+    # identical f32 inputs + integer bin counts: curves must match to f32 eps
+    for g, o, part in zip(got, oracle, ("precision", "recall", "thresholds")):
+        assert rel_err(g, o) < 1e-6, f"{part}: rel_err={rel_err(g, o):.2e}"
+
+
+def test_binned_auroc(tpu_device, cpu_device):
+    from torchmetrics_tpu.functional.classification import binary_auroc
+
+    n = 8192
+    preds = RNG.random(n).astype(np.float32)
+    target = RNG.integers(0, 2, n)
+    fn = lambda p, t: binary_auroc(p, t, thresholds=101)
+    got = run_on(tpu_device, fn, _f32(preds), jnp.asarray(target, jnp.int32))
+    oracle = run_on(cpu_device, fn, _f32(preds), jnp.asarray(target, jnp.int32))
+    assert rel_err(got, oracle) < 1e-6
+
+
+# --------------------------------------------------------- inception features
+
+def test_inception_features(tpu_device, cpu_device):
+    from torchmetrics_tpu.models import make_fid_inception
+
+    model, params, extract = make_fid_inception(2048)
+    imgs = RNG.integers(0, 256, (2, 3, 96, 96)).astype(np.uint8)
+
+    def fwd32(x):
+        return extract(x)
+
+    got = run_on(tpu_device, fwd32, jnp.asarray(imgs))
+    # the f64 oracle needs the same normalize+resize preprocessing the
+    # extractor applies; recreate by running the f32 extractor on CPU too —
+    # deep-net f32 CPU vs f32 TPU bounds the TPU lowering error
+    oracle32 = run_on(cpu_device, fwd32, jnp.asarray(imgs))
+    err = rel_err(got, oracle32)
+    # bf16 convs in a 94-layer net give >=1e-2 here; f32 TPU noise is ~1e-5
+    assert err < 1e-3, f"inception features: rel_err={err:.2e}"
+
+
+def test_fid_compute(tpu_device, cpu_device):
+    from torchmetrics_tpu.image.fid import _compute_fid
+
+    d, n = 256, 512
+    real = RNG.standard_normal((n, d)).astype(np.float32)
+    fake = (RNG.standard_normal((n, d)) + 0.3).astype(np.float32)
+
+    def fid_from_feats(r, f):
+        mu1, mu2 = jnp.mean(r, axis=0), jnp.mean(f, axis=0)
+        s1 = jnp.matmul(r.T, r, precision=jax.lax.Precision.HIGHEST) / n - jnp.outer(mu1, mu1)
+        s2 = jnp.matmul(f.T, f, precision=jax.lax.Precision.HIGHEST) / n - jnp.outer(mu2, mu2)
+        return _compute_fid(mu1, s1, mu2, s2)
+
+    got = run_on(tpu_device, fid_from_feats, _f32(real), _f32(fake))
+    oracle = run_on(cpu_device, fid_from_feats, _f64(real), _f64(fake))
+    err = rel_err(got, oracle)
+    assert err < 5e-3, f"fid: got={float(got):.4f} oracle={float(oracle):.4f} rel_err={err:.2e}"
+
+
+# ------------------------------------------------------------------ audio
+
+def test_sdr_toeplitz_solve(tpu_device, cpu_device):
+    from torchmetrics_tpu.functional.audio import signal_distortion_ratio
+
+    t = 8000
+    target = RNG.standard_normal((2, t)).astype(np.float32)
+    preds = (0.8 * target + 0.2 * RNG.standard_normal((2, t))).astype(np.float32)
+    fn = lambda p, tg: signal_distortion_ratio(p, tg, filter_length=64)
+    got = run_on(tpu_device, fn, _f32(preds), _f32(target))
+    oracle = run_on(cpu_device, fn, _f64(preds), _f64(target))
+    err = rel_err(got, oracle)
+    assert err < 1e-3, f"sdr: got={got} oracle={oracle} rel_err={err:.2e}"
+
+
+def test_si_sdr(tpu_device, cpu_device):
+    from torchmetrics_tpu.functional.audio import scale_invariant_signal_distortion_ratio
+
+    t = 8000
+    target = RNG.standard_normal((2, t)).astype(np.float32)
+    preds = (0.8 * target + 0.2 * RNG.standard_normal((2, t))).astype(np.float32)
+    got = run_on(tpu_device, scale_invariant_signal_distortion_ratio, _f32(preds), _f32(target))
+    oracle = run_on(cpu_device, scale_invariant_signal_distortion_ratio, _f64(preds), _f64(target))
+    assert rel_err(got, oracle) < 1e-4
+
+
+# ------------------------------------------------------- pairwise / BERTScore
+
+def test_pairwise_cosine(tpu_device, cpu_device):
+    from torchmetrics_tpu.functional import pairwise_cosine_similarity
+
+    x = RNG.standard_normal((128, 256)).astype(np.float32)
+    y = RNG.standard_normal((96, 256)).astype(np.float32)
+    got = run_on(tpu_device, pairwise_cosine_similarity, _f32(x), _f32(y))
+    oracle = run_on(cpu_device, pairwise_cosine_similarity, _f64(x), _f64(y))
+    assert rel_err(got, oracle) < 1e-5
+
+
+def test_pairwise_euclidean(tpu_device, cpu_device):
+    from torchmetrics_tpu.functional import pairwise_euclidean_distance
+
+    x = RNG.standard_normal((128, 256)).astype(np.float32)
+    y = RNG.standard_normal((96, 256)).astype(np.float32)
+    got = run_on(tpu_device, pairwise_euclidean_distance, _f32(x), _f32(y))
+    oracle = run_on(cpu_device, pairwise_euclidean_distance, _f64(x), _f64(y))
+    assert rel_err(got, oracle) < 1e-4
+
+
+def test_bertscore_matching_kernel(tpu_device, cpu_device):
+    from torchmetrics_tpu.functional.text.bert import bert_score_from_embeddings
+
+    b, t, d = 8, 64, 256
+    emb_p = RNG.standard_normal((b, t, d)).astype(np.float32)
+    emb_t = RNG.standard_normal((b, t, d)).astype(np.float32)
+    mask = np.ones((b, t), dtype=np.int32)
+    mask[:, t // 2:] = RNG.integers(0, 2, (b, t // 2))
+
+    fn = lambda p, mp, tg, mt: bert_score_from_embeddings(p, mp, tg, mt)
+    got = run_on(tpu_device, fn, _f32(emb_p), jnp.asarray(mask), _f32(emb_t), jnp.asarray(mask))
+    oracle = run_on(cpu_device, fn, _f64(emb_p), jnp.asarray(mask), _f64(emb_t), jnp.asarray(mask))
+    for key in ("precision", "recall", "f1"):
+        assert rel_err(got[key], oracle[key]) < 1e-5, key
+
+
+# ------------------------------------------------------------------- LPIPS
+
+def test_lpips_forward(tpu_device, cpu_device):
+    import warnings
+
+    from torchmetrics_tpu.models.lpips import make_lpips
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        _mod, _params, dist = make_lpips("alex")
+    x = (RNG.random((2, 3, 64, 64)).astype(np.float32) * 2 - 1)
+    y = (RNG.random((2, 3, 64, 64)).astype(np.float32) * 2 - 1)
+    got = run_on(tpu_device, dist, _f32(x), _f32(y))
+    oracle = run_on(cpu_device, dist, _f32(x), _f32(y))
+    # same f32 net both sides; TPU must agree to f32 roundoff, not bf16
+    assert rel_err(got, oracle) < 1e-4
+
+
+# -------------------------------------------------------------- regression
+
+def test_pearson_corrcoef(tpu_device, cpu_device):
+    from torchmetrics_tpu.functional import pearson_corrcoef
+
+    x = RNG.standard_normal(4096).astype(np.float32)
+    y = (0.5 * x + 0.5 * RNG.standard_normal(4096)).astype(np.float32)
+    got = run_on(tpu_device, pearson_corrcoef, _f32(x), _f32(y))
+    oracle = run_on(cpu_device, pearson_corrcoef, _f64(x), _f64(y))
+    assert rel_err(got, oracle) < 1e-4

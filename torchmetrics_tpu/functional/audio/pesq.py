@@ -136,7 +136,8 @@ def _bark_spectrum(x: Array, c: dict) -> Array:
     frames = _frame_signal(x, c["nfft"])
     spec = jnp.abs(jnp.fft.rfft(frames, axis=-1)) ** 2 * c["spec_norm"]
     fb = jnp.asarray(c["fb"])
-    return spec @ fb.T  # (T, NB)
+    # pin: Bark filterbank projection must stay f32 on TPU
+    return jnp.matmul(spec, fb.T, precision=jax.lax.Precision.HIGHEST)  # (T, NB)
 
 
 def _align_level(x: Array, fs: int) -> Array:

@@ -68,7 +68,11 @@ def signal_distortion_ratio(
     if load_diag is not None:
         r_0 = r_0.at[..., 0].add(load_diag)
     r = _symmetric_toeplitz(r_0)
-    sol = jnp.linalg.solve(r, b[..., None])[..., 0]
+    # the LU solve's internal dot_generals follow the ambient matmul
+    # precision; without this pin TPU lowers them to bf16 and the
+    # distortion ratio drifts at the 1e-3 level
+    with jax.default_matmul_precision("highest"):
+        sol = jnp.linalg.solve(r, b[..., None])[..., 0]
     coh = jnp.sum(b * sol, axis=-1)
     ratio = coh / jnp.maximum(1.0 - coh, 1e-12)
     return 10.0 * jnp.log10(jnp.maximum(ratio, 1e-12))

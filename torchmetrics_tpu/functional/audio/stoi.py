@@ -113,7 +113,8 @@ def _stft_bands(x: Array, obm: Array) -> Array:
     frames = x[idx] * jnp.asarray(_hann(framelen))
     spec = jnp.fft.rfft(frames, NFFT, axis=-1)  # (T, F)
     power = jnp.abs(spec) ** 2
-    return jnp.sqrt(obm @ power.T)  # (bands, T): sqrt of band-summed power
+    # pin: band summation must stay f32 on TPU (bf16 would bias band levels)
+    return jnp.sqrt(jnp.matmul(obm, power.T, precision=jax.lax.Precision.HIGHEST))  # (bands, T)
 
 
 def _segments(x: Array, n: int = N_SEG) -> Array:

@@ -115,9 +115,11 @@ def spatial_distortion_index(
         )
     if pan_lr is None:
         degraded = _uniform_filter_2d(pan, window_size)
-        degraded = jax.image.resize(
-            degraded, degraded.shape[:2] + (ms_h, ms_w), jax.image.ResizeMethod.LINEAR, antialias=False
-        )
+        # ambient pin: resize lowers to dot_generals (bf16 on TPU otherwise)
+        with jax.default_matmul_precision("highest"):
+            degraded = jax.image.resize(
+                degraded, degraded.shape[:2] + (ms_h, ms_w), jax.image.ResizeMethod.LINEAR, antialias=False
+            )
     else:
         pan_lr = jnp.asarray(pan_lr, jnp.float32)
         if pan_lr.shape[-2:] != (ms_h, ms_w):

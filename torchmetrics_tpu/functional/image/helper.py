@@ -33,7 +33,7 @@ def gaussian_kernel_3d(channels: int, kernel_size: Sequence[int], sigma: Sequenc
     kd = _gaussian_1d(kernel_size[2], sigma[2], dtype) if len(kernel_size) > 2 else None
     kh = _gaussian_1d(kernel_size[0], sigma[0], dtype)
     kw = _gaussian_1d(kernel_size[1], sigma[1], dtype)
-    k3d = jnp.einsum("i,j,k->ijk", kh, kw, kd)
+    k3d = jnp.einsum("i,j,k->ijk", kh, kw, kd, precision=jax.lax.Precision.HIGHEST)
     return jnp.broadcast_to(k3d, (channels, 1) + k3d.shape)
 
 

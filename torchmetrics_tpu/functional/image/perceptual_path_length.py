@@ -100,8 +100,10 @@ def perceptual_path_length(
             imgs2 = jnp.asarray(generator(latents2))
         if resize is not None:
             shape = (*imgs1.shape[:-2], resize, resize)
-            imgs1 = jax.image.resize(imgs1, shape, method="bilinear")
-            imgs2 = jax.image.resize(imgs2, shape, method="bilinear")
+            # ambient pin: resize lowers to dot_generals (bf16 on TPU otherwise)
+            with jax.default_matmul_precision("highest"):
+                imgs1 = jax.image.resize(imgs1, shape, method="bilinear")
+                imgs2 = jax.image.resize(imgs2, shape, method="bilinear")
         d = jnp.asarray(distance_fn(imgs1, imgs2)).reshape(-1) / (epsilon**2)
         distances.append(d)
         remaining -= bsz

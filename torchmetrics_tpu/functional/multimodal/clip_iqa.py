@@ -84,7 +84,9 @@ def _clip_iqa_update(images, anchors: Array, model: Any, processor: Any,
     """
     imgs = np.asarray(images, dtype=np.float32) / float(data_range)
     feats = _image_features(list(imgs), model, processor)  # (N, D) normalized
-    logits = 100.0 * feats @ anchors.T  # (N, 2P)
+    # pin: logits are scaled by 100, so bf16 multiply noise would move
+    # the prompt-pair softmax at the 1e-3 level
+    logits = 100.0 * jnp.matmul(feats, anchors.T, precision=jax.lax.Precision.HIGHEST)  # (N, 2P)
     pairs = logits.reshape(feats.shape[0], -1, 2)
     probs = jax.nn.softmax(pairs, axis=-1)[..., 0]  # (N, P)
     return probs

@@ -47,7 +47,10 @@ def _image_features(images, model: Any, processor: Any) -> Array:
     if not all(np.asarray(i).ndim == 3 for i in images):
         raise ValueError("Expected all images to be 3d but found image that has either more or less")
     processed = processor(images=[np.asarray(i) for i in images], return_tensors="np")
-    feats = model.get_image_features(jnp.asarray(processed["pixel_values"]))
+    # ambient pin: third-party Flax encoders (transformers CLIP) don't expose
+    # per-layer precision; bf16 matmuls on TPU would break torch parity
+    with jax.default_matmul_precision("highest"):
+        feats = model.get_image_features(jnp.asarray(processed["pixel_values"]))
     return feats / jnp.linalg.norm(feats, axis=-1, keepdims=True)
 
 
@@ -67,7 +70,8 @@ def _text_features(text, model: Any, processor: Any) -> Array:
         )
         input_ids = input_ids[..., :max_pos]
         mask = mask[..., :max_pos]
-    feats = model.get_text_features(jnp.asarray(input_ids), jnp.asarray(mask))
+    with jax.default_matmul_precision("highest"):
+        feats = model.get_text_features(jnp.asarray(input_ids), jnp.asarray(mask))
     return feats / jnp.linalg.norm(feats, axis=-1, keepdims=True)
 
 

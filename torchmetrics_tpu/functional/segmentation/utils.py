@@ -247,7 +247,8 @@ def mask_edges(
     dn = lax.conv_dimension_numbers(vol.shape, (1, 1) + kernel.shape,
                                     ("NCHW", "OIHW", "NCHW") if ndim == 2 else ("NCDHW", "OIDHW", "NCDHW"))
     codes = lax.conv_general_dilated(vol, kernel[None, None], (1,) * ndim, "VALID",
-                                     dimension_numbers=dn)[:, 0]
+                                     dimension_numbers=dn,
+                                     precision=lax.Precision.HIGHEST)[:, 0]
     codes_i = codes.astype(jnp.int32)
     all_ones = len(np.asarray(table)) - 1
     edges = (codes_i != 0) & (codes_i != all_ones)

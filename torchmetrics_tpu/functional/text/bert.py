@@ -176,8 +176,10 @@ def bert_score(
         enc_t = tokenizer(target_, padding=True, truncation=True, max_length=max_length, return_tensors="np")
         tok_p = {"input_ids": jnp.asarray(enc_p["input_ids"]), "attention_mask": jnp.asarray(enc_p["attention_mask"])}
         tok_t = {"input_ids": jnp.asarray(enc_t["input_ids"]), "attention_mask": jnp.asarray(enc_t["attention_mask"])}
-        emb_p = model(**enc_p).last_hidden_state
-        emb_t = model(**enc_t).last_hidden_state
+        # ambient pin: third-party Flax encoders don't expose per-layer precision
+        with jax.default_matmul_precision("highest"):
+            emb_p = model(**enc_p).last_hidden_state
+            emb_t = model(**enc_t).last_hidden_state
         emb_p, emb_t = jnp.asarray(emb_p), jnp.asarray(emb_t)
 
     pred_idf_arr = target_idf_arr = None

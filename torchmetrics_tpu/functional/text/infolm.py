@@ -118,7 +118,7 @@ def _sentence_distribution_from_logits(logits: Array, attention_mask: Array, idf
     w = attention_mask.astype(jnp.float32)
     if idf_w is not None:
         w = w * idf_w
-    num = jnp.einsum("blv,bl->bv", probs, w)
+    num = jnp.einsum("blv,bl->bv", probs, w, precision=jax.lax.Precision.HIGHEST)
     return num / jnp.maximum(jnp.sum(w, axis=-1, keepdims=True), _EPS)
 
 
@@ -170,8 +170,10 @@ def infolm(
         enc_t = tokenizer(target_, padding=True, truncation=True, max_length=max_length, return_tensors="np")
         tok_p = {k: jnp.asarray(v) for k, v in enc_p.items()}
         tok_t = {k: jnp.asarray(v) for k, v in enc_t.items()}
-        logits_p = jnp.asarray(model(**enc_p).logits)
-        logits_t = jnp.asarray(model(**enc_t).logits)
+        # ambient pin: third-party Flax LMs don't expose per-layer precision
+        with jax.default_matmul_precision("highest"):
+            logits_p = jnp.asarray(model(**enc_p).logits)
+            logits_t = jnp.asarray(model(**enc_t).logits)
 
     logits_p = jnp.asarray(logits_p) / temperature
     logits_t = jnp.asarray(logits_t) / temperature

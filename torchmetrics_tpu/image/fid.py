@@ -24,21 +24,27 @@ from ..metric import Metric
 Array = jax.Array
 
 
+# covariance/sqrtm matmuls must not lower to bf16 multiplies on TPU —
+# FID is a trace of eigenvalues of matmul products, so bf16 noise in the
+# products shifts the headline value at the 1e-2 level
+_HI = jax.lax.Precision.HIGHEST
+
+
 def _sqrtm_psd(mat: Array) -> Array:
     """Symmetric PSD matrix square root via eigendecomposition."""
     vals, vecs = jnp.linalg.eigh(mat)
     vals = jnp.clip(vals, min=0.0)
-    return (vecs * jnp.sqrt(vals)[None, :]) @ vecs.T
+    return jnp.matmul(vecs * jnp.sqrt(vals)[None, :], vecs.T, precision=_HI)
 
 
 def _compute_fid(mu1: Array, sigma1: Array, mu2: Array, sigma2: Array) -> Array:
     """Parity: reference ``image/fid.py:159``."""
     diff = mu1 - mu2
     s1h = _sqrtm_psd(sigma1)
-    covmean_sq = s1h @ sigma2 @ s1h
+    covmean_sq = jnp.matmul(jnp.matmul(s1h, sigma2, precision=_HI), s1h, precision=_HI)
     vals = jnp.clip(jnp.linalg.eigvalsh(covmean_sq), min=0.0)
     tr_covmean = jnp.sum(jnp.sqrt(vals))
-    return jnp.dot(diff, diff) + jnp.trace(sigma1) + jnp.trace(sigma2) - 2.0 * tr_covmean
+    return jnp.dot(diff, diff, precision=_HI) + jnp.trace(sigma1) + jnp.trace(sigma2) - 2.0 * tr_covmean
 
 
 def _resolve_feature_extractor(feature: Union[int, Callable], metric_name: str) -> Callable:
@@ -101,7 +107,7 @@ class FrechetInceptionDistance(Metric):
         features = jnp.asarray(self.inception(imgs)).astype(jnp.float32)
         self._ensure_states(features.shape[-1])
         f_sum = jnp.sum(features, axis=0)
-        f_cov = features.T @ features
+        f_cov = jnp.matmul(features.T, features, precision=_HI)
         n = jnp.asarray(features.shape[0], dtype=jnp.float32)
         if real:
             self.real_features_sum = self.real_features_sum + f_sum

@@ -20,7 +20,8 @@ Array = jax.Array
 def poly_kernel(f1: Array, f2: Array, degree: int = 3, gamma: Optional[float] = None, coef: float = 1.0) -> Array:
     if gamma is None:
         gamma = 1.0 / f1.shape[1]
-    return (f1 @ f2.T * gamma + coef) ** degree
+    # pin: bf16 multiplies on TPU would perturb the kernel Gram matrix
+    return (jnp.matmul(f1, f2.T, precision=jax.lax.Precision.HIGHEST) * gamma + coef) ** degree
 
 
 def poly_mmd(f_real: Array, f_fake: Array, degree: int = 3, gamma: Optional[float] = None, coef: float = 1.0) -> Array:

@@ -22,7 +22,8 @@ def _normalize_rows(x: Array) -> Array:
 
 def _compute_cosine_distance(features1: Array, features2: Array, cosine_distance_eps: float = 0.1) -> Array:
     f1, f2 = _normalize_rows(features1), _normalize_rows(features2)
-    d = 1.0 - jnp.abs(f1 @ f2.T)
+    # pin: bf16 multiplies on TPU would perturb cosine similarities
+    d = 1.0 - jnp.abs(jnp.matmul(f1, f2.T, precision=jax.lax.Precision.HIGHEST))
     mean_min_d = jnp.mean(jnp.min(d, axis=1))
     return jnp.where(mean_min_d < cosine_distance_eps, mean_min_d, 1.0)
 
@@ -62,8 +63,11 @@ class MemorizationInformedFrechetInceptionDistance(Metric):
         real = dim_zero_cat(self.real_features)
         fake = dim_zero_cat(self.fake_features)
         mu1, mu2 = jnp.mean(real, axis=0), jnp.mean(fake, axis=0)
-        sigma1 = jnp.cov(real, rowvar=False)
-        sigma2 = jnp.cov(fake, rowvar=False)
+        # jnp.cov matmuls follow the ambient precision; pin to keep the
+        # covariance f32-exact on TPU
+        with jax.default_matmul_precision("highest"):
+            sigma1 = jnp.cov(real, rowvar=False)
+            sigma2 = jnp.cov(fake, rowvar=False)
         fid = _compute_fid(mu1, sigma1, mu2, sigma2)
         distance = _compute_cosine_distance(fake, real, self.cosine_distance_eps)
         return fid / (distance + 1e-15)

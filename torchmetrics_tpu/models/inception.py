@@ -44,8 +44,10 @@ class BasicConv2d(nn.Module):
 
     @nn.compact
     def __call__(self, x: Array) -> Array:
+        # pin: on TPU the default conv precision is bf16 multiplies; FID
+        # features must match the torch extractor at f32 accuracy
         x = nn.Conv(self.features, self.kernel, self.strides, padding=self.padding,
-                    use_bias=False, name="conv")(x)
+                    use_bias=False, precision=jax.lax.Precision.HIGHEST, name="conv")(x)
         x = nn.BatchNorm(use_running_average=True, epsilon=1e-3, momentum=0.9, name="bn")(x)
         return nn.relu(x)
 
@@ -160,7 +162,11 @@ class FIDInceptionV3(nn.Module):
         # antialias=False: torch-fidelity resizes with F.interpolate(bilinear,
         # align_corners=False), which never antialiases — with the default
         # antialias=True, downscaling >299px inputs would diverge from it
-        x = jax.image.resize(x, (n, c, 299, 299), jax.image.ResizeMethod.LINEAR, antialias=False)
+        # ambient pin: jax.image.resize lowers to dot_generals (one per
+        # spatial dim) that TPU would otherwise run as bf16 — caught by the
+        # on-chip suite at 1.2e-2 relative feature error
+        with jax.default_matmul_precision("highest"):
+            x = jax.image.resize(x, (n, c, 299, 299), jax.image.ResizeMethod.LINEAR, antialias=False)
         x = (x - 128.0) / 128.0
         x = jnp.transpose(x, (0, 2, 3, 1))
 
@@ -193,7 +199,7 @@ class FIDInceptionV3(nn.Module):
         if 2048 in self.features_list:
             out[2048] = pooled
         if "logits_unbiased" in self.features_list or 1008 in self.features_list:
-            logits = nn.Dense(1008, use_bias=False, name="fc")(pooled)
+            logits = nn.Dense(1008, use_bias=False, precision=jax.lax.Precision.HIGHEST, name="fc")(pooled)
             out["logits_unbiased"] = logits
             if 1008 in self.features_list:
                 out[1008] = logits

@@ -35,6 +35,11 @@ _VGG_PLAN = ((64, 64), (128, 128), (256, 256, 256), (512, 512, 512), (512, 512, 
 _SQUEEZE_FIRES = ((16, 64), (16, 64), (32, 128), (32, 128), (48, 192), (48, 192), (64, 256), (64, 256))
 
 
+# pin: LPIPS parity vs the reference requires f32 conv multiplies on TPU
+# (the default lowers to bf16, ~1e-3 relative noise per layer)
+_HI = jax.lax.Precision.HIGHEST
+
+
 class AlexFeatures(nn.Module):
     """AlexNet feature trunk with taps after each of the 5 relu stages."""
 
@@ -44,7 +49,7 @@ class AlexFeatures(nn.Module):
         for i, (feats, k, s, p) in enumerate(_ALEX_CFG):
             if i in (1, 2):  # maxpool precedes conv2 and conv3
                 x = nn.max_pool(x, (3, 3), (2, 2))
-            x = nn.Conv(feats, (k, k), (s, s), padding=((p, p), (p, p)), name=f"conv{i}")(x)
+            x = nn.Conv(feats, (k, k), (s, s), padding=((p, p), (p, p)), precision=_HI, name=f"conv{i}")(x)
             x = nn.relu(x)
             taps.append(x)
         return tuple(taps)
@@ -61,7 +66,7 @@ class VGG16Features(nn.Module):
             if stage > 0:
                 x = nn.max_pool(x, (2, 2), (2, 2))
             for w in widths:
-                x = nn.Conv(w, (3, 3), padding=((1, 1), (1, 1)), name=f"conv{idx}")(x)
+                x = nn.Conv(w, (3, 3), padding=((1, 1), (1, 1)), precision=_HI, name=f"conv{idx}")(x)
                 x = nn.relu(x)
                 idx += 1
             taps.append(x)
@@ -95,7 +100,7 @@ class SqueezeFeatures(nn.Module):
 
         def conv(x, feats, k, stride=1, pad=0):
             nonlocal idx
-            y = nn.Conv(feats, (k, k), (stride, stride), padding=((pad, pad), (pad, pad)), name=f"conv{idx}")(x)
+            y = nn.Conv(feats, (k, k), (stride, stride), padding=((pad, pad), (pad, pad)), precision=_HI, name=f"conv{idx}")(x)
             idx += 1
             return y
 
@@ -143,7 +148,7 @@ class LPIPSNet(nn.Module):
         total = 0.0
         for i, (a, b) in enumerate(zip(f0, f1)):
             d = (_unit_normalize(a) - _unit_normalize(b)) ** 2
-            w = nn.Conv(1, (1, 1), use_bias=False, name=f"lin{i}")(d)  # NetLinLayer
+            w = nn.Conv(1, (1, 1), use_bias=False, precision=_HI, name=f"lin{i}")(d)  # NetLinLayer
             total = total + w.mean(axis=(1, 2))[:, 0]  # spatial average
         return total
 
