@@ -546,12 +546,20 @@ class Metric:
         if not should_sync or not backend.is_available():
             return
         self._cache = self._snapshot_state()
-        if hasattr(backend, "set_current"):  # FakeSync group addressing
-            for name in self._state:
+        for name in self._state:
+            if hasattr(backend, "set_current"):  # FakeSync group addressing
                 backend.set_current(name)
-                self._state[name] = backend.sync_tensor(self._precat(name), self._reductions[name])
-        else:
-            for name in self._state:
+            if name in self._list_states and self._reductions[name] == Reduction.NONE:
+                # ragged object list states (dist_reduce_fx=None: per-image
+                # arrays, COCO RLE dicts) — gather whole per-rank lists and
+                # extend in rank order, preserving element boundaries
+                # (reference detection/mean_ap.py:1007-1032 all_gather_object)
+                gathered = backend.all_gather_object(list(self._state[name]))
+                merged: list = []
+                for rank_list in gathered:
+                    merged.extend(rank_list)
+                self._state[name] = merged
+            else:
                 self._state[name] = backend.sync_tensor(self._precat(name), self._reductions[name])
         self._is_synced = True
 
