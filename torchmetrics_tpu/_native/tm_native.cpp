@@ -291,4 +291,55 @@ void tm_coco_match(const double* ious, int64_t n_dt, int64_t n_gt,
     }
 }
 
+// ---------------------------------------------------------------------------
+// Batched COCOeval matcher: every (image, class, area) cell of an epoch in
+// one call, amortizing the per-call ctypes marshalling that dominates the
+// per-cell variant (~30us/call x thousands of cells). Cell c reads
+//   ious_flat[iou_off[c] : iou_off[c] + n_dt[c]*n_gt[c]]   (row-major)
+//   gt_ignore/crowd_flat[gt_off[c] : gt_off[c] + n_gt[c]]  (ignore-sorted)
+// and writes (T, n_dt[c]) uint8 matched/ignored blocks at dt_off[c]*T.
+// Matching semantics identical to tm_coco_match above.
+// ---------------------------------------------------------------------------
+void tm_coco_match_batch(const double* ious_flat, const int64_t* iou_off,
+                         const int64_t* n_dt, const int64_t* n_gt,
+                         const uint8_t* gt_ignore_flat, const uint8_t* gt_crowd_flat,
+                         const int64_t* gt_off,
+                         const double* iou_thrs, int64_t T, int64_t n_cells,
+                         const int64_t* dt_off,
+                         uint8_t* dt_matched, uint8_t* dt_ignored) {
+    std::vector<int64_t> gtm;
+    for (int64_t c = 0; c < n_cells; ++c) {
+        const int64_t D = n_dt[c], G = n_gt[c];
+        if (D == 0) continue;
+        const double* ious = ious_flat + iou_off[c];
+        const uint8_t* g_ign = gt_ignore_flat + gt_off[c];
+        const uint8_t* g_crw = gt_crowd_flat + gt_off[c];
+        uint8_t* m_base = dt_matched + dt_off[c] * T;
+        uint8_t* i_base = dt_ignored + dt_off[c] * T;
+        if (G == 0) continue;  // outputs pre-zeroed
+        if ((int64_t)gtm.size() < G) gtm.resize(G);
+        for (int64_t t = 0; t < T; ++t) {
+            const double thr = iou_thrs[t];
+            uint8_t* dtm = m_base + t * D;
+            uint8_t* dti = i_base + t * D;
+            std::fill(gtm.begin(), gtm.begin() + G, 0);
+            for (int64_t d = 0; d < D; ++d) {
+                double iou = std::min(thr, 1.0 - 1e-10);
+                int64_t match = -1;
+                for (int64_t g = 0; g < G; ++g) {
+                    if (gtm[g] > 0 && !g_crw[g]) continue;
+                    if (match > -1 && !g_ign[match] && g_ign[g]) break;
+                    if (ious[d * G + g] < iou) continue;
+                    iou = ious[d * G + g];
+                    match = g;
+                }
+                if (match == -1) continue;
+                dti[d] = g_ign[match];
+                dtm[d] = 1;
+                gtm[match] = 1;
+            }
+        }
+    }
+}
+
 }  // extern "C"

@@ -80,74 +80,6 @@ def mask_iou_np(dt, gt, iscrowd: np.ndarray) -> np.ndarray:
     return np.where(union > 0, inter / np.where(union > 0, union, 1.0), 0.0)
 
 
-def match_image(
-    ious: np.ndarray,
-    dt_scores: np.ndarray,
-    gt_ignore: np.ndarray,
-    gt_crowd: np.ndarray,
-    dt_area_ignore: np.ndarray,
-    iou_thresholds: np.ndarray,
-    max_det: int,
-) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Greedy COCO matching for one (image, category) pair.
-
-    Returns ``(dt_matched, dt_ignored, scores)`` each shaped ``(T, D)`` /
-    ``(D,)`` where D = min(#dets, max_det), following COCOeval's
-    ``evaluateImg``: detections in score order claim the best still-free gt
-    with IoU >= t; crowd gts are matchable many times; a match to an ignored
-    gt marks the detection ignored; unmatched detections outside the area
-    range are ignored.
-    """
-    order = np.argsort(-dt_scores, kind="stable")[:max_det]
-    ious = ious[order]
-    scores = dt_scores[order]
-    dt_area_ignore = dt_area_ignore[order]
-    n_t = len(iou_thresholds)
-    n_d = len(order)
-    n_g = ious.shape[1]
-    # gt sorted: non-ignored first (COCO sorts gt by ignore flag)
-    g_order = np.argsort(gt_ignore, kind="stable")
-    ious = ious[:, g_order]
-    g_ignore = gt_ignore[g_order].astype(bool)
-    g_crowd = gt_crowd[g_order].astype(bool)
-
-    if _native.NATIVE_AVAILABLE and n_d and n_g:
-        dt_m, _gt_m, dt_ig = _native.coco_match(
-            ious, g_ignore.astype(np.uint8), g_crowd.astype(np.uint8), iou_thresholds
-        )
-        dt_matched = dt_m > 0
-        dt_ignored = dt_ig.astype(bool)
-        dt_ignored |= (~dt_matched) & dt_area_ignore.astype(bool)[None, :]
-        return dt_matched, dt_ignored, scores
-
-    dt_matched = np.zeros((n_t, n_d), dtype=bool)
-    dt_ignored = np.zeros((n_t, n_d), dtype=bool)
-    for ti, t in enumerate(iou_thresholds):
-        g_used = np.zeros(n_g, dtype=bool)
-        for di in range(n_d):
-            best_iou = min(t, 1 - 1e-10)
-            best_g = -1
-            for gi in range(n_g):
-                if g_used[gi] and not g_crowd[gi]:
-                    continue
-                # best non-ignored candidate found and this gt is ignored:
-                # later gts are all ignored (sorted) → stop
-                if best_g > -1 and not g_ignore[best_g] and g_ignore[gi]:
-                    break
-                if ious[di, gi] < best_iou:
-                    continue
-                best_iou = ious[di, gi]
-                best_g = gi
-            if best_g == -1:
-                continue
-            g_used[best_g] = True
-            dt_matched[ti, di] = True
-            dt_ignored[ti, di] = g_ignore[best_g]
-        # unmatched detections outside the area range are ignored
-        dt_ignored[ti] |= (~dt_matched[ti]) & dt_area_ignore.astype(bool)
-    return dt_matched, dt_ignored, scores
-
-
 def accumulate(
     per_image: List[Dict],
     classes: Sequence[int],
@@ -158,8 +90,11 @@ def accumulate(
 ) -> Dict[str, np.ndarray]:
     """PR accumulation over all (class, area, maxDet) cells.
 
-    ``per_image`` entries hold, per image: dict class -> precomputed matching
-    inputs (see :func:`evaluate_detections`). Returns ``precision`` of shape
+    ``per_image`` entries hold, per image, ``(cls, area) -> (matched,
+    ignored, scores, n_pos)`` matching outputs at the LARGEST maxDet (see
+    :func:`evaluate_detections`); smaller maxDets slice the per-image
+    score-ordered columns, exactly like pycocotools' ``accumulate`` slices
+    ``evaluateImg``'s maxDets[-1] run. Returns ``precision`` of shape
     ``(T, R, K, A, M)`` and ``recall`` ``(T, K, A, M)`` (COCOeval layout),
     plus ``scores`` ``(T, R, K, A, M)``.
     """
@@ -171,53 +106,38 @@ def accumulate(
 
     for ki, cls in enumerate(classes):
         for ai, area in enumerate(area_keys):
+            cells = [c for c in (img.get((cls, area)) for img in per_image) if c is not None]
+            n_gt = sum(c[3] for c in cells)
+            if n_gt == 0 or not cells:
+                continue
             for mi, max_det in enumerate(max_dets):
-                all_scores, all_matched, all_ignored = [], [], []
-                n_gt = 0
-                for img in per_image:
-                    cell = img.get((cls, area, max_det))
-                    if cell is None:
-                        continue
-                    matched, ignored, scores, n_pos = cell
-                    all_scores.append(scores)
-                    all_matched.append(matched)
-                    all_ignored.append(ignored)
-                    n_gt += n_pos
-                if n_gt == 0:
-                    continue
-                if not all_scores:
-                    continue
-                scores = np.concatenate(all_scores)
+                scores = np.concatenate([c[2][:max_det] for c in cells])
                 order = np.argsort(-scores, kind="mergesort")
                 scores = scores[order]
-                matched = np.concatenate(all_matched, axis=1)[:, order]
-                ignored = np.concatenate(all_ignored, axis=1)[:, order]
+                matched = np.concatenate([c[0][:, :max_det] for c in cells], axis=1)[:, order]
+                ignored = np.concatenate([c[1][:, :max_det] for c in cells], axis=1)[:, order]
 
                 tps = matched & ~ignored
                 fps = ~matched & ~ignored
                 tp_cum = np.cumsum(tps, axis=1).astype(np.float64)
                 fp_cum = np.cumsum(fps, axis=1).astype(np.float64)
+                n_d = tp_cum.shape[1]
+                # float32 like the reference: the recall grid is the float32
+                # quantization of linspace(0,1,101), and exact float64
+                # recalls (e.g. 2/5) land on the wrong side of float32(0.4)
+                # in searchsorted
+                rc = (tp_cum / n_gt).astype(np.float32)  # (T, N)
+                pr = tp_cum / np.maximum(tp_cum + fp_cum, np.finfo(np.float64).eps)
+                recall[:, ki, ai, mi] = rc[:, -1] if n_d else 0.0
+                # precision envelope: monotone non-increasing from the right
+                pr = np.maximum.accumulate(pr[:, ::-1], axis=1)[:, ::-1]
                 for ti in range(n_t):
-                    tp, fp = tp_cum[ti], fp_cum[ti]
-                    # float32 like the reference: the recall grid is the
-                    # float32 quantization of linspace(0,1,101), and exact
-                    # float64 recalls (e.g. 2/5) land on the wrong side of
-                    # float32(0.4) in searchsorted
-                    rc = (tp / n_gt).astype(np.float32)
-                    pr = tp / np.maximum(tp + fp, np.finfo(np.float64).eps)
-                    recall[ti, ki, ai, mi] = rc[-1] if len(rc) else 0.0
-                    # precision envelope (monotone non-increasing from right)
-                    pr = pr.tolist()
-                    for i in range(len(pr) - 1, 0, -1):
-                        if pr[i] > pr[i - 1]:
-                            pr[i - 1] = pr[i]
-                    inds = np.searchsorted(rc, rec_thresholds, side="left")
+                    inds = np.searchsorted(rc[ti], rec_thresholds, side="left")
+                    valid = inds < n_d
                     q = np.zeros(n_r)
                     ss = np.zeros(n_r)
-                    for ri, pi in enumerate(inds):
-                        if pi < len(pr):
-                            q[ri] = pr[pi]
-                            ss[ri] = scores[pi]
+                    q[valid] = pr[ti, inds[valid]]
+                    ss[valid] = scores[inds[valid]]
                     precision[ti, :, ki, ai, mi] = q
                     scores_out[ti, :, ki, ai, mi] = ss
     return {"precision": precision, "recall": recall, "scores": scores_out}
@@ -251,8 +171,15 @@ def evaluate_detections(
     classes = [0] if class_agnostic else sorted(int(c) for c in classes)
 
     area_keys = tuple(AREA_RANGES)
+    max_det_cap = max_dets[-1]
     per_image: List[Dict] = []
     ious_map: Dict[Tuple[int, int], np.ndarray] = {}
+    # cell staging: one batched native matcher call for the whole epoch
+    # (per-cell ctypes round-trips otherwise dominate the evaluation)
+    cell_ious: List[np.ndarray] = []
+    cell_gign: List[np.ndarray] = []
+    cell_gcrowd: List[np.ndarray] = []
+    cell_meta: List[Tuple[Dict, Tuple[int, str], np.ndarray, np.ndarray, int]] = []
     for img_idx, (det, gt) in enumerate(zip(detections, groundtruths)):
         dt_labels = np.asarray(det["labels"]).reshape(-1)
         gt_labels = np.asarray(gt["labels"]).reshape(-1)
@@ -294,23 +221,33 @@ def evaluate_detections(
             else:
                 ious_full = iou_fn(dt_geom[d_sel], gt_geom[g_sel], gt_crowd[g_sel])
             ious_map[(img_idx, cls)] = ious_full
+            # matching runs once per (img, cls, area) at the LARGEST maxDet
+            # (detections in score order; smaller maxDets are column slices
+            # at accumulate time — greedy matching of the top-k prefix is
+            # independent of later detections, pycocotools semantics)
+            order = np.argsort(-dt_scores[d_sel], kind="stable")[:max_det_cap]
+            ious_d = ious_full[order]
+            scores_sorted = dt_scores[d_sel][order]
+            crowd_sel = gt_crowd[g_sel]
             for area in area_keys:
                 lo, hi = AREA_RANGES[area]
-                g_ignore = gt_crowd[g_sel] | (gt_areas[g_sel] < lo) | (gt_areas[g_sel] > hi)
+                g_ignore = crowd_sel | (gt_areas[g_sel] < lo) | (gt_areas[g_sel] > hi)
                 d_area_ignore = (dt_areas[d_sel] < lo) | (dt_areas[d_sel] > hi)
                 n_pos = int((~g_ignore).sum())
-                for max_det in max_dets:
-                    matched, ignored, scores = match_image(
-                        ious_full,
-                        dt_scores[d_sel],
-                        g_ignore.astype(np.int64),
-                        gt_crowd[g_sel].astype(np.int64),
-                        d_area_ignore,
-                        iou_thresholds,
-                        max_det,
-                    )
-                    img_cells[(cls, area, max_det)] = (matched, ignored, scores, n_pos)
+                # gt sorted: non-ignored first (COCO sorts gt by ignore flag)
+                g_order = np.argsort(g_ignore, kind="stable")
+                cell_ious.append(np.ascontiguousarray(ious_d[:, g_order]))
+                cell_gign.append(g_ignore[g_order].astype(np.uint8))
+                cell_gcrowd.append(crowd_sel[g_order].astype(np.uint8))
+                cell_meta.append((img_cells, (cls, area), scores_sorted, d_area_ignore[order], n_pos))
         per_image.append(img_cells)
+
+    for (img_cells, key, scores, d_area_ignore, n_pos), (matched, match_ignored) in zip(
+        cell_meta, _native.coco_match_batch(cell_ious, cell_gign, cell_gcrowd, iou_thresholds)
+    ):
+        # unmatched detections outside the area range are ignored
+        ignored = match_ignored | (~matched & d_area_ignore[None, :])
+        img_cells[key] = (matched, ignored, scores, n_pos)
 
     out = accumulate(per_image, classes, iou_thresholds, rec_thresholds, max_dets, area_keys)
     out["ious"] = ious_map
