@@ -1,0 +1,202 @@
+"""Filled fixed-shape exact computes == eager dynamic-shape exact computes.
+
+``_exact_jit`` re-expresses the exact-mode (thresholds=None) AUROC / AP /
+at-fixed scalars over length-N "filled" curves so they jit (one compile per
+epoch length). The eager ``_binary_clf_curve`` path is the oracle; inputs
+sweep heavy ties (quantized preds), all-negative / all-positive labels, and
+ignore_index, which is where held-duplicate handling could diverge.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.classification import _exact_jit as EJ
+from torchmetrics_tpu.functional.classification.auroc import (
+    _binary_auroc_compute,
+    _reduce_auroc,
+)
+from torchmetrics_tpu.functional.classification.average_precision import (
+    _binary_average_precision_exact,
+    _reduce_average_precision,
+)
+from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    _binary_precision_recall_curve_compute,
+    _multiclass_precision_recall_curve_compute,
+    _multilabel_precision_recall_curve_compute,
+)
+from torchmetrics_tpu.functional.classification.roc import (
+    _binary_roc_compute,
+    _multiclass_roc_compute,
+    _multilabel_roc_compute,
+)
+from torchmetrics_tpu.functional.classification.specificity_sensitivity import (
+    _best_subject_to,
+    _scan_per_class,
+)
+
+RNG = np.random.default_rng(7)
+
+
+def _binary_cases():
+    n = 257
+    smooth = RNG.random(n).astype(np.float32)
+    tied = np.round(smooth, 1).astype(np.float32)  # heavy ties
+    few = np.asarray([0.3, 0.3, 0.3], np.float32)
+    for preds in (smooth, tied, few):
+        m = preds.shape[0]
+        for target in (
+            RNG.integers(0, 2, m),
+            np.zeros(m, np.int64),  # all negative
+            np.ones(m, np.int64),  # all positive
+        ):
+            yield jnp.asarray(preds), jnp.asarray(target, jnp.int32)
+
+
+@pytest.mark.parametrize("case", range(9))
+def test_binary_auroc_matches_eager(case):
+    preds, target = list(_binary_cases())[case]
+    eager = _binary_auroc_compute((preds, target), None, None)
+    jitted = EJ.binary_auroc_exact(preds, target)
+    np.testing.assert_allclose(np.asarray(jitted), np.asarray(eager), atol=1e-6)
+
+
+@pytest.mark.parametrize("case", range(9))
+def test_binary_ap_matches_eager(case):
+    preds, target = list(_binary_cases())[case]
+    eager = _binary_average_precision_exact(preds, target)
+    jitted = EJ.binary_ap_exact(preds, target)
+    np.testing.assert_allclose(np.asarray(jitted), np.asarray(eager), atol=1e-6)
+
+
+@pytest.mark.parametrize("curve", ["prc", "roc"])
+@pytest.mark.parametrize("objective_first", [True, False])
+@pytest.mark.parametrize("min_value", [0.0, 0.5, 0.9])
+def test_binary_at_fixed_matches_eager(curve, objective_first, min_value):
+    for preds, target in _binary_cases():
+        if curve == "prc":
+            precision, recall, t = _binary_precision_recall_curve_compute((preds, target), None)
+            a, b = ((recall, precision) if objective_first else (precision, recall))
+        else:
+            fpr, tpr, t = _binary_roc_compute((preds, target), None)
+            a, b = ((tpr, 1 - fpr) if objective_first else (1 - fpr, tpr))
+        eager = _best_subject_to(a, b, t, min_value)
+        jitted = EJ.binary_at_fixed_exact(preds, target, min_value, curve, objective_first)
+        for e, j, part in zip(eager, jitted, ("value", "threshold")):
+            np.testing.assert_allclose(np.asarray(j), np.asarray(e), atol=1e-6, err_msg=part)
+
+
+def _mc_case(tied: bool):
+    n, c = 193, 5
+    preds = RNG.random((n, c)).astype(np.float32)
+    if tied:
+        preds = np.round(preds, 1)
+    preds = preds / preds.sum(1, keepdims=True)
+    target = RNG.integers(0, c - 1, n)  # class c-1 empty (no positives)
+    return jnp.asarray(preds), jnp.asarray(target, jnp.int32)
+
+
+@pytest.mark.parametrize("tied", [False, True])
+@pytest.mark.parametrize("average", ["macro", "weighted", "none"])
+def test_multiclass_auroc_matches_eager(tied, average):
+    preds, target = _mc_case(tied)
+    fpr, tpr, _ = _multiclass_roc_compute((preds, target), preds.shape[1], None)
+    support = np.asarray([(np.asarray(target) == c).sum() for c in range(preds.shape[1])], np.float32)
+    eager = _reduce_auroc(fpr, tpr, average, weights=jnp.asarray(support))
+    jitted = EJ.multiclass_auroc_exact(preds, target, average)
+    np.testing.assert_allclose(np.asarray(jitted), np.asarray(eager), atol=1e-6)
+
+
+@pytest.mark.parametrize("tied", [False, True])
+@pytest.mark.parametrize("average", ["macro", "weighted", "none"])
+def test_multiclass_ap_matches_eager(tied, average):
+    preds, target = _mc_case(tied)
+    precision, recall, _ = _multiclass_precision_recall_curve_compute((preds, target), preds.shape[1], None)
+    support = jnp.sum(jnp.asarray(np.asarray(target)[:, None] == np.arange(preds.shape[1])), axis=0)
+    eager = _reduce_average_precision(precision, recall, average, weights=support.astype(jnp.float32),
+                                      exclude_empty=True)
+    jitted = EJ.multiclass_ap_exact(preds, target, average)
+    np.testing.assert_allclose(np.asarray(jitted), np.asarray(eager), atol=1e-6, equal_nan=True)
+
+
+def _ml_case(ignore: bool):
+    n, l = 151, 4
+    preds = np.round(RNG.random((n, l)), 1).astype(np.float32)
+    target = RNG.integers(0, 2, (n, l))
+    if ignore:
+        target[RNG.random((n, l)) < 0.2] = -1
+    return jnp.asarray(preds), jnp.asarray(target, jnp.int32)
+
+
+@pytest.mark.parametrize("ignore", [False, True])
+@pytest.mark.parametrize("average", ["macro", "none"])
+def test_multilabel_auroc_matches_eager(ignore, average):
+    preds, target = _ml_case(ignore)
+    ignore_index = -1 if ignore else None
+    fpr, tpr, _ = _multilabel_roc_compute((preds, target), preds.shape[1], None, ignore_index)
+    support = jnp.sum(target == 1, axis=0).astype(jnp.float32)
+    eager = _reduce_auroc(fpr, tpr, average, weights=support)
+    jitted = EJ.multilabel_auroc_exact(preds, target, average, ignore_index)
+    np.testing.assert_allclose(np.asarray(jitted), np.asarray(eager), atol=1e-6)
+
+
+@pytest.mark.parametrize("ignore", [False, True])
+@pytest.mark.parametrize("average", ["macro", "none"])
+def test_multilabel_ap_matches_eager(ignore, average):
+    preds, target = _ml_case(ignore)
+    ignore_index = -1 if ignore else None
+    precision, recall, _ = _multilabel_precision_recall_curve_compute(
+        (preds, target), preds.shape[1], None, ignore_index
+    )
+    support = jnp.sum(target == 1, axis=0).astype(jnp.float32)
+    eager = _reduce_average_precision(precision, recall, average, weights=support, exclude_empty=True)
+    jitted = EJ.multilabel_ap_exact(preds, target, average, ignore_index)
+    np.testing.assert_allclose(np.asarray(jitted), np.asarray(eager), atol=1e-6, equal_nan=True)
+
+
+@pytest.mark.parametrize("curve", ["prc", "roc"])
+@pytest.mark.parametrize("objective_first", [True, False])
+def test_ovr_at_fixed_matches_eager(curve, objective_first):
+    preds, target = _mc_case(tied=True)
+    if curve == "prc":
+        curves = _multiclass_precision_recall_curve_compute((preds, target), preds.shape[1], None)
+        pick = (lambda p, r: (r, p)) if objective_first else (lambda p, r: (p, r))
+    else:
+        curves = _multiclass_roc_compute((preds, target), preds.shape[1], None)
+        pick = (lambda f, t: (t, 1 - f)) if objective_first else (lambda f, t: (1 - f, t))
+    eager = _scan_per_class(curves, None, pick, 0.5)
+    jitted = EJ.ovr_at_fixed_exact(preds, target, 0.5, curve, objective_first)
+    for e, j, part in zip(eager, jitted, ("value", "threshold")):
+        np.testing.assert_allclose(np.asarray(j), np.asarray(e), atol=1e-6, err_msg=part)
+
+
+def test_multilabel_micro_auroc_respects_ignore_index():
+    # regression: micro exact mode must DROP ignored (sample, label) pairs,
+    # not feed the raw ignore value into the curve cumsums
+    from torchmetrics_tpu.classification import MultilabelAUROC
+
+    preds, target = _ml_case(ignore=True)
+    flat_p, flat_t = np.asarray(preds).reshape(-1), np.asarray(target).reshape(-1)
+    keep = flat_t != -1
+    oracle = _binary_auroc_compute((jnp.asarray(flat_p[keep]), jnp.asarray(flat_t[keep])), None, None)
+    for jit in (True, False):
+        m = MultilabelAUROC(num_labels=preds.shape[1], average="micro", ignore_index=-1, jit=jit)
+        m.update(preds, target)
+        np.testing.assert_allclose(np.asarray(m.compute()), np.asarray(oracle), atol=1e-6)
+
+
+@pytest.mark.parametrize("curve", ["prc", "roc"])
+@pytest.mark.parametrize("ignore", [False, True])
+def test_multilabel_at_fixed_matches_eager(curve, ignore):
+    preds, target = _ml_case(ignore)
+    ignore_index = -1 if ignore else None
+    if curve == "prc":
+        curves = _multilabel_precision_recall_curve_compute((preds, target), preds.shape[1], None, ignore_index)
+        pick = lambda p, r: (r, p)  # noqa: E731
+    else:
+        curves = _multilabel_roc_compute((preds, target), preds.shape[1], None, ignore_index)
+        pick = lambda f, t: (t, 1 - f)  # noqa: E731
+    eager = _scan_per_class(curves, None, pick, 0.5)
+    jitted = EJ.multilabel_at_fixed_exact(preds, target, 0.5, curve, True, ignore_index)
+    for e, j, part in zip(eager, jitted, ("value", "threshold")):
+        np.testing.assert_allclose(np.asarray(j), np.asarray(e), atol=1e-6, err_msg=part)

@@ -7,6 +7,7 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 
+from ..functional.classification import _exact_jit as _EJ
 from ..functional.classification.auroc import (
     _binary_auroc_compute,
     _reduce_auroc,
@@ -45,6 +46,11 @@ class BinaryAUROC(BinaryPrecisionRecallCurve):
 
     def compute(self) -> Array:
         if self.thresholds is None:
+            if self.max_fpr is None and self._use_jit:
+                # fixed epoch-end shape → traced filled-curve compute (one
+                # XLA program instead of an eager op-by-op host round-trip);
+                # the max_fpr partial-AUC path stays eager (dynamic slice)
+                return _EJ.binary_auroc_exact(*self._exact_state())
             return _binary_auroc_compute(self._exact_state(), None, self.max_fpr)
         return _binary_auroc_compute(self.confmat, self.thresholds, self.max_fpr)
 
@@ -80,6 +86,8 @@ class MulticlassAUROC(MulticlassPrecisionRecallCurve):
     def compute(self) -> Array:
         if self.thresholds is None:
             preds, target = self._exact_state()
+            if self._use_jit:
+                return _EJ.multiclass_auroc_exact(preds, target, self.average)
             fpr, tpr, _ = _multiclass_roc_compute((preds, target), self.num_classes, None)
             support = jnp.sum(jax.nn.one_hot(target, self.num_classes), axis=0)
         else:
@@ -109,7 +117,17 @@ class MultilabelAUROC(MultilabelPrecisionRecallCurve):
         if self.thresholds is None:
             preds, target = self._exact_state()
             if self.average == "micro":
-                return _binary_auroc_compute((preds.reshape(-1), target.reshape(-1)), None, None)
+                preds, target = preds.reshape(-1), target.reshape(-1)
+                if self._use_jit:
+                    # ignore mask folds in as 0-weights (no dynamic filter)
+                    w = None if self.ignore_index is None else (target != self.ignore_index)
+                    return _EJ.binary_auroc_exact(preds, target, w)
+                if self.ignore_index is not None:
+                    keep = target != self.ignore_index
+                    preds, target = preds[keep], target[keep]
+                return _binary_auroc_compute((preds, target), None, None)
+            if self._use_jit:
+                return _EJ.multilabel_auroc_exact(preds, target, self.average, self.ignore_index)
             fpr, tpr, _ = _multilabel_roc_compute((preds, target), self.num_labels, None, self.ignore_index)
             support = jnp.sum(target == 1, axis=0).astype(jnp.float32)
         else:

@@ -7,6 +7,7 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 
+from ..functional.classification import _exact_jit as _EJ
 from ..functional.classification.average_precision import (
     _binary_average_precision_compute,
     _binary_average_precision_exact,
@@ -42,6 +43,8 @@ class BinaryAveragePrecision(BinaryPrecisionRecallCurve):
 
     def compute(self) -> Array:
         if self.thresholds is None:
+            if self._use_jit:  # fixed epoch-end shape → traced filled curve
+                return _EJ.binary_ap_exact(*self._exact_state())
             return _binary_average_precision_exact(*self._exact_state())
         return _binary_average_precision_compute(self.confmat, self.thresholds)
 
@@ -66,6 +69,8 @@ class MulticlassAveragePrecision(MulticlassPrecisionRecallCurve):
     def compute(self) -> Array:
         if self.thresholds is None:
             preds, target = self._exact_state()
+            if self._use_jit:
+                return _EJ.multiclass_ap_exact(preds, target, self.average)
             precision, recall, _ = _multiclass_precision_recall_curve_compute(
                 (preds, target), self.num_classes, None
             )
@@ -106,10 +111,16 @@ class MultilabelAveragePrecision(MultilabelPrecisionRecallCurve):
             preds, target = self._exact_state()
             if self.average == "micro":
                 preds, target = preds.reshape(-1), target.reshape(-1)
+                if self._use_jit:
+                    # ignore mask folds in as 0-weights (no dynamic filter)
+                    w = None if self.ignore_index is None else (target != self.ignore_index)
+                    return _EJ.binary_ap_exact(preds, target, w)
                 if self.ignore_index is not None:
                     keep = target != self.ignore_index
                     preds, target = preds[keep], target[keep]
                 return _binary_average_precision_exact(preds, target)
+            if self._use_jit:
+                return _EJ.multilabel_ap_exact(preds, target, self.average, self.ignore_index)
             precision, recall, _ = _multilabel_precision_recall_curve_compute(
                 (preds, target), self.num_labels, None, self.ignore_index
             )

@@ -8,6 +8,7 @@ from typing import Any, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..functional.classification import _exact_jit as _EJ
 from ..functional.classification.precision_recall_curve import (
     Thresholds,
     _binary_precision_recall_curve_compute,
@@ -52,6 +53,9 @@ class BinaryRecallAtFixedPrecision(BinaryPrecisionRecallCurve):
         return _binary_precision_recall_curve_compute(self.confmat, self.thresholds)
 
     def compute(self) -> Tuple[Array, Array]:
+        if self.thresholds is None and self._use_jit:
+            # fixed epoch-end shape → traced filled-curve scan
+            return _EJ.binary_at_fixed_exact(*self._exact_state(), self.min_precision, "prc", True)
         precision, recall, t = self._curve()
         return _best_subject_to(recall, precision, t, self.min_precision)
 
@@ -65,6 +69,8 @@ class BinaryPrecisionAtFixedRecall(BinaryRecallAtFixedPrecision):
         self.min_recall = min_recall
 
     def compute(self) -> Tuple[Array, Array]:
+        if self.thresholds is None and self._use_jit:
+            return _EJ.binary_at_fixed_exact(*self._exact_state(), self.min_recall, "prc", False)
         precision, recall, t = self._curve()
         return _best_subject_to(precision, recall, t, self.min_recall)
 
@@ -79,6 +85,8 @@ class BinarySensitivityAtSpecificity(BinaryRecallAtFixedPrecision):
 
     def compute(self) -> Tuple[Array, Array]:
         if self.thresholds is None:
+            if self._use_jit:
+                return _EJ.binary_at_fixed_exact(*self._exact_state(), self.min_specificity, "roc", True)
             fpr, tpr, t = _binary_roc_compute(self._exact_state(), None)
         else:
             fpr, tpr, t = _binary_roc_compute(self.confmat, self.thresholds)
@@ -95,6 +103,8 @@ class BinarySpecificityAtSensitivity(BinaryRecallAtFixedPrecision):
 
     def compute(self) -> Tuple[Array, Array]:
         if self.thresholds is None:
+            if self._use_jit:
+                return _EJ.binary_at_fixed_exact(*self._exact_state(), self.min_sensitivity, "roc", False)
             fpr, tpr, t = _binary_roc_compute(self._exact_state(), None)
         else:
             fpr, tpr, t = _binary_roc_compute(self.confmat, self.thresholds)
@@ -116,6 +126,9 @@ class _PerClassAtFixed(MulticlassPrecisionRecallCurve):
     def compute(self):
         pick = (lambda p, r: (r, p)) if self._objective_is_recall else (lambda p, r: (p, r))
         if self.thresholds is None:
+            if self._use_jit:
+                return _EJ.ovr_at_fixed_exact(*self._exact_state(), self.min_value, "prc",
+                                              self._objective_is_recall)
             curves = _multiclass_precision_recall_curve_compute(self._exact_state(), self.num_classes, None)
             return _scan_per_class(curves, None, pick, self.min_value)
         curves = _multiclass_precision_recall_curve_compute(self.confmat, self.num_classes, self.thresholds)
@@ -140,6 +153,9 @@ class MultilabelRecallAtFixedPrecision(MultilabelPrecisionRecallCurve):
     def compute(self):
         pick = lambda p, r: (r, p)  # noqa: E731
         if self.thresholds is None:
+            if self._use_jit:
+                return _EJ.multilabel_at_fixed_exact(*self._exact_state(), self.min_precision, "prc",
+                                                     True, self.ignore_index)
             curves = _multilabel_precision_recall_curve_compute(
                 self._exact_state(), self.num_labels, None, self.ignore_index
             )
@@ -167,6 +183,9 @@ class _PerClassRocScan(MulticlassPrecisionRecallCurve):
         from ..functional.classification.roc import _multiclass_roc_compute
 
         if self.thresholds is None:
+            if self._use_jit:
+                return _EJ.ovr_at_fixed_exact(*self._exact_state(), self.min_value, "roc",
+                                              self._objective_is_tpr)
             curves = _multiclass_roc_compute(self._exact_state(), self.num_classes, None)
             return _scan_per_class(curves, None, self._pick, self.min_value)
         curves = _multiclass_roc_compute(self.confmat, self.num_classes, self.thresholds)
@@ -192,6 +211,7 @@ class _PerLabelScan(MultilabelPrecisionRecallCurve):
 
     _use_roc = False
     _pick = staticmethod(lambda a, b: (a, b))
+    _objective_first = True  # _EJ convention: see binary_at_fixed_exact
 
     def __init__(self, num_labels: int, min_value: float, thresholds: Thresholds = None,
                  ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
@@ -203,6 +223,11 @@ class _PerLabelScan(MultilabelPrecisionRecallCurve):
 
         compute = _multilabel_roc_compute if self._use_roc else _multilabel_precision_recall_curve_compute
         if self.thresholds is None:
+            if self._use_jit:
+                return _EJ.multilabel_at_fixed_exact(
+                    *self._exact_state(), self.min_value, "roc" if self._use_roc else "prc",
+                    self._objective_first, self.ignore_index,
+                )
             curves = compute(self._exact_state(), self.num_labels, None, self.ignore_index)
             return _scan_per_class(curves, None, self._pick, self.min_value)
         curves = compute(self.confmat, self.num_labels, self.thresholds)
@@ -214,6 +239,7 @@ class MultilabelPrecisionAtFixedRecall(_PerLabelScan):
 
     _use_roc = False
     _pick = staticmethod(lambda precision, recall: (precision, recall))
+    _objective_first = False  # objective = precision, constraint = recall
 
 
 class MultilabelSensitivityAtSpecificity(_PerLabelScan):
@@ -221,6 +247,7 @@ class MultilabelSensitivityAtSpecificity(_PerLabelScan):
 
     _use_roc = True
     _pick = staticmethod(lambda fpr, tpr: (tpr, 1 - fpr))
+    _objective_first = True
 
 
 class MultilabelSpecificityAtSensitivity(_PerLabelScan):
@@ -228,6 +255,7 @@ class MultilabelSpecificityAtSensitivity(_PerLabelScan):
 
     _use_roc = True
     _pick = staticmethod(lambda fpr, tpr: (1 - fpr, tpr))
+    _objective_first = False
 
 
 class RecallAtFixedPrecision(_ClassificationTaskWrapper):

@@ -1,0 +1,221 @@
+"""Jittable fixed-shape exact-mode curve computes (scalar consumers).
+
+Exact mode (``thresholds=None``) concatenates raw preds/target at epoch end,
+so the shape is static from there on — but the classic ``_binary_clf_curve``
+(reference ``functional/classification/precision_recall_curve.py:28``) keeps
+only distinct-threshold positions and is therefore shape-dynamic and eager.
+
+The trick here: return length-N arrays where every position that is NOT the
+last element of a tied-prediction block repeats the previous block end (and
+the origin before the first block end). Trapezoids, step-sums and
+constrained-argmax consumers are invariant to such held duplicates (they
+contribute zero-width segments / duplicate candidate triples), so AUROC,
+AveragePrecision and the at-fixed scanners computed from these arrays equal
+the eager distinct-only results while tracing with fixed shapes — one XLA
+compile per epoch length instead of a host round-trip per compute.
+
+Used by the class layer for exact-mode computes; the eager functional path
+remains the parity oracle (``tests/classification/test_exact_jit.py``).
+"""
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.compute import _safe_divide
+from .auroc import _reduce_auroc, _trapz
+from .average_precision import _ap_from_curve, _reduce_average_precision
+from .specificity_sensitivity import _best_subject_to
+
+Array = jax.Array
+
+
+def _clf_curve_filled(preds: Array, target: Array, weights: Optional[Array] = None) -> Tuple[Array, Array, Array]:
+    """Fixed-shape ``_binary_clf_curve``: (fps, tps, thresh, is_real) length N.
+
+    Positions before the first tied-block end hold the origin (0, 0, +inf,
+    is_real=False); interior non-block-end positions hold the previous block
+    end. ``weights`` (0/1) supports per-sample ignore masks without
+    data-dependent filtering.
+    """
+    n = preds.shape[0]
+    desc = jnp.argsort(preds)[::-1]  # same tie/NaN placement as the eager path
+    p = preds[desc]
+    t = target[desc].astype(jnp.float32)
+    if weights is None:
+        w = jnp.ones_like(p)
+    else:
+        w = weights[desc].astype(jnp.float32)
+    tps_all = jnp.cumsum(t * w)
+    fps_all = jnp.cumsum((1.0 - t) * w)
+    idx = jnp.arange(n)
+    distinct = jnp.concatenate([p[:-1] != p[1:], jnp.ones((1,), bool)])
+    marker = jnp.where(distinct, idx, -1)
+    last_end = jax.lax.associative_scan(jnp.maximum, marker)  # cummax
+    safe = jnp.clip(last_end, 0, None)
+    has = last_end >= 0
+    fps = jnp.where(has, fps_all[safe], 0.0)
+    tps = jnp.where(has, tps_all[safe], 0.0)
+    thresh = jnp.where(has, p[safe], jnp.inf)
+    return fps, tps, thresh, has
+
+
+def _roc_filled(preds: Array, target: Array, weights: Optional[Array] = None) -> Tuple[Array, Array, Array]:
+    """(fpr, tpr, thresh) length N+1 with the sklearn inf-threshold origin."""
+    fps, tps, thresh, _ = _clf_curve_filled(preds, target, weights)
+    tps = jnp.concatenate([jnp.zeros(1, tps.dtype), tps])
+    fps = jnp.concatenate([jnp.zeros(1, fps.dtype), fps])
+    thresh = jnp.concatenate([jnp.asarray([jnp.inf], thresh.dtype), thresh])
+    tpr = _safe_divide(tps, tps[-1])
+    fpr = _safe_divide(fps, fps[-1])
+    return fpr, tpr, thresh
+
+
+def _prc_filled(preds: Array, target: Array, weights: Optional[Array] = None) -> Tuple[Array, Array, Array]:
+    """(precision, recall, thresh) mirroring the eager exact PRC compute
+    (reversed block order, appended (1, 0) endpoint, length N+1/N+1/N).
+
+    Unlike ROC (whose eager arrays contain the inf-threshold origin), the
+    eager PR curve has no origin point, so pre-first-block-end positions
+    must replicate the FIRST block end rather than (0, 0, inf) — otherwise
+    an at-fixed argmax can pick a fake point and return threshold=inf.
+    """
+    fps, tps, thresh, is_real = _clf_curve_filled(preds, target, weights)
+    first_end = jnp.argmax(is_real)  # index of the first block end
+    fps = jnp.where(is_real, fps, fps[first_end])
+    tps = jnp.where(is_real, tps, tps[first_end])
+    thresh = jnp.where(is_real, thresh, thresh[first_end])
+    precision = _safe_divide(tps, tps + fps)
+    # no positives → recall 1 everywhere (modern-sklearn semantics)
+    recall = jnp.where(tps[-1] == 0, jnp.ones_like(tps), tps / jnp.where(tps[-1] == 0, 1.0, tps[-1]))
+    precision = jnp.concatenate([precision[::-1], jnp.ones(1, dtype=precision.dtype)])
+    recall = jnp.concatenate([recall[::-1], jnp.zeros(1, dtype=recall.dtype)])
+    return precision, recall, thresh[::-1]
+
+
+def _ovr_targets(target: Array, num_classes: int) -> Array:
+    return (target[:, None] == jnp.arange(num_classes)).astype(jnp.int32)  # (N, C)
+
+
+def _ml_weights(target: Array, ignore_index: Optional[int]) -> Tuple[Array, Optional[Array]]:
+    """Multilabel per-label ignore handling: (clipped target, 0/1 weights)."""
+    if ignore_index is None:
+        return target, None
+    w = (target != ignore_index).astype(jnp.float32)
+    return jnp.clip(target, 0, 1), w
+
+
+# ------------------------------------------------------------------- AUROC
+
+@jax.jit
+def binary_auroc_exact(preds: Array, target: Array, weights: Optional[Array] = None) -> Array:
+    """``weights`` (0/1) folds an ignore mask in without dynamic filtering
+    (multilabel micro path)."""
+    fpr, tpr, _ = _roc_filled(preds, target, weights)
+    return _trapz(tpr, fpr)
+
+
+@partial(jax.jit, static_argnames=("average",))
+def multiclass_auroc_exact(preds: Array, target: Array, average: Optional[str] = "macro") -> Array:
+    tgt = _ovr_targets(target, preds.shape[1])
+    fpr, tpr, _ = jax.vmap(_roc_filled, in_axes=(1, 1))(preds, tgt)  # (C, N+1)
+    support = jnp.sum(tgt, axis=0).astype(jnp.float32)
+    return _reduce_auroc(fpr, tpr, average, weights=support)
+
+
+@partial(jax.jit, static_argnames=("average", "ignore_index"))
+def multilabel_auroc_exact(preds: Array, target: Array, average: Optional[str] = "macro",
+                           ignore_index: Optional[int] = None) -> Array:
+    tgt, w = _ml_weights(target, ignore_index)
+    if w is None:
+        fpr, tpr, _ = jax.vmap(_roc_filled, in_axes=(1, 1))(preds, tgt)
+    else:
+        fpr, tpr, _ = jax.vmap(_roc_filled, in_axes=(1, 1, 1))(preds, tgt, w)
+    support = jnp.sum(target == 1, axis=0).astype(jnp.float32)
+    return _reduce_auroc(fpr, tpr, average, weights=support)
+
+
+# ---------------------------------------------------------- AveragePrecision
+
+@jax.jit
+def binary_ap_exact(preds: Array, target: Array, weights: Optional[Array] = None) -> Array:
+    """``weights`` (0/1) folds an ignore mask in without dynamic filtering
+    (multilabel micro path)."""
+    precision, recall, _ = _prc_filled(preds, target, weights)
+    ap = _ap_from_curve(precision, recall)
+    # the reference's recall is 0/0 -> nan with no positive samples
+    n_pos = jnp.sum((target == 1) * (1.0 if weights is None else weights))
+    return jnp.where(n_pos > 0, ap, jnp.nan)
+
+
+@partial(jax.jit, static_argnames=("average",))
+def multiclass_ap_exact(preds: Array, target: Array, average: Optional[str] = "macro") -> Array:
+    tgt = _ovr_targets(target, preds.shape[1])
+    precision, recall, _ = jax.vmap(_prc_filled, in_axes=(1, 1))(preds, tgt)  # (C, N+1)
+    support = jnp.sum(tgt, axis=0).astype(jnp.float32)
+    return _reduce_average_precision(precision, recall, average, weights=support, exclude_empty=True)
+
+
+@partial(jax.jit, static_argnames=("average", "ignore_index"))
+def multilabel_ap_exact(preds: Array, target: Array, average: Optional[str] = "macro",
+                        ignore_index: Optional[int] = None) -> Array:
+    tgt, w = _ml_weights(target, ignore_index)
+    if w is None:
+        precision, recall, _ = jax.vmap(_prc_filled, in_axes=(1, 1))(preds, tgt)
+    else:
+        precision, recall, _ = jax.vmap(_prc_filled, in_axes=(1, 1, 1))(preds, tgt, w)
+    # raw-target support, mirroring MultilabelAveragePrecision's eager path
+    support = jnp.sum(target == 1, axis=0).astype(jnp.float32)
+    return _reduce_average_precision(precision, recall, average, weights=support, exclude_empty=True)
+
+
+# ----------------------------------------------------------- at-fixed scans
+
+@partial(jax.jit, static_argnames=("curve", "objective_first"))
+def binary_at_fixed_exact(preds: Array, target: Array, min_value, curve: str = "prc",
+                          objective_first: bool = True) -> Tuple[Array, Array]:
+    """Constrained scan over the filled exact curve.
+
+    ``curve="prc"``: arrays (precision, recall); ``curve="roc"``: (tpr,
+    1-fpr) i.e. (sensitivity, specificity). ``objective_first=True``
+    maximizes the first array subject to the second >= min_value; False
+    swaps roles.
+    """
+    if curve == "prc":
+        precision, recall, t = _prc_filled(preds, target)
+        a, b = (recall, precision) if objective_first else (precision, recall)
+    else:
+        fpr, tpr, t = _roc_filled(preds, target)
+        a, b = (tpr, 1 - fpr) if objective_first else (1 - fpr, tpr)
+    return _best_subject_to(a, b, t, min_value)
+
+
+@partial(jax.jit, static_argnames=("curve", "objective_first"))
+def ovr_at_fixed_exact(preds: Array, target: Array, min_value, curve: str = "prc",
+                       objective_first: bool = True) -> Tuple[Array, Array]:
+    """Per-class constrained scan (multiclass one-vs-rest)."""
+    tgt = _ovr_targets(target, preds.shape[1])
+    return _batched_at_fixed(preds, tgt, None, min_value, curve, objective_first)
+
+
+@partial(jax.jit, static_argnames=("curve", "objective_first", "ignore_index"))
+def multilabel_at_fixed_exact(preds: Array, target: Array, min_value, curve: str = "prc",
+                              objective_first: bool = True,
+                              ignore_index: Optional[int] = None) -> Tuple[Array, Array]:
+    tgt, w = _ml_weights(target, ignore_index)
+    return _batched_at_fixed(preds, tgt, w, min_value, curve, objective_first)
+
+
+def _batched_at_fixed(preds, tgt, w, min_value, curve, objective_first):
+    fill = _prc_filled if curve == "prc" else _roc_filled
+    if w is None:
+        x, y, t = jax.vmap(fill, in_axes=(1, 1))(preds, tgt)
+    else:
+        x, y, t = jax.vmap(fill, in_axes=(1, 1, 1))(preds, tgt, w)
+    if curve == "prc":
+        a, b = (y, x) if objective_first else (x, y)  # (recall, precision) / swap
+    else:
+        fpr, tpr = x, y
+        a, b = (tpr, 1 - fpr) if objective_first else (1 - fpr, tpr)
+    return _best_subject_to(a, b, t, min_value)
