@@ -202,3 +202,35 @@ def test_group_fairness():
     out = f.compute()
     assert set(out) == {"DP", "EO"}
     assert 0 <= float(out["DP"]) <= 1 and 0 <= float(out["EO"]) <= 1
+
+
+def test_hinge_ignore_index_masked_update():
+    """The 0-weight ignore mask must (a) equal the filtering semantics, (b)
+    stay jit-traceable, and (c) not let non-finite preds on ignored (padded)
+    rows poison the sum (0 * NaN)."""
+    import jax
+
+    rng = np.random.RandomState(5)
+    logits = rng.randn(24, 4).astype(np.float32)
+    t = rng.randint(0, 4, 24)
+    keep = t != 0
+    expect = multiclass_hinge_loss(jnp.asarray(logits[keep]), jnp.asarray(t[keep]), 4)
+    got = multiclass_hinge_loss(jnp.asarray(logits), jnp.asarray(t), 4, ignore_index=0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expect), atol=1e-6)
+
+    poisoned = logits.copy()
+    poisoned[~keep] = np.nan
+    got_nan = multiclass_hinge_loss(jnp.asarray(poisoned), jnp.asarray(t), 4, ignore_index=0)
+    np.testing.assert_allclose(np.asarray(got_nan), np.asarray(expect), atol=1e-6)
+
+    m = MulticlassHingeLoss(num_classes=4, ignore_index=0)
+    st = jax.jit(lambda s, p, tt: m.update_state(s, p, tt))(m.init_state(), jnp.asarray(logits), jnp.asarray(t))
+    np.testing.assert_allclose(np.asarray(m.compute_state(st)), np.asarray(expect), atol=1e-6)
+
+    bs = rng.randn(24).astype(np.float32)
+    bt = rng.randint(0, 2, 24)
+    bkeep = bt != 0  # ignore the 0 class
+    be = binary_hinge_loss(jnp.asarray(bs[bkeep]), jnp.asarray(bt[bkeep]))
+    bs_p = bs.copy(); bs_p[~bkeep] = np.inf
+    bg = binary_hinge_loss(jnp.asarray(bs_p), jnp.asarray(bt), ignore_index=0)
+    np.testing.assert_allclose(np.asarray(bg), np.asarray(be), atol=1e-6)

@@ -1,0 +1,79 @@
+"""Accumulation invariance: update(full batch) == update(half) ; update(half).
+
+This is the reference ``MetricTester``'s core class-vs-accumulation check
+(``tests/unittests/_helpers/testers.py:206-320``) applied uniformly: a
+metric's epoch result must not depend on how the epoch was batched. Runs for
+every (class, input-case) pair in the registry — including host/string
+metrics — except classes whose semantics are intentionally batch-dependent
+(running windows) or stochastic at compute (KID subset sampling).
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "helpers"))
+from example_inputs import CASES, all_cases  # noqa: E402
+from testers import _assert_allclose  # noqa: E402
+
+# batch-dependent by design:
+# - Running*/Running: windowed over the last k updates
+# - KernelInceptionDistance: subset resampling at compute over pooled state
+BATCH_DEPENDENT = {"RunningMean", "RunningSum", "Running", "KernelInceptionDistance"}
+
+
+def _split_call(args):
+    """Split every batch-shaped leaf of one update call in half."""
+    def size(x):
+        if isinstance(x, (list, tuple)) and not hasattr(x, "shape"):
+            return len(x)
+        return x.shape[0]
+
+    def cut(x, sl):
+        if isinstance(x, dict):
+            return {k: cut(v, sl) for k, v in x.items()}
+        if isinstance(x, (list, tuple)) and not hasattr(x, "shape"):
+            return type(x)(x[sl])
+        return x[sl]
+
+    n = min(size(a) for a in args)
+    h = n // 2
+    if h == 0:
+        return None
+    return tuple(cut(a, slice(0, h)) for a in args), tuple(cut(a, slice(h, None)) for a in args)
+
+
+CASE_IDS = [
+    f"{name}:{cid}"
+    for name in sorted(CASES)
+    for cid, case in all_cases(name)
+    if name not in BATCH_DEPENDENT and case.batch_axis
+]
+
+
+@pytest.mark.parametrize("case_key", CASE_IDS)
+def test_batch_split_invariance(case_key):
+    name, cid = case_key.split(":")
+    case = dict(all_cases(name))[cid]
+
+    calls = case.make_inputs(np.random.RandomState(11), 16)
+
+    m_full = case.build(name)
+    for c in calls:
+        m_full.update(*c)
+    expected = m_full.compute()
+
+    m_split = case.build(name)
+    for c in calls:
+        halves = _split_call(c)
+        if halves is None:
+            m_split.update(*c)
+            continue
+        m_split.update(*halves[0])
+        m_split.update(*halves[1])
+    result = m_split.compute()
+
+    _assert_allclose(result, expected, atol=1e-4, rtol=1e-4, msg=f"{case_key} split vs full")

@@ -32,18 +32,13 @@ class BinaryHingeLoss(Metric):
         self.squared = squared
         self.ignore_index = ignore_index
         self.validate_args = validate_args
-        if ignore_index is not None:
-            self._use_jit = False
         self.add_state("measures", jnp.asarray(0.0), dist_reduce_fx="sum")
         self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
-        p, t = preds, target
-        if self.ignore_index is not None:
-            keep = t.reshape(-1) != self.ignore_index
-            p = p.reshape(-1)[keep]
-            t = jnp.clip(t.reshape(-1)[keep], 0, 1)
-        measures, total = _binary_hinge_loss_update(p, t, self.squared)
+        # ignore mask folds in as 0-weights: no dynamic filter, stays traceable
+        w = None if self.ignore_index is None else (target.reshape(-1) != self.ignore_index)
+        measures, total = _binary_hinge_loss_update(preds, target, self.squared, w)
         self.measures = self.measures + measures
         self.total = self.total + total
 
@@ -72,19 +67,16 @@ class MulticlassHingeLoss(Metric):
         self.multiclass_mode = multiclass_mode
         self.ignore_index = ignore_index
         self.validate_args = validate_args
-        if ignore_index is not None:
-            self._use_jit = False
         default = jnp.asarray(0.0) if multiclass_mode == "crammer-singer" else jnp.zeros((num_classes,))
         self.add_state("measures", default, dist_reduce_fx="sum")
         self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
-        p, t = preds, target
-        if self.ignore_index is not None:
-            keep = t.reshape(-1) != self.ignore_index
-            p = p.reshape(-1, self.num_classes)[keep]
-            t = jnp.clip(t.reshape(-1)[keep], 0, self.num_classes - 1)
-        measures, total = _multiclass_hinge_loss_update(p, t, self.num_classes, self.squared, self.multiclass_mode)
+        # ignore mask folds in as 0-weights: no dynamic filter, stays traceable
+        w = None if self.ignore_index is None else (target.reshape(-1) != self.ignore_index)
+        measures, total = _multiclass_hinge_loss_update(
+            preds, target, self.num_classes, self.squared, self.multiclass_mode, w
+        )
         if self.multiclass_mode == "crammer-singer":
             measures = jnp.sum(measures)
         self.measures = self.measures + measures

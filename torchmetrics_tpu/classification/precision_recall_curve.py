@@ -80,7 +80,9 @@ class BinaryPrecisionRecallCurve(Metric):
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
         if self.ignore_index is not None:
-            keep = dim_zero_cat(self.valid)
+            # astype(bool): sync transports may return the mask as 0/1 ints,
+            # and integer `preds[keep]` would gather rows instead of masking
+            keep = dim_zero_cat(self.valid).astype(bool)
             preds, target = preds[keep], target[keep]
         return preds, target
 
@@ -141,7 +143,7 @@ class MulticlassPrecisionRecallCurve(Metric):
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
         if self.ignore_index is not None:
-            keep = dim_zero_cat(self.valid)
+            keep = dim_zero_cat(self.valid).astype(bool)
             preds, target = preds[keep], target[keep]
         return preds, target
 

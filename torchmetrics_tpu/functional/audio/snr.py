@@ -20,6 +20,9 @@ def _check_same_shape(preds: Array, target: Array) -> None:
 def signal_noise_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
     """SNR = 10 log10(|target|² / |target - preds|²). Parity: ``snr.py:22``."""
     _check_same_shape(preds, target)
+    # f16 sums of squares over the time axis overflow; accumulate in f32
+    preds = preds.astype(jnp.promote_types(preds.dtype, jnp.float32))
+    target = target.astype(preds.dtype)
     if zero_mean:
         target = target - jnp.mean(target, axis=-1, keepdims=True)
         preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
@@ -36,6 +39,9 @@ def scale_invariant_signal_noise_ratio(preds: Array, target: Array) -> Array:
 def scale_invariant_signal_distortion_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
     """SI-SDR via optimal scaling projection. Parity: ``sdr.py:201``."""
     _check_same_shape(preds, target)
+    # f16 sums of squares over the time axis overflow; accumulate in f32
+    preds = preds.astype(jnp.promote_types(preds.dtype, jnp.float32))
+    target = target.astype(preds.dtype)
     if zero_mean:
         target = target - jnp.mean(target, axis=-1, keepdims=True)
         preds = preds - jnp.mean(preds, axis=-1, keepdims=True)

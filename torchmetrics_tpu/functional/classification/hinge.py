@@ -12,17 +12,28 @@ from ...utils.compute import normalize_logits_if_needed
 Array = jax.Array
 
 
-def _binary_hinge_loss_update(preds: Array, target: Array, squared: bool) -> Tuple[Array, Array]:
+def _binary_hinge_loss_update(
+    preds: Array, target: Array, squared: bool, weights: Optional[Array] = None
+) -> Tuple[Array, Array]:
     # the reference routes preds through the confusion-matrix format stage
-    # (hinge.py:118), which sigmoids inputs outside [0,1]
-    preds = normalize_logits_if_needed(preds.reshape(-1).astype(jnp.float32), "sigmoid")
-    target = target.reshape(-1)
+    # (hinge.py:118), which sigmoids inputs outside [0,1]. ``weights`` (0/1)
+    # folds an ignore mask in without data-dependent filtering, keeping the
+    # update traceable (shard_map/jit) — the normalize decision consults the
+    # mask so out-of-range values on ignored rows don't flip it
+    valid = None if weights is None else weights.reshape(-1).astype(bool)
+    preds = normalize_logits_if_needed(preds.reshape(-1).astype(jnp.float32), "sigmoid", valid)
+    target = jnp.clip(target.reshape(-1), 0, 1)
     target_s = target * 2 - 1  # {0,1} → {-1,1}
     margin = 1 - target_s * preds
     losses = jnp.maximum(margin, 0.0)
     if squared:
         losses = losses**2
-    return jnp.sum(losses), jnp.asarray(target.shape[0], dtype=jnp.float32)
+    if weights is None:
+        return jnp.sum(losses), jnp.asarray(target.shape[0], dtype=jnp.float32)
+    w = weights.reshape(-1).astype(jnp.float32)
+    # where, not bare multiply: 0 * NaN = NaN, and ignored (padded) rows may
+    # legitimately hold non-finite preds the filtering path used to drop
+    return jnp.sum(jnp.where(w > 0, losses, 0.0) * w), jnp.sum(w)
 
 
 def binary_hinge_loss(
@@ -30,20 +41,20 @@ def binary_hinge_loss(
     validate_args: bool = True,
 ) -> Array:
     """Parity: reference ``hinge.py:76``. Expects unnormalized decision scores."""
-    if ignore_index is not None:
-        keep = target.reshape(-1) != ignore_index
-        preds = preds.reshape(-1)[keep]
-        target = jnp.clip(target.reshape(-1)[keep], 0, 1)
-    measure, total = _binary_hinge_loss_update(preds, target, squared)
+    w = None if ignore_index is None else (target.reshape(-1) != ignore_index)
+    measure, total = _binary_hinge_loss_update(preds, target, squared, w)
     return measure / total
 
 
 def _multiclass_hinge_loss_update(
-    preds: Array, target: Array, num_classes: int, squared: bool, multiclass_mode: str
+    preds: Array, target: Array, num_classes: int, squared: bool, multiclass_mode: str,
+    weights: Optional[Array] = None,
 ) -> Tuple[Array, Array]:
-    # softmax inputs outside [0,1], like the reference (hinge.py:156-157)
-    preds = normalize_logits_if_needed(preds.reshape(-1, num_classes).astype(jnp.float32), "softmax")
-    target = target.reshape(-1)
+    # softmax inputs outside [0,1], like the reference (hinge.py:156-157);
+    # ``weights`` (0/1) = traceable ignore mask (see binary update)
+    valid = None if weights is None else weights.reshape(-1).astype(bool)[:, None]
+    preds = normalize_logits_if_needed(preds.reshape(-1, num_classes).astype(jnp.float32), "softmax", valid)
+    target = jnp.clip(target.reshape(-1), 0, num_classes - 1)
     tgt_oh = jax.nn.one_hot(target, num_classes)
     if multiclass_mode == "crammer-singer":
         margin = preds[jnp.arange(preds.shape[0]), target]
@@ -54,7 +65,12 @@ def _multiclass_hinge_loss_update(
         losses = jnp.maximum(1 - target_s * preds, 0.0)
     if squared:
         losses = losses**2
-    return jnp.sum(losses, axis=0), jnp.asarray(target.shape[0], dtype=jnp.float32)
+    if weights is None:
+        return jnp.sum(losses, axis=0), jnp.asarray(target.shape[0], dtype=jnp.float32)
+    w = weights.reshape(-1).astype(jnp.float32)
+    w_b = w if losses.ndim == 1 else w[:, None]
+    # where, not bare multiply: 0 * NaN = NaN (see binary update)
+    return jnp.sum(jnp.where(w_b > 0, losses, 0.0) * w_b, axis=0), jnp.sum(w)
 
 
 def multiclass_hinge_loss(
@@ -66,11 +82,8 @@ def multiclass_hinge_loss(
         raise ValueError(
             f"Argument `multiclass_mode` is expected to be 'crammer-singer' or 'one-vs-all' but got {multiclass_mode}"
         )
-    if ignore_index is not None:
-        keep = target.reshape(-1) != ignore_index
-        preds = preds.reshape(-1, num_classes)[keep]
-        target = jnp.clip(target.reshape(-1)[keep], 0, num_classes - 1)
-    measure, total = _multiclass_hinge_loss_update(preds, target, num_classes, squared, multiclass_mode)
+    w = None if ignore_index is None else (target.reshape(-1) != ignore_index)
+    measure, total = _multiclass_hinge_loss_update(preds, target, num_classes, squared, multiclass_mode, w)
     return jnp.sum(measure) / total if multiclass_mode == "crammer-singer" else measure / total
 
 

@@ -50,8 +50,15 @@ def _invariant_all_gather(value: Array, axis_name: str, stack: bool = False) -> 
     """
     n = lax.axis_size(axis_name)
     i = lax.axis_index(axis_name)
-    buf = jnp.zeros((n,) + value.shape, value.dtype).at[i].set(value)
+    # psum promotes bool to an integer sum; round-trip through uint8 so
+    # boolean mask states (e.g. exact-mode `valid`) keep their dtype —
+    # otherwise downstream `preds[mask]` silently becomes integer indexing
+    is_bool = value.dtype == jnp.bool_
+    v = value.astype(jnp.uint8) if is_bool else value
+    buf = jnp.zeros((n,) + v.shape, v.dtype).at[i].set(v)
     buf = lax.psum(buf, axis_name)
+    if is_bool:
+        buf = buf.astype(jnp.bool_)
     if stack:
         return buf  # (world, ...) — parity with reference gather-no-reduce
     return buf.reshape((n * value.shape[0],) + value.shape[1:]) if value.ndim else buf
