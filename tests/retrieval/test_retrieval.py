@@ -267,6 +267,42 @@ def test_ignore_index():
     np.testing.assert_allclose(float(metric.compute()), ref, atol=1e-5)
 
 
+@pytest.mark.parametrize("dtype", [np.int16, np.int32, np.uint32, np.int64])
+def test_ignore_index_any_index_dtype(dtype):
+    """The IGNORED_QUERY sentinel must not wrap in non-int32 index dtypes."""
+    from torchmetrics_tpu import RetrievalMRR
+
+    metric = RetrievalMRR(ignore_index=-1)
+    metric.update(jnp.asarray([0.9, 0.2, 0.8, 0.3]), jnp.asarray([1, 0, -1, 1]),
+                  indexes=jnp.asarray(np.asarray([0, 0, 1, 1], dtype)))
+    # q0: first hit at rank 1; q1: its only surviving row is relevant
+    np.testing.assert_allclose(float(metric.compute()), 1.0, atol=1e-6)
+
+
+def test_negative_query_ids_supported():
+    """Real negative ids are legitimate (reference `_flexible_bincount`
+    shifts by `x.min()`); only the sentinel row is dropped."""
+    from torchmetrics_tpu import RetrievalMRR
+
+    metric = RetrievalMRR()
+    metric.update(jnp.asarray([0.9, 0.2, 0.8, 0.3]), jnp.asarray([0, 1, 1, 0]),
+                  indexes=jnp.asarray([-1, -1, 0, 0]))
+    np.testing.assert_allclose(float(metric.compute()), 0.75, atol=1e-6)
+
+
+def test_all_rows_ignored_returns_zero():
+    from torchmetrics_tpu import RetrievalMAP, RetrievalPrecisionRecallCurve
+
+    m = RetrievalMAP(ignore_index=0)
+    m.update(jnp.asarray([0.5, 0.3]), jnp.asarray([0, 0]), jnp.asarray([0, 1]))
+    assert float(m.compute()) == 0.0
+    c = RetrievalPrecisionRecallCurve(max_k=2, ignore_index=0)
+    c.update(jnp.asarray([0.5, 0.3]), jnp.asarray([0, 0]), jnp.asarray([0, 1]))
+    prec, rec, ks = c.compute()
+    assert np.all(np.asarray(prec) == 0.0) and np.all(np.asarray(rec) == 0.0)
+    assert list(np.asarray(ks)) == [1, 2]
+
+
 def test_pr_curve_class_and_recall_at_fixed_precision():
     m = RetrievalPrecisionRecallCurve(max_k=4)
     m.update(jnp.asarray(PREDS), jnp.asarray(TARGET), jnp.asarray(INDEXES))

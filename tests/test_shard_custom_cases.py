@@ -1,0 +1,143 @@
+"""Custom 8-device shard_map cases for metrics the generic sweep can't cover.
+
+VERDICT r2 #8: ``batch_axis=False`` registry entries (dict args, dual
+real/fake updates, wrapper slicing) are excluded from
+``test_dtype_grad_sweep.py::test_shard_map_state_sync`` because their update
+signatures don't fit the one-leading-batch-axis protocol — not because their
+sync is untestable. Each case here writes the step function by hand:
+``init_state -> update_state (shape-appropriate) -> reduce_state('dp')`` on a
+virtual 8-device mesh, compared against the single-device update on the full
+batch (reference ``ddp=True`` semantics, ``_helpers/testers.py:398``).
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "helpers"))
+from example_inputs import CASES  # noqa: E402
+from testers import _assert_allclose, _shard_map, sim_devices  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    from jax.sharding import Mesh
+
+    devs = sim_devices(8)
+    if len(devs) < 8:
+        pytest.skip("needs 8 devices")
+    return Mesh(np.array(devs), ("dp",))
+
+
+def _compare(m, step, args, in_specs, expected_state, mesh, atol=1e-4):
+    from jax.sharding import PartitionSpec as P
+
+    expected = m.compute_state(expected_state)
+    fn = _shard_map()(step, mesh=mesh, in_specs=in_specs, out_specs=P())
+    synced = jax.jit(fn)(*args)
+    result = m.compute_state(synced)
+    _assert_allclose(result, expected, atol=atol, rtol=atol, msg=f"{type(m).__name__} sharded vs single")
+
+
+@pytest.mark.parametrize("name", ["FrechetInceptionDistance"])
+def test_shard_dual_update_moments(name, mesh):
+    """real/fake dual update: both accumulated per shard, psum-reduced."""
+    from jax.sharding import PartitionSpec as P
+
+    case = CASES[name]
+    m = case.build(name)
+    (real_imgs, _), (fake_imgs, _) = case.make_inputs(np.random.RandomState(7), 16)
+    # FID registers states lazily on first update (feature width unknown
+    # until the net runs); trigger registration, then drop that state
+    m.update(real_imgs[:2], real=True)
+    m.reset()
+
+    def seq(st, r, f):
+        st = m.update_state(st, r, True)
+        return m.update_state(st, f, False)
+
+    def step(r, f):
+        return m.reduce_state(seq(m.init_state(), r, f), "dp")
+
+    _compare(m, step, (real_imgs, fake_imgs), (P("dp"), P("dp")),
+             seq(m.init_state(), real_imgs, fake_imgs), mesh)
+
+
+@pytest.mark.parametrize("name", ["KernelInceptionDistance",
+                                  "MemorizationInformedFrechetInceptionDistance"])
+def test_shard_dual_update_feature_lists(name, mesh):
+    """cat feature-list states: the gather must deliver every row exactly
+    once. compute() is subset-sampling / degenerate-covariance sensitive to
+    row order, so the assertion is on the synced STATE: sorted rows equal."""
+    from jax.sharding import PartitionSpec as P
+
+    case = CASES[name]
+    m = case.build(name)
+    (real_imgs, _), (fake_imgs, _) = case.make_inputs(np.random.RandomState(7), 16)
+
+    def seq(st, r, f):
+        st = m.update_state(st, r, True)
+        return m.update_state(st, f, False)
+
+    def step(r, f):
+        return m.reduce_state(seq(m.init_state(), r, f), "dp")
+
+    fn = _shard_map()(step, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P())
+    synced = jax.jit(fn)(real_imgs, fake_imgs)
+    expected = seq(m.init_state(), real_imgs, fake_imgs)
+    for key in expected:
+        exp = np.concatenate([np.asarray(v) for v in expected[key]]) if isinstance(expected[key], (tuple, list)) \
+            else np.asarray(expected[key])
+        got = np.concatenate([np.asarray(v) for v in synced[key]]) if isinstance(synced[key], (tuple, list)) \
+            else np.asarray(synced[key])
+        assert exp.shape == got.shape, f"{name}.{key}: shape {got.shape} != {exp.shape}"
+        exp2, got2 = exp.reshape(exp.shape[0], -1), got.reshape(got.shape[0], -1)
+        order_e = np.lexsort(exp2.T)
+        order_g = np.lexsort(got2.T)
+        np.testing.assert_allclose(got2[order_g], exp2[order_e], atol=1e-5,
+                                   err_msg=f"{name}.{key}: gathered rows are not a permutation")
+
+
+@pytest.mark.parametrize("name", ["SpatialDistortionIndex", "QualityWithNoReference"])
+def test_shard_dict_arg_update(name, mesh):
+    """dict-valued update arg ({'ms','pan'}): leaves sharded individually."""
+    from jax.sharding import PartitionSpec as P
+
+    case = CASES[name]
+    m = case.build(name)
+    (preds, d), = case.make_inputs(np.random.RandomState(7), 16)
+
+    def step(p, ms, pan):
+        st = m.update_state(m.init_state(), p, {"ms": ms, "pan": pan})
+        return m.reduce_state(st, "dp")
+
+    _compare(m, step, (preds, d["ms"], d["pan"]), (P("dp"), P("dp"), P("dp")),
+             m.update_state(m.init_state(), preds, d), mesh)
+
+
+@pytest.mark.parametrize("name", ["LearnedPerceptualImagePatchSimilarity", "InceptionScore"])
+def test_shard_injected_net(name, mesh):
+    """injected feature/distance callables are pure jnp -> traceable."""
+    from jax.sharding import PartitionSpec as P
+
+    case = CASES[name]
+    m = case.build(name)
+    call = case.make_inputs(np.random.RandomState(7), 16)[0]
+
+    def step(*a):
+        st = m.update_state(m.init_state(), *a)
+        return m.reduce_state(st, "dp")
+
+    _compare(m, step, call, tuple(P("dp") for _ in call),
+             m.update_state(m.init_state(), *call), mesh)
+
+
+# NOTE: wrapper metrics (MultioutputWrapper, MinMaxMetric, BootStrapper,
+# Running, MetricTracker) are deliberately absent: WrapperMetric is
+# ``jittable=False`` by design — inner metrics own their states and sync
+# through the eager class API (``Metric.merge_states`` / ``sync()``), which
+# ``tests/test_wrappers.py`` and ``tests/test_uneven_sync.py`` exercise.

@@ -37,7 +37,9 @@ class _LabelClusteringMetric(Metric):
     is_differentiable = True
     higher_is_better = True
     full_state_update = True
-    jittable = False  # label spaces are data-dependent; compute is eager
+    # update is a trace-safe append (in-graph all_gather syncs the cat
+    # states); only compute is eager — label spaces are data-dependent
+    jittable = True
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)
@@ -255,7 +257,7 @@ class _EmbeddingClusteringMetric(Metric):
 
     is_differentiable = True
     full_state_update = True
-    jittable = False
+    jittable = True  # append-only update; compute is eager (see above)
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)
@@ -330,9 +332,6 @@ class DunnIndex(_EmbeddingClusteringMetric):
     def __init__(self, p: float = 2.0, **kwargs: Any) -> None:
         super().__init__(**kwargs)
         self.p = p
-
-    def update(self, data: Array, labels: Array) -> None:  # arg name parity
-        super().update(data, labels)
 
     def compute(self) -> Array:
         return dunn_index(dim_zero_cat(self.data), dim_zero_cat(self.labels), self.p)

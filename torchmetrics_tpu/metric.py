@@ -110,6 +110,23 @@ class Metric:
             multi-process else NoSync. Replaces ``dist_sync_fn`` /
             ``process_group`` / ``distributed_available_fn``.
         jit: trace update/forward with ``jax.jit`` (per input-shape cache).
+
+    Example (defining a custom metric):
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import Metric
+        >>> class RunningTotal(Metric):
+        ...     def __init__(self):
+        ...         super().__init__()
+        ...         self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+        ...     def update(self, x):
+        ...         self.total = self.total + jnp.sum(x)
+        ...     def compute(self):
+        ...         return self.total
+        >>> metric = RunningTotal()
+        >>> metric.update(jnp.asarray([1.0, 2.0]))
+        >>> metric.update(jnp.asarray([3.0]))
+        >>> float(metric.compute())
+        6.0
     """
 
     __jit_state_names__: Tuple[str, ...] = ()
@@ -332,11 +349,13 @@ class Metric:
             shadow[k] = []
         old = self.__dict__["_state"]
         object.__setattr__(self, "_state", shadow)
+        object.__setattr__(self, "_in_pure_update", True)
         try:
             self._update_impl(*args, **kwargs)
             captured = self.__dict__["_state"]
         finally:
             object.__setattr__(self, "_state", old)
+            object.__setattr__(self, "_in_pure_update", False)
         new_tensors = {k: captured[k] for k in tensor_state}
         appends = {k: tuple(captured[k]) for k in self._list_states}
         return new_tensors, appends
@@ -824,6 +843,12 @@ class Metric:
 def _wrap_update(update_fn: Callable) -> Callable:
     @functools.wraps(update_fn)
     def wrapped(self: Metric, *args: Any, **kwargs: Any) -> None:
+        if getattr(self, "_in_pure_update", False):
+            # super().update() from inside a traced _pure_update: run the
+            # raw body against the shadow state (re-entering jit would leak
+            # tracers / recurse; bookkeeping already done by the outer call)
+            update_fn(self, *args, **kwargs)
+            return
         self._computed = None
         self._update_count += 1
         if self._is_synced:
@@ -876,6 +901,16 @@ class CompositionalMetric(Metric):
     Parity: reference ``metric.py:1088-1211`` — update/reset/persistent fan
     out to child metrics; sync is a no-op (children sync themselves inside
     their own compute).
+
+    Example (built via operator overloading, not directly):
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import MeanMetric, SumMetric
+        >>> combined = SumMetric() + MeanMetric()
+        >>> type(combined).__name__
+        'CompositionalMetric'
+        >>> combined.update(jnp.asarray([1.0, 2.0, 3.0]))
+        >>> float(combined.compute())  # sum (6.0) + mean (2.0)
+        8.0
     """
 
     jittable = False

@@ -155,7 +155,7 @@ class FleissKappa(Metric):
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
-    jittable = False
+    jittable = True  # shape/dtype-only validation; trace-safe append update
     plot_lower_bound = 0.0
     plot_upper_bound = 1.0
 

@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..metric import Metric
+from ..utils.checks import is_tracing
 from ..utils.data import dim_zero_cat
 
 Array = jax.Array
@@ -35,10 +36,41 @@ def _retrieval_aggregate(values: Array, aggregation: Union[str, Callable] = "mea
     return aggregation(values)
 
 
+# ignore_index rows are marked with this query-id sentinel instead of being
+# filtered in update (static shapes under jit/shard_map); int32 min cannot
+# collide with real ids, which may be any other integer incl. negatives
+# (reference semantics: `_flexible_bincount` shifts by `x.min()`,
+# `utilities/data.py`)
+IGNORED_QUERY = np.iinfo(np.int32).min
+
+
+def _mask_ignored(indexes: Array, target: Array, ignore_index: Optional[int]):
+    """Pin ids to the sentinel's int32 space; mark ignored rows (trace-safe).
+
+    The single implementation of the ignore_index protocol, shared by
+    :class:`RetrievalMetric` and ``RetrievalPrecisionRecallCurve``. Casting
+    to int32 first is what makes the sentinel collision-free: in any other
+    integer dtype ``IGNORED_QUERY`` would wrap to an in-range id.
+    """
+    indexes = indexes.astype(jnp.int32)
+    if ignore_index is not None:
+        keep = target != ignore_index
+        indexes = jnp.where(keep, indexes, IGNORED_QUERY)
+        target = jnp.where(keep, target, 0)
+    return indexes, target
+
+
 def _pad_by_query(
     indexes: np.ndarray, preds: np.ndarray, target: np.ndarray
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Group flat rows by query id into dense (Q, L_max) arrays + mask."""
+    """Group flat rows by query id into dense (Q, L_max) arrays + mask.
+
+    Rows whose id equals :data:`IGNORED_QUERY` (the ``update`` sentinel for
+    ``ignore_index``) are dropped here, on host — the single filtering site.
+    """
+    keep = indexes != IGNORED_QUERY
+    if not keep.all():
+        indexes, preds, target = indexes[keep], preds[keep], target[keep]
     order = np.argsort(indexes, kind="stable")
     idx_s, p_s, t_s = indexes[order], preds[order], target[order]
     uniq, starts, counts = np.unique(idx_s, return_index=True, return_counts=True)
@@ -61,7 +93,9 @@ class RetrievalMetric(Metric, ABC):
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
-    jittable = False  # host-side grouping; updates are trivial appends
+    # update is trace-safe (masking, not filtering; value checks skipped
+    # under tracing); host-side query grouping happens in eager compute
+    jittable = True
 
     allow_non_binary_target = False
 
@@ -106,10 +140,13 @@ class RetrievalMetric(Metric, ABC):
         indexes = jnp.asarray(indexes).reshape(-1)
         preds = jnp.asarray(preds).reshape(-1).astype(jnp.float32)
         tgt = tgt.reshape(-1)
-        if self.ignore_index is not None:
-            keep = tgt != self.ignore_index
-            indexes, preds, tgt = indexes[keep], preds[keep], tgt[keep]
-        if not self.allow_non_binary_target and tgt.size and bool((tgt.max() > 1) | (tgt.min() < 0)):
+        indexes, tgt = _mask_ignored(indexes, tgt, self.ignore_index)
+        if (
+            not self.allow_non_binary_target
+            and not is_tracing(tgt)
+            and tgt.size
+            and bool((tgt.max() > 1) | (tgt.min() < 0))
+        ):
             raise ValueError("`target` must contain binary values")
         self.indexes.append(indexes)
         self.preds.append(preds)
@@ -128,9 +165,9 @@ class RetrievalMetric(Metric, ABC):
         indexes = np.asarray(dim_zero_cat(self.indexes))
         preds = np.asarray(dim_zero_cat(self.preds))
         target = np.asarray(dim_zero_cat(self.target))
-        if indexes.size == 0:
-            return jnp.asarray(0.0)
         p, t, m = _pad_by_query(indexes, preds, target)
+        if p.shape[0] == 0:  # no rows at all, or every row ignored
+            return jnp.asarray(0.0)
         p, t, m = jnp.asarray(p), jnp.asarray(t), jnp.asarray(m)
         empty = self._empty_mask(t, m)
         if self.empty_target_action == "error" and bool(jnp.any(empty)):

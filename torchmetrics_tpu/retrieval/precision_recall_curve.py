@@ -14,7 +14,7 @@ import numpy as np
 from ..functional.retrieval._ops import batched_precision_recall_curve
 from ..metric import Metric
 from ..utils.data import dim_zero_cat
-from .base import _pad_by_query
+from .base import _mask_ignored, _pad_by_query
 
 Array = jax.Array
 
@@ -50,7 +50,7 @@ class RetrievalPrecisionRecallCurve(Metric):
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
-    jittable = False
+    jittable = True  # masking (not filtering) keeps update trace-safe
 
     def __init__(
         self,
@@ -87,9 +87,7 @@ class RetrievalPrecisionRecallCurve(Metric):
         indexes = jnp.asarray(indexes).reshape(-1)
         preds = jnp.asarray(preds).reshape(-1).astype(jnp.float32)
         target = jnp.asarray(target).reshape(-1)
-        if self.ignore_index is not None:
-            keep = target != self.ignore_index
-            indexes, preds, target = indexes[keep], preds[keep], target[keep]
+        indexes, target = _mask_ignored(indexes, target, self.ignore_index)
         self.indexes.append(indexes)
         self.preds.append(preds)
         self.target.append(target)
@@ -99,6 +97,10 @@ class RetrievalPrecisionRecallCurve(Metric):
         preds = np.asarray(dim_zero_cat(self.preds))
         target = np.asarray(dim_zero_cat(self.target))
         p, t, m = _pad_by_query(indexes, preds, target)
+        if p.shape[0] == 0:  # no rows at all, or every row ignored
+            max_k = self.max_k or 1
+            z = jnp.zeros((max_k,))
+            return z, z, jnp.arange(1, max_k + 1, dtype=jnp.int32)
         max_k = self.max_k or p.shape[1]
         p, t, m = jnp.asarray(p), jnp.asarray(t), jnp.asarray(m)
         prec_q, rec_q, ks = batched_precision_recall_curve(p, t, m, max_k, self.adaptive_k)
