@@ -62,6 +62,31 @@ def _resolve_feature_extractor(feature: Union[int, Callable], metric_name: str) 
 
 
 class FrechetInceptionDistance(Metric):
+    """Frechet distance between real/fake feature distributions.
+
+    Parity: reference ``image/fid.py:182``. States are streaming moments
+    (sum, outer-product sum, count — all ``"sum"``-reducible; features are
+    never stored), the InceptionV3-fid extractor is a Flax module, and
+    ``feature`` also accepts any callable ``(N,C,H,W) -> (N,D)`` so the
+    metric runs offline / with custom embeddings.
+
+    Example (custom feature callable; real Inception features need the
+    converted checkpoint, see ``torchmetrics_tpu.models.inception``):
+        >>> import numpy as np
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import FrechetInceptionDistance
+        >>> def feat(imgs):
+        ...     flat = imgs.reshape(imgs.shape[0], -1).astype(jnp.float32)
+        ...     return jnp.stack([flat.mean(axis=1), flat.std(axis=1)], axis=1)
+        >>> fid = FrechetInceptionDistance(feature=feat, normalize=True)
+        >>> real = jnp.asarray(np.random.RandomState(0).rand(8, 3, 16, 16), jnp.float32)
+        >>> fake = jnp.asarray(np.random.RandomState(1).rand(8, 3, 16, 16) * 0.5, jnp.float32)
+        >>> fid.update(real, real=True)
+        >>> fid.update(fake, real=False)
+        >>> round(float(fid.compute()), 4)
+        0.0813
+    """
+
     higher_is_better = False
     is_differentiable = False
     full_state_update = False

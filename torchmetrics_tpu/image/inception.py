@@ -16,6 +16,27 @@ Array = jax.Array
 
 
 class InceptionScore(Metric):
+    """Exp-KL sharpness/diversity score over class logits.
+
+    Parity: reference ``image/inception.py:34`` (stored logits list with
+    ``"cat"`` reduction). ``feature`` accepts a Flax InceptionV3 spec or any
+    callable ``(N,C,H,W) -> (N,num_classes)`` returning logits.
+
+    Example (custom logits callable):
+        >>> import numpy as np
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import InceptionScore
+        >>> def logits_net(imgs):
+        ...     flat = imgs.reshape(imgs.shape[0], -1).astype(jnp.float32)
+        ...     return jnp.stack([flat.mean(axis=1), flat.std(axis=1), flat.max(axis=1)], axis=1)
+        >>> inception = InceptionScore(feature=logits_net, splits=2, normalize=True)
+        >>> imgs = jnp.asarray(np.random.RandomState(0).rand(8, 3, 16, 16), jnp.float32)
+        >>> inception.update(imgs)
+        >>> score_mean, score_std = inception.compute()
+        >>> round(float(score_mean), 4)
+        1.0
+    """
+
     higher_is_better = True
     is_differentiable = False
     full_state_update = False

@@ -22,6 +22,28 @@ Array = jax.Array
 
 
 class LearnedPerceptualImagePatchSimilarity(Metric):
+    """LPIPS perceptual distance between image pairs.
+
+    Parity: reference ``image/lpip.py`` over ``functional/image/lpips.py:258``.
+    ``net_type`` selects a backbone (``'alex'/'vgg'/'squeeze'`` — reference-
+    comparable scores require a converted checkpoint, see
+    ``torchmetrics_tpu.models.lpips.convert_lpips_torch``) or accepts any
+    callable ``(img1, img2) -> (N,)`` distance for offline use.
+
+    Example (custom distance callable; inputs in [-1, 1]):
+        >>> import numpy as np
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import LearnedPerceptualImagePatchSimilarity
+        >>> def patch_distance(a, b):
+        ...     return jnp.mean((a - b) ** 2, axis=(1, 2, 3))
+        >>> lpips = LearnedPerceptualImagePatchSimilarity(net_type=patch_distance)
+        >>> img1 = jnp.asarray(np.random.RandomState(1).rand(4, 3, 16, 16), jnp.float32) * 2 - 1
+        >>> img2 = jnp.asarray(np.random.RandomState(2).rand(4, 3, 16, 16), jnp.float32) * 2 - 1
+        >>> lpips.update(img1, img2)
+        >>> round(float(lpips.compute()), 4)
+        0.6814
+    """
+
     is_differentiable = True
     higher_is_better = False
     full_state_update = False

@@ -29,6 +29,28 @@ def _compute_cosine_distance(features1: Array, features2: Array, cosine_distance
 
 
 class MemorizationInformedFrechetInceptionDistance(Metric):
+    """FID divided by a memorization penalty (cosine distance to train set).
+
+    Parity: reference ``image/mifid.py``. Stores real/fake feature lists
+    (``"cat"``); ``feature`` accepts a Flax InceptionV3 spec or any callable
+    ``(N,C,H,W) -> (N,D)``.
+
+    Example (custom feature callable):
+        >>> import numpy as np
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import MemorizationInformedFrechetInceptionDistance
+        >>> def feat(imgs):
+        ...     flat = imgs.reshape(imgs.shape[0], -1).astype(jnp.float32)
+        ...     return jnp.stack([flat.mean(axis=1), flat.std(axis=1)], axis=1)
+        >>> mifid = MemorizationInformedFrechetInceptionDistance(feature=feat, normalize=True)
+        >>> real = jnp.asarray(np.random.RandomState(0).rand(8, 3, 16, 16), jnp.float32)
+        >>> fake = jnp.asarray(np.random.RandomState(1).rand(8, 3, 16, 16) * 0.5, jnp.float32)
+        >>> mifid.update(real, real=True)
+        >>> mifid.update(fake, real=False)
+        >>> round(float(mifid.compute()), 4)
+        2069.8726
+    """
+
     higher_is_better = False
     is_differentiable = False
     full_state_update = False

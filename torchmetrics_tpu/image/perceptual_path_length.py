@@ -16,7 +16,34 @@ Array = jax.Array
 
 
 class PerceptualPathLength(Metric):
-    """Class wrapper over :func:`perceptual_path_length`."""
+    """Perceptual smoothness of a generator's latent space.
+
+    Parity: reference ``image/perceptual_path_length.py`` over
+    ``functional/image/perceptual_path_length.py:72``. The generator follows
+    the reference ``GeneratorType`` protocol: ``sample(num_samples) ->
+    latents`` plus being callable on latents; ``distance_fn`` is a perceptual
+    distance (e.g. an LPIPS callable).
+
+    Example:
+        >>> import numpy as np
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import PerceptualPathLength
+        >>> def patch_distance(a, b):
+        ...     return jnp.sum((a - b) ** 2, axis=(1, 2, 3))
+        >>> class Generator:
+        ...     def __init__(self):
+        ...         self.rng = np.random.RandomState(1)
+        ...     def sample(self, num_samples):
+        ...         return jnp.asarray(self.rng.randn(num_samples, 8), jnp.float32)
+        ...     def __call__(self, z):
+        ...         return jnp.tanh(z[:, :3, None, None] * jnp.ones((1, 3, 16, 16)))
+        >>> ppl = PerceptualPathLength(distance_fn=patch_distance, num_samples=16,
+        ...                            batch_size=8, resize=None)
+        >>> ppl.update(Generator())
+        >>> ppl_mean, ppl_std, _ = ppl.compute()
+        >>> round(float(ppl_mean), 4)
+        424.2019
+    """
 
     is_differentiable = False
     higher_is_better = False

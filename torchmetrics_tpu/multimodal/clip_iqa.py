@@ -18,6 +18,43 @@ Array = jax.Array
 
 
 class CLIPImageQualityAssessment(Metric):
+    """CLIP-IQA: no-reference image quality via prompt-pair softmax.
+
+    Parity: reference ``multimodal/clip_iqa.py`` — each image is scored by
+    the softmax between a positive/negative prompt pair's logits.
+    ``model_name_or_path`` takes a HF/clip_iqa spec or an injected
+    ``(model, processor)`` pair (same protocol as :class:`CLIPScore`).
+
+    Example (tiny injected model; see :class:`CLIPScore` for the protocol):
+        >>> import numpy as np
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import CLIPImageQualityAssessment
+        >>> emb = np.abs(np.random.RandomState(7).randn(100, 4)).astype(np.float32)
+        >>> class TinyClip:
+        ...     def get_image_features(self, pixel_values):
+        ...         flat = pixel_values.reshape(pixel_values.shape[0], -1)
+        ...         return jnp.stack([flat.mean(1), flat.std(1), flat.min(1), flat.max(1)], axis=1)
+        ...     def get_text_features(self, input_ids, attention_mask):
+        ...         e = jnp.asarray(emb)[input_ids]
+        ...         m = attention_mask[..., None]
+        ...         return (e * m).sum(1) / m.sum(1)
+        >>> class TinyProcessor:
+        ...     def __call__(self, text=None, images=None, return_tensors="np", padding=True):
+        ...         if images is not None:
+        ...             return {"pixel_values": np.stack([np.asarray(i, np.float32) for i in images])}
+        ...         ids = np.zeros((len(text), 4), dtype=np.int32)
+        ...         mask = np.zeros((len(text), 4), dtype=np.int32)
+        ...         for i, t in enumerate(text):
+        ...             toks = [sum(map(ord, w)) % 100 for w in t.split()][:4]
+        ...             ids[i, :len(toks)] = toks
+        ...             mask[i, :len(toks)] = 1
+        ...         return {"input_ids": ids, "attention_mask": mask}
+        >>> metric = CLIPImageQualityAssessment(model_name_or_path=(TinyClip(), TinyProcessor()))
+        >>> metric.update(jnp.asarray(np.random.RandomState(3).rand(2, 3, 16, 16), jnp.float32))
+        >>> [round(float(v), 4) for v in np.asarray(metric.compute())]
+        [0.0012, 0.001]
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update = False

@@ -13,6 +13,46 @@ from ..metric import Metric
 
 
 class CLIPScore(Metric):
+    """CLIP image/text (or image/image, text/text) alignment score.
+
+    Parity: reference ``multimodal/clip_score.py`` — score is
+    ``max(100 * cosine, 0)`` averaged over pairs. ``model_name_or_path``
+    takes a HF name (resolved via transformers' Flax CLIP) or an injected
+    ``(model, processor)`` pair for offline use: ``model`` exposes
+    ``get_image_features`` / ``get_text_features``, ``processor`` maps
+    images/text to arrays.
+
+    Example (tiny injected model):
+        >>> import numpy as np
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import CLIPScore
+        >>> emb = np.abs(np.random.RandomState(7).randn(100, 4)).astype(np.float32)
+        >>> class TinyClip:
+        ...     def get_image_features(self, pixel_values):
+        ...         flat = pixel_values.reshape(pixel_values.shape[0], -1)
+        ...         return jnp.stack([flat.mean(1), flat.std(1), flat.min(1), flat.max(1)], axis=1)
+        ...     def get_text_features(self, input_ids, attention_mask):
+        ...         e = jnp.asarray(emb)[input_ids]
+        ...         m = attention_mask[..., None]
+        ...         return (e * m).sum(1) / m.sum(1)
+        >>> class TinyProcessor:
+        ...     def __call__(self, text=None, images=None, return_tensors="np", padding=True):
+        ...         if images is not None:
+        ...             return {"pixel_values": np.stack([np.asarray(i, np.float32) for i in images])}
+        ...         ids = np.zeros((len(text), 4), dtype=np.int32)
+        ...         mask = np.zeros((len(text), 4), dtype=np.int32)
+        ...         for i, t in enumerate(text):
+        ...             toks = [sum(map(ord, w)) % 100 for w in t.split()][:4]
+        ...             ids[i, :len(toks)] = toks
+        ...             mask[i, :len(toks)] = 1
+        ...         return {"input_ids": ids, "attention_mask": mask}
+        >>> metric = CLIPScore(model_name_or_path=(TinyClip(), TinyProcessor()))
+        >>> imgs = [np.random.RandomState(2).rand(3, 16, 16).astype(np.float32)]
+        >>> metric.update(imgs, ["a photo of a cat"])
+        >>> round(float(metric.compute()), 4)
+        97.1641
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update = False

@@ -179,7 +179,31 @@ class SQuAD(_HostTextMetric):
 class BERTScore(_HostTextMetric):
     """Parity: reference ``text/bert.py:BERTScore`` — stores raw sentence
     pairs (the reference stores tokenized ids, same storage semantics) and
-    runs the encoder + greedy matching once at compute."""
+    runs the encoder + greedy matching once at compute.
+
+    Example (user-provided tokenizer + embedding forward, the reference's
+    ``user_tokenizer``/``user_forward_fn`` escape hatch; a HF name like
+    ``'roberta-large'`` works when transformers weights are available):
+        >>> import numpy as np
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import BERTScore
+        >>> emb = np.random.RandomState(7).randn(100, 12).astype(np.float32)
+        >>> def tok(texts, max_length=None):
+        ...     ids = np.zeros((len(texts), 4), dtype=np.int32)
+        ...     mask = np.zeros((len(texts), 4), dtype=np.int32)
+        ...     for i, t in enumerate(texts):
+        ...         toks = [sum(map(ord, w)) % 100 for w in t.split()][:4]
+        ...         ids[i, :len(toks)] = toks
+        ...         mask[i, :len(toks)] = 1
+        ...     return {"input_ids": jnp.asarray(ids), "attention_mask": jnp.asarray(mask)}
+        >>> def fwd(ids, mask):
+        ...     return jnp.asarray(emb)[ids]
+        >>> bert = BERTScore(user_tokenizer=tok, user_forward_fn=fwd)
+        >>> bert.update(["the cat sat"], ["the cat ran"])
+        >>> res = bert.compute()
+        >>> {k: round(float(res[k]), 4) for k in sorted(res)}
+        {'f1': 0.8789, 'precision': 0.7839, 'recall': 1.0}
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -227,7 +251,30 @@ class BERTScore(_HostTextMetric):
 
 
 class InfoLM(_HostTextMetric):
-    """Parity: reference ``text/infolm.py:InfoLM`` (244 LoC)."""
+    """Parity: reference ``text/infolm.py:InfoLM`` (244 LoC).
+
+    Example (user-provided tokenizer + masked-LM logits forward; a HF name
+    like ``'bert-base-uncased'`` works when transformers weights are
+    available):
+        >>> import numpy as np
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import InfoLM
+        >>> emb = np.abs(np.random.RandomState(7).randn(100, 4)).astype(np.float32)
+        >>> def tok(texts, max_length=None):
+        ...     ids = np.zeros((len(texts), 4), dtype=np.int32)
+        ...     mask = np.zeros((len(texts), 4), dtype=np.int32)
+        ...     for i, t in enumerate(texts):
+        ...         toks = [sum(map(ord, w)) % 100 for w in t.split()][:4]
+        ...         ids[i, :len(toks)] = toks
+        ...         mask[i, :len(toks)] = 1
+        ...     return {"input_ids": jnp.asarray(ids), "attention_mask": jnp.asarray(mask)}
+        >>> def fwd(ids, mask):
+        ...     return jnp.asarray(emb)[ids] @ jnp.asarray(emb).T
+        >>> infolm = InfoLM(user_tokenizer=tok, user_forward_fn=fwd, idf=False)
+        >>> infolm.update(["the cat sat"], ["the cat ran"])
+        >>> round(float(infolm.compute()), 4)
+        0.1659
+    """
 
     is_differentiable = False
     higher_is_better = False

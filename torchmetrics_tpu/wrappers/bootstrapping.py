@@ -28,6 +28,26 @@ def _bootstrap_sampler(size: int, sampling_strategy: str, rng: np.random.RandomS
 
 
 class BootStrapper(WrapperMetric):
+    """Bootstrap confidence intervals around a base metric.
+
+    Parity: reference ``wrappers/bootstrapping.py:54`` — keeps
+    ``num_bootstraps`` copies of the base metric; each update resamples the
+    batch (poisson or multinomial) per copy; compute reports mean/std/
+    quantile/raw over the copies. Resampling is host-side numpy driven by
+    ``seed`` (deterministic), the metric math itself runs on device.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import BootStrapper, MeanSquaredError
+        >>> boot = BootStrapper(MeanSquaredError(), num_bootstraps=4, seed=0)
+        >>> boot.update(jnp.asarray([1.0, 2.0, 3.0, 4.0]), jnp.asarray([1.5, 2.0, 2.5, 4.5]))
+        >>> out = boot.compute()
+        >>> sorted(out)
+        ['mean', 'std']
+        >>> round(float(out["mean"]), 4), round(float(out["std"]), 4)
+        (0.1962, 0.0243)
+    """
+
     full_state_update = True
 
     def __init__(
