@@ -467,6 +467,129 @@ CASES["SQuAD"] = ExampleCase(
     batch_axis=False,
 )
 
+# detection — host list-of-dict updates (COCO protocol)
+def _det_scene(rng, n_boxes, n_classes=3, with_scores=True):
+    boxes = rng.rand(n_boxes, 4).astype(np.float32) * 40
+    boxes[:, 2:] = boxes[:, :2] + 1 + boxes[:, 2:] * 0.5
+    d = {"boxes": jnp.asarray(boxes), "labels": jnp.asarray(rng.randint(0, n_classes, n_boxes))}
+    if with_scores:
+        d["scores"] = jnp.asarray(rng.rand(n_boxes).astype(np.float32))
+    return d
+
+
+def _det_case(rng, n):
+    imgs = min(n, 3)
+    preds = [_det_scene(rng, rng.randint(1, 4)) for _ in range(imgs)]
+    target = [_det_scene(rng, rng.randint(1, 4), with_scores=False) for _ in range(imgs)]
+    return preds, target
+
+
+# device=False keeps these host metrics out of the dtype/shard sweeps;
+# batch_axis=True opts them into the batch-split accumulation sweep (the
+# list-of-dict "batch" splits across updates)
+_reg(
+    ["IntersectionOverUnion", "GeneralizedIntersectionOverUnion",
+     "DistanceIntersectionOverUnion", "CompleteIntersectionOverUnion",
+     "MeanAveragePrecision"],
+    factory=_one(_det_case),
+    device=False,
+)
+
+
+def _panoptic_case(rng, n):
+    b = min(n, 2)
+    cat_t = rng.choice([0, 1, 2], size=(b, 8, 8))
+    inst_t = rng.randint(0, 2, (b, 8, 8))
+    cat_p = np.where(rng.rand(b, 8, 8) < 0.8, cat_t, rng.choice([0, 1, 2], size=(b, 8, 8)))
+    return (jnp.asarray(np.stack([cat_p, inst_t], axis=-1)),
+            jnp.asarray(np.stack([cat_t, inst_t], axis=-1)))
+
+
+_reg(
+    ["PanopticQuality", "ModifiedPanopticQuality"],
+    factory=_one(_panoptic_case),
+    device=False,
+)
+
+
+# network-backed classes via their injectable hooks (no pretrained weights)
+_TOY_EMB = np.abs(np.random.RandomState(7).randn(100, 4)).astype(np.float32)
+
+
+def _toy_tokenizer(texts, max_length=None):
+    ids = np.zeros((len(texts), 4), dtype=np.int32)
+    mask = np.zeros((len(texts), 4), dtype=np.int32)
+    for i, t in enumerate(texts):
+        toks = [sum(map(ord, w)) % 100 for w in t.split()][:4]
+        ids[i, : len(toks)] = toks
+        mask[i, : len(toks)] = 1
+    return {"input_ids": jnp.asarray(ids), "attention_mask": jnp.asarray(mask)}
+
+
+def _toy_bert_fwd(ids, mask):
+    return jnp.asarray(np.random.RandomState(3).randn(100, 8).astype(np.float32))[ids]
+
+
+def _toy_lm_fwd(ids, mask):
+    return jnp.asarray(_TOY_EMB)[ids] @ jnp.asarray(_TOY_EMB).T
+
+
+class _ToyClip:
+    def get_image_features(self, pixel_values):
+        flat = pixel_values.reshape(pixel_values.shape[0], -1)
+        return jnp.stack([flat.mean(1), flat.std(1), flat.min(1), flat.max(1)], axis=1)
+
+    def get_text_features(self, input_ids, attention_mask):
+        e = jnp.asarray(_TOY_EMB)[input_ids]
+        m = attention_mask[..., None]
+        return (e * m).sum(1) / m.sum(1)
+
+
+class _ToyClipProcessor:
+    def __call__(self, text=None, images=None, return_tensors="np", padding=True):
+        if images is not None:
+            return {"pixel_values": np.stack([np.asarray(i, np.float32) for i in images])}
+        out = _toy_tokenizer(list(text))
+        return {"input_ids": np.asarray(out["input_ids"]), "attention_mask": np.asarray(out["attention_mask"])}
+
+
+EXTRA.update(
+    BERTScore=lambda: {"user_tokenizer": _toy_tokenizer, "user_forward_fn": _toy_bert_fwd},
+    InfoLM=lambda: {"user_tokenizer": _toy_tokenizer, "user_forward_fn": _toy_lm_fwd, "idf": False},
+    CLIPScore=lambda: {"model_name_or_path": (_ToyClip(), _ToyClipProcessor())},
+    CLIPImageQualityAssessment=lambda: {"model_name_or_path": (_ToyClip(), _ToyClipProcessor())},
+)
+
+_reg(["BERTScore", "InfoLM"], factory=_one(_strings), device=False)
+CASES["CLIPScore"] = ExampleCase(
+    make_inputs=_one(lambda rng, n: (
+        [rng.rand(3, 16, 16).astype(np.float32) for _ in range(min(n, 4))],
+        ["a photo number %d" % i for i in range(min(n, 4))])),
+    device=False,
+)
+CASES["CLIPImageQualityAssessment"] = ExampleCase(
+    make_inputs=_one(lambda rng, n: (jnp.asarray(rng.rand(min(n, 4), 3, 16, 16), jnp.float32),)),
+    device=False,
+)
+
+
+# PerceptualPathLength has no registry case: its update consumes a
+# generator object (no batch axis to split/shard), its tuple output has no
+# generic plot, and its end-to-end path is covered by the class doctest.
+
+# composition — collection and multitask take the shared MSE case
+CASES["MetricCollection"] = ExampleCase(
+    make_inputs=_one(lambda rng, n: tuple(map(jnp.asarray, _float_pair(rng, n)))),
+    device=False,
+)
+CASES["MultitaskWrapper"] = ExampleCase(
+    make_inputs=_one(lambda rng, n: (
+        {"t": jnp.asarray(_float_pair(rng, n)[0])},
+        {"t": jnp.asarray(_float_pair(rng, n)[1])})),
+    device=False,
+    batch_axis=False,
+)
+
 # wrappers around MSE / multiclass accuracy
 _reg(
     ["BootStrapper", "MinMaxMetric"],

@@ -38,7 +38,13 @@ SLOW_HOST_AUDIO = {
     "ShortTimeObjectiveIntelligibility",
     "SpeechReverberationModulationEnergyRatio",
 }
-PLOT_NAMES = [n for n in sorted(CASES) if n not in SLOW_HOST_AUDIO]
+EXCLUDED = SLOW_HOST_AUDIO | {
+    # (mean, std, raw-distances) ragged tuple output has no generic plot
+    "PerceptualPathLength",
+    # has its own plot() protocol (list of figures) — tested below
+    "MetricCollection",
+}
+PLOT_NAMES = [n for n in sorted(CASES) if n not in EXCLUDED]
 
 
 def test_plot_sweep_breadth():
@@ -111,6 +117,27 @@ def test_plot_curves():
     fig, _ = mc.plot()
     assert fig is not None
     plt.close(fig)
+
+
+def test_plot_metric_collection():
+    """MetricCollection.plot: per-metric figures, and together-mode over a
+    sequence of step results (parity: reference ``collections.py:578``)."""
+    import torchmetrics_tpu as M
+
+    coll = M.MetricCollection({"mse": M.MeanSquaredError(), "mae": M.MeanAbsoluteError()},
+                              prefix="val_")
+    rng = np.random.RandomState(0)
+    vals = [coll(jnp.asarray(rng.randn(8).astype(np.float32)),
+                 jnp.asarray(rng.randn(8).astype(np.float32))) for _ in range(3)]
+    out = coll.plot()
+    assert len(out) == 2
+    for f, _ in out:
+        plt.close(f)
+    fig, _ = coll.plot(vals, together=True)
+    assert fig is not None
+    plt.close(fig)
+    with pytest.raises(ValueError, match="together"):
+        coll.plot(together="x")
 
 
 def test_plot_respects_bounds_and_ax():

@@ -353,6 +353,51 @@ class MetricCollection:
         inner = ",\n  ".join(f"{k}: {type(v).__name__}" for k, v in self._metrics.items())
         return f"MetricCollection(\n  {inner}\n)"
 
+    def plot(
+        self,
+        val: Optional[Union[Dict, Sequence[Dict]]] = None,
+        ax: Any = None,
+        together: bool = False,
+    ) -> Any:
+        """Plot every member's value(s). Parity: reference ``collections.py:578``.
+
+        ``together=False`` (default) returns ``[(fig, ax), ...]`` — one per
+        member, each via that metric's own ``plot``; ``together=True`` puts
+        all values on one axis. ``val`` may be one compute/forward result
+        dict or a sequence of them (multi-step curves); omitted, ``compute``
+        is called.
+        """
+        from .utils.plot import plot_single_or_multi_val
+
+        if not isinstance(together, bool):
+            raise ValueError(f"Expected argument `together` to be a boolean, but got {type(together)}")
+        if not together and ax is not None:
+            if not isinstance(ax, Sequence) or len(ax) != len(self):
+                raise ValueError(
+                    "Expected argument `ax` to be a sequence of matplotlib axis objects with the same "
+                    f"length as the number of metrics in the collection, but got {type(ax)} "
+                    "when `together=False`"
+                )
+        if val is None:
+            val = self.compute()
+        if together:
+            return plot_single_or_multi_val(val, ax=ax)
+        fig_axs = []
+        # keep_base=False so keys line up with compute()'s (prefixed) names.
+        # Members whose compute returns a dict are flattened by INNER key in
+        # compute() (``_compute_and_reduce``), so their collection name is
+        # absent from ``val`` — plot those from their own computed value.
+        for i, (k, m) in enumerate(self.items(keep_base=False, copy_state=False)):
+            member_ax = ax[i] if ax is not None else None
+            if isinstance(val, dict):
+                f, a = m.plot(val[k], ax=member_ax) if k in val else m.plot(ax=member_ax)
+            elif val and k in val[0]:
+                f, a = m.plot([v[k] for v in val], ax=member_ax)
+            else:
+                f, a = m.plot(ax=member_ax)
+            fig_axs.append((f, a))
+        return fig_axs
+
     # ------------------------------------------------------------------
     # pure-functional SPMD API: one pytree for the whole collection
     # ------------------------------------------------------------------

@@ -40,14 +40,27 @@ def plot_single_or_multi_val(
             ax.plot(np.arange(len(arr)), arr, marker="o", label=str(k))
         ax.legend()
     elif isinstance(val, Sequence) and not hasattr(val, "shape"):
-        arr = np.stack([np.atleast_1d(np.asarray(v)) for v in val])
-        if arr.ndim == 2 and arr.shape[1] > 1:
-            for i in range(arr.shape[1]):
-                ax.plot(np.arange(arr.shape[0]), arr[:, i], marker="o",
-                        label=f"{legend_name or 'val'} {i}")
+        if val and isinstance(val[0], dict):
+            # sequence of result dicts (e.g. MetricCollection multi-step):
+            # one line per key over the step axis; non-scalar values get one
+            # line per component
+            for k in val[0]:
+                arr = np.stack([np.atleast_1d(np.asarray(v[k])) for v in val])
+                if arr.shape[1] == 1:
+                    ax.plot(np.arange(arr.shape[0]), arr[:, 0], marker="o", label=str(k))
+                else:
+                    for i in range(arr.shape[1]):
+                        ax.plot(np.arange(arr.shape[0]), arr[:, i], marker="o", label=f"{k} {i}")
             ax.legend()
         else:
-            ax.plot(np.arange(arr.shape[0]), arr.reshape(arr.shape[0]), marker="o")
+            arr = np.stack([np.atleast_1d(np.asarray(v)) for v in val])
+            if arr.ndim == 2 and arr.shape[1] > 1:
+                for i in range(arr.shape[1]):
+                    ax.plot(np.arange(arr.shape[0]), arr[:, i], marker="o",
+                            label=f"{legend_name or 'val'} {i}")
+                ax.legend()
+            else:
+                ax.plot(np.arange(arr.shape[0]), arr.reshape(arr.shape[0]), marker="o")
     else:
         arr = np.atleast_1d(np.asarray(val))
         ax.plot(np.arange(len(arr)), arr, marker="o", label=legend_name)
