@@ -85,6 +85,8 @@ EXTRA = {
     "Dice": lambda: {"num_classes": 5},
     "CriticalSuccessIndex": lambda: {"threshold": 0.5},
     "FeatureShare": lambda: {"metrics": [M.MeanSquaredError()]},
+    "CompositionalMetric": lambda: {"operator": __import__("operator").add,
+                                    "metric_a": M.SumMetric(), "metric_b": M.MeanMetric()},
 }
 
 
@@ -855,6 +857,39 @@ def _strings_repeat(rng, n):
 
 _add_var(_TEXT_PLAIN, "with_empty", _one(_strings_with_empty))
 _add_var(_TEXT_PLAIN, "repeat", _one(_strings_repeat))
+
+# ---- detection: empty-prediction images + crowd gts + single-class scenes
+_DET = ["IntersectionOverUnion", "GeneralizedIntersectionOverUnion",
+        "DistanceIntersectionOverUnion", "CompleteIntersectionOverUnion",
+        "MeanAveragePrecision"]
+
+
+def _det_case_with_empty(rng, n):
+    preds, target = _det_case(rng, n)
+    empty = {"boxes": jnp.zeros((0, 4)), "labels": jnp.zeros((0,), jnp.int32),
+             "scores": jnp.zeros((0,))}
+    preds[0] = empty  # an image with no detections at all
+    return preds, target
+
+
+def _det_case_crowd(rng, n):
+    preds, target = _det_case(rng, n)
+    for t in target:
+        nb = t["labels"].shape[0]
+        t["iscrowd"] = jnp.asarray((np.arange(nb) == 0).astype(np.int64))
+    return preds, target
+
+
+def _det_case_single_class(rng, n):
+    preds, target = _det_case(rng, n)
+    for d in preds + target:
+        d["labels"] = jnp.zeros_like(d["labels"])
+    return preds, target
+
+
+_add_var(_DET, "empty_preds", _one(_det_case_with_empty))
+_add_var(_DET, "single_class", _one(_det_case_single_class))
+_add_var(["MeanAveragePrecision"], "crowd_gt", _one(_det_case_crowd))
 
 # ---- aggregation: NaN-bearing values with explicit nan strategies
 _add_var(["MeanMetric", "SumMetric", "MaxMetric", "MinMetric"], "nan_ignore",
