@@ -4,9 +4,10 @@ Parity: the reference runs doctests over the whole of ``src/``
 (``/root/reference/Makefile:26``). Here every module under
 ``torchmetrics_tpu`` is auto-discovered and its examples executed; a global
 floor on the number of attempted examples guards against silently losing
-coverage. Classes whose examples need unavailable pretrained networks
-(BERTScore, CLIP*, FID-family, LPIPS, PPL, InfoLM) carry no examples —
-the reference skips those via ``__doctest_skip__`` for the same reason.
+coverage. All 149 public classes carry runnable examples — the
+network-backed ones (BERTScore, CLIP*, FID-family, LPIPS, PPL, InfoLM) use
+their injectable feature/tokenizer/model hooks instead of pretrained
+weights, where the reference resorts to ``__doctest_skip__``.
 """
 import doctest
 import importlib

