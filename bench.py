@@ -583,10 +583,12 @@ def main() -> None:
         print(json.dumps(result))
         return
 
-    # headline: median of 3 fresh-subprocess runs (reproducibility target
-    # +-5%); each child additionally reports the r1-style unsalted number
-    # that explains the r01 -> r02 headline drop (dispatch memoization)
-    c1_runs = [_run_child("config1") for _ in range(3)]
+    # headline: median of 5 fresh-subprocess runs — the remote chip is
+    # time-shared, so the median over a wider window is materially more
+    # stable than 3 (observed 39-42% min-max spread across a contended
+    # hour). Each child additionally reports the r1-style unsalted number
+    # that explains the r01 -> r02 headline drop (dispatch memoization).
+    c1_runs = [_run_child("config1") for _ in range(5)]
     ok_runs = [r for r in c1_runs if "value" in r]
     if ok_runs:
         ok_runs.sort(key=lambda r: r["value"])
@@ -603,7 +605,7 @@ def main() -> None:
         "headline_spread_pct": round(100 * spread, 2) if spread is not None else None,
         "r1_style_unsalted_value": c1.get("r1_style_unsalted_value"),
         "note": (
-            "each config runs in a fresh subprocess; headline = median of 3. "
+            "each config runs in a fresh subprocess; headline = median of 5. "
             "r1_style_unsalted_value re-times config1 with the pre-r2 constant "
             "salt base, where the remote-TPU layer can serve memoized dispatches "
             "across runs — the BENCH_r01 60.5k headline was inflated by exactly "

@@ -1,0 +1,99 @@
+"""Native-vs-fallback parity for the batched COCO kernels.
+
+The C++ fast paths (`tm_box_iou_batch`, `tm_coco_stage_match_batch`) must be
+bit-identical with their pure-numpy fallbacks — the fallbacks are the
+correctness oracles (themselves pinned against the reference's legacy torch
+COCOeval by ``test_map_vs_reference.py``). Randomized cells cover empties,
+score ties, NaN scores, crowds, and all four area ranges.
+"""
+import importlib
+
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import _native
+
+AREA_LO = np.array([0.0, 0.0, 32.0**2, 96.0**2])
+AREA_HI = np.array([1e10, 32.0**2, 96.0**2, 1e10])
+THRS = np.linspace(0.5, 0.95, 10)
+
+
+def _fallback_module(monkeypatch):
+    """A second module instance forced onto the numpy fallback path."""
+    monkeypatch.setenv("TM_TPU_DISABLE_NATIVE", "1")
+    spec = importlib.util.find_spec("torchmetrics_tpu._native")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert not mod.NATIVE_AVAILABLE
+    return mod
+
+
+def _random_cells(rng, n_cells, with_nan=False):
+    ious, scores, d_areas, g_areas, crowds = [], [], [], [], []
+    dts, gts = [], []
+    for _ in range(n_cells):
+        D, G = rng.randint(0, 9), rng.randint(0, 7)
+        box_d = rng.rand(D, 4) * 100
+        box_d[:, 2:] += box_d[:, :2] + 1
+        box_g = rng.rand(G, 4) * 100
+        box_g[:, 2:] += box_g[:, :2] + 1
+        dts.append(box_d)
+        gts.append(box_g)
+        crowds.append((rng.rand(G) < 0.2).astype(np.uint8))
+        ious.append(rng.rand(D, G))
+        sc = np.round(rng.rand(D), 1)  # coarse grid -> ties exercise stability
+        if with_nan and D:
+            sc[rng.randint(D)] = np.nan
+        scores.append(sc)
+        d_areas.append(rng.rand(D) * 10000)
+        g_areas.append(rng.rand(G) * 10000)
+    return dts, gts, crowds, ious, scores, d_areas, g_areas
+
+
+@pytest.mark.skipif(not _native.NATIVE_AVAILABLE, reason="native lib unavailable")
+@pytest.mark.parametrize("seed", [0, 1])
+def test_box_iou_batch_matches_fallback(seed, monkeypatch):
+    rng = np.random.RandomState(seed)
+    dts, gts, crowds, *_ = _random_cells(rng, 40)
+    native = _native.box_iou_batch(dts, gts, crowds)
+    fb = _fallback_module(monkeypatch)
+    ref = fb.box_iou_batch(dts, gts, crowds)
+    for n, r in zip(native, ref):
+        np.testing.assert_allclose(n, r, atol=1e-12)
+
+
+@pytest.mark.skipif(not _native.NATIVE_AVAILABLE, reason="native lib unavailable")
+@pytest.mark.parametrize("with_nan", [False, True])
+def test_coco_stage_match_batch_matches_fallback(with_nan, monkeypatch):
+    rng = np.random.RandomState(3)
+    _, _, crowds, ious, scores, d_areas, g_areas = _random_cells(rng, 50, with_nan=with_nan)
+    native = _native.coco_stage_match_batch(
+        ious, scores, d_areas, g_areas, crowds, AREA_LO, AREA_HI, THRS, cap=5)
+    fb = _fallback_module(monkeypatch)
+    ref = fb.coco_stage_match_batch(
+        ious, scores, d_areas, g_areas, crowds, AREA_LO, AREA_HI, THRS, cap=5)
+    for c, (n, r) in enumerate(zip(native, ref)):
+        for i, name in enumerate(("order", "matched", "ignored", "npos")):
+            np.testing.assert_array_equal(
+                np.asarray(n[i]), np.asarray(r[i]),
+                err_msg=f"cell {c} field {name} (with_nan={with_nan})")
+
+
+@pytest.mark.skipif(not _native.NATIVE_AVAILABLE, reason="native lib unavailable")
+def test_stage_match_prebuilt_flat_path(monkeypatch):
+    """ious_prebuilt (box_iou_batch's flat buffer) must change nothing."""
+    rng = np.random.RandomState(7)
+    dts, gts, crowds, _, scores, d_areas, g_areas = _random_cells(rng, 30)
+    # scores/areas must agree with box counts for the flat path
+    scores = [np.round(rng.rand(len(d)), 1) for d in dts]
+    d_areas = [rng.rand(len(d)) * 10000 for d in dts]
+    g_areas = [rng.rand(len(g)) * 10000 for g in gts]
+    cells, flat = _native.box_iou_batch(dts, gts, crowds, return_flat=True)
+    via_flat = _native.coco_stage_match_batch(
+        cells, scores, d_areas, g_areas, crowds, AREA_LO, AREA_HI, THRS, cap=5,
+        ious_prebuilt=flat)
+    via_cells = _native.coco_stage_match_batch(
+        cells, scores, d_areas, g_areas, crowds, AREA_LO, AREA_HI, THRS, cap=5)
+    for a, b in zip(via_flat, via_cells):
+        for i in range(4):
+            np.testing.assert_array_equal(np.asarray(a[i]), np.asarray(b[i]))

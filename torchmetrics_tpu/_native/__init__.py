@@ -98,11 +98,14 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
     lib.tm_rle_iou.argtypes = [p_u32, p_i64, i64, p_u32, p_i64, i64, p_u8, p_f64]
     lib.tm_box_iou.restype = None
     lib.tm_box_iou.argtypes = [p_f64, i64, p_f64, i64, p_u8, p_f64]
+    lib.tm_box_iou_batch.restype = None
+    lib.tm_box_iou_batch.argtypes = [p_f64, p_i64, p_f64, p_i64, p_u8, i64, p_f64, p_i64]
     lib.tm_coco_match.restype = None
     lib.tm_coco_match.argtypes = [p_f64, i64, i64, p_u8, p_u8, p_f64, i64, p_i64, p_i64, p_u8]
-    lib.tm_coco_match_batch.restype = None
-    lib.tm_coco_match_batch.argtypes = [p_f64, p_i64, p_i64, p_i64, p_u8, p_u8, p_i64,
-                                        p_f64, i64, i64, p_i64, p_u8, p_u8]
+    lib.tm_coco_stage_match_batch.restype = None
+    lib.tm_coco_stage_match_batch.argtypes = [
+        p_f64, p_i64, p_f64, p_f64, p_i64, p_f64, p_u8, p_i64, i64,
+        p_f64, p_f64, i64, p_f64, i64, i64, p_i64, p_i64, p_u8, p_u8, p_i64]
     return lib
 
 
@@ -386,6 +389,51 @@ def box_iou(dt: np.ndarray, gt: np.ndarray, iscrowd: np.ndarray) -> np.ndarray:
     return out
 
 
+def box_iou_batch(
+    dts: List[np.ndarray], gts: List[np.ndarray], crowds: List[np.ndarray],
+    return_flat: bool = False,
+):
+    """Pairwise box IoU for N (dt set, gt set, gt crowd flags) cells.
+
+    One native call for the whole batch (vs one ctypes round-trip per cell —
+    the marshalling otherwise dominates COCO evaluation at ~13us x thousands
+    of per-(image, class) cells per epoch). Semantics per cell identical to
+    :func:`box_iou`. With ``return_flat`` also returns the backing
+    ``(flat, offsets)`` buffer so downstream batch consumers (the fused
+    stage+match kernel) can skip re-flattening the epoch's IoU data.
+    """
+    n_cells = len(dts)
+    if n_cells == 0:
+        return ([], None) if return_flat else []
+    if not _ensure_loaded():
+        cells = [box_iou(d, g, c) for d, g, c in zip(dts, gts, crowds)]
+        return (cells, None) if return_flat else cells
+    dt_arrs = [np.ascontiguousarray(d, np.float64).reshape(-1, 4) for d in dts]
+    gt_arrs = [np.ascontiguousarray(g, np.float64).reshape(-1, 4) for g in gts]
+    n_dt = np.asarray([len(d) for d in dt_arrs], dtype=np.int64)
+    n_gt = np.asarray([len(g) for g in gt_arrs], dtype=np.int64)
+    dt_off = np.zeros(n_cells + 1, dtype=np.int64)
+    np.cumsum(n_dt, out=dt_off[1:])
+    gt_off = np.zeros(n_cells + 1, dtype=np.int64)
+    np.cumsum(n_gt, out=gt_off[1:])
+    out_off = np.zeros(n_cells + 1, dtype=np.int64)
+    np.cumsum(n_dt * n_gt, out=out_off[1:])
+    dt_flat = np.concatenate(dt_arrs) if dt_off[-1] else np.zeros((0, 4), np.float64)
+    gt_flat = np.concatenate(gt_arrs) if gt_off[-1] else np.zeros((0, 4), np.float64)
+    crowd_flat = (np.concatenate([np.ascontiguousarray(c, np.uint8) for c in crowds])
+                  if gt_off[-1] else np.zeros(0, np.uint8))
+    out_flat = np.empty(int(out_off[-1]), dtype=np.float64)
+    _lib.tm_box_iou_batch(_ptr(dt_flat, ctypes.c_double), _ptr(dt_off, ctypes.c_int64),
+                          _ptr(gt_flat, ctypes.c_double), _ptr(gt_off, ctypes.c_int64),
+                          _ptr(crowd_flat, ctypes.c_uint8), n_cells,
+                          _ptr(out_flat, ctypes.c_double), _ptr(out_off, ctypes.c_int64))
+    cells = [out_flat[out_off[c]:out_off[c + 1]].reshape(n_dt[c], n_gt[c])
+             for c in range(n_cells)]
+    if return_flat:
+        return cells, (out_flat, out_off[:-1].copy())
+    return cells
+
+
 def coco_match(ious: np.ndarray, gt_ignore: np.ndarray, gt_crowd: np.ndarray,
                iou_thrs: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Greedy COCO matching across thresholds.
@@ -431,63 +479,118 @@ def coco_match(ious: np.ndarray, gt_ignore: np.ndarray, gt_crowd: np.ndarray,
     return dt_m, gt_m, dt_ig
 
 
-def coco_match_batch(
+def coco_stage_match_batch(
     ious: List[np.ndarray],
-    gt_ignore: List[np.ndarray],
+    scores: List[np.ndarray],
+    d_areas: List[np.ndarray],
+    g_areas: List[np.ndarray],
     gt_crowd: List[np.ndarray],
+    area_lo: np.ndarray,
+    area_hi: np.ndarray,
     iou_thrs: np.ndarray,
-) -> List[Tuple[np.ndarray, np.ndarray]]:
-    """Greedy COCO matching for a whole epoch of (image, class, area) cells.
+    cap: int,
+    ious_prebuilt: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+) -> List[Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]]:
+    """Fused COCOeval staging + matching for an epoch of (image, class) cells.
 
-    One native call for every cell (vs one ctypes round-trip each — the
-    marshalling otherwise dominates at ~30us x thousands of cells per epoch).
-    Cell ``c``: ``ious[c]`` (D_c, G_c) with detections in descending-score
-    order and gts ignore-sorted; returns per cell ``(dt_matched, dt_ignored)``
-    both (T, D_c) bool, semantics identical to :func:`coco_match`.
+    Per cell c, from the UNordered full IoU matrix ``ious[c]`` (D, G) plus
+    detection scores/areas and gt areas/crowd flags, evaluates all area
+    ranges x thresholds in one native call and returns
+    ``(order, matched, ignored, npos)``: ``order`` (D2,) descending-score dt
+    indices (D2 = min(D, cap)), ``matched``/``ignored`` (A, T, D2) bool, and
+    ``npos`` (A,) non-ignored gt counts. ``ious_prebuilt`` (flat, offsets)
+    from ``box_iou_batch(..., return_flat=True)`` skips re-flattening the
+    epoch's IoU data (its cells must be in-order views of that buffer).
+    Pure-numpy fallback mirrors the per-cell :func:`coco_match` path.
     """
-    iou_thrs = np.ascontiguousarray(iou_thrs, dtype=np.float64)
-    T = len(iou_thrs)
     n_cells = len(ious)
+    area_lo = np.ascontiguousarray(area_lo, np.float64).reshape(-1)
+    area_hi = np.ascontiguousarray(area_hi, np.float64).reshape(-1)
+    iou_thrs = np.ascontiguousarray(iou_thrs, np.float64)
+    A, T = len(area_lo), len(iou_thrs)
     if n_cells == 0:
         return []
     if not _ensure_loaded():
         out = []
         for c in range(n_cells):
-            dt_m, _gt_m, dt_ig = coco_match(ious[c], gt_ignore[c], gt_crowd[c], iou_thrs)
-            out.append((dt_m > 0, dt_ig.astype(bool)))
+            sc = np.asarray(scores[c], np.float64)
+            order = np.argsort(-sc, kind="stable")[:cap]
+            D2 = len(order)
+            ious_d = np.asarray(ious[c], np.float64)[order]
+            crowd = np.asarray(gt_crowd[c], bool)
+            ga = np.asarray(g_areas[c], np.float64)
+            da = np.asarray(d_areas[c], np.float64)[order]
+            matched = np.zeros((A, T, D2), bool)
+            ignored = np.zeros((A, T, D2), bool)
+            npos = np.zeros(A, np.int64)
+            for a in range(A):
+                g_ign = crowd | (ga < area_lo[a]) | (ga > area_hi[a])
+                npos[a] = int((~g_ign).sum())
+                g_order = np.argsort(g_ign, kind="stable")
+                dt_m, _gt_m, dt_ig = coco_match(
+                    np.ascontiguousarray(ious_d[:, g_order]),
+                    g_ign[g_order].astype(np.uint8),
+                    crowd[g_order].astype(np.uint8), iou_thrs)
+                m = dt_m > 0
+                d_ign = (da < area_lo[a]) | (da > area_hi[a])
+                matched[a] = m
+                ignored[a] = dt_ig.astype(bool) | (~m & d_ign[None, :])
+            out.append((order, matched, ignored, npos))
         return out
 
-    n_dt = np.asarray([m.shape[0] for m in ious], dtype=np.int64)
-    n_gt = np.asarray([m.shape[1] for m in ious], dtype=np.int64)
+    n_dt = np.asarray([np.asarray(s).shape[0] for s in scores], dtype=np.int64)
+    n_gt = np.asarray([np.asarray(g).shape[0] for g in g_areas], dtype=np.int64)
+    n_d2 = np.minimum(n_dt, cap)
     iou_off = np.zeros(n_cells, dtype=np.int64)
     np.cumsum((n_dt * n_gt)[:-1], out=iou_off[1:])
-    dt_off = np.zeros(n_cells, dtype=np.int64)
-    np.cumsum(n_dt[:-1], out=dt_off[1:])
-    gt_off = np.zeros(n_cells, dtype=np.int64)
-    np.cumsum(n_gt[:-1], out=gt_off[1:])
+    d_off = np.zeros(n_cells + 1, dtype=np.int64)
+    np.cumsum(n_dt, out=d_off[1:])
+    g_off = np.zeros(n_cells + 1, dtype=np.int64)
+    np.cumsum(n_gt, out=g_off[1:])
+    d2_off = np.zeros(n_cells + 1, dtype=np.int64)
+    np.cumsum(n_d2, out=d2_off[1:])
 
-    ious_flat = (np.concatenate([np.ascontiguousarray(m, np.float64).ravel() for m in ious])
-                 if int((n_dt * n_gt).sum()) else np.zeros(0, np.float64))
-    gt_ign_flat = (np.concatenate([np.ascontiguousarray(g, np.uint8) for g in gt_ignore])
-                   if int(n_gt.sum()) else np.zeros(0, np.uint8))
-    gt_crw_flat = (np.concatenate([np.ascontiguousarray(g, np.uint8) for g in gt_crowd])
-                   if int(n_gt.sum()) else np.zeros(0, np.uint8))
-    total_dt = int(n_dt.sum())
-    dt_matched = np.zeros(total_dt * T, dtype=np.uint8)
-    dt_ignored = np.zeros(total_dt * T, dtype=np.uint8)
-    _lib.tm_coco_match_batch(
+    def _cat(arrs, dtype, total):
+        return (np.concatenate([np.ascontiguousarray(a, dtype).ravel() for a in arrs])
+                if total else np.zeros(0, dtype))
+
+    if ious_prebuilt is not None:
+        ious_flat, iou_off = ious_prebuilt
+        ious_flat = np.ascontiguousarray(ious_flat, np.float64)
+        iou_off = np.ascontiguousarray(iou_off, np.int64)
+    else:
+        ious_flat = _cat(ious, np.float64, int((n_dt * n_gt).sum()))
+    scores_flat = _cat(scores, np.float64, int(n_dt.sum()))
+    d_areas_flat = _cat(d_areas, np.float64, int(n_dt.sum()))
+    g_areas_flat = _cat(g_areas, np.float64, int(n_gt.sum()))
+    crowd_flat = _cat(gt_crowd, np.uint8, int(n_gt.sum()))
+
+    total_d2 = int(d2_off[-1])
+    order_flat = np.zeros(total_d2, dtype=np.int64)
+    matched_flat = np.zeros(total_d2 * A * T, dtype=np.uint8)
+    ignored_flat = np.zeros(total_d2 * A * T, dtype=np.uint8)
+    npos_flat = np.zeros(n_cells * A, dtype=np.int64)
+    _lib.tm_coco_stage_match_batch(
         _ptr(ious_flat, ctypes.c_double), _ptr(iou_off, ctypes.c_int64),
-        _ptr(n_dt, ctypes.c_int64), _ptr(n_gt, ctypes.c_int64),
-        _ptr(gt_ign_flat, ctypes.c_uint8), _ptr(gt_crw_flat, ctypes.c_uint8),
-        _ptr(gt_off, ctypes.c_int64),
-        _ptr(iou_thrs, ctypes.c_double), T, n_cells,
-        _ptr(dt_off, ctypes.c_int64),
-        _ptr(dt_matched, ctypes.c_uint8), _ptr(dt_ignored, ctypes.c_uint8),
+        _ptr(scores_flat, ctypes.c_double), _ptr(d_areas_flat, ctypes.c_double),
+        _ptr(d_off, ctypes.c_int64),
+        _ptr(g_areas_flat, ctypes.c_double), _ptr(crowd_flat, ctypes.c_uint8),
+        _ptr(g_off, ctypes.c_int64), n_cells,
+        _ptr(area_lo, ctypes.c_double), _ptr(area_hi, ctypes.c_double), A,
+        _ptr(iou_thrs, ctypes.c_double), T, int(cap),
+        _ptr(d2_off, ctypes.c_int64),
+        _ptr(order_flat, ctypes.c_int64), _ptr(matched_flat, ctypes.c_uint8),
+        _ptr(ignored_flat, ctypes.c_uint8), _ptr(npos_flat, ctypes.c_int64),
     )
     out = []
     for c in range(n_cells):
-        base = dt_off[c] * T
-        block_m = dt_matched[base: base + T * n_dt[c]].reshape(T, n_dt[c]).astype(bool)
-        block_i = dt_ignored[base: base + T * n_dt[c]].reshape(T, n_dt[c]).astype(bool)
-        out.append((block_m, block_i))
+        D2 = int(n_d2[c])
+        base = int(d2_off[c]) * A * T
+        shape = (A, T, D2)
+        out.append((
+            order_flat[d2_off[c]:d2_off[c] + D2],
+            matched_flat[base: base + A * T * D2].reshape(shape).view(bool),
+            ignored_flat[base: base + A * T * D2].reshape(shape).view(bool),
+            npos_flat[c * A:(c + 1) * A],
+        ))
     return out

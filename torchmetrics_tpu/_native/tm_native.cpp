@@ -257,6 +257,24 @@ void tm_box_iou(const double* dt, int64_t n_dt, const double* gt, int64_t n_gt,
     }
 }
 
+// Batched pairwise box IoU over N independent (dt set, gt set) cells with
+// flat concatenated storage — one ctypes round-trip for a whole epoch of
+// per-(image, class) IoU matrices (the per-call marshalling otherwise
+// dominates: ~13us x thousands of calls).
+// dt_flat: sum(n_dt) boxes; offsets are element counts (not byte offsets);
+// out_flat laid out cell-major with out_off[c] = sum of n_dt*n_gt before c.
+void tm_box_iou_batch(const double* dt_flat, const int64_t* dt_off,
+                      const double* gt_flat, const int64_t* gt_off,
+                      const uint8_t* crowd_flat, int64_t n_cells,
+                      double* out_flat, const int64_t* out_off) {
+    for (int64_t c = 0; c < n_cells; ++c) {
+        const int64_t n_dt = dt_off[c + 1] - dt_off[c];
+        const int64_t n_gt = gt_off[c + 1] - gt_off[c];
+        tm_box_iou(dt_flat + dt_off[c] * 4, n_dt, gt_flat + gt_off[c] * 4, n_gt,
+                   crowd_flat + gt_off[c], out_flat + out_off[c]);
+    }
+}
+
 // ---------------------------------------------------------------------------
 // COCOeval greedy matcher: one (image, class) cell across T IoU thresholds.
 // ious: (n_dt, n_gt) row-major; dt sorted by descending score; gt sorted
@@ -292,51 +310,102 @@ void tm_coco_match(const double* ious, int64_t n_dt, int64_t n_gt,
 }
 
 // ---------------------------------------------------------------------------
-// Batched COCOeval matcher: every (image, class, area) cell of an epoch in
-// one call, amortizing the per-call ctypes marshalling that dominates the
-// per-cell variant (~30us/call x thousands of cells). Cell c reads
-//   ious_flat[iou_off[c] : iou_off[c] + n_dt[c]*n_gt[c]]   (row-major)
-//   gt_ignore/crowd_flat[gt_off[c] : gt_off[c] + n_gt[c]]  (ignore-sorted)
-// and writes (T, n_dt[c]) uint8 matched/ignored blocks at dt_off[c]*T.
-// Matching semantics identical to tm_coco_match above.
+// Fused COCOeval staging + matching: one call per epoch over (image, class)
+// cells, each evaluated across A area ranges and T IoU thresholds. Replaces
+// the per-cell Python staging (score argsort, per-area gt ignore-sort,
+// matrix reorders) that dominates evaluation once IoU and matching are
+// native. Cell c reads the UNordered full matrices:
+//   ious_flat[iou_off[c] .. +D*G]  (row-major, detection-major)
+//   scores/d_areas at d_off[c] (D), g_areas/crowd at g_off[c] (G)
+// and writes, with D2 = min(D, cap):
+//   order_flat[d2_off[c] .. +D2]          descending-score dt indices
+//   matched/ignored_flat[d2_off[c]*A*T ..] laid out (A, T, D2) per cell
+//   npos_flat[c*A .. +A]                  non-ignored gt count per area
+// Semantics identical to per-cell tm_coco_match with staged inputs: gts sorted
+// ignore-last per area, greedy threshold matching, unmatched dts outside
+// the area range ignored.
 // ---------------------------------------------------------------------------
-void tm_coco_match_batch(const double* ious_flat, const int64_t* iou_off,
-                         const int64_t* n_dt, const int64_t* n_gt,
-                         const uint8_t* gt_ignore_flat, const uint8_t* gt_crowd_flat,
-                         const int64_t* gt_off,
-                         const double* iou_thrs, int64_t T, int64_t n_cells,
-                         const int64_t* dt_off,
-                         uint8_t* dt_matched, uint8_t* dt_ignored) {
-    std::vector<int64_t> gtm;
+void tm_coco_stage_match_batch(
+    const double* ious_flat, const int64_t* iou_off,
+    const double* scores_flat, const double* d_areas_flat, const int64_t* d_off,
+    const double* g_areas_flat, const uint8_t* crowd_flat, const int64_t* g_off,
+    int64_t n_cells,
+    const double* area_lo, const double* area_hi, int64_t A,
+    const double* iou_thrs, int64_t T, int64_t cap,
+    const int64_t* d2_off,
+    int64_t* order_flat, uint8_t* matched_flat, uint8_t* ignored_flat,
+    int64_t* npos_flat) {
+    std::vector<int64_t> gidx;
+    std::vector<uint8_t> g_ign, gtm, d_ign;
     for (int64_t c = 0; c < n_cells; ++c) {
-        const int64_t D = n_dt[c], G = n_gt[c];
-        if (D == 0) continue;
+        const int64_t D = d_off[c + 1] - d_off[c];
+        const int64_t G = g_off[c + 1] - g_off[c];
+        const int64_t D2 = d2_off[c + 1] - d2_off[c];
         const double* ious = ious_flat + iou_off[c];
-        const uint8_t* g_ign = gt_ignore_flat + gt_off[c];
-        const uint8_t* g_crw = gt_crowd_flat + gt_off[c];
-        uint8_t* m_base = dt_matched + dt_off[c] * T;
-        uint8_t* i_base = dt_ignored + dt_off[c] * T;
-        if (G == 0) continue;  // outputs pre-zeroed
-        if ((int64_t)gtm.size() < G) gtm.resize(G);
-        for (int64_t t = 0; t < T; ++t) {
-            const double thr = iou_thrs[t];
-            uint8_t* dtm = m_base + t * D;
-            uint8_t* dti = i_base + t * D;
-            std::fill(gtm.begin(), gtm.begin() + G, 0);
-            for (int64_t d = 0; d < D; ++d) {
-                double iou = std::min(thr, 1.0 - 1e-10);
-                int64_t match = -1;
-                for (int64_t g = 0; g < G; ++g) {
-                    if (gtm[g] > 0 && !g_crw[g]) continue;
-                    if (match > -1 && !g_ign[match] && g_ign[g]) break;
-                    if (ious[d * G + g] < iou) continue;
-                    iou = ious[d * G + g];
-                    match = g;
+        const double* scores = scores_flat + d_off[c];
+        const double* d_areas = d_areas_flat + d_off[c];
+        const double* g_areas = g_areas_flat + g_off[c];
+        const uint8_t* crowd = crowd_flat + g_off[c];
+        int64_t* order = order_flat + d2_off[c];
+
+        // descending-score stable order, truncated to cap; NaN scores sort
+        // last (np.argsort(-scores) semantics) — mapping NaN to -inf keeps
+        // the comparator a strict weak ordering
+        std::vector<int64_t> full(D);
+        for (int64_t i = 0; i < D; ++i) full[i] = i;
+        const auto key = [&](int64_t i) {
+            const double s = scores[i];
+            return std::isnan(s) ? -std::numeric_limits<double>::infinity() : s;
+        };
+        std::stable_sort(full.begin(), full.end(),
+                         [&](int64_t a, int64_t b) { return key(a) > key(b); });
+        for (int64_t i = 0; i < D2; ++i) order[i] = full[i];
+
+        if ((int64_t)gidx.size() < G) { gidx.resize(G); g_ign.resize(G); gtm.resize(G); }
+        if ((int64_t)d_ign.size() < D2) d_ign.resize(D2);
+
+        for (int64_t a = 0; a < A; ++a) {
+            const double lo = area_lo[a], hi = area_hi[a];
+            int64_t npos = 0;
+            for (int64_t g = 0; g < G; ++g) {
+                g_ign[g] = crowd[g] || g_areas[g] < lo || g_areas[g] > hi;
+                if (!g_ign[g]) ++npos;
+            }
+            npos_flat[c * A + a] = npos;
+            for (int64_t g = 0; g < G; ++g) gidx[g] = g;
+            std::stable_sort(gidx.begin(), gidx.begin() + G,
+                             [&](int64_t x, int64_t y) { return g_ign[x] < g_ign[y]; });
+            for (int64_t i = 0; i < D2; ++i) {
+                const double ar = d_areas[order[i]];
+                d_ign[i] = ar < lo || ar > hi;
+            }
+            uint8_t* m_base = matched_flat + d2_off[c] * A * T + a * T * D2;
+            uint8_t* i_base = ignored_flat + d2_off[c] * A * T + a * T * D2;
+            for (int64_t t = 0; t < T; ++t) {
+                const double thr = iou_thrs[t];
+                uint8_t* dtm = m_base + t * D2;
+                uint8_t* dti = i_base + t * D2;
+                std::fill(gtm.begin(), gtm.begin() + G, 0);
+                for (int64_t d = 0; d < D2; ++d) {
+                    const double* iou_row = ious + order[d] * G;
+                    double iou = std::min(thr, 1.0 - 1e-10);
+                    int64_t match = -1;
+                    for (int64_t gi = 0; gi < G; ++gi) {
+                        const int64_t g = gidx[gi];
+                        if (gtm[gi] && !crowd[g]) continue;
+                        if (match > -1 && !g_ign[gidx[match]] && g_ign[g]) break;
+                        if (iou_row[g] < iou) continue;
+                        iou = iou_row[g];
+                        match = gi;
+                    }
+                    if (match == -1) {
+                        dti[d] = d_ign[d];  // unmatched dt outside area range
+                        continue;
+                    }
+                    dti[d] = g_ign[gidx[match]];
+                    dtm[d] = 1;
+                    gtm[match] = 1;
                 }
-                if (match == -1) continue;
-                dti[d] = g_ign[match];
-                dtm[d] = 1;
-                gtm[match] = 1;
             }
         }
     }

@@ -174,12 +174,12 @@ def evaluate_detections(
     max_det_cap = max_dets[-1]
     per_image: List[Dict] = []
     ious_map: Dict[Tuple[int, int], np.ndarray] = {}
-    # cell staging: one batched native matcher call for the whole epoch
-    # (per-cell ctypes round-trips otherwise dominate the evaluation)
-    cell_ious: List[np.ndarray] = []
-    cell_gign: List[np.ndarray] = []
-    cell_gcrowd: List[np.ndarray] = []
-    cell_meta: List[Tuple[Dict, Tuple[int, str], np.ndarray, np.ndarray, int]] = []
+    # cell staging: one batched native call each for pairwise bbox IoU and
+    # for the fused stage+match kernel, covering the whole epoch (per-cell
+    # ctypes round-trips and numpy micro-ops otherwise dominate evaluation)
+    # one record per (image, class): context for the fused staging call
+    cell_meta: List[Tuple] = []
+    iou_cells: List[Tuple] = []  # (dt boxes, gt boxes, crowd) for the bbox IoU batch
     for img_idx, (det, gt) in enumerate(zip(detections, groundtruths)):
         dt_labels = np.asarray(det["labels"]).reshape(-1)
         gt_labels = np.asarray(gt["labels"]).reshape(-1)
@@ -218,36 +218,59 @@ def evaluate_detections(
                 continue
             if isinstance(dt_geom, list):  # RLE dict lists index elementwise
                 ious_full = iou_fn([dt_geom[i] for i in d_sel], [gt_geom[j] for j in g_sel], gt_crowd[g_sel])
-            else:
+            elif iou_fn is bbox_iou_np:
+                # bbox IoU is deferred into ONE batched native call below
+                ious_full = None
+                iou_cells.append((dt_geom[d_sel], gt_geom[g_sel], gt_crowd[g_sel]))
+            else:  # dense-mask IoU
                 ious_full = iou_fn(dt_geom[d_sel], gt_geom[g_sel], gt_crowd[g_sel])
-            ious_map[(img_idx, cls)] = ious_full
-            # matching runs once per (img, cls, area) at the LARGEST maxDet
-            # (detections in score order; smaller maxDets are column slices
-            # at accumulate time — greedy matching of the top-k prefix is
-            # independent of later detections, pycocotools semantics)
-            order = np.argsort(-dt_scores[d_sel], kind="stable")[:max_det_cap]
-            ious_d = ious_full[order]
-            scores_sorted = dt_scores[d_sel][order]
-            crowd_sel = gt_crowd[g_sel]
-            for area in area_keys:
-                lo, hi = AREA_RANGES[area]
-                g_ignore = crowd_sel | (gt_areas[g_sel] < lo) | (gt_areas[g_sel] > hi)
-                d_area_ignore = (dt_areas[d_sel] < lo) | (dt_areas[d_sel] > hi)
-                n_pos = int((~g_ignore).sum())
-                # gt sorted: non-ignored first (COCO sorts gt by ignore flag)
-                g_order = np.argsort(g_ignore, kind="stable")
-                cell_ious.append(np.ascontiguousarray(ious_d[:, g_order]))
-                cell_gign.append(g_ignore[g_order].astype(np.uint8))
-                cell_gcrowd.append(crowd_sel[g_order].astype(np.uint8))
-                cell_meta.append((img_cells, (cls, area), scores_sorted, d_area_ignore[order], n_pos))
+            cell_meta.append((
+                img_cells, img_idx, cls, ious_full, dt_scores[d_sel], gt_crowd[g_sel],
+                gt_areas[g_sel], dt_areas[d_sel],
+            ))
         per_image.append(img_cells)
 
-    for (img_cells, key, scores, d_area_ignore, n_pos), (matched, match_ignored) in zip(
-        cell_meta, _native.coco_match_batch(cell_ious, cell_gign, cell_gcrowd, iou_thresholds)
+    if iou_cells:
+        iou_views, iou_flat = _native.box_iou_batch(*zip(*iou_cells), return_flat=True)
+    else:
+        iou_views, iou_flat = [], None
+    iou_results = iter(iou_views)
+    area_lo = np.asarray([AREA_RANGES[a][0] for a in area_keys])
+    area_hi = np.asarray([AREA_RANGES[a][1] for a in area_keys])
+    stage_ious: List[np.ndarray] = []
+    stage_scores: List[np.ndarray] = []
+    stage_dareas: List[np.ndarray] = []
+    stage_gareas: List[np.ndarray] = []
+    stage_crowd: List[np.ndarray] = []
+    for img_cells, img_idx, cls, ious_full, scores_sel, crowd_sel, g_areas, d_areas in cell_meta:
+        if ious_full is None:
+            ious_full = next(iou_results)
+        ious_map[(img_idx, cls)] = ious_full
+        stage_ious.append(ious_full)
+        stage_scores.append(scores_sel)
+        stage_dareas.append(d_areas)
+        stage_gareas.append(g_areas)
+        stage_crowd.append(crowd_sel.astype(np.uint8))
+
+    # staging (score ordering, per-area gt ignore-sorting) + greedy matching
+    # run fused in ONE native call for the whole epoch; matching runs once
+    # per (img, cls, area) at the LARGEST maxDet (detections in score order;
+    # smaller maxDets are column slices at accumulate time — greedy matching
+    # of the top-k prefix is independent of later detections, pycocotools
+    # semantics). A pure-bbox epoch's stage_ious are in-order views of the
+    # IoU batch's flat buffer, which then skips a full re-flatten.
+    all_bbox = len(iou_cells) == len(cell_meta)
+    staged = _native.coco_stage_match_batch(
+        stage_ious, stage_scores, stage_dareas, stage_gareas, stage_crowd,
+        area_lo, area_hi, iou_thresholds, max_det_cap,
+        ious_prebuilt=iou_flat if (all_bbox and iou_flat is not None) else None,
+    )
+    for (img_cells, _img_idx, cls, _ious, scores_sel, *_rest), (order, matched, ignored, npos) in zip(
+        cell_meta, staged
     ):
-        # unmatched detections outside the area range are ignored
-        ignored = match_ignored | (~matched & d_area_ignore[None, :])
-        img_cells[key] = (matched, ignored, scores, n_pos)
+        scores_sorted = scores_sel[order]
+        for a, area in enumerate(area_keys):
+            img_cells[(cls, area)] = (matched[a], ignored[a], scores_sorted, int(npos[a]))
 
     out = accumulate(per_image, classes, iou_thresholds, rec_thresholds, max_dets, area_keys)
     out["ious"] = ious_map
