@@ -2,14 +2,12 @@
 
 A from-scratch reimplementation of the COCOeval matching + accumulation
 algorithm (the reference delegates to the ``pycocotools`` C extension,
-``detection/mean_ap.py:50-71``; this build owns the algorithm). The
-per-image pairwise IoU matrices are computed with the JAX kernels from
-``box_ops.py``; the greedy score-ordered matching and the PR accumulation run
-in numpy on host — they are O(dets·gts) bookkeeping, not FLOPs.
-
-A C++ implementation of the inner matching loop + pairwise IoU is used when
-the compiled extension is available (``torchmetrics_tpu._native``); this
-numpy path is the always-available fallback and the correctness oracle for it.
+``detection/mean_ap.py:50-71``; this build owns the algorithm). The hot
+path is two epoch-wide native C++ calls (``torchmetrics_tpu._native``):
+batched pairwise bbox IoU over every (image, class) cell, then a fused
+staging + greedy-matching kernel covering all area ranges x IoU thresholds;
+PR accumulation runs vectorized in numpy grouped by (class, area). Every
+native entry has a numpy fallback that doubles as its correctness oracle.
 """
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -81,7 +79,7 @@ def mask_iou_np(dt, gt, iscrowd: np.ndarray) -> np.ndarray:
 
 
 def accumulate(
-    per_image: List[Dict],
+    cells_by_key: Dict[Tuple[int, str], List[Tuple]],
     classes: Sequence[int],
     iou_thresholds: np.ndarray,
     rec_thresholds: np.ndarray,
@@ -90,13 +88,13 @@ def accumulate(
 ) -> Dict[str, np.ndarray]:
     """PR accumulation over all (class, area, maxDet) cells.
 
-    ``per_image`` entries hold, per image, ``(cls, area) -> (matched,
-    ignored, scores, n_pos)`` matching outputs at the LARGEST maxDet (see
-    :func:`evaluate_detections`); smaller maxDets slice the per-image
-    score-ordered columns, exactly like pycocotools' ``accumulate`` slices
-    ``evaluateImg``'s maxDets[-1] run. Returns ``precision`` of shape
-    ``(T, R, K, A, M)`` and ``recall`` ``(T, K, A, M)`` (COCOeval layout),
-    plus ``scores`` ``(T, R, K, A, M)``.
+    ``cells_by_key`` maps ``(cls, area)`` to that key's per-image
+    ``(matched, ignored, scores, n_pos)`` matching outputs in image order,
+    evaluated at the LARGEST maxDet (see :func:`evaluate_detections`);
+    smaller maxDets slice the per-image score-ordered columns, exactly like
+    pycocotools' ``accumulate`` slices ``evaluateImg``'s maxDets[-1] run.
+    Returns ``precision`` of shape ``(T, R, K, A, M)`` and ``recall``
+    ``(T, K, A, M)`` (COCOeval layout), plus ``scores`` ``(T, R, K, A, M)``.
     """
     n_t, n_r = len(iou_thresholds), len(rec_thresholds)
     n_k, n_a, n_m = len(classes), len(area_keys), len(max_dets)
@@ -106,7 +104,7 @@ def accumulate(
 
     for ki, cls in enumerate(classes):
         for ai, area in enumerate(area_keys):
-            cells = [c for c in (img.get((cls, area)) for img in per_image) if c is not None]
+            cells = cells_by_key.get((cls, area), ())
             n_gt = sum(c[3] for c in cells)
             if n_gt == 0 or not cells:
                 continue
@@ -172,7 +170,6 @@ def evaluate_detections(
 
     area_keys = tuple(AREA_RANGES)
     max_det_cap = max_dets[-1]
-    per_image: List[Dict] = []
     ious_map: Dict[Tuple[int, int], np.ndarray] = {}
     # cell staging: one batched native call each for pairwise bbox IoU and
     # for the fused stage+match kernel, covering the whole epoch (per-cell
@@ -210,7 +207,6 @@ def evaluate_detections(
         if "area" in gt and np.asarray(gt["area"]).size:
             gt_areas = np.asarray(gt["area"], np.float64).reshape(-1)
 
-        img_cells: Dict = {}
         for cls in classes:
             d_sel = np.nonzero(dt_labels == cls)[0]
             g_sel = np.nonzero(gt_labels == cls)[0]
@@ -225,10 +221,9 @@ def evaluate_detections(
             else:  # dense-mask IoU
                 ious_full = iou_fn(dt_geom[d_sel], gt_geom[g_sel], gt_crowd[g_sel])
             cell_meta.append((
-                img_cells, img_idx, cls, ious_full, dt_scores[d_sel], gt_crowd[g_sel],
+                img_idx, cls, ious_full, dt_scores[d_sel], gt_crowd[g_sel],
                 gt_areas[g_sel], dt_areas[d_sel],
             ))
-        per_image.append(img_cells)
 
     if iou_cells:
         iou_views, iou_flat = _native.box_iou_batch(*zip(*iou_cells), return_flat=True)
@@ -242,7 +237,7 @@ def evaluate_detections(
     stage_dareas: List[np.ndarray] = []
     stage_gareas: List[np.ndarray] = []
     stage_crowd: List[np.ndarray] = []
-    for img_cells, img_idx, cls, ious_full, scores_sel, crowd_sel, g_areas, d_areas in cell_meta:
+    for img_idx, cls, ious_full, scores_sel, crowd_sel, g_areas, d_areas in cell_meta:
         if ious_full is None:
             ious_full = next(iou_results)
         ious_map[(img_idx, cls)] = ious_full
@@ -265,14 +260,17 @@ def evaluate_detections(
         area_lo, area_hi, iou_thresholds, max_det_cap,
         ious_prebuilt=iou_flat if (all_bbox and iou_flat is not None) else None,
     )
-    for (img_cells, _img_idx, cls, _ious, scores_sel, *_rest), (order, matched, ignored, npos) in zip(
+    # (cls, area) -> cells in image order (cell_meta iterates images in order)
+    cells_by_key: Dict[Tuple[int, str], List[Tuple]] = {}
+    for (_img_idx, cls, _ious, scores_sel, *_rest), (order, matched, ignored, npos) in zip(
         cell_meta, staged
     ):
         scores_sorted = scores_sel[order]
         for a, area in enumerate(area_keys):
-            img_cells[(cls, area)] = (matched[a], ignored[a], scores_sorted, int(npos[a]))
+            cells_by_key.setdefault((cls, area), []).append(
+                (matched[a], ignored[a], scores_sorted, int(npos[a])))
 
-    out = accumulate(per_image, classes, iou_thresholds, rec_thresholds, max_dets, area_keys)
+    out = accumulate(cells_by_key, classes, iou_thresholds, rec_thresholds, max_dets, area_keys)
     out["ious"] = ious_map
     out["classes"] = np.asarray(classes, np.int64)
     out["iou_thresholds"] = iou_thresholds
