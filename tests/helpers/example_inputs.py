@@ -798,6 +798,17 @@ def _audio_scaled(rng, n):
 _add_var(_AUDIO, "dc_offset", _one(_audio_offset))
 _add_var(_AUDIO, "scaled", _one(_audio_scaled))
 
+# multichannel (..., spk, T) through the SNR family's leading-dim broadcast,
+# and a 5-speaker PIT case that crosses the exhaustive->Hungarian switch
+# (spk > 3 runs the host Jonker-Volgenant assignment via jax.pure_callback,
+# so it stays jit/shard-safe)
+_add_var(["SignalNoiseRatio", "ScaleInvariantSignalDistortionRatio"], "multichannel",
+         _one(lambda rng, n: (jnp.asarray(rng.randn(n, 2, 800).astype(np.float32)),
+                              jnp.asarray(rng.randn(n, 2, 800).astype(np.float32)))))
+_add_var(["PermutationInvariantTraining"], "five_speakers",
+         _one(lambda rng, n: (jnp.asarray(rng.randn(n, 5, 200).astype(np.float32)),
+                              jnp.asarray(rng.randn(n, 5, 200).astype(np.float32)))))
+
 # ---- multilabel ranking: logits + sparse targets
 _ML_RANK = ["MultilabelCoverageError", "MultilabelRankingAveragePrecision", "MultilabelRankingLoss"]
 

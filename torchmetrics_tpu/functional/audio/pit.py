@@ -56,22 +56,51 @@ def permutation_invariant_training(
         if spk > 3:
             # Hungarian on host: optimal without enumerating spk! options.
             # First-party C++ Jonker-Volgenant (``_native``); scipy fallback.
-            from ... import _native
+            # Wrapped in ``jax.pure_callback`` so the speaker-wise PIT stays
+            # usable under jit/shard_map (the solver output shapes are
+            # static: one permutation per sample).
+            sign = -1.0 if eval_func == "max" else 1.0
 
-            if _native.NATIVE_AVAILABLE:
-                linear_sum_assignment = _native.linear_sum_assignment
+            def _solve_host(mat_np: np.ndarray) -> np.ndarray:
+                from ... import _native
+
+                if _native.NATIVE_AVAILABLE:
+                    linear_sum_assignment = _native.linear_sum_assignment
+                else:
+                    from scipy.optimize import linear_sum_assignment
+
+                mat_np = np.asarray(mat_np, np.float64)
+                cols_out = np.empty((mat_np.shape[0], spk), dtype=np.int32)
+                for b in range(mat_np.shape[0]):
+                    _rows, cols = linear_sum_assignment(sign * mat_np[b])
+                    cols_out[b] = cols
+                return cols_out
+
+            if isinstance(matrix, jax.core.Tracer):
+                # under jit/shard_map/vmap: host solver via pure_callback
+                # (static output shapes — one permutation per sample). Note:
+                # runtimes without host-callback support (e.g. the axon dev
+                # tunnel) cannot execute this traced path; the eager branch
+                # below works everywhere.
+                # stop_gradient: the chosen permutation is piecewise-constant
+                # in the inputs, so gradients flow (correctly) only through
+                # the selected matrix entries below — and pure_callback has
+                # no JVP. vmap_method="sequential" keeps update_state_batched
+                # (a vmap over steps) working.
+                best_perm = jax.pure_callback(
+                    _solve_host,
+                    jax.ShapeDtypeStruct((matrix.shape[0], spk), jnp.int32),
+                    jax.lax.stop_gradient(matrix),
+                    vmap_method="sequential",
+                )
             else:
-                from scipy.optimize import linear_sum_assignment
-
-            mat_np = np.asarray(matrix)
-            best_perm = np.empty((mat_np.shape[0], spk), dtype=np.int64)
-            best_metric = np.empty(mat_np.shape[0])
-            for b in range(mat_np.shape[0]):
-                sign = -1.0 if eval_func == "max" else 1.0
-                rows, cols = linear_sum_assignment(sign * mat_np[b])
-                best_perm[b] = cols
-                best_metric[b] = mat_np[b, rows, cols].mean()
-            return jnp.asarray(best_metric), jnp.asarray(best_perm)
+                # concrete arrays solve directly on host — some TPU runtimes
+                # (axon) do not implement host callbacks even eagerly
+                best_perm = jnp.asarray(_solve_host(np.asarray(matrix)))
+            # matrix[b, i, best_perm[b, i]] per (sample, speaker)
+            chosen = jnp.take_along_axis(matrix, best_perm[..., None], axis=2)[..., 0]
+            best_metric = jnp.mean(chosen, axis=-1)
+            return best_metric, best_perm
         # exhaustive: gather each permutation's diagonal from the matrix
         perm_arr = jnp.asarray(perms)  # (P, spk)
         rows = jnp.arange(spk)
