@@ -269,14 +269,34 @@ def test_ignore_index():
 
 @pytest.mark.parametrize("dtype", [np.int16, np.int32, np.uint32, np.int64])
 def test_ignore_index_any_index_dtype(dtype):
-    """The IGNORED_QUERY sentinel must not wrap in non-int32 index dtypes."""
+    """Ignore masking must be collision-free for every index dtype — incl.
+    ids outside int32 range (an id-space sentinel would wrap/merge them)."""
     from torchmetrics_tpu import RetrievalMRR
 
+    if dtype == np.uint32:
+        big = np.uint32(2**31)  # wraps to int32 min under an int32 cast
+    elif dtype == np.int64:
+        big = np.int64(2**40)  # outside int32 range entirely
+    else:
+        big = dtype(1)
     metric = RetrievalMRR(ignore_index=-1)
     metric.update(jnp.asarray([0.9, 0.2, 0.8, 0.3]), jnp.asarray([1, 0, -1, 1]),
-                  indexes=jnp.asarray(np.asarray([0, 0, 1, 1], dtype)))
-    # q0: first hit at rank 1; q1: its only surviving row is relevant
+                  indexes=jnp.asarray(np.asarray([0, 0, big, big], dtype)))
+    # q0: first hit at rank 1; q_big: its only surviving row is relevant
     np.testing.assert_allclose(float(metric.compute()), 1.0, atol=1e-6)
+
+
+def test_int32_min_id_is_a_real_query():
+    """An id equal to int32 min is legitimate and must not be dropped
+    (it used to collide with the ignore sentinel)."""
+    from torchmetrics_tpu import RetrievalMRR
+
+    sentinel_like = np.int32(np.iinfo(np.int32).min)
+    metric = RetrievalMRR()
+    metric.update(jnp.asarray([0.9, 0.2, 0.8, 0.3]), jnp.asarray([0, 1, 1, 0]),
+                  indexes=jnp.asarray(np.asarray([sentinel_like, sentinel_like, 0, 0], np.int32)))
+    # both queries present: MRR = (1/2 + 1) / 2
+    np.testing.assert_allclose(float(metric.compute()), 0.75, atol=1e-6)
 
 
 def test_negative_query_ids_supported():

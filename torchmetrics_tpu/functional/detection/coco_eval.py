@@ -271,6 +271,11 @@ def evaluate_detections(
                 (matched[a], ignored[a], scores_sorted, int(npos[a])))
 
     out = accumulate(cells_by_key, classes, iou_thresholds, rec_thresholds, max_dets, area_keys)
+    if iou_flat is not None:
+        # bbox-path cells are views into one epoch-wide flat buffer; copy so
+        # a caller holding any single returned matrix doesn't keep the whole
+        # epoch's IoU memory alive (mask/RLE cells already own their data)
+        ious_map = {k: (np.array(v) if v.base is not None else v) for k, v in ious_map.items()}
     out["ious"] = ious_map
     out["classes"] = np.asarray(classes, np.int64)
     out["iou_thresholds"] = iou_thresholds

@@ -83,8 +83,8 @@ class FrechetInceptionDistance(Metric):
         >>> fake = jnp.asarray(np.random.RandomState(1).rand(8, 3, 16, 16) * 0.5, jnp.float32)
         >>> fid.update(real, real=True)
         >>> fid.update(fake, real=False)
-        >>> round(float(fid.compute()), 4)
-        0.0813
+        >>> round(float(fid.compute()), 2)
+        0.08
     """
 
     higher_is_better = False

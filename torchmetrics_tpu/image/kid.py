@@ -58,8 +58,8 @@ class KernelInceptionDistance(Metric):
         >>> kid.update(real, real=True)
         >>> kid.update(fake, real=False)
         >>> kid_mean, kid_std = kid.compute()
-        >>> round(float(kid_mean), 4)
-        0.1731
+        >>> round(float(kid_mean), 2)
+        0.17
     """
 
     higher_is_better = False

@@ -36,40 +36,37 @@ def _retrieval_aggregate(values: Array, aggregation: Union[str, Callable] = "mea
     return aggregation(values)
 
 
-# ignore_index rows are marked with this query-id sentinel instead of being
-# filtered in update (static shapes under jit/shard_map); int32 min cannot
-# collide with real ids, which may be any other integer incl. negatives
-# (reference semantics: `_flexible_bincount` shifts by `x.min()`,
-# `utilities/data.py`)
-IGNORED_QUERY = np.iinfo(np.int32).min
-
-
 def _mask_ignored(indexes: Array, target: Array, ignore_index: Optional[int]):
-    """Pin ids to the sentinel's int32 space; mark ignored rows (trace-safe).
+    """Mark ignored rows with an explicit boolean mask (trace-safe).
 
     The single implementation of the ignore_index protocol, shared by
-    :class:`RetrievalMetric` and ``RetrievalPrecisionRecallCurve``. Casting
-    to int32 first is what makes the sentinel collision-free: in any other
-    integer dtype ``IGNORED_QUERY`` would wrap to an in-range id.
+    :class:`RetrievalMetric` and ``RetrievalPrecisionRecallCurve``. Query ids
+    keep their original integer dtype — an id-space sentinel would collide
+    with legitimate ids for some dtype (any int64/uint32 id outside int32
+    range, or an id equal to the sentinel itself), so the ignore bit rides in
+    a parallel ``(N,)`` bool array instead. Ignored targets are zeroed so the
+    binary-target check in ``update`` stays valid.
     """
-    indexes = indexes.astype(jnp.int32)
-    if ignore_index is not None:
-        keep = target != ignore_index
-        indexes = jnp.where(keep, indexes, IGNORED_QUERY)
-        target = jnp.where(keep, target, 0)
-    return indexes, target
+    if ignore_index is None:
+        return indexes, target, None
+    ignore = target == ignore_index
+    target = jnp.where(ignore, 0, target)
+    return indexes, target, ignore
 
 
 def _pad_by_query(
-    indexes: np.ndarray, preds: np.ndarray, target: np.ndarray
+    indexes: np.ndarray,
+    preds: np.ndarray,
+    target: np.ndarray,
+    ignore: Optional[np.ndarray] = None,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Group flat rows by query id into dense (Q, L_max) arrays + mask.
 
-    Rows whose id equals :data:`IGNORED_QUERY` (the ``update`` sentinel for
-    ``ignore_index``) are dropped here, on host — the single filtering site.
+    Rows flagged in ``ignore`` (the ``update`` mask for ``ignore_index``)
+    are dropped here, on host — the single filtering site.
     """
-    keep = indexes != IGNORED_QUERY
-    if not keep.all():
+    if ignore is not None and ignore.any():
+        keep = ~ignore
         indexes, preds, target = indexes[keep], preds[keep], target[keep]
     order = np.argsort(indexes, kind="stable")
     idx_s, p_s, t_s = indexes[order], preds[order], target[order]
@@ -124,6 +121,8 @@ class RetrievalMetric(Metric, ABC):
         self.add_state("indexes", [], dist_reduce_fx="cat")
         self.add_state("preds", [], dist_reduce_fx="cat")
         self.add_state("target", [], dist_reduce_fx="cat")
+        if ignore_index is not None:  # mask channel only when rows can be ignored
+            self.add_state("ignore", [], dist_reduce_fx="cat")
 
     def update(self, preds: Array, target: Array, indexes: Array) -> None:
         if indexes is None:
@@ -140,7 +139,7 @@ class RetrievalMetric(Metric, ABC):
         indexes = jnp.asarray(indexes).reshape(-1)
         preds = jnp.asarray(preds).reshape(-1).astype(jnp.float32)
         tgt = tgt.reshape(-1)
-        indexes, tgt = _mask_ignored(indexes, tgt, self.ignore_index)
+        indexes, tgt, ignore = _mask_ignored(indexes, tgt, self.ignore_index)
         if (
             not self.allow_non_binary_target
             and not is_tracing(tgt)
@@ -151,6 +150,8 @@ class RetrievalMetric(Metric, ABC):
         self.indexes.append(indexes)
         self.preds.append(preds)
         self.target.append(tgt)
+        if ignore is not None:
+            self.ignore.append(ignore)
 
     # -- per-metric hooks -------------------------------------------------
     @abstractmethod
@@ -165,7 +166,12 @@ class RetrievalMetric(Metric, ABC):
         indexes = np.asarray(dim_zero_cat(self.indexes))
         preds = np.asarray(dim_zero_cat(self.preds))
         target = np.asarray(dim_zero_cat(self.target))
-        p, t, m = _pad_by_query(indexes, preds, target)
+        ignore = (
+            np.asarray(dim_zero_cat(self.ignore)).astype(bool)
+            if self.ignore_index is not None
+            else None
+        )
+        p, t, m = _pad_by_query(indexes, preds, target, ignore)
         if p.shape[0] == 0:  # no rows at all, or every row ignored
             return jnp.asarray(0.0)
         p, t, m = jnp.asarray(p), jnp.asarray(t), jnp.asarray(m)

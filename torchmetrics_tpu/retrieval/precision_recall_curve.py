@@ -78,6 +78,8 @@ class RetrievalPrecisionRecallCurve(Metric):
         self.add_state("indexes", [], dist_reduce_fx="cat")
         self.add_state("preds", [], dist_reduce_fx="cat")
         self.add_state("target", [], dist_reduce_fx="cat")
+        if ignore_index is not None:  # mask channel only when rows can be ignored
+            self.add_state("ignore", [], dist_reduce_fx="cat")
 
     def update(self, preds: Array, target: Array, indexes: Array) -> None:
         if indexes is None:
@@ -87,16 +89,23 @@ class RetrievalPrecisionRecallCurve(Metric):
         indexes = jnp.asarray(indexes).reshape(-1)
         preds = jnp.asarray(preds).reshape(-1).astype(jnp.float32)
         target = jnp.asarray(target).reshape(-1)
-        indexes, target = _mask_ignored(indexes, target, self.ignore_index)
+        indexes, target, ignore = _mask_ignored(indexes, target, self.ignore_index)
         self.indexes.append(indexes)
         self.preds.append(preds)
         self.target.append(target)
+        if ignore is not None:
+            self.ignore.append(ignore)
 
     def compute(self) -> Tuple[Array, Array, Array]:
         indexes = np.asarray(dim_zero_cat(self.indexes))
         preds = np.asarray(dim_zero_cat(self.preds))
         target = np.asarray(dim_zero_cat(self.target))
-        p, t, m = _pad_by_query(indexes, preds, target)
+        ignore = (
+            np.asarray(dim_zero_cat(self.ignore)).astype(bool)
+            if self.ignore_index is not None
+            else None
+        )
+        p, t, m = _pad_by_query(indexes, preds, target, ignore)
         if p.shape[0] == 0:  # no rows at all, or every row ignored
             max_k = self.max_k or 1
             z = jnp.zeros((max_k,))
