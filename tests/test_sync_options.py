@@ -156,9 +156,109 @@ _HOST_SYNC_WORKER = textwrap.dedent(
     c.update(jnp.asarray([float(rank), float(rank) + 0.5]))
     vals = np.sort(np.asarray(c.compute()))
     assert np.allclose(vals, [0.0, 0.5, 1.0, 1.5]), vals
+
+    # UNEVEN cat state: rank0 holds 3 samples, rank1 holds 1 (the reference's
+    # pad-to-max protocol, utilities/distributed.py:124-147)
+    u = CatMetric(sync_backend=HostSync())
+    u.update(jnp.asarray([1.0, 2.0, 3.0]) if rank == 0 else jnp.asarray([4.0]))
+    vals = np.sort(np.asarray(u.compute()))
+    assert np.allclose(vals, [1.0, 2.0, 3.0, 4.0]), vals
+
+    # EMPTY rank: rank0 never updates (its placeholder is (0,) float32)
+    e = CatMetric(sync_backend=HostSync())
+    if rank == 1:
+        e.update(jnp.asarray([7.0, 8.0]))
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # rank0: compute-before-update
+        vals = np.sort(np.asarray(e.compute()))
+    assert np.allclose(vals, [7.0, 8.0]), vals
+
+    # exact-mode AUROC across uneven shards == single-process ground truth
+    from torchmetrics_tpu.classification import BinaryAUROC
+    preds = {0: [0.9, 0.4, 0.6], 1: [0.2]}
+    tgt = {0: [1, 0, 1], 1: [0]}
+    a = BinaryAUROC(thresholds=None, sync_backend=HostSync())
+    a.update(jnp.asarray(preds[rank]), jnp.asarray(tgt[rank]))
+    ref = BinaryAUROC(thresholds=None)
+    ref.update(jnp.asarray(preds[0] + preds[1]), jnp.asarray(tgt[0] + tgt[1]))
+    assert abs(float(a.compute()) - float(ref.compute())) < 1e-6, float(a.compute())
+
+    # empty-rank exact AUROC: rank0 holds NO samples; its float32 (0,)
+    # placeholders must adopt the group's int target dtype in the gather
+    a2 = BinaryAUROC(thresholds=None, sync_backend=HostSync())
+    if rank == 1:
+        a2.update(jnp.asarray([0.9, 0.4, 0.6, 0.2]), jnp.asarray([1, 0, 1, 0]))
+    ref2 = BinaryAUROC(thresholds=None)
+    ref2.update(jnp.asarray([0.9, 0.4, 0.6, 0.2]), jnp.asarray([1, 0, 1, 0]))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        got2 = float(a2.compute())
+    assert abs(got2 - float(ref2.compute())) < 1e-6, got2
     print(f"RANK{rank} OK")
     """
 )
+
+
+def test_hostsync_timeout_raises_instead_of_hanging(monkeypatch):
+    """A stalled peer must surface as TimeoutError, not a hang (the reference
+    blocks forever at utilities/distributed.py:118)."""
+    import time
+
+    from jax.experimental import multihost_utils
+
+    from torchmetrics_tpu.parallel.sync import HostSync
+
+    def stalled_gather(value, *a, **k):
+        time.sleep(30)
+        return value
+
+    monkeypatch.setattr(multihost_utils, "process_allgather", stalled_gather)
+    hs = HostSync(timeout_s=0.5)
+    t0 = time.monotonic()
+    from torchmetrics_tpu.parallel.reduction import Reduction
+
+    with pytest.raises(TimeoutError, match="stalled or dead"):
+        hs.sync_tensor(jnp.asarray([1.0]), Reduction.SUM)
+    assert time.monotonic() - t0 < 5.0
+    with pytest.raises(TimeoutError, match="stalled or dead"):
+        hs.all_gather_object({"a": 1})
+
+
+def test_failed_sync_leaves_local_state_intact(monkeypatch):
+    """A gather failure mid-sync must not corrupt the metric: state stays
+    local, no half-synced mix is left behind, and the metric keeps working."""
+    import time
+
+    from jax.experimental import multihost_utils
+
+    from torchmetrics_tpu.parallel.sync import HostSync
+
+    def stalled_gather(value, *a, **k):
+        time.sleep(30)
+        return value
+
+    monkeypatch.setattr(multihost_utils, "process_allgather", stalled_gather)
+    hs = HostSync(timeout_s=0.3)
+    monkeypatch.setattr(hs, "is_available", lambda: True)
+    m = CatMetric(sync_backend=hs)
+    m.update(jnp.asarray([1.0, 2.0]))
+    with pytest.raises(TimeoutError):
+        m.sync()
+    assert not m._is_synced
+    assert m._cache is None
+    # local state is untouched and still usable
+    np.testing.assert_array_equal(np.asarray(jnp.concatenate(m.metric_state["value"])), [1.0, 2.0])
+    m.update(jnp.asarray([3.0]))
+    m._sync_backend = None  # back to NoSync
+    np.testing.assert_array_equal(np.asarray(m.compute()), [1.0, 2.0, 3.0])
+
+
+def test_hostsync_timeout_validation():
+    from torchmetrics_tpu.parallel.sync import HostSync
+
+    with pytest.raises(ValueError, match="timeout_s"):
+        HostSync(timeout_s=0.0)
 
 
 @pytest.mark.slow

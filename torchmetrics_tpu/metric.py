@@ -565,21 +565,31 @@ class Metric:
         if not should_sync or not backend.is_available():
             return
         self._cache = self._snapshot_state()
-        for name in self._state:
-            if hasattr(backend, "set_current"):  # FakeSync group addressing
-                backend.set_current(name)
-            if name in self._list_states and self._reductions[name] == Reduction.NONE:
-                # ragged object list states (dist_reduce_fx=None: per-image
-                # arrays, COCO RLE dicts) — gather whole per-rank lists and
-                # extend in rank order, preserving element boundaries
-                # (reference detection/mean_ap.py:1007-1032 all_gather_object)
-                gathered = backend.all_gather_object(list(self._state[name]))
-                merged: list = []
-                for rank_list in gathered:
-                    merged.extend(rank_list)
-                self._state[name] = merged
-            else:
-                self._state[name] = backend.sync_tensor(self._precat(name), self._reductions[name])
+        # gather into a scratch dict and swap atomically: a failed gather
+        # (e.g. HostSync TimeoutError on a stalled peer) must leave local
+        # state intact — a half-synced state dict would be checkpointed or
+        # double-counted by the recovery path
+        synced: Dict[str, Any] = {}
+        try:
+            for name in self._state:
+                if hasattr(backend, "set_current"):  # FakeSync group addressing
+                    backend.set_current(name)
+                if name in self._list_states and self._reductions[name] == Reduction.NONE:
+                    # ragged object list states (dist_reduce_fx=None: per-image
+                    # arrays, COCO RLE dicts) — gather whole per-rank lists and
+                    # extend in rank order, preserving element boundaries
+                    # (reference detection/mean_ap.py:1007-1032 all_gather_object)
+                    gathered = backend.all_gather_object(list(self._state[name]))
+                    merged: list = []
+                    for rank_list in gathered:
+                        merged.extend(rank_list)
+                    synced[name] = merged
+                else:
+                    synced[name] = backend.sync_tensor(self._precat(name), self._reductions[name])
+        except Exception:
+            self._cache = None
+            raise
+        self._state.update(synced)
         self._is_synced = True
 
     def _precat(self, name: str) -> Array:

@@ -146,8 +146,25 @@ class HostSync(SyncBackend):
 
     Mirrors the reference's eager gather-then-reduce
     (``metric.py:427-457``): gather a (world, ...) stack then apply the
-    per-state reduction over axis 0. Requires ``jax.distributed.initialize``.
+    per-state reduction over axis 0. ``cat`` states use the reference's
+    pad-to-max protocol (``utilities/distributed.py:124-147``) so ranks may
+    hold *different* sample counts — including zero. Requires
+    ``jax.distributed.initialize``.
+
+    Args:
+        timeout_s: optional wall-clock bound per DCN gather. The reference
+            blocks forever when a peer is stalled or dead
+            (``utilities/distributed.py:118``); with a timeout set, a stuck
+            gather raises :class:`TimeoutError` instead so the training loop
+            can react (checkpoint, shrink the mesh, re-init
+            ``jax.distributed``). ``None`` (default) preserves blocking
+            semantics.
     """
+
+    def __init__(self, timeout_s: Optional[float] = None):
+        if timeout_s is not None and timeout_s <= 0:
+            raise ValueError(f"`timeout_s` must be positive or None, got {timeout_s}")
+        self.timeout_s = timeout_s
 
     def is_available(self) -> bool:
         return jax.process_count() > 1
@@ -155,10 +172,49 @@ class HostSync(SyncBackend):
     def world_size(self) -> int:
         return jax.process_count()
 
-    def sync_tensor(self, value: Array, reduction) -> Array:
+    def _gather(self, value):
+        """``process_allgather`` with an optional watchdog timeout.
+
+        The gather blocks inside the runtime, so it cannot be interrupted;
+        with ``timeout_s`` set it runs on a worker thread and the caller
+        raises once the deadline passes (the worker is leaked — the process
+        is expected to tear down / re-initialize after this error).
+        """
         from jax.experimental import multihost_utils
 
-        gathered = multihost_utils.process_allgather(value)  # (world, ...)
+        if self.timeout_s is None:
+            return multihost_utils.process_allgather(value)
+        import threading
+
+        result: list = []
+        err: list = []
+
+        def _run() -> None:
+            try:
+                result.append(multihost_utils.process_allgather(value))
+            except Exception as e:  # surfaced on the caller thread below
+                err.append(e)
+
+        t = threading.Thread(target=_run, daemon=True)
+        t.start()
+        t.join(self.timeout_s)
+        if t.is_alive():
+            raise TimeoutError(
+                f"HostSync gather did not complete within {self.timeout_s}s — a peer "
+                f"process is likely stalled or dead (world_size={self.world_size()}). "
+                "Local metric state is intact: checkpoint it, then tear down and "
+                "re-initialize jax.distributed before syncing again (the timed-out "
+                "collective may still be in flight, so retrying in this process "
+                "would race it)."
+            )
+        if err:
+            raise err[0]
+        return result[0]
+
+    def sync_tensor(self, value: Array, reduction) -> Array:
+        if reduction == Reduction.CAT:
+            return self._gather_uneven_cat(jnp.atleast_1d(value))
+        gathered = self._gather(value)  # (world, ...)
         if reduction == Reduction.SUM:
             return jnp.sum(gathered, axis=0)
         if reduction == Reduction.MEAN:
@@ -167,13 +223,70 @@ class HostSync(SyncBackend):
             return jnp.max(gathered, axis=0)
         if reduction == Reduction.MIN:
             return jnp.min(gathered, axis=0)
-        if reduction == Reduction.CAT:
-            return jnp.concatenate(list(gathered), axis=0)
         if reduction == Reduction.NONE:
             return gathered  # caller's compute merges (e.g. Pearson moment merge)
         if callable(reduction):
             return reduction(gathered)
         raise ValueError(f"Unknown reduction {reduction}")
+
+    # dtype wire codes for the cat-gather metadata exchange (a rank that
+    # never updated holds a (0,)-float32 placeholder and must adopt the
+    # group's real trailing shape + dtype before the uniform gather)
+    _CAT_DTYPES = ("float32", "float64", "int32", "int64", "uint8", "int16",
+                   "uint32", "bool", "bfloat16", "float16")
+    _CAT_MAX_TRAILING = 6
+
+    def _gather_uneven_cat(self, value: Array) -> Array:
+        """Concatenate per-rank ``cat`` shards that may differ in length.
+
+        The reference's pad-to-max protocol
+        (``utilities/distributed.py:124-147``): gather per-rank metadata
+        (length, trailing shape, dtype) first, pad the local shard to the max
+        length with zeros, gather the now-uniform buffers, then slice each
+        rank back to its true length. Ranks with zero samples participate —
+        including never-updated ranks whose placeholder is ``(0,)`` float32
+        regardless of the state's true shape/dtype.
+        """
+        import numpy as np
+
+        trailing = value.shape[1:]
+        if len(trailing) > self._CAT_MAX_TRAILING:
+            raise ValueError(
+                f"cat state has {len(trailing)} trailing dims; HostSync supports up to "
+                f"{self._CAT_MAX_TRAILING}"
+            )
+        try:
+            dtype_code = self._CAT_DTYPES.index(str(np.dtype(value.dtype)))
+        except ValueError:
+            raise ValueError(f"Unsupported cat-state dtype for HostSync gather: {value.dtype}")
+        meta = np.full(2 + self._CAT_MAX_TRAILING, -1, dtype=np.int32)
+        meta[0] = value.shape[0]
+        meta[1] = dtype_code
+        meta[2 : 2 + len(trailing)] = trailing
+        metas = np.asarray(self._gather(jnp.asarray(meta))).reshape(-1, meta.size)
+        lens = metas[:, 0]
+        lmax = int(lens.max()) if lens.size else 0
+        if lmax == 0:  # every rank is empty
+            return value
+        # adopt the group's trailing shape + dtype from any non-empty rank
+        # (they must all agree; empty ranks carry placeholder metadata)
+        donor = metas[int(np.argmax(lens > 0))]
+        group_trailing = tuple(int(d) for d in donor[2:] if d >= 0)
+        group_dtype = np.dtype(self._CAT_DTYPES[int(donor[1])])
+        nonempty = metas[lens > 0]
+        if not (nonempty[:, 1:] == donor[1:]).all():
+            raise ValueError(
+                "cat state shards disagree on trailing shape or dtype across ranks: "
+                f"{[tuple(m) for m in nonempty]}"
+            )
+        if value.shape[0] == 0 and (trailing != group_trailing or value.dtype != group_dtype):
+            value = jnp.zeros((0,) + group_trailing, group_dtype)
+        pad = jnp.zeros((lmax - value.shape[0],) + group_trailing, group_dtype)
+        value = jnp.concatenate([value.astype(group_dtype), pad], axis=0)
+        gathered = self._gather(value)  # (world, lmax, ...)
+        return jnp.concatenate(
+            [gathered[r, : int(lens[r])] for r in range(len(lens))], axis=0
+        )
 
     def all_gather_object(self, obj: Any) -> list:
         """Gather an arbitrary picklable object from every process.
@@ -187,15 +300,14 @@ class HostSync(SyncBackend):
         import pickle
 
         import numpy as np
-        from jax.experimental import multihost_utils
 
         payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
         lens = np.asarray(
-            multihost_utils.process_allgather(jnp.asarray(payload.size, dtype=jnp.int32))
+            self._gather(jnp.asarray(payload.size, dtype=jnp.int32))
         ).reshape(-1)
         padded = np.zeros(int(lens.max()) if lens.size else 0, dtype=np.uint8)
         padded[: payload.size] = payload
-        gathered = np.asarray(multihost_utils.process_allgather(jnp.asarray(padded)))
+        gathered = np.asarray(self._gather(jnp.asarray(padded)))
         return [
             pickle.loads(gathered[r, : int(lens[r])].tobytes()) for r in range(len(lens))
         ]
