@@ -1,0 +1,176 @@
+"""BootStrapper vmap fast path: exactness, single-compile, loop equivalence.
+
+The multinomial vmap path must be bit-identical to the per-copy replay loop
+(same RandomState stream: one (B, N) draw == B sequential (N,) draws), trace
+exactly once across batches of the same shape, and survive pickling.
+"""
+from copy import deepcopy
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu import BootStrapper, CatMetric, MeanSquaredError
+from torchmetrics_tpu.classification import MulticlassAccuracy
+
+
+def _batches(n_batches=3, n=16, seed=123):
+    rng = np.random.RandomState(seed)
+    return [
+        (rng.rand(n).astype(np.float32), rng.rand(n).astype(np.float32))
+        for _ in range(n_batches)
+    ]
+
+
+def test_vmap_path_selected_for_jittable_multinomial():
+    assert BootStrapper(MeanSquaredError(), sampling_strategy="multinomial")._vmap_path
+    assert not BootStrapper(MeanSquaredError(), sampling_strategy="poisson")._vmap_path
+    # warn-mode CatMetric filters eagerly (not trace-safe) -> loop path
+    assert not BootStrapper(CatMetric(), sampling_strategy="multinomial")._vmap_path
+
+
+def test_multinomial_vmap_matches_manual_replay():
+    B = 5
+    boot = BootStrapper(
+        MeanSquaredError(), num_bootstraps=B, sampling_strategy="multinomial",
+        seed=0, raw=True,
+    )
+    assert boot._vmap_path
+    ref_rng = np.random.RandomState(0)
+    acc = [[] for _ in range(B)]  # (preds, target) pairs per replica
+    for p, t in _batches():
+        boot.update(jnp.asarray(p), jnp.asarray(t))
+        idx = ref_rng.randint(0, len(p), (B, len(p)))
+        for b in range(B):
+            acc[b].append((p[idx[b]], t[idx[b]]))
+    out = boot.compute()
+    raw = np.asarray(out["raw"])
+    expected = np.asarray([
+        np.mean((np.concatenate([p for p, _ in rep]) - np.concatenate([t for _, t in rep])) ** 2)
+        for rep in acc
+    ])
+    np.testing.assert_allclose(raw, expected, rtol=1e-5)
+    np.testing.assert_allclose(float(out["mean"]), expected.mean(), rtol=1e-5)
+    np.testing.assert_allclose(float(out["std"]), expected.std(ddof=1), rtol=1e-4)
+
+
+def test_multinomial_vmap_bit_identical_to_loop():
+    B = 4
+    kwargs = dict(num_bootstraps=B, sampling_strategy="multinomial", seed=7, raw=True)
+    fast = BootStrapper(MeanSquaredError(), **kwargs)
+    slow = BootStrapper(MeanSquaredError(), **kwargs)
+    slow._vmap_path = False  # force the reference-style replay loop
+    slow.metrics = [deepcopy(slow.base_metric) for _ in range(B)]
+    for p, t in _batches(n_batches=4, n=10):
+        fast.update(jnp.asarray(p), jnp.asarray(t))
+        slow.update(jnp.asarray(p), jnp.asarray(t))
+    np.testing.assert_allclose(
+        np.asarray(fast.compute()["raw"]), np.asarray(slow.compute()["raw"]), rtol=1e-6
+    )
+
+
+def test_single_compile_across_resamples():
+    boot = BootStrapper(MeanSquaredError(), num_bootstraps=8, sampling_strategy="multinomial", seed=1)
+    for p, t in _batches(n_batches=10, n=32):
+        boot.update(jnp.asarray(p), jnp.asarray(t))
+    assert boot.trace_count == 1, f"retraced: {boot.trace_count} compiles for 10 resamples"
+    boot.compute()
+    assert boot.trace_count == 1
+
+
+def test_vmap_classification_base():
+    B = 6
+    rng = np.random.RandomState(3)
+    boot = BootStrapper(
+        MulticlassAccuracy(num_classes=4), num_bootstraps=B,
+        sampling_strategy="multinomial", seed=11, raw=True, quantile=0.5,
+    )
+    assert boot._vmap_path
+    for _ in range(3):
+        preds = rng.rand(20, 4).astype(np.float32)
+        target = rng.randint(0, 4, 20)
+        boot.update(jnp.asarray(preds), jnp.asarray(target))
+    out = boot.compute()
+    assert np.asarray(out["raw"]).shape == (B,)
+    assert 0.0 <= float(out["mean"]) <= 1.0
+    assert np.isfinite(float(out["quantile"]))
+
+
+def test_vmap_cat_state_base():
+    """List (cat) states stack per replica: disable nan filtering so
+    CatMetric's update is trace-safe."""
+    B = 3
+    boot = BootStrapper(
+        CatMetric(nan_strategy="disable"), num_bootstraps=B,
+        sampling_strategy="multinomial", seed=5, raw=True, mean=False, std=False,
+    )
+    assert boot._vmap_path
+    boot.update(jnp.asarray([1.0, 2.0, 3.0, 4.0]))
+    boot.update(jnp.asarray([5.0, 6.0]))
+    raw = np.asarray(boot.compute()["raw"])
+    assert raw.shape == (B, 6)
+    # every resampled element came from the corresponding batch
+    assert set(np.unique(raw[:, :4])) <= {1.0, 2.0, 3.0, 4.0}
+    assert set(np.unique(raw[:, 4:])) <= {5.0, 6.0}
+
+
+def test_vmap_pickle_roundtrip():
+    import pickle
+
+    boot = BootStrapper(MeanSquaredError(), num_bootstraps=4, sampling_strategy="multinomial", seed=2)
+    batches = _batches(n_batches=4, n=12, seed=9)
+    for p, t in batches[:2]:
+        boot.update(jnp.asarray(p), jnp.asarray(t))
+    clone = pickle.loads(pickle.dumps(boot))
+    for p, t in batches[2:]:
+        boot.update(jnp.asarray(p), jnp.asarray(t))
+        clone.update(jnp.asarray(p), jnp.asarray(t))
+    np.testing.assert_allclose(
+        np.asarray(boot.compute()["mean"]), np.asarray(clone.compute()["mean"]), rtol=1e-6
+    )
+
+
+def test_vmap_reset():
+    boot = BootStrapper(MeanSquaredError(), num_bootstraps=4, sampling_strategy="multinomial", seed=2)
+    boot.update(jnp.asarray([1.0, 2.0]), jnp.asarray([1.5, 2.5]))
+    boot.reset()
+    assert boot._stacked is None
+    boot.update(jnp.asarray([1.0, 2.0]), jnp.asarray([1.0, 2.0]))
+    assert float(boot.compute()["mean"]) == pytest.approx(0.0)
+
+
+def test_vmap_path_runs_eager_validation():
+    """The jitted stacked update must not bypass validate_args checks."""
+    boot = BootStrapper(
+        MulticlassAccuracy(num_classes=4, validate_args=True),
+        num_bootstraps=3, sampling_strategy="multinomial", seed=0,
+    )
+    assert boot._vmap_path
+    with pytest.raises(RuntimeError, match="outside the expected range"):
+        boot.update(jnp.asarray(np.eye(4, dtype=np.float32)), jnp.asarray([0, 1, 2, 7]))
+
+
+def test_none_reduction_base_takes_loop_path():
+    """Pearson's custom/NONE-reduction states can't sync elementwise in the
+    stacked layout — the wrapper must fall back to the replay loop."""
+    from torchmetrics_tpu.regression import PearsonCorrCoef
+
+    boot = BootStrapper(PearsonCorrCoef(), num_bootstraps=3, sampling_strategy="multinomial", seed=0)
+    assert not boot._vmap_path
+    rng = np.random.RandomState(0)
+    boot.update(jnp.asarray(rng.rand(16).astype(np.float32)), jnp.asarray(rng.rand(16).astype(np.float32)))
+    assert np.isfinite(float(boot.compute()["mean"]))
+
+
+def test_poisson_loop_is_eager_no_retrace_hazard():
+    """Poisson copies run eagerly (``_use_jit=False``): distinct resample
+    lengths must not populate per-copy jit caches."""
+    boot = BootStrapper(MeanSquaredError(), num_bootstraps=4, sampling_strategy="poisson", seed=0)
+    for p, t in _batches(n_batches=5, n=32):
+        boot.update(jnp.asarray(p), jnp.asarray(t))
+    for m in boot.metrics:
+        assert not m._use_jit
+        assert len(m._jit_cache) == 0
+    out = boot.compute()
+    assert np.isfinite(float(out["mean"]))

@@ -195,6 +195,36 @@ _HOST_SYNC_WORKER = textwrap.dedent(
         warnings.simplefilter("ignore")
         got2 = float(a2.compute())
     assert abs(got2 - float(ref2.compute())) < 1e-6, got2
+
+    # dtype generality: int8 cat shards, uneven (outside any whitelist)
+    from torchmetrics_tpu.parallel.reduction import Reduction
+    hs = HostSync()
+    shard = jnp.asarray([1, 2, 3] if rank == 0 else [4], dtype=jnp.int8)
+    merged = np.asarray(hs.sync_tensor(shard, Reduction.CAT))
+    assert merged.dtype == np.int8 and sorted(merged.tolist()) == [1, 2, 3, 4], merged
+
+    # BootStrapper vmap path syncs its stacked state like the replay loop
+    from copy import deepcopy
+    from torchmetrics_tpu import BootStrapper
+    from torchmetrics_tpu.classification import BinaryF1Score
+
+    def shard_batches(r):
+        rng2 = np.random.RandomState(100 + r)
+        return [(rng2.rand(12).astype(np.float32), rng2.randint(0, 2, 12)) for _ in range(2)]
+
+    fast = BootStrapper(BinaryF1Score(sync_backend=HostSync()), num_bootstraps=4,
+                        sampling_strategy="multinomial", seed=5, raw=True)
+    slow = BootStrapper(BinaryF1Score(sync_backend=HostSync()), num_bootstraps=4,
+                        sampling_strategy="multinomial", seed=5, raw=True)
+    assert fast._vmap_path
+    slow._vmap_path = False
+    slow.metrics = [deepcopy(slow.base_metric) for _ in range(4)]
+    for p, t in shard_batches(rank):
+        fast.update(jnp.asarray(p), jnp.asarray(t))
+        slow.update(jnp.asarray(p), jnp.asarray(t))
+    f_raw = np.asarray(fast.compute()["raw"])
+    s_raw = np.asarray(slow.compute()["raw"])
+    assert np.allclose(f_raw, s_raw, atol=1e-6), (f_raw, s_raw)
     print(f"RANK{rank} OK")
     """
 )

@@ -229,12 +229,29 @@ class HostSync(SyncBackend):
             return reduction(gathered)
         raise ValueError(f"Unknown reduction {reduction}")
 
-    # dtype wire codes for the cat-gather metadata exchange (a rank that
-    # never updated holds a (0,)-float32 placeholder and must adopt the
-    # group's real trailing shape + dtype before the uniform gather)
-    _CAT_DTYPES = ("float32", "float64", "int32", "int64", "uint8", "int16",
-                   "uint32", "bool", "bfloat16", "float16")
+    # cat-gather metadata wire format (a rank that never updated holds a
+    # (0,)-float32 placeholder and must adopt the group's real trailing
+    # shape + dtype before the uniform gather): the dtype travels as its
+    # numpy name in 16 ascii bytes (4 int32 words), so any numpy/ml_dtypes
+    # dtype round-trips — no whitelist
     _CAT_MAX_TRAILING = 6
+    _CAT_NAME_WORDS = 4
+
+    @classmethod
+    def _encode_dtype(cls, dt) -> "np.ndarray":
+        import numpy as np
+
+        name = np.dtype(dt).name.encode("ascii")
+        if len(name) > 4 * cls._CAT_NAME_WORDS:
+            raise ValueError(f"dtype name too long for the cat-gather metadata: {name!r}")
+        return np.frombuffer(name.ljust(4 * cls._CAT_NAME_WORDS, b"\0"), dtype=np.int32)
+
+    @classmethod
+    def _decode_dtype(cls, words) -> "np.dtype":
+        import numpy as np
+
+        raw = np.asarray(words, dtype=np.int32).tobytes().rstrip(b"\0")
+        return np.dtype(raw.decode("ascii"))
 
     def _gather_uneven_cat(self, value: Array) -> Array:
         """Concatenate per-rank ``cat`` shards that may differ in length.
@@ -255,14 +272,10 @@ class HostSync(SyncBackend):
                 f"cat state has {len(trailing)} trailing dims; HostSync supports up to "
                 f"{self._CAT_MAX_TRAILING}"
             )
-        try:
-            dtype_code = self._CAT_DTYPES.index(str(np.dtype(value.dtype)))
-        except ValueError:
-            raise ValueError(f"Unsupported cat-state dtype for HostSync gather: {value.dtype}")
-        meta = np.full(2 + self._CAT_MAX_TRAILING, -1, dtype=np.int32)
+        meta = np.full(1 + self._CAT_MAX_TRAILING + self._CAT_NAME_WORDS, -1, dtype=np.int32)
         meta[0] = value.shape[0]
-        meta[1] = dtype_code
-        meta[2 : 2 + len(trailing)] = trailing
+        meta[1 : 1 + len(trailing)] = trailing
+        meta[1 + self._CAT_MAX_TRAILING :] = self._encode_dtype(value.dtype)
         metas = np.asarray(self._gather(jnp.asarray(meta))).reshape(-1, meta.size)
         lens = metas[:, 0]
         lmax = int(lens.max()) if lens.size else 0
@@ -271,8 +284,10 @@ class HostSync(SyncBackend):
         # adopt the group's trailing shape + dtype from any non-empty rank
         # (they must all agree; empty ranks carry placeholder metadata)
         donor = metas[int(np.argmax(lens > 0))]
-        group_trailing = tuple(int(d) for d in donor[2:] if d >= 0)
-        group_dtype = np.dtype(self._CAT_DTYPES[int(donor[1])])
+        group_trailing = tuple(
+            int(d) for d in donor[1 : 1 + self._CAT_MAX_TRAILING] if d >= 0
+        )
+        group_dtype = self._decode_dtype(donor[1 + self._CAT_MAX_TRAILING :])
         nonempty = metas[lens > 0]
         if not (nonempty[:, 1:] == donor[1:]).all():
             raise ValueError(

@@ -1,8 +1,26 @@
 """BootStrapper — bootstrap confidence intervals over any metric.
 
 Parity: reference ``src/torchmetrics/wrappers/bootstrapping.py:54`` (sampler
-:31, update :125-146): keeps N copies of the base metric; each update
-resamples the batch (poisson or multinomial weights) and feeds each copy.
+:31, update :125-146): the reference keeps N deep copies of the base metric
+and replays each update N times through a Python loop.
+
+TPU-first redesign: for jittable base metrics with multinomial resampling the
+wrapper keeps ONE base metric and a *stacked* state pytree with a leading
+``num_bootstraps`` axis. Each update draws a static-shape ``(B, N)`` index
+matrix on host (same RandomState stream as the loop design, so results are
+bit-identical for a given seed) and advances all replicas in a single jitted
+``vmap`` over the replica axis — one compile per input signature, no retrace
+across batches, and the N resampled updates run as one batched XLA program
+on the MXU instead of N Python dispatches.
+
+Poisson resampling cannot ride the static-shape gather: each replica's total
+sample count is itself random (``sum_i Poisson(1)``), and a fixed-length
+gather always feeds exactly L samples, so no gather-only realization can
+reproduce the count distribution (e.g. ``SumMetric``'s state after one
+update would be deterministic where the reference's is random). Poisson
+therefore keeps the per-copy replay semantics, with the former retrace
+hazard removed: copies run their updates eagerly (op-by-op) instead of
+re-jitting per distinct resample length.
 """
 from copy import deepcopy
 from typing import Any, Dict, Optional, Sequence, Union
@@ -15,6 +33,8 @@ from ..metric import Metric, _squeeze_if_scalar
 from .abstract import WrapperMetric
 
 Array = jax.Array
+
+_ARRAY_TYPES = (jax.Array, jnp.ndarray, np.ndarray)
 
 
 def _bootstrap_sampler(size: int, sampling_strategy: str, rng: np.random.RandomState) -> np.ndarray:
@@ -30,11 +50,16 @@ def _bootstrap_sampler(size: int, sampling_strategy: str, rng: np.random.RandomS
 class BootStrapper(WrapperMetric):
     """Bootstrap confidence intervals around a base metric.
 
-    Parity: reference ``wrappers/bootstrapping.py:54`` — keeps
-    ``num_bootstraps`` copies of the base metric; each update resamples the
-    batch (poisson or multinomial) per copy; compute reports mean/std/
-    quantile/raw over the copies. Resampling is host-side numpy driven by
-    ``seed`` (deterministic), the metric math itself runs on device.
+    Parity: reference ``wrappers/bootstrapping.py:54`` — ``num_bootstraps``
+    resampled replicas of the base metric; each update resamples the batch
+    (poisson or multinomial) per replica; compute reports mean/std/quantile/
+    raw over the replicas. Resampling indices come from host numpy driven by
+    ``seed`` (deterministic); the metric math runs on device.
+
+    Jittable base metrics with ``sampling_strategy="multinomial"`` take the
+    vmap fast path: one stacked state pytree, one jitted vmapped update for
+    all replicas (see module docstring). Other combinations replay updates
+    per replica copy, matching the reference design.
 
     Example:
         >>> import jax.numpy as jnp
@@ -67,7 +92,6 @@ class BootStrapper(WrapperMetric):
             raise ValueError(
                 f"Expected base metric to be an instance of torchmetrics_tpu.Metric but received {base_metric}"
             )
-        self.metrics = [deepcopy(base_metric) for _ in range(num_bootstraps)]
         self.num_bootstraps = num_bootstraps
         self.mean = mean
         self.std = std
@@ -79,27 +103,213 @@ class BootStrapper(WrapperMetric):
         self.sampling_strategy = sampling_strategy
         self._rng = np.random.RandomState(seed)
 
+        self.base_metric = deepcopy(base_metric)
+        # _use_jit is the per-instance trace-safety knob (False for metrics
+        # whose update filters eagerly, e.g. CatMetric warn-mode); associative
+        # reductions are required so the stacked state can sync across
+        # processes with per-leaf elementwise semantics (NONE/custom states —
+        # Pearson moment merges — take the replay loop instead)
+        from ..parallel.reduction import Reduction
+
+        self._vmap_path = (
+            bool(getattr(base_metric, "jittable", False))
+            and bool(getattr(base_metric, "_use_jit", False))
+            and sampling_strategy == "multinomial"
+            and all(
+                not callable(r) and r != Reduction.NONE
+                for r in base_metric._reductions.values()
+            )
+        )
+        # how many times the stacked update body was traced (== XLA compiles
+        # triggered by this wrapper); asserted to stay at 1 across batches
+        self.trace_count = 0
+        self._stacked_update_fn = None
+        self._stacked_compute_fn = None
+        self._stacked: Optional[Dict[str, Any]] = None  # vmap path state
+        if self._vmap_path:
+            self.metrics: list = []
+        else:
+            self.metrics = [deepcopy(base_metric) for _ in range(num_bootstraps)]
+            if sampling_strategy == "poisson":
+                # poisson resample lengths differ per (copy, batch); jitted
+                # per-copy updates would recompile for every distinct length
+                for m in self.metrics:
+                    m._use_jit = False
+
+    # ------------------------------------------------------------------
+    # vmap fast path
+    # ------------------------------------------------------------------
+    def _init_stacked(self) -> Dict[str, Any]:
+        base = self.base_metric
+        out: Dict[str, Any] = {}
+        for k, v in base._defaults.items():
+            if k in base._list_states:
+                out[k] = ()
+            else:
+                # strip weak types so the first jitted update's input avals
+                # match its outputs (otherwise batch 2 retraces)
+                arr = jnp.asarray(v)
+                arr = jax.lax.convert_element_type(arr, arr.dtype)
+                out[k] = jnp.tile(arr[None], (self.num_bootstraps,) + (1,) * arr.ndim)
+        return out
+
+    def _get_stacked_update(self):
+        if self._stacked_update_fn is None:
+            base = self.base_metric
+            list_states = base._list_states
+
+            def stacked_update(tensors, lists, idx, arr_args, arr_kwargs, static_args, static_kwargs):
+                self.trace_count += 1  # runs once per trace, not per call
+
+                def one(tens, ib):
+                    it_a = iter(arr_args)
+                    g_args = tuple(
+                        jnp.take(next(it_a), ib, axis=0) if is_arr else a
+                        for a, is_arr in static_args
+                    )
+                    g_kwargs = {
+                        k: (jnp.take(arr_kwargs[k], ib, axis=0) if k in arr_kwargs else v)
+                        for k, v in static_kwargs
+                    }
+                    return base._pure_update(tens, g_args, dict(g_kwargs))
+
+                new_tensors, appends = jax.vmap(one, in_axes=(0, 0))(tensors, idx)
+                new_lists = {k: tuple(lists.get(k, ())) + appends[k] for k in list_states}
+                return new_tensors, new_lists
+
+            self._stacked_update_fn = jax.jit(stacked_update, static_argnums=(5, 6))
+        return self._stacked_update_fn
+
+    def __getstate__(self) -> Dict[str, Any]:
+        state = super().__getstate__()
+        state["_stacked_update_fn"] = None  # jitted closures: not picklable
+        state["_stacked_compute_fn"] = None
+        return state
+
+    def _vmap_update(self, *args: Any, **kwargs: Any) -> None:
+        base = self.base_metric
+        # the loop path gets per-metric host-side validation from each
+        # copy's wrapped update; the jitted stacked update skips it, so run
+        # the base's validation hook once on the raw (pre-resample) batch
+        args = tuple(base._to_array(a) for a in args)
+        kwargs = {k: base._to_array(v) for k, v in kwargs.items()}
+        base._eager_validate(*args, **kwargs)
+        arrs = [a for a in args if isinstance(a, _ARRAY_TYPES)]
+        arrs += [v for v in kwargs.values() if isinstance(v, _ARRAY_TYPES)]
+        size = arrs[0].shape[0] if arrs else 0
+        if size == 0:
+            return
+        # one (B, N) draw == B sequential (N,) draws from the same
+        # RandomState (row-major fill): bit-identical to the loop design
+        idx = jnp.asarray(self._rng.randint(0, size, (self.num_bootstraps, size)))
+        if self._stacked is None:
+            self._stacked = self._init_stacked()
+        tensors = {k: v for k, v in self._stacked.items() if k not in base._list_states}
+        lists = {k: self._stacked[k] for k in base._list_states}
+        # static structure (hashable) + array payloads (traced)
+        static_args = tuple(
+            (None, True) if isinstance(a, _ARRAY_TYPES) else (a, False) for a in args
+        )
+        arr_args = tuple(jnp.asarray(a) for a in args if isinstance(a, _ARRAY_TYPES))
+        arr_kwargs = {k: jnp.asarray(v) for k, v in kwargs.items() if isinstance(v, _ARRAY_TYPES)}
+        static_kwargs = tuple(
+            (k, None if isinstance(v, _ARRAY_TYPES) else v) for k, v in sorted(kwargs.items())
+        )
+        fn = self._get_stacked_update()
+        new_tensors, new_lists = fn(
+            tensors, lists, idx, arr_args, arr_kwargs, static_args, static_kwargs
+        )
+        self._stacked = {**new_tensors, **new_lists}
+
+    def _replica_state(self, stacked: Dict[str, Any], b: int) -> Dict[str, Any]:
+        base = self.base_metric
+        out: Dict[str, Any] = {}
+        for k, v in stacked.items():
+            if k in base._list_states:
+                out[k] = tuple(e[b] for e in v)
+            else:
+                out[k] = v[b]
+        return out
+
+    def _sync_stacked(self, stacked: Dict[str, Any]) -> Dict[str, Any]:
+        """Cross-process merge of the stacked state (loop-path parity: each
+        copy's compute syncs its own states). Tensor leaves reduce
+        elementwise over the replica axis; cat leaves concatenate every
+        rank's samples per replica (gather rides axis 0 after a swap)."""
+        base = self.base_metric
+        backend = base.sync_backend
+        if not getattr(base, "_to_sync", True) or not backend.is_available():
+            return stacked
+        from ..parallel.reduction import Reduction
+
+        out: Dict[str, Any] = {}
+        for k, v in stacked.items():
+            if hasattr(backend, "set_current"):
+                backend.set_current(k)
+            if k in base._list_states:
+                if v:
+                    elems = jnp.concatenate([jnp.asarray(e) for e in v], axis=1)
+                else:  # never updated: (B, 0) placeholder, peers define shape
+                    elems = jnp.zeros((self.num_bootstraps, 0), base._dtype)
+                moved = jnp.moveaxis(elems, 1, 0)  # (L, B, ...)
+                gathered = backend.sync_tensor(moved, Reduction.CAT)
+                out[k] = (jnp.moveaxis(gathered, 0, 1),)
+            else:
+                out[k] = backend.sync_tensor(v, base._reductions[k])
+        return out
+
+    def _vmap_compute(self) -> Array:
+        base = self.base_metric
+        if self._stacked is None:
+            self._stacked = self._init_stacked()
+        stacked = self._sync_stacked(self._stacked)
+        if getattr(base, "_compute_jittable", True):
+            tensors = {k: v for k, v in stacked.items() if k not in base._list_states}
+            lists = {k: stacked[k] for k in base._list_states}
+            if self._stacked_compute_fn is None:
+
+                def one(tens, ls):
+                    return jnp.asarray(base._pure_compute(tens, {k: list(v) for k, v in ls.items()}))
+
+                self._stacked_compute_fn = jax.jit(jax.vmap(one, in_axes=(0, 0)))
+            return self._stacked_compute_fn(tensors, lists)
+        # host-path computes (exact curves, retrieval grouping): per replica
+        vals = [
+            jnp.asarray(base.compute_state(self._replica_state(stacked, b)))
+            for b in range(self.num_bootstraps)
+        ]
+        return jnp.stack(vals, axis=0)
+
+    # ------------------------------------------------------------------
+    # shared API
+    # ------------------------------------------------------------------
     def update(self, *args: Any, **kwargs: Any) -> None:
-        """Resample the batch for every bootstrap copy."""
-        arrs = [a for a in args if isinstance(a, (jax.Array, jnp.ndarray, np.ndarray))]
+        """Resample the batch for every bootstrap replica."""
+        if self._vmap_path:
+            self._vmap_update(*args, **kwargs)
+            return
+        arrs = [a for a in args if isinstance(a, _ARRAY_TYPES)]
         size = arrs[0].shape[0] if arrs else 0
         for idx in range(self.num_bootstraps):
             sample_idx = _bootstrap_sampler(size, self.sampling_strategy, self._rng)
             if len(sample_idx) == 0:
                 continue
             new_args = tuple(
-                a[jnp.asarray(sample_idx)] if isinstance(a, (jax.Array, jnp.ndarray, np.ndarray)) else a
+                a[jnp.asarray(sample_idx)] if isinstance(a, _ARRAY_TYPES) else a
                 for a in args
             )
             new_kwargs = {
-                k: (v[jnp.asarray(sample_idx)] if isinstance(v, (jax.Array, jnp.ndarray, np.ndarray)) else v)
+                k: (v[jnp.asarray(sample_idx)] if isinstance(v, _ARRAY_TYPES) else v)
                 for k, v in kwargs.items()
             }
             self.metrics[idx].update(*new_args, **new_kwargs)
 
     def compute(self) -> Dict[str, Array]:
         """Parity: reference ``bootstrapping.py:148``."""
-        computed_vals = jnp.stack([jnp.asarray(m.compute()) for m in self.metrics], axis=0)
+        if self._vmap_path:
+            computed_vals = self._vmap_compute()
+        else:
+            computed_vals = jnp.stack([jnp.asarray(m.compute()) for m in self.metrics], axis=0)
         output: Dict[str, Array] = {}
         if self.mean:
             output["mean"] = jnp.mean(computed_vals, axis=0)
@@ -116,6 +326,8 @@ class BootStrapper(WrapperMetric):
         return self.compute()
 
     def reset(self) -> None:
+        self._stacked = None
+        self.base_metric.reset()
         for m in self.metrics:
             m.reset()
         super().reset()
