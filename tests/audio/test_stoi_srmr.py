@@ -95,3 +95,27 @@ def test_srmr_batched_and_class():
     m = SpeechReverberationModulationEnergyRatio(fs=FS)
     m.update(x)
     assert np.isclose(float(m.compute()), vals.mean(), rtol=1e-5)
+
+
+@pytest.mark.parametrize("kw", [{"norm": True}, {"fast": True}, {"norm": True, "fast": True}])
+def test_srmr_variants_keep_reverb_penalty(kw):
+    """norm (30 dB clamp, max_cf=30) and fast (gammatonegram) variants must
+    preserve the metric's core ordering: clean > reverbed > 0."""
+    x = _speechlike(seconds=1.5)
+    rng = np.random.RandomState(6)
+    ir = np.zeros(int(0.4 * FS))
+    ir[0] = 1.0
+    taps = rng.randint(100, len(ir), 300)
+    ir[taps] += rng.randn(300) * np.exp(-3.0 * taps / len(ir)) * 0.5
+    reverbed = np.convolve(x, ir)[: len(x)]
+    clean_score = float(speech_reverberation_modulation_energy_ratio(x, FS, **kw))
+    reverb_score = float(speech_reverberation_modulation_energy_ratio(reverbed, FS, **kw))
+    assert clean_score > reverb_score > 0
+
+
+def test_srmr_class_passes_variant_options():
+    x = _speechlike(seconds=1.5)
+    m = SpeechReverberationModulationEnergyRatio(fs=FS, norm=True, fast=True)
+    m.update(x)
+    direct = float(speech_reverberation_modulation_energy_ratio(x, FS, norm=True, fast=True))
+    assert np.isclose(float(m.compute()), direct, rtol=1e-5)

@@ -4,7 +4,7 @@ Parity targets: reference ``audio/{snr,sdr,pit,pesq,stoi,srmr}.py`` — every
 class keeps ``sum_<metric>`` + ``total`` sum states (mean at compute), the
 exact state design of the reference's audio domain.
 """
-from typing import Any, Callable
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -351,12 +351,32 @@ class SpeechReverberationModulationEnergyRatio(_MeanAudioMetric):
     higher_is_better = True
     jittable = False
 
-    def __init__(self, fs: int, **kwargs: Any) -> None:
+    def __init__(
+        self,
+        fs: int,
+        n_cochlear_filters: int = 23,
+        low_freq: float = 125.0,
+        min_cf: float = 4.0,
+        max_cf: Optional[float] = None,
+        norm: bool = False,
+        fast: bool = False,
+        **kwargs: Any,
+    ) -> None:
         super().__init__(**kwargs)
         self.fs = fs
+        self.n_cochlear_filters = n_cochlear_filters
+        self.low_freq = low_freq
+        self.min_cf = min_cf
+        self.max_cf = max_cf
+        self.norm = norm
+        self.fast = fast
 
     def update(self, preds: Array) -> None:  # SRMR is reference-free
-        values = speech_reverberation_modulation_energy_ratio(preds, self.fs)
+        values = speech_reverberation_modulation_energy_ratio(
+            preds, self.fs, n_cochlear_filters=self.n_cochlear_filters,
+            low_freq=self.low_freq, min_cf=self.min_cf, max_cf=self.max_cf,
+            norm=self.norm, fast=self.fast,
+        )
         self.sum_value = self.sum_value + jnp.sum(values)
         self.total = self.total + values.size
 

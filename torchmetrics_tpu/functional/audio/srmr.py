@@ -9,15 +9,20 @@ build owns the pipeline (Falk et al., 2010):
    friendly, no sequential IIR recursion);
 2. temporal envelopes via FFT Hilbert transform;
 3. 8-band modulation filterbank (2nd-order bandpass, Q=2, centers 4-128 Hz
-   log-spaced) on the envelopes, also frequency-domain;
-4. 256 ms / 64 ms framed modulation energies;
+   log-spaced — 4-30 Hz under ``norm``) on the envelopes, also
+   frequency-domain;
+4. 256 ms / 64 ms framed modulation energies, optionally clamped to a 30 dB
+   dynamic range (``norm=True``, reference ``_normalize_energy``);
 5. SRMR = energy(modulation bands 1-4) / energy(bands 5-8).
 
-Everything after input validation is one jittable jnp program per signal
-length; filter frequency responses are host-precomputed constants.
+``fast=True`` swaps stage 1-2 for a 10 ms / 2.5 ms gammatonegram (400 Hz
+envelope rate, SRMRpy ``fft_gtgram`` analogue): the modulation filterbank
+then runs on a ~fs/400x shorter envelope. Everything after input validation
+is one jittable jnp program per signal length; filter frequency responses
+are host-precomputed constants.
 """
 from functools import lru_cache
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -29,6 +34,9 @@ N_GT = 23
 MOD_CENTERS_LO = 4.0
 MOD_CENTERS_HI = 128.0
 N_MOD = 8
+NORM_DRANGE_DB = 30.0  # `norm=True` energy dynamic range (reference srmr.py:147-160)
+GTGRAM_WIN_S = 0.010  # `fast=True` gammatonegram window / hop (SRMRpy fft_gtgram)
+GTGRAM_HOP_S = 0.0025  # -> 400 Hz envelope rate
 
 
 def _erb(f: np.ndarray) -> np.ndarray:
@@ -77,42 +85,89 @@ def _modulation_response(fs_env: int, n_fft: int, min_cf: float, max_cf: float, 
     return np.stack(resp)
 
 
+@lru_cache(maxsize=16)
+def _gtgram_weights(fs: int, nfft_win: int, low: float, n_filters: int) -> np.ndarray:
+    """(n_filters, nfft_win//2+1) gammatone magnitudes on a short-window FFT
+    grid, for the ``fast=True`` gammatonegram path (SRMRpy ``fft_gtgram``):
+    interpolated from the high-resolution bank responses."""
+    hi_res = 8192
+    resp, _cf = _gammatone_response(fs, hi_res, low, n_filters)
+    mag_hi = np.abs(resp)
+    f_hi = np.fft.rfftfreq(hi_res, 1.0 / fs)
+    f_win = np.fft.rfftfreq(nfft_win, 1.0 / fs)
+    return np.stack([np.interp(f_win, f_hi, m) for m in mag_hi])
+
+
 def speech_reverberation_modulation_energy_ratio(
     preds: Array,
     fs: int,
     n_cochlear_filters: int = N_GT,
     low_freq: float = 125.0,
     min_cf: float = MOD_CENTERS_LO,
-    max_cf: float = MOD_CENTERS_HI,
+    max_cf: Optional[float] = None,
     norm: bool = False,
     fast: bool = False,
 ) -> Array:
     """SRMR of ``preds`` (..., time). Higher = less reverberant/noisy.
 
     Parity: reference ``functional/audio/srmr.py:speech_reverberation_modulation_energy_ratio``
-    (same signature; there delegated to the SRMRpy port). ``norm``/``fast``
-    variants are not implemented in this build and raise.
+    (same signature; there delegated to the SRMRpy port).
+
+    Args:
+        preds: signal ``(..., time)``
+        fs: sampling rate
+        n_cochlear_filters: gammatone bank size
+        low_freq: lowest gammatone center frequency
+        min_cf: first modulation-filter center (Hz)
+        max_cf: last modulation-filter center (Hz); ``None`` follows the
+            reference default — 30 Hz when ``norm`` else 128 Hz
+        norm: clamp framed modulation energies into a 30 dB dynamic range
+            below the batch peak (reference ``_normalize_energy``,
+            ``functional/audio/srmr.py:147-160``)
+        fast: compute envelopes from a 10 ms / 2.5 ms gammatonegram (400 Hz
+            envelope rate, SRMRpy ``fft_gtgram``) instead of full-rate
+            Hilbert envelopes — ~fs/400 less modulation-filter work
     """
-    if norm or fast:
-        raise NotImplementedError(
-            "The `norm=True` / `fast=True` SRMR variants are not implemented in torchmetrics_tpu yet; "
-            "use the default (norm=False, fast=False) pipeline."
-        )
+    if max_cf is None:
+        max_cf = 30.0 if norm else MOD_CENTERS_HI
     x = jnp.asarray(preds, jnp.float32)
     shape = x.shape
     flat = x.reshape(-1, shape[-1])
     n = shape[-1]
-    win = int(0.256 * fs)
-    hop = int(0.064 * fs)
-    if n < win:
-        raise ValueError(
-            f"Expected at least {win} samples (256 ms at fs={fs}) to frame modulation energies, got {n}."
-        )
-    n_fft = int(2 ** np.ceil(np.log2(2 * n)))
-    gt_resp, _cf = _gammatone_response(fs, n_fft, float(low_freq), int(n_cochlear_filters))
-    mod_resp = _modulation_response(fs, n_fft, float(min_cf), float(max_cf), N_MOD)
+    if fast:
+        win_gt = int(GTGRAM_WIN_S * fs)
+        hop_gt = int(GTGRAM_HOP_S * fs)
+        mfs = int(round(fs / hop_gt / 100.0) * 100)  # 400 Hz envelope rate
+        nfft_win = int(2 ** np.ceil(np.log2(win_gt)))
+        gt_w = _gtgram_weights(fs, nfft_win, float(low_freq), int(n_cochlear_filters))
+        n_env = max((n - win_gt) // hop_gt + 1, 1)
+    else:
+        mfs = fs
+        n_fft = int(2 ** np.ceil(np.log2(2 * n)))
+        gt_resp, _cf = _gammatone_response(fs, n_fft, float(low_freq), int(n_cochlear_filters))
+        n_env = n
 
-    def one(sig: Array) -> Array:
+    win = int(0.256 * mfs)
+    hop = int(0.064 * mfs)
+    if n_env < win:
+        raise ValueError(
+            f"Expected at least {win} envelope samples (256 ms at {mfs} Hz), got {n_env}."
+        )
+    n_fft_env = int(2 ** np.ceil(np.log2(2 * n_env)))
+    mod_resp = _modulation_response(mfs, n_fft_env, float(min_cf), float(max_cf), N_MOD)
+
+    def envelopes(sig: Array) -> Array:
+        """(C, T_env) temporal envelopes of the cochlear bands."""
+        if fast:
+            # gammatonegram: Hann short-window power spectrogram projected
+            # onto the bank's magnitude responses, env = sqrt(band power)
+            idx = jnp.arange(win_gt)[None, :] + hop_gt * jnp.arange(n_env)[:, None]
+            frames = sig[idx] * jnp.asarray(np.hanning(win_gt))
+            pow_spec = jnp.abs(jnp.fft.rfft(frames, nfft_win, axis=-1)) ** 2  # (S, F)
+            band_pow = jnp.matmul(
+                jnp.asarray(gt_w**2), pow_spec.T, precision=jax.lax.Precision.HIGHEST
+            )  # (C, S)
+            return jnp.sqrt(band_pow)
         spec = jnp.fft.rfft(sig, n_fft)  # (F,)
         bands = jnp.fft.irfft(spec[None, :] * jnp.asarray(gt_resp), n_fft)[:, :n]  # (C, T)
         # Hilbert envelope per cochlear channel
@@ -120,20 +175,44 @@ def speech_reverberation_modulation_energy_ratio(
         h = jnp.zeros(n_fft).at[0].set(1.0).at[1 : (n_fft + 1) // 2].set(2.0)
         if n_fft % 2 == 0:
             h = h.at[n_fft // 2].set(1.0)
-        env = jnp.abs(jnp.fft.ifft(bf * h[None, :], axis=-1))[:, :n]  # (C, T)
+        return jnp.abs(jnp.fft.ifft(bf * h[None, :], axis=-1))[:, :n]  # (C, T)
+
+    def one(sig: Array) -> Array:
+        env = envelopes(sig)
         # modulation filterbank on envelopes (freq domain)
-        ef = jnp.fft.rfft(env, n_fft, axis=-1)  # (C, F)
-        mod = jnp.fft.irfft(ef[:, None, :] * jnp.asarray(mod_resp)[None, :, :], n_fft, axis=-1)[..., :n]  # (C, M, T)
+        ef = jnp.fft.rfft(env, n_fft_env, axis=-1)  # (C, F)
+        mod = jnp.fft.irfft(
+            ef[:, None, :] * jnp.asarray(mod_resp)[None, :, :], n_fft_env, axis=-1
+        )[..., :n_env]  # (C, M, T_env)
         # framed energies
-        n_frames = max((n - win) // hop + 1, 1)
+        n_frames = max((n_env - win) // hop + 1, 1)
         idx = jnp.arange(win)[None, :] + hop * jnp.arange(n_frames)[:, None]
         frames = mod[..., idx]  # (C, M, S, W)
         energy = jnp.sum(frames**2, axis=-1)  # (C, M, S)
+        if norm:
+            # 30 dB dynamic range below the peak of the cochlear-mean energy
+            # (reference `_normalize_energy`)
+            peak = jnp.max(jnp.mean(energy, axis=0))
+            floor = peak * 10.0 ** (-NORM_DRANGE_DB / 10.0)
+            energy = jnp.clip(energy, floor, peak)
         e_mean = jnp.mean(energy, axis=-1)  # (C, M) average over frames
         total = jnp.sum(e_mean, axis=0)  # (M,) sum over cochlear channels
         num = jnp.sum(total[:4])
         den = jnp.sum(total[4:])
         return num / (den + 1e-12)
 
-    out = jax.vmap(one)(flat)
+    # SRMR is an eager, host-orchestrated metric (jittable=False) whose cost
+    # is FFTs over short signals; the experimental axon remote-TPU backend
+    # cannot compile parts of this chained FFT/Hilbert program
+    # (UNIMPLEMENTED), so the math runs pinned to the host CPU backend on
+    # every platform — deterministic and faster than per-op TPU dispatch.
+    try:
+        cpu = jax.local_devices(backend="cpu")[0]
+    except RuntimeError:
+        cpu = None
+    if cpu is not None and flat.devices() != {cpu}:
+        with jax.default_device(cpu):
+            out = jax.vmap(one)(jnp.asarray(np.asarray(flat)))
+    else:
+        out = jax.vmap(one)(flat)
     return out.reshape(shape[:-1]) if len(shape) > 1 else out[0]
