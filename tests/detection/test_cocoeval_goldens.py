@@ -1,0 +1,154 @@
+"""Analytic COCOeval goldens for crowd / ignore / truncation / tie semantics.
+
+Each scene is small enough that precision/recall can be derived on paper
+from the pycocotools algorithm (the reference's backend,
+``/root/reference/src/torchmetrics/detection/mean_ap.py:50-71``):
+
+- greedy matching in score order, each detection taking the best remaining
+  IoU >= t GT; a real (non-ignored) match is never traded for a crowd;
+- ``iscrowd`` GTs are ignore-only regions with IoU = inter / det_area; any
+  number of detections may overlap one, and all become IGNORED, not FP;
+- GTs outside the area range are ignored; detections matched to ignored GTs
+  are ignored; unmatched detections outside the range are ignored;
+- maxDets truncates each image's score-ordered detections BEFORE matching
+  statistics are accumulated;
+- score ties keep input order (stable mergesort).
+
+These pins are independent of the reference legacy pure-torch mAP (which
+has no crowd handling at all) — they check the algorithm itself.
+"""
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.functional.detection.coco_eval import (
+    evaluate_detections,
+    summarize,
+)
+
+T05 = np.asarray([0.5])
+FAR = [200.0, 200.0, 210.0, 210.0]  # overlaps nothing
+
+
+def _det(boxes, scores, labels=None):
+    boxes = np.asarray(boxes, np.float32).reshape(-1, 4)
+    return {
+        "boxes": boxes,
+        "scores": np.asarray(scores, np.float32),
+        "labels": np.asarray(labels if labels is not None else [1] * len(boxes)),
+    }
+
+
+def _gt(boxes, labels=None, iscrowd=None):
+    boxes = np.asarray(boxes, np.float32).reshape(-1, 4)
+    out = {
+        "boxes": boxes,
+        "labels": np.asarray(labels if labels is not None else [1] * len(boxes)),
+    }
+    if iscrowd is not None:
+        out["iscrowd"] = np.asarray(iscrowd)
+    return out
+
+
+def _eval(dets, gts, max_dets=(1, 10, 100)):
+    ev = evaluate_detections(dets, gts, iou_thresholds=T05, max_dets=max_dets)
+    return ev, summarize(ev)
+
+
+def test_crowd_absorbs_multiple_detections():
+    """One real TP + two detections inside a crowd region: the crowd GT is
+    not a target (npos=1), both crowd-overlapping detections are ignored
+    (not FP), so precision is 1 at every recall level -> AP = 1."""
+    g1 = [0.0, 0, 10, 10]
+    crowd = [100.0, 100, 140, 140]
+    dets = [_det([g1, [105.0, 105, 115, 115], [120.0, 120, 130, 130]], [0.9, 0.8, 0.7])]
+    gts = [_gt([g1, crowd], iscrowd=[0, 1])]
+    ev, summ = _eval(dets, gts)
+    assert float(summ["map"]) == pytest.approx(1.0)
+    # the crowd is not a recall target
+    assert float(ev["recall"][0, 0, 0, -1]) == pytest.approx(1.0)
+
+
+def test_crowd_without_real_match_is_ignored_not_fp():
+    """A detection below the IoU threshold on the real GT but inside a crowd
+    becomes ignored: no FP is recorded, the real GT stays unmatched ->
+    recall 0, precision all zeros -> AP = 0 (not -1: one GT exists)."""
+    g1 = [0.0, 0, 10, 10]
+    crowd = [0.0, 0, 60, 60]  # covers the detection fully -> crowd IoU = 1
+    # det overlaps g1 with IoU = 25/175 < 0.5, sits inside the crowd region
+    det_box = [5.0, 5, 20, 20]
+    dets = [_det([det_box], [0.9])]
+    gts = [_gt([g1, crowd], iscrowd=[0, 1])]
+    ev, summ = _eval(dets, gts)
+    assert float(summ["map"]) == pytest.approx(0.0)
+    assert float(ev["recall"][0, 0, 0, -1]) == pytest.approx(0.0)
+
+
+def test_area_range_ignore_semantics():
+    """A 10x10 GT (area 100, 'small') matched perfectly: AP_small = 1; in
+    the 'large' range both the GT and its matched detection are ignored ->
+    no targets, AP_large = -1 (pycocotools sentinel)."""
+    g1 = [0.0, 0, 10, 10]
+    dets = [_det([g1], [0.9])]
+    gts = [_gt([g1])]
+    _, summ = _eval(dets, gts)
+    assert float(summ["map_small"]) == pytest.approx(1.0)
+    assert float(summ["map_medium"]) == pytest.approx(-1.0)
+    assert float(summ["map_large"]) == pytest.approx(-1.0)
+    assert float(summ["map"]) == pytest.approx(1.0)
+
+
+def test_maxdets_truncation():
+    """Two high-scoring FPs ahead of the true match: maxDets=1 and 2 see
+    only FPs (recall 0); maxDets=3 reaches the TP at rank 3 -> the
+    interpolated precision is 1/3 at every recall threshold -> AP = 1/3."""
+    g1 = [0.0, 0, 10, 10]
+    dets = [_det([FAR, [220.0, 220, 230, 230], g1], [0.9, 0.8, 0.7])]
+    gts = [_gt([g1])]
+    ev, summ = _eval(dets, gts, max_dets=(1, 2, 3))
+    assert float(summ["mar_1"]) == pytest.approx(0.0)
+    assert float(summ["mar_2"]) == pytest.approx(0.0)
+    assert float(summ["mar_3"]) == pytest.approx(1.0)
+    # map uses the largest maxDet
+    assert float(summ["map"]) == pytest.approx(1.0 / 3.0)
+    precision = ev["precision"][0, :, 0, 0, -1]  # (R,) at IoU .5, area all
+    assert np.allclose(precision, 1.0 / 3.0)
+
+
+def test_score_tie_keeps_input_order():
+    """Equal scores resolve by stable sort (pycocotools mergesort): with the
+    FP listed first the TP lands at rank 2 -> AP = 0.5; with the TP listed
+    first -> AP = 1."""
+    g1 = [0.0, 0, 10, 10]
+    gts = [_gt([g1])]
+    _, summ_fp_first = _eval([_det([FAR, g1], [0.5, 0.5])], gts)
+    _, summ_tp_first = _eval([_det([g1, FAR], [0.5, 0.5])], gts)
+    assert float(summ_fp_first["map"]) == pytest.approx(0.5)
+    assert float(summ_tp_first["map"]) == pytest.approx(1.0)
+
+
+def test_real_match_wins_over_crowd():
+    """A detection overlapping a real GT above threshold AND a crowd region
+    must match the real GT (greedy matching never trades a real match for a
+    crowd): TP, AP = 1."""
+    g1 = [0.0, 0, 20, 20]
+    crowd = [0.0, 0, 60, 60]
+    dets = [_det([[0.0, 0, 20, 22]], [0.9])]  # IoU with g1 = 20*20/(20*22) ~ 0.909
+    gts = [_gt([g1, crowd], iscrowd=[0, 1])]
+    _, summ = _eval(dets, gts)
+    assert float(summ["map"]) == pytest.approx(1.0)
+
+
+def test_crowd_and_truncation_compose():
+    """maxDets truncation applies before crowd absorption: with maxDets=1
+    only the crowd-overlapping detection survives (ignored, no FP) and the
+    real GT is missed -> AP = 0; maxDets=2 adds the TP -> AP = 1 (the
+    ignored crowd det does not dent precision)."""
+    g1 = [0.0, 0, 10, 10]
+    crowd = [100.0, 100, 140, 140]
+    dets = [_det([[105.0, 105, 115, 115], g1], [0.9, 0.8])]
+    gts = [_gt([g1, crowd], iscrowd=[0, 1])]
+    _, summ = _eval(dets, gts, max_dets=(1, 2, 3))
+    # summarize's map uses maxDets[-1]=3: TP present, crowd det ignored
+    assert float(summ["map"]) == pytest.approx(1.0)
+    assert float(summ["mar_1"]) == pytest.approx(0.0)
+    assert float(summ["mar_2"]) == pytest.approx(1.0)

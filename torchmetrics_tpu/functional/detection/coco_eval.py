@@ -240,7 +240,6 @@ def evaluate_detections(
     for img_idx, cls, ious_full, scores_sel, crowd_sel, g_areas, d_areas in cell_meta:
         if ious_full is None:
             ious_full = next(iou_results)
-        ious_map[(img_idx, cls)] = ious_full
         stage_ious.append(ious_full)
         stage_scores.append(scores_sel)
         stage_dareas.append(d_areas)
@@ -262,20 +261,21 @@ def evaluate_detections(
     )
     # (cls, area) -> cells in image order (cell_meta iterates images in order)
     cells_by_key: Dict[Tuple[int, str], List[Tuple]] = {}
-    for (_img_idx, cls, _ious, scores_sel, *_rest), (order, matched, ignored, npos) in zip(
-        cell_meta, staged
+    for (img_idx, cls, _ious, scores_sel, *_rest), cell_ious, (order, matched, ignored, npos) in zip(
+        cell_meta, stage_ious, staged
     ):
+        # extended-summary convention follows pycocotools computeIoU: rows in
+        # score order, truncated to maxDets[-1] — exactly the staged `order`
+        # (one shared sort; the fancy indexing also detaches the block from
+        # the epoch-wide flat IoU buffer, so holding one matrix does not
+        # retain the whole epoch)
+        ious_map[(img_idx, cls)] = cell_ious[order]
         scores_sorted = scores_sel[order]
         for a, area in enumerate(area_keys):
             cells_by_key.setdefault((cls, area), []).append(
                 (matched[a], ignored[a], scores_sorted, int(npos[a])))
 
     out = accumulate(cells_by_key, classes, iou_thresholds, rec_thresholds, max_dets, area_keys)
-    if iou_flat is not None:
-        # bbox-path cells are views into one epoch-wide flat buffer; copy so
-        # a caller holding any single returned matrix doesn't keep the whole
-        # epoch's IoU memory alive (mask/RLE cells already own their data)
-        ious_map = {k: (np.array(v) if v.base is not None else v) for k, v in ious_map.items()}
     out["ious"] = ious_map
     out["classes"] = np.asarray(classes, np.int64)
     out["iou_thresholds"] = iou_thresholds
