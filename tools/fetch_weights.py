@@ -1,0 +1,141 @@
+"""One-command canonical pretrained-weight fetch + convert + verify.
+
+Usage (network required):
+
+    python tools/fetch_weights.py            # everything
+    python tools/fetch_weights.py fid lpips  # subset: fid | lpips | clip
+
+Downloads the canonical checkpoints the reference uses, verifies each file's
+sha256 against the pin embedded in its published filename, converts torch
+layouts to this package's flax pytrees, and stores npz artifacts in the
+weights cache (``$TM_TPU_WEIGHTS_DIR`` or ``~/.cache/torchmetrics_tpu``).
+After a successful run:
+
+- ``FrechetInceptionDistance(feature=2048)`` (and KID/MiFID/IS int-feature
+  ctors) build the canonical extractor automatically;
+- ``make_lpips(net_type, backbone="pretrained")`` loads the converted
+  torchvision backbone under the reference's trained heads;
+- ``CLIPScore("openai/clip-vit-base-patch16")`` resolves through the
+  transformers cache primed here.
+
+Certify with: ``python -m pytest tests/test_pretrained_weights.py -m weights``.
+
+Reference behavior being replaced: auto-download at metric construction
+(``/root/reference/src/torchmetrics/image/fid.py:44``, torch-fidelity URL;
+torchvision backbones for LPIPS; HF hub for CLIP).
+"""
+import hashlib
+import os
+import sys
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# Published filenames embed the first 8 hex chars of each file's sha256 —
+# the same pin torchvision/torch-fidelity verify on download.
+FID_URL = (
+    "https://github.com/toshas/torch-fidelity/releases/download/v0.2.0/"
+    "weights-inception-2015-12-05-6726825d.pth"
+)
+TORCHVISION_URLS = {
+    "alex": "https://download.pytorch.org/models/alexnet-owt-7be5be79.pth",
+    "vgg": "https://download.pytorch.org/models/vgg16-397923af.pth",
+    "squeeze": "https://download.pytorch.org/models/squeezenet1_1-b8a52dc0.pth",
+}
+CLIP_MODEL = "openai/clip-vit-base-patch16"
+
+
+def _cache_dir() -> str:
+    from torchmetrics_tpu.models.pretrained import weights_dir
+
+    path = weights_dir()
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def _sha256(path: str) -> str:
+    digest = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            digest.update(chunk)
+    return digest.hexdigest()
+
+
+def _download(url: str) -> str:
+    """Download to the cache (idempotent) and verify the filename hash pin."""
+    name = url.rsplit("/", 1)[-1]
+    dest = os.path.join(_cache_dir(), name)
+    if not os.path.exists(dest):
+        print(f"downloading {url}")
+        tmp = dest + ".part"
+        urllib.request.urlretrieve(url, tmp)
+        os.replace(tmp, dest)
+    pin = name.rsplit("-", 1)[-1].split(".")[0]
+    digest = _sha256(dest)
+    if len(pin) == 8 and all(c in "0123456789abcdef" for c in pin) and not digest.startswith(pin):
+        os.remove(dest)  # keep the cache clean so a retry re-downloads
+        raise RuntimeError(f"checksum mismatch for {name}: sha256 {digest} does not start with pinned {pin}")
+    print(f"verified {name} (sha256 {digest[:16]}...)")
+    return dest
+
+
+def fetch_fid() -> None:
+    import numpy as np
+    import torch
+
+    from torchmetrics_tpu.models.inception import convert_torch_state_dict
+    from torchmetrics_tpu.models.pretrained import FID_NPZ, flatten_pytree
+
+    pth = _download(FID_URL)
+    state = torch.load(pth, map_location="cpu", weights_only=True)
+    variables = convert_torch_state_dict({k: v.numpy() for k, v in state.items()})
+    out = os.path.join(_cache_dir(), FID_NPZ)
+    np.savez_compressed(out, **flatten_pytree(variables))
+    print("wrote", out)
+
+
+def fetch_lpips() -> None:
+    import numpy as np
+    import torch
+
+    from torchmetrics_tpu.models.lpips import convert_lpips_torch, lpips_head_params
+    from torchmetrics_tpu.models.pretrained import LPIPS_NPZ, flatten_pytree
+
+    for net, url in TORCHVISION_URLS.items():
+        pth = _download(url)
+        state = {k: v.numpy() for k, v in torch.load(pth, map_location="cpu", weights_only=True).items()}
+        # torchvision checkpoints carry classifier tensors too; the trunks
+        # only consume the `features.` convs (squeezenet's classifier is a
+        # 4-D conv that must not be mistaken for a trunk kernel)
+        if any(k.startswith("features.") for k in state):
+            state = {k: v for k, v in state.items() if k.startswith("features.")}
+        params = convert_lpips_torch(state, {}, net_type=net)
+        inner = dict(params["params"])
+        inner.update(lpips_head_params(net))  # vendored reference heads
+        out = os.path.join(_cache_dir(), LPIPS_NPZ.format(net=net))
+        np.savez_compressed(out, **flatten_pytree({"params": inner}))
+        print("wrote", out)
+
+
+def fetch_clip() -> None:
+    from transformers import AutoProcessor, FlaxCLIPModel
+
+    FlaxCLIPModel.from_pretrained(CLIP_MODEL)
+    AutoProcessor.from_pretrained(CLIP_MODEL)
+    print(f"primed transformers cache for {CLIP_MODEL}")
+
+
+def main() -> None:
+    targets = sys.argv[1:] or ["fid", "lpips", "clip"]
+    fns = {"fid": fetch_fid, "lpips": fetch_lpips, "clip": fetch_clip}
+    unknown = [t for t in targets if t not in fns]
+    if unknown:
+        raise SystemExit(f"unknown targets {unknown}; choose from {sorted(fns)}")
+    for target in targets:
+        fns[target]()
+    print("done — certify with: python -m pytest tests/test_pretrained_weights.py -m weights")
+
+
+if __name__ == "__main__":
+    main()

@@ -262,16 +262,17 @@ class PerceptualEvaluationSpeechQuality(_MeanAudioMetric):
     backend is still preferred automatically when installed
     (``implementation="auto"``).
 
-    Example:
+    Example (tones inside the narrow-band 300-3100 Hz telephone band — the
+    P.862 input filter removes anything below it):
         >>> import jax.numpy as jnp
         >>> from torchmetrics_tpu import PerceptualEvaluationSpeechQuality
         >>> metric = PerceptualEvaluationSpeechQuality(fs=8000, mode="nb", implementation="native")
-        >>> t = jnp.linspace(0.0, 100.0, 4096)
-        >>> target = jnp.sin(t)
-        >>> preds = target + 0.1 * jnp.cos(3.0 * t)
+        >>> t = jnp.arange(8000) / 8000.0
+        >>> target = jnp.sin(2 * jnp.pi * 440.0 * t)
+        >>> preds = target + 0.1 * jnp.sin(2 * jnp.pi * 1320.0 * t)
         >>> metric.update(preds, target)
-        >>> round(float(metric.compute()), 4)
-        2.4043
+        >>> round(float(metric.compute()), 2)
+        2.96
     """
 
     is_differentiable = False

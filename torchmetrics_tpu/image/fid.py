@@ -47,16 +47,21 @@ def _compute_fid(mu1: Array, sigma1: Array, mu2: Array, sigma2: Array) -> Array:
     return jnp.dot(diff, diff, precision=_HI) + jnp.trace(sigma1) + jnp.trace(sigma2) - 2.0 * tr_covmean
 
 
-def _resolve_feature_extractor(feature: Union[int, Callable], metric_name: str) -> Callable:
+def _resolve_feature_extractor(feature: Union[int, str, Callable], metric_name: str) -> Callable:
     if callable(feature):
         return feature
-    if isinstance(feature, int):
+    if isinstance(feature, (int, str)):  # tap id: 64/192/768/2048 or 'logits_unbiased'
+        from ..models.pretrained import fid_inception_extractor, weights_dir
+
+        extractor = fid_inception_extractor(feature)
+        if extractor is not None:
+            return extractor
         raise ModuleNotFoundError(
-            f"Metric `{metric_name}` with `feature={feature}` requires the pretrained FID-InceptionV3 weights, "
-            "which are not available in this offline environment. Build the architecture with "
-            "`torchmetrics_tpu.models.make_fid_inception(feature)` and load converted weights via "
-            "`torchmetrics_tpu.models.convert_torch_state_dict(...)`, or pass any callable mapping "
-            "(N, C, H, W) images to (N, D) features as `feature=`."
+            f"Metric `{metric_name}` with `feature={feature!r}` requires the pretrained FID-InceptionV3 weights, "
+            f"which were not found in the weights cache ({weights_dir()}). On a machine with network access run "
+            "`python tools/fetch_weights.py fid` once (download + checksum + convert; the reference "
+            "auto-downloads the same torch-fidelity checkpoint at construction). Alternatively pass any "
+            "callable mapping (N, C, H, W) images to (N, D) features as `feature=`."
         )
     raise TypeError(f"Got unknown input to argument `feature`: {feature}")
 

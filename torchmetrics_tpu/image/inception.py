@@ -52,11 +52,6 @@ class InceptionScore(Metric):
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        if isinstance(feature, (str, int)):
-            raise ModuleNotFoundError(
-                "InceptionScore with the pretrained InceptionV3 requires downloaded weights, which are not "
-                "available in this offline environment. Pass a callable mapping images to class logits."
-            )
         self.inception = _resolve_feature_extractor(feature, "InceptionScore")
         if not (isinstance(splits, int) and splits > 0):
             raise ValueError("Integer input to argument `splits` must be larger than 0")

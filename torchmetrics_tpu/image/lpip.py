@@ -64,11 +64,17 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
             valid_net_type = ("vgg", "alex", "squeeze")
             if net_type not in valid_net_type:
                 raise ValueError(f"Argument `net_type` must be one of {valid_net_type}, but got {net_type}.")
-            raise ModuleNotFoundError(
-                f"LPIPS with the pretrained `{net_type}` backbone requires torchvision weights that cannot be "
-                "downloaded in this offline environment. Pass a callable `(img1, img2) -> distances` instead "
-                "(see torchmetrics_tpu.models.lpips for the network definition and weight conversion)."
-            )
+            from ..models.lpips import make_lpips
+            from ..models.pretrained import lpips_params, weights_dir
+
+            if lpips_params(net_type) is None:
+                raise ModuleNotFoundError(
+                    f"LPIPS with the pretrained `{net_type}` backbone requires the converted torchvision "
+                    f"weights, which were not found in the weights cache ({weights_dir()}). On a machine "
+                    "with network access run `python tools/fetch_weights.py lpips` once, or pass a callable "
+                    "`(img1, img2) -> distances` (see torchmetrics_tpu.models.lpips)."
+                )
+            _, _, net_type = make_lpips(net_type, backbone="pretrained")
         if not callable(net_type):
             raise ValueError("Argument `net_type` must be a string preset or a callable")
         self.net = net_type

@@ -180,29 +180,49 @@ def lpips_head_params(net_type: str = "alex") -> Dict:
     return heads
 
 
-def make_lpips(net_type: str = "alex", rng_seed: int = 0, pretrained_heads: bool = True):
+def make_lpips(net_type: str = "alex", rng_seed: int = 0, pretrained_heads: bool = True,
+               backbone: str = "auto"):
     """(module, params, distance_fn); ``distance_fn(x, y)`` maps two
     (N, 3, H, W) [-1, 1] image batches to (N,) distances — directly usable as
     the ``net_type=`` callable of ``LearnedPerceptualImagePatchSimilarity``.
 
-    The backbone is random-init (torchvision's ImageNet weights are not
-    fetchable offline); ``pretrained_heads=True`` overlays the reference's
-    trained NetLinLayer weights from :func:`lpips_head_params`.
+    ``backbone``: ``"auto"`` loads the converted canonical torchvision
+    weights from the cache when ``tools/fetch_weights.py lpips`` has run
+    (reference-comparable distances) and falls back to random init with a
+    warning otherwise; ``"pretrained"`` requires the cache; ``"random"``
+    never consults it. ``pretrained_heads=True`` overlays the reference's
+    trained NetLinLayer weights from :func:`lpips_head_params` (random
+    backbones only; the cached artifact already contains the heads).
     """
+    if backbone not in ("auto", "pretrained", "random"):
+        raise ValueError(f"`backbone` must be 'auto', 'pretrained' or 'random', got {backbone!r}")
     mod = LPIPSNet(net_type=net_type)
-    params = mod.init(jax.random.PRNGKey(rng_seed), jnp.zeros((1, 3, 64, 64)), jnp.zeros((1, 3, 64, 64)))
-    if pretrained_heads:
-        warnings.warn(
-            "make_lpips: trained LPIPS heads are overlaid on a RANDOM-init backbone;"
-            " distances are self-consistent but not comparable to reference LPIPS"
-            " until converted torchvision backbone weights are loaded via"
-            " convert_lpips_torch().",
-            UserWarning,
-            stacklevel=2,
-        )
-        inner = dict(params["params"])
-        inner.update(lpips_head_params(net_type))
-        params = {"params": inner}
+    params = None
+    if backbone in ("auto", "pretrained"):
+        from .pretrained import lpips_params, weights_dir
+
+        loaded = lpips_params(net_type)
+        if loaded is not None:
+            params = jax.tree.map(jnp.asarray, loaded)
+        elif backbone == "pretrained":
+            raise FileNotFoundError(
+                f"make_lpips(backbone='pretrained'): no converted {net_type!r} backbone in the weights "
+                f"cache ({weights_dir()}); run `python tools/fetch_weights.py lpips` on a networked machine."
+            )
+    if params is None:
+        params = mod.init(jax.random.PRNGKey(rng_seed), jnp.zeros((1, 3, 64, 64)), jnp.zeros((1, 3, 64, 64)))
+        if pretrained_heads:
+            warnings.warn(
+                "make_lpips: trained LPIPS heads are overlaid on a RANDOM-init backbone;"
+                " distances are self-consistent but not comparable to reference LPIPS."
+                " Run `python tools/fetch_weights.py lpips` once (networked) to cache the"
+                " canonical torchvision backbone weights.",
+                UserWarning,
+                stacklevel=2,
+            )
+            inner = dict(params["params"])
+            inner.update(lpips_head_params(net_type))
+            params = {"params": inner}
 
     @jax.jit
     def distance(x: Array, y: Array) -> Array:

@@ -1,0 +1,86 @@
+"""Canonical pretrained-weight cache: loaders for artifacts produced by
+``tools/fetch_weights.py``.
+
+The reference auto-downloads FID-InceptionV3 weights at construction
+(``/root/reference/src/torchmetrics/image/fid.py:44``) and LPIPS backbones
+via torchvision. This build separates concerns: ``tools/fetch_weights.py``
+downloads + checksum-verifies + converts once (network required), and these
+loaders read the converted npz artifacts from the cache so metric
+construction stays offline-deterministic. Cache location:
+``$TM_TPU_WEIGHTS_DIR`` or ``~/.cache/torchmetrics_tpu``.
+"""
+import os
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+FID_NPZ = "fid_inception_v3.npz"
+LPIPS_NPZ = "lpips_{net}.npz"
+
+
+def weights_dir() -> str:
+    return os.environ.get(
+        "TM_TPU_WEIGHTS_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "torchmetrics_tpu"),
+    )
+
+
+def flatten_pytree(tree: Dict, prefix: str = "") -> Dict[str, np.ndarray]:
+    """'/'-joined flat dict of array leaves (npz-serializable)."""
+    out: Dict[str, np.ndarray] = {}
+    for key, value in tree.items():
+        path = f"{prefix}/{key}" if prefix else str(key)
+        if isinstance(value, dict):
+            out.update(flatten_pytree(value, path))
+        else:
+            out[path] = np.asarray(value)
+    return out
+
+
+def unflatten_pytree(flat: Dict[str, np.ndarray]) -> Dict:
+    tree: Dict = {}
+    for path, value in flat.items():
+        node = tree
+        parts = path.split("/")
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = value
+    return tree
+
+
+def _load_npz_tree(name: str) -> Optional[Dict]:
+    path = os.path.join(weights_dir(), name)
+    if not os.path.exists(path):
+        return None
+    with np.load(path) as data:
+        return unflatten_pytree({k: data[k] for k in data.files})
+
+
+def fid_inception_extractor(features: Any) -> Optional[Callable]:
+    """Canonical FID-InceptionV3 extractor from the cached converted
+    weights, or None when the cache is absent. ``features`` is a single tap
+    id: 64/192/768/2048 or 'logits_unbiased'."""
+    if isinstance(features, (tuple, list)):
+        raise ValueError("fid_inception_extractor takes a single tap id, not a list")
+    variables = _load_npz_tree(FID_NPZ)
+    if variables is None:
+        return None
+    import jax
+    import jax.numpy as jnp
+
+    from .inception import FIDInceptionV3
+
+    mod = FIDInceptionV3(features_list=(features,))
+    variables = jax.tree.map(jnp.asarray, variables)
+
+    @jax.jit
+    def extract(imgs):
+        return mod.apply(variables, imgs)[features]
+
+    return extract
+
+
+def lpips_params(net_type: str) -> Optional[Dict]:
+    """Converted torchvision-backbone + reference-head LPIPS params pytree
+    from the cache, or None when absent."""
+    return _load_npz_tree(LPIPS_NPZ.format(net=net_type))
