@@ -108,6 +108,30 @@ def test_inception_score_resolves_from_cache(tmp_path, monkeypatch):
 
 
 def test_lpips_class_resolves_from_cache(tmp_path, monkeypatch):
+    monkeypatch.setenv("TM_TPU_WEIGHTS_DIR", str(tmp_path))
+    _write_mirror_alex_cache(str(tmp_path))
+    from torchmetrics_tpu.image.lpip import LearnedPerceptualImagePatchSimilarity
+
+    metric = LearnedPerceptualImagePatchSimilarity(net_type="alex")
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(2, 3, 64, 64).astype(np.float32) * 2 - 1)
+    metric.update(x, x)
+    assert float(metric.compute()) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_fid_invalid_tap_rejected_up_front(tmp_path, monkeypatch):
+    monkeypatch.setenv("TM_TPU_WEIGHTS_DIR", str(tmp_path))
+    from torchmetrics_tpu import FrechetInceptionDistance, InceptionScore
+
+    with pytest.raises(ValueError, match="must be one of"):
+        FrechetInceptionDistance(feature=1024)
+    with pytest.raises(ValueError, match="must be one of"):
+        InceptionScore(feature="logits_unbiassed")
+
+
+def _write_mirror_alex_cache(cache_dir: str) -> dict:
+    """Random torchvision-layout alex state dict -> converted npz in the
+    cache, exactly as tools/fetch_weights.py would; returns the state."""
     from torchmetrics_tpu.models.lpips import convert_lpips_torch, lpips_head_params
 
     rng = np.random.RandomState(0)
@@ -118,39 +142,21 @@ def test_lpips_class_resolves_from_cache(tmp_path, monkeypatch):
         state[f"features.{i}.bias"] = rng.randn(cout).astype(np.float32) * 0.01
     inner = dict(convert_lpips_torch(state, {}, net_type="alex")["params"])
     inner.update(lpips_head_params("alex"))
-    monkeypatch.setenv("TM_TPU_WEIGHTS_DIR", str(tmp_path))
     np.savez_compressed(
-        os.path.join(str(tmp_path), PT.LPIPS_NPZ.format(net="alex")),
+        os.path.join(cache_dir, PT.LPIPS_NPZ.format(net="alex")),
         **PT.flatten_pytree({"params": inner}),
     )
-    from torchmetrics_tpu.image.lpip import LearnedPerceptualImagePatchSimilarity
-
-    metric = LearnedPerceptualImagePatchSimilarity(net_type="alex")
-    x = jnp.asarray(rng.rand(2, 3, 64, 64).astype(np.float32) * 2 - 1)
-    metric.update(x, x)
-    assert float(metric.compute()) == pytest.approx(0.0, abs=1e-6)
+    return state
 
 
 def test_lpips_pipeline_offline_with_mirror_backbone(tmp_path, monkeypatch):
     """A random torchvision-layout alex state dict flows through the tool's
     convert+cache path and make_lpips(backbone='pretrained') loads it."""
-    from torchmetrics_tpu.models.lpips import convert_lpips_torch, lpips_head_params, make_lpips
-
-    rng = np.random.RandomState(0)
-    cfg = ((3, 64, 11), (64, 192, 5), (192, 384, 3), (384, 256, 3), (256, 256, 3))
-    state = {}
-    for i, (cin, cout, k) in enumerate(cfg):
-        state[f"features.{i}.weight"] = rng.randn(cout, cin, k, k).astype(np.float32) * 0.01
-        state[f"features.{i}.bias"] = rng.randn(cout).astype(np.float32) * 0.01
-    params = convert_lpips_torch(state, {}, net_type="alex")
-    inner = dict(params["params"])
-    inner.update(lpips_head_params("alex"))
+    from torchmetrics_tpu.models.lpips import make_lpips
 
     monkeypatch.setenv("TM_TPU_WEIGHTS_DIR", str(tmp_path))
-    np.savez_compressed(
-        os.path.join(str(tmp_path), PT.LPIPS_NPZ.format(net="alex")),
-        **PT.flatten_pytree({"params": inner}),
-    )
+    state = _write_mirror_alex_cache(str(tmp_path))
+    rng = np.random.RandomState(3)
     _, loaded, distance = make_lpips("alex", backbone="pretrained")
     kern = np.asarray(loaded["params"]["net"]["conv0"]["kernel"])
     np.testing.assert_allclose(kern, state["features.0.weight"].transpose(2, 3, 1, 0))

@@ -282,3 +282,93 @@ def test_pearson_corrcoef(tpu_device, cpu_device):
     got = run_on(tpu_device, pearson_corrcoef, _f32(x), _f32(y))
     oracle = run_on(cpu_device, pearson_corrcoef, _f64(x), _f64(y))
     assert rel_err(got, oracle) < 1e-4
+
+
+# ------------------------------------------- exact-mode curve engines (r4)
+
+def test_exact_auroc_and_average_precision(tpu_device, cpu_device):
+    """Exact (thresholds=None) curve engines: traced filled-curve path on
+    the chip vs the same computation at f64 on CPU."""
+    from torchmetrics_tpu.functional.classification import (
+        binary_auroc,
+        binary_average_precision,
+    )
+
+    n = 20000
+    preds = RNG.random(n).astype(np.float32)
+    target = RNG.integers(0, 2, n)
+    for name, fn, tol in (
+        ("auroc", lambda p, t: binary_auroc(p, t, thresholds=None), 1e-5),
+        ("ap", lambda p, t: binary_average_precision(p, t, thresholds=None), 1e-5),
+    ):
+        got = run_on(tpu_device, fn, _f32(preds), jnp.asarray(target, jnp.int32))
+        oracle = run_on(cpu_device, fn, _f64(preds), jnp.asarray(target, jnp.int32))
+        assert rel_err(got, oracle) < tol, f"exact {name}: rel_err={rel_err(got, oracle):.2e}"
+
+
+# ---------------------------------------------------- batched retrieval (r4)
+
+def test_retrieval_batched_kernels(tpu_device, cpu_device):
+    """Dense (Q, L) one-program retrieval kernels on chip vs CPU-f64."""
+    from torchmetrics_tpu.functional.retrieval._ops import (
+        batched_average_precision,
+        batched_ndcg,
+        batched_reciprocal_rank,
+    )
+
+    q, l = 64, 128
+    preds = RNG.random((q, l)).astype(np.float32)
+    target = (RNG.random((q, l)) > 0.7).astype(np.int32)
+    lens = RNG.integers(l // 2, l + 1, q)
+    mask = (np.arange(l)[None, :] < lens[:, None])
+    for name, fn in (
+        ("map", batched_average_precision),
+        ("mrr", batched_reciprocal_rank),
+        ("ndcg", batched_ndcg),
+    ):
+        call = lambda p, t, m: fn(p, t, m)
+        got = run_on(tpu_device, call, _f32(preds), jnp.asarray(target), jnp.asarray(mask))
+        oracle = run_on(cpu_device, call, _f64(preds), jnp.asarray(target), jnp.asarray(mask))
+        assert rel_err(got, oracle) < 1e-5, f"retrieval {name}: rel_err={rel_err(got, oracle):.2e}"
+
+
+# ------------------------------------------------ PIT host-callback (r4)
+
+def test_pit_host_callback_path(tpu_device, cpu_device):
+    """spk>3 PIT routes through the C++ Jonker-Volgenant host callback —
+    must work with TPU-resident arrays and match the CPU run exactly."""
+    from torchmetrics_tpu.functional.audio import (
+        permutation_invariant_training,
+        scale_invariant_signal_noise_ratio,
+    )
+
+    b, spk, t = 2, 4, 1024
+    preds = RNG.standard_normal((b, spk, t)).astype(np.float32)
+    perm = RNG.permutation(spk)
+    target = preds[:, perm] + 0.05 * RNG.standard_normal((b, spk, t)).astype(np.float32)
+    fn = lambda p, tg: permutation_invariant_training(p, tg, scale_invariant_signal_noise_ratio)
+    got_val, got_perm = run_on(tpu_device, fn, _f32(preds), _f32(target))
+    ora_val, ora_perm = run_on(cpu_device, fn, _f32(preds), _f32(target))
+    np.testing.assert_array_equal(np.asarray(got_perm), np.asarray(ora_perm))
+    assert rel_err(got_val, ora_val) < 1e-4
+
+
+# ----------------------------------------------------- panoptic quality (r4)
+
+def test_panoptic_quality_from_device_arrays(tpu_device, cpu_device):
+    """Panoptic matching is host-side by design; it must accept TPU-resident
+    (category, instance) maps and agree with the CPU run bit-exactly."""
+    from torchmetrics_tpu.functional.detection.panoptic_quality import panoptic_quality
+
+    h = w = 64
+    cats = RNG.integers(0, 3, (1, h, w))
+    inst = RNG.integers(0, 4, (1, h, w))
+    pred = np.stack([cats, inst], axis=-1).astype(np.int32)
+    cats_t = cats.copy()
+    flip = RNG.random((1, h, w)) < 0.1
+    cats_t[flip] = (cats_t[flip] + 1) % 3
+    targ = np.stack([cats_t, inst], axis=-1).astype(np.int32)
+    fn = lambda p, t: panoptic_quality(p, t, things={0, 1}, stuffs={2})
+    got = run_on(tpu_device, fn, jnp.asarray(pred), jnp.asarray(targ))
+    oracle = run_on(cpu_device, fn, jnp.asarray(pred), jnp.asarray(targ))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(oracle), atol=1e-12)

@@ -51,6 +51,11 @@ def _resolve_feature_extractor(feature: Union[int, str, Callable], metric_name: 
     if callable(feature):
         return feature
     if isinstance(feature, (int, str)):  # tap id: 64/192/768/2048 or 'logits_unbiased'
+        valid = (64, 192, 768, 2048, 1008, "logits_unbiased")
+        if feature not in valid:
+            raise ValueError(
+                f"Integer input to argument `feature` must be one of {valid}, but got {feature!r}"
+            )
         from ..models.pretrained import fid_inception_extractor, weights_dir
 
         extractor = fid_inception_extractor(feature)
