@@ -489,30 +489,37 @@ def bench_auroc_exact() -> dict:
     target = jnp.asarray(rng.randint(0, 2, n), jnp.int32)
 
     jax.block_until_ready(EJ.binary_auroc_exact(preds, target))  # compile
-    # per-rep block: the eager baseline is synchronous per compute, so the
-    # jit side must not amortize dispatch RTT across pipelined reps
+    # fresh HOST data per rep (transfer excluded from the timed region):
+    # derived salted inputs (preds + c) were observed to hit the remote
+    # layer's memoization in child processes — r3/r4 initially reported a
+    # physically impossible 28-37k computes/s (the roofline's >700x of HBM
+    # peak exposed it); host-fresh buffers measure the real ~120 ms sort
+    fresh = [jnp.asarray((rng.rand(n) + _SALT_BASE).astype(np.float32)) for _ in range(5)]
+    jax.block_until_ready(fresh)
     jit_times = []
-    for r in range(5):
-        p_r = preds + jnp.float32(_SALT_BASE * (r + 1) * 1e-3)
+    for p_r in fresh:
         t0 = time.perf_counter()
-        jax.block_until_ready(EJ.binary_auroc_exact(p_r, target))
+        # pull the scalar to host: on the remote-TPU layer block_until_ready
+        # alone has been observed to return before the program finishes
+        float(EJ.binary_auroc_exact(p_r, target))
         jit_times.append(time.perf_counter() - t0)
     jit_s = sorted(jit_times)[len(jit_times) // 2]
 
-    # eager baseline: warmed and salted like every other rep (identical
-    # dispatches are memoized across runs by the remote-TPU layer)
+    # eager baseline: warmed, fresh host data per rep as above
     jax.block_until_ready(_binary_auroc_compute((preds, target), None, None))
+    fresh_e = [jnp.asarray((rng.rand(n) + _SALT_BASE).astype(np.float32)) for _ in range(3)]
+    jax.block_until_ready(fresh_e)
     eager_times = []
-    for r in range(3):
-        p_r = preds + jnp.float32(_SALT_BASE * (r + 11) * 1e-3)
+    for p_r in fresh_e:
         t0 = time.perf_counter()
-        jax.block_until_ready(_binary_auroc_compute((p_r, target), None, None))
+        float(jnp.asarray(_binary_auroc_compute((p_r, target), None, None)).reshape(()))
         eager_times.append(time.perf_counter() - t0)
     eager_s = sorted(eager_times)[1]
 
     return {"value": round(1.0 / jit_s, 2), "unit": "computes/s (exact AUROC, N=1e6)",
             "vs_baseline": round(eager_s / jit_s, 3),
-            "note": "vs_baseline = eager dynamic-shape exact compute on the same device (median of 3 salted reps)",
+            "note": "vs_baseline = eager dynamic-shape exact compute on the same device "
+                    "(median of 3 fresh-host-data reps, result pulled to host)",
             "roofline": _roofline(jax.jit(EJ.binary_auroc_exact), (preds, target), 1.0 / jit_s)}
 
 

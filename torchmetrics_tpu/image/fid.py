@@ -54,7 +54,7 @@ def _resolve_feature_extractor(feature: Union[int, str, Callable], metric_name: 
         valid = (64, 192, 768, 2048, 1008, "logits_unbiased")
         if feature not in valid:
             raise ValueError(
-                f"Integer input to argument `feature` must be one of {valid}, but got {feature!r}"
+                f"Input to argument `feature` must be one of {valid}, but got {feature!r}"
             )
         from ..models.pretrained import fid_inception_extractor, weights_dir
 
