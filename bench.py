@@ -644,7 +644,10 @@ def bench_bootstrap() -> dict:
         for i in range(steps):
             boot.update(preds[i] + jnp.float32(salt), target[i])
         out = boot.compute()
-        jax.block_until_ready(out)
+        # sync on the ARRAY states too, then pull the scalar result: scalar
+        # block_until_ready alone can return early on the remote layer
+        jax.block_until_ready(boot._stacked if boot._vmap_path else [m.metric_state for m in boot.metrics])
+        float(out["mean"])
         return steps / (time.perf_counter() - t0)
 
     fast = run(make(loop=False), _SALT_BASE)
