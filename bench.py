@@ -846,9 +846,10 @@ def main() -> None:
             rep_cap = int(2 * result.get("_child_s", 300) + 60)
             second = _run_child(name, timeout=min(_remaining_timeout(), rep_cap), retries=0)
             if second.get(metric_key):
-                lo, hi = sorted([abs(result[metric_key]), abs(second[metric_key])])
-                result[f"rep2_{metric_key}"] = second[metric_key]
-                result["spread_pct"] = round(100.0 * (hi - lo) / hi, 2) if hi else None
+                a, b = result[metric_key], second[metric_key]
+                denom = max(abs(a), abs(b))
+                result[f"rep2_{metric_key}"] = b
+                result["spread_pct"] = round(100.0 * abs(a - b) / denom, 2) if denom else None
         result.pop("_child_s", None)  # budget bookkeeping, not a metric
         extra[name] = result
     extra["methodology"] = {

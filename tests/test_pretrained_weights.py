@@ -119,6 +119,21 @@ def test_lpips_class_resolves_from_cache(tmp_path, monkeypatch):
     assert float(metric.compute()) == pytest.approx(0.0, abs=1e-6)
 
 
+def test_ppl_string_simnet_resolves_from_cache(tmp_path, monkeypatch):
+    """Reference-parity sim_net strings for PPL: resolve via the weights
+    cache, raise with fetch-tool guidance otherwise."""
+    monkeypatch.setenv("TM_TPU_WEIGHTS_DIR", str(tmp_path))
+    from torchmetrics_tpu.image.perceptual_path_length import PerceptualPathLength
+
+    with pytest.raises(ModuleNotFoundError, match="fetch_weights"):
+        PerceptualPathLength(distance_fn="alex", num_samples=4, batch_size=2)
+    with pytest.raises(ValueError, match="one of"):
+        PerceptualPathLength(distance_fn="resnet")
+    _write_mirror_alex_cache(str(tmp_path))
+    ppl = PerceptualPathLength(distance_fn="alex", num_samples=4, batch_size=2, resize=None)
+    assert callable(ppl.distance_fn)
+
+
 def test_fid_invalid_tap_rejected_up_front(tmp_path, monkeypatch):
     monkeypatch.setenv("TM_TPU_WEIGHTS_DIR", str(tmp_path))
     from torchmetrics_tpu import FrechetInceptionDistance, InceptionScore

@@ -11,7 +11,7 @@ TPU note: the generator and distance network run as ordinary jitted JAX
 calls; the driver loop stays on host (data-dependent batch count), matching
 the reference's host-side batching at ``perceptual_path_length.py:236-252``.
 """
-from typing import Any, Callable, Optional, Tuple
+from typing import Any, Callable, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -53,7 +53,7 @@ def _interpolate(latents1: Array, latents2: Array, epsilon: float, interpolation
 
 def perceptual_path_length(
     generator: Any,
-    distance_fn: Callable[[Array, Array], Array],
+    distance_fn: Union[str, Callable[[Array, Array], Array]] = "vgg",
     num_samples: int = 10_000,
     conditional: bool = False,
     batch_size: int = 64,
@@ -74,6 +74,9 @@ def perceptual_path_length(
     ``resize`` bilinearly resizes generated images to ``(resize, resize)``
     before the distance (the reference threads it into its LPIPS net).
     """
+    from ...models.lpips import resolve_pretrained_distance
+
+    distance_fn = resolve_pretrained_distance(distance_fn, "perceptual_path_length", "distance_fn")
     if not hasattr(generator, "sample"):
         raise NotImplementedError(
             "The generator must have a `sample` method returning latents (GeneratorType protocol)."

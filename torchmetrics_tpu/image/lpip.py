@@ -60,25 +60,9 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        if isinstance(net_type, str):
-            valid_net_type = ("vgg", "alex", "squeeze")
-            if net_type not in valid_net_type:
-                raise ValueError(f"Argument `net_type` must be one of {valid_net_type}, but got {net_type}.")
-            from ..models.lpips import make_lpips
-            from ..models.pretrained import weights_dir
+        from ..models.lpips import resolve_pretrained_distance
 
-            try:
-                _, _, net_type = make_lpips(net_type, backbone="pretrained")
-            except FileNotFoundError:
-                raise ModuleNotFoundError(
-                    f"LPIPS with the pretrained `{net_type}` backbone requires the converted torchvision "
-                    f"weights, which were not found in the weights cache ({weights_dir()}). On a machine "
-                    "with network access run `python tools/fetch_weights.py lpips` once, or pass a callable "
-                    "`(img1, img2) -> distances` (see torchmetrics_tpu.models.lpips)."
-                ) from None
-        if not callable(net_type):
-            raise ValueError("Argument `net_type` must be a string preset or a callable")
-        self.net = net_type
+        self.net = resolve_pretrained_distance(net_type, "LPIPS", "net_type")
         valid_reduction = ("mean", "sum")
         if reduction not in valid_reduction:
             raise ValueError(f"Argument `reduction` must be one of {valid_reduction}, but got {reduction}")

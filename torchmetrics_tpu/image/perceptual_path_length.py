@@ -5,7 +5,7 @@ Parity: reference
 (``GeneratorType`` protocol, latent interpolation lerp/slerp, LPIPS distance
 between epsilon-jittered latent pairs).
 """
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Optional, Union
 
 import jax
 
@@ -50,12 +50,17 @@ class PerceptualPathLength(Metric):
     full_state_update = False
     jittable = False
 
-    def __init__(self, distance_fn: Callable, num_samples: int = 10_000, conditional: bool = False,
+    def __init__(self, distance_fn: Union[str, Callable] = "vgg", num_samples: int = 10_000,
+                 conditional: bool = False,
                  batch_size: int = 128, interpolation_method: str = "lerp", epsilon: float = 1e-4,
                  resize: Optional[int] = 64, lower_discard: Optional[float] = 0.01,
                  upper_discard: Optional[float] = 0.99, **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        self.distance_fn = distance_fn
+        from ..models.lpips import resolve_pretrained_distance
+
+        # reference parity: `sim_net` strings resolve to a pretrained LPIPS
+        # from the weights cache (tools/fetch_weights.py); callables as-is
+        self.distance_fn = resolve_pretrained_distance(distance_fn, type(self).__name__, "distance_fn")
         self.num_samples = num_samples
         self.conditional = conditional
         self.batch_size = batch_size

@@ -231,6 +231,33 @@ def make_lpips(net_type: str = "alex", rng_seed: int = 0, pretrained_heads: bool
     return mod, params, distance
 
 
+def resolve_pretrained_distance(net_or_fn, metric_name: str, arg_name: str):
+    """Shared string→pretrained-LPIPS resolution for metric ctors.
+
+    Callables pass through; 'alex'/'vgg'/'squeeze' load the converted
+    canonical backbone from the weights cache, raising one consistent
+    fetch-tool-guidance error when it is absent."""
+    if callable(net_or_fn):
+        return net_or_fn
+    if isinstance(net_or_fn, str):
+        valid = ("vgg", "alex", "squeeze")
+        if net_or_fn not in valid:
+            raise ValueError(f"Argument `{arg_name}` must be one of {valid} or a callable, but got {net_or_fn!r}.")
+        from .pretrained import weights_dir
+
+        try:
+            _, _, distance = make_lpips(net_or_fn, backbone="pretrained")
+        except FileNotFoundError:
+            raise ModuleNotFoundError(
+                f"{metric_name} with the pretrained `{net_or_fn}` LPIPS net requires the converted "
+                f"torchvision weights, which were not found in the weights cache ({weights_dir()}). On a "
+                "machine with network access run `python tools/fetch_weights.py lpips` once, or pass a "
+                f"callable `(img1, img2) -> distances` as `{arg_name}`."
+            ) from None
+        return distance
+    raise ValueError(f"Argument `{arg_name}` must be a string preset or a callable")
+
+
 _EXPECTED_CONVS = {"alex": 5, "vgg": 13, "squeeze": 1 + 3 * len(_SQUEEZE_FIRES)}
 
 
