@@ -246,13 +246,28 @@ def test_hostsync_timeout_raises_instead_of_hanging(monkeypatch):
     monkeypatch.setattr(multihost_utils, "process_allgather", stalled_gather)
     hs = HostSync(timeout_s=0.5)
     t0 = time.monotonic()
+    from torchmetrics_tpu.parallel import sync as sync_mod
     from torchmetrics_tpu.parallel.reduction import Reduction
 
-    with pytest.raises(TimeoutError, match="stalled or dead"):
-        hs.sync_tensor(jnp.asarray([1.0]), Reduction.SUM)
-    assert time.monotonic() - t0 < 5.0
-    with pytest.raises(TimeoutError, match="stalled or dead"):
-        hs.all_gather_object({"a": 1})
+    sync_mod.clear_poison()
+    try:
+        with pytest.raises(TimeoutError, match="stalled or dead"):
+            hs.sync_tensor(jnp.asarray([1.0]), Reduction.SUM)
+        assert time.monotonic() - t0 < 5.0
+        # the timed-out collective may still be in flight: EVERY further
+        # gather in this process (any HostSync instance) must refuse to run
+        # rather than pair with it and silently desequence (ADVICE r4)
+        with pytest.raises(RuntimeError, match="poisoned"):
+            hs.all_gather_object({"a": 1})
+        with pytest.raises(RuntimeError, match="poisoned"):
+            HostSync().sync_tensor(jnp.asarray([1.0]), Reduction.SUM)
+        # clear_poison() re-arms (caller's contract: only after jax.distributed
+        # re-init) — the next gather runs again and times out afresh
+        sync_mod.clear_poison()
+        with pytest.raises(TimeoutError, match="stalled or dead"):
+            hs.sync_tensor(jnp.asarray([1.0]), Reduction.SUM)
+    finally:
+        sync_mod.clear_poison()
 
 
 def test_failed_sync_leaves_local_state_intact(monkeypatch):
@@ -269,19 +284,25 @@ def test_failed_sync_leaves_local_state_intact(monkeypatch):
         return value
 
     monkeypatch.setattr(multihost_utils, "process_allgather", stalled_gather)
-    hs = HostSync(timeout_s=0.3)
-    monkeypatch.setattr(hs, "is_available", lambda: True)
-    m = CatMetric(sync_backend=hs)
-    m.update(jnp.asarray([1.0, 2.0]))
-    with pytest.raises(TimeoutError):
-        m.sync()
-    assert not m._is_synced
-    assert m._cache is None
-    # local state is untouched and still usable
-    np.testing.assert_array_equal(np.asarray(jnp.concatenate(m.metric_state["value"])), [1.0, 2.0])
-    m.update(jnp.asarray([3.0]))
-    m._sync_backend = None  # back to NoSync
-    np.testing.assert_array_equal(np.asarray(m.compute()), [1.0, 2.0, 3.0])
+    from torchmetrics_tpu.parallel import sync as sync_mod
+
+    sync_mod.clear_poison()
+    try:
+        hs = HostSync(timeout_s=0.3)
+        monkeypatch.setattr(hs, "is_available", lambda: True)
+        m = CatMetric(sync_backend=hs)
+        m.update(jnp.asarray([1.0, 2.0]))
+        with pytest.raises(TimeoutError):
+            m.sync()
+        assert not m._is_synced
+        assert m._cache is None
+        # local state is untouched and still usable
+        np.testing.assert_array_equal(np.asarray(jnp.concatenate(m.metric_state["value"])), [1.0, 2.0])
+        m.update(jnp.asarray([3.0]))
+        m._sync_backend = None  # back to NoSync
+        np.testing.assert_array_equal(np.asarray(m.compute()), [1.0, 2.0, 3.0])
+    finally:
+        sync_mod.clear_poison()
 
 
 def test_hostsync_timeout_validation():

@@ -11,15 +11,29 @@ build owns the pipeline (Falk et al., 2010):
 3. 8-band modulation filterbank (2nd-order bandpass, Q=2, centers 4-128 Hz
    log-spaced — 4-30 Hz under ``norm``) on the envelopes, also
    frequency-domain;
-4. 256 ms / 64 ms framed modulation energies, optionally clamped to a 30 dB
-   dynamic range (``norm=True``, reference ``_normalize_energy``);
-5. SRMR = energy(modulation bands 1-4) / energy(bands 5-8).
+4. 256 ms / 64 ms Hamming-windowed framed modulation energies, optionally
+   clamped to a 30 dB dynamic range (``norm=True``, reference
+   ``_normalize_energy``);
+5. SRMR = energy(modulation bands 1-4) / energy(bands 5..k*), where k* is
+   the adaptive truncation from the 90%-cumulative-energy cochlear
+   bandwidth vs the modulation filters' 3 dB left cutoffs (reference
+   ``_cal_srmr_score``).
 
 ``fast=True`` swaps stage 1-2 for a 10 ms / 2.5 ms gammatonegram (400 Hz
 envelope rate, SRMRpy ``fft_gtgram`` analogue): the modulation filterbank
 then runs on a ~fs/400x shorter envelope. Everything after input validation
 is one jittable jnp program per signal length; filter frequency responses
-are host-precomputed constants.
+are host-precomputed constants. Concrete (non-tracer) inputs are pinned to
+the host CPU backend (the axon remote-TPU backend cannot compile this FFT
+chain); tracer inputs compose under jit/vmap on the caller's backend.
+
+Known divergence from the reference pipeline: the modulation filterbank is
+applied as analog 2nd-order bandpass magnitudes in the frequency domain,
+not as the reference's bilinear-transformed IIR ``lfilter`` — phase-free
+band energies instead of sequential recursion (TPU-hostile). Band-energy
+goldens are therefore self-consistency pins, not reference numbers; the
+energy normalization, Hamming framing, and k* truncation do follow the
+reference algorithm.
 """
 from functools import lru_cache
 from typing import Optional, Tuple
@@ -83,6 +97,16 @@ def _modulation_response(fs_env: int, n_fft: int, min_cf: float, max_cf: float, 
         den = np.sqrt((w0**2 - w**2) ** 2 + (w0 * w / q) ** 2)
         resp.append(num / den)
     return np.stack(resp)
+
+
+@lru_cache(maxsize=16)
+def _modulation_left_cutoffs(fs_env: int, min_cf: float, max_cf: float, n_mod: int) -> np.ndarray:
+    """3 dB left cutoff of each modulation bandpass (reference
+    ``_calc_cutoffs``: prewarped ``b0 = tan(w0/2)/q``, ``ll = cf - b0*fs/2pi``)."""
+    centers = np.exp(np.linspace(np.log(min_cf), np.log(max_cf), n_mod))
+    w0 = 2 * np.pi * centers / fs_env
+    b0 = np.tan(w0 / 2.0) / 2.0
+    return centers - b0 * fs_env / (2 * np.pi)
 
 
 @lru_cache(maxsize=16)
@@ -155,6 +179,13 @@ def speech_reverberation_modulation_energy_ratio(
         )
     n_fft_env = int(2 ** np.ceil(np.log2(2 * n_env)))
     mod_resp = _modulation_response(mfs, n_fft_env, float(min_cf), float(max_cf), N_MOD)
+    mod_ll = _modulation_left_cutoffs(mfs, float(min_cf), float(max_cf), N_MOD)
+    # ERB bandwidths of the (ascending-cf) cochlear channels, for the
+    # 90%-energy bandwidth -> k* denominator truncation
+    erbs = _erb(_gammatone_freqs(fs, float(low_freq), int(n_cochlear_filters)))
+    # matches reference `hamming_window(w+1)[:-1]` with torch's default
+    # periodic=True: 0.54 - 0.46*cos(2*pi*n/(w+1)) for n = 0..w-1
+    ham = 0.54 - 0.46 * np.cos(2 * np.pi * np.arange(win) / (win + 1))
 
     def envelopes(sig: Array) -> Array:
         """(C, T_env) temporal envelopes of the cochlear bands."""
@@ -184,10 +215,10 @@ def speech_reverberation_modulation_energy_ratio(
         mod = jnp.fft.irfft(
             ef[:, None, :] * jnp.asarray(mod_resp)[None, :, :], n_fft_env, axis=-1
         )[..., :n_env]  # (C, M, T_env)
-        # framed energies
+        # Hamming-windowed framed energies (reference srmr.py:294,303)
         n_frames = max((n_env - win) // hop + 1, 1)
         idx = jnp.arange(win)[None, :] + hop * jnp.arange(n_frames)[:, None]
-        frames = mod[..., idx]  # (C, M, S, W)
+        frames = mod[..., idx] * jnp.asarray(ham, jnp.float32)  # (C, M, S, W)
         energy = jnp.sum(frames**2, axis=-1)  # (C, M, S)
         if norm:
             # 30 dB dynamic range below the peak of the cochlear-mean energy
@@ -196,23 +227,39 @@ def speech_reverberation_modulation_energy_ratio(
             floor = peak * 10.0 ** (-NORM_DRANGE_DB / 10.0)
             energy = jnp.clip(energy, floor, peak)
         e_mean = jnp.mean(energy, axis=-1)  # (C, M) average over frames
+        # adaptive denominator truncation (reference `_cal_srmr_score`):
+        # 90%-cumulative-energy bandwidth over ascending-cf channels -> the
+        # ERB of that channel -> k* from the modulation filters' left
+        # cutoffs. Trace-safe monotone count instead of the elif chain; a
+        # bw below ll[4] saturates at k*=5 (the reference raises there).
+        ac = jnp.sum(e_mean, axis=1)  # (C,) per-channel energy
+        perc_cum = jnp.cumsum(100.0 * ac / (jnp.sum(ac) + 1e-12))
+        k90 = jnp.argmax(perc_cum > 90.0)
+        bw = jnp.asarray(erbs, jnp.float32)[k90]
+        kstar = 5 + jnp.sum(jnp.asarray(mod_ll[5:], jnp.float32) <= bw)
         total = jnp.sum(e_mean, axis=0)  # (M,) sum over cochlear channels
         num = jnp.sum(total[:4])
-        den = jnp.sum(total[4:])
+        den_mask = jnp.arange(N_MOD) < kstar
+        den = jnp.sum(jnp.where(den_mask[4:], total[4:], 0.0))
         return num / (den + 1e-12)
 
     # SRMR is an eager, host-orchestrated metric (jittable=False) whose cost
     # is FFTs over short signals; the experimental axon remote-TPU backend
     # cannot compile parts of this chained FFT/Hilbert program
-    # (UNIMPLEMENTED), so the math runs pinned to the host CPU backend on
-    # every platform — deterministic and faster than per-op TPU dispatch.
-    try:
-        cpu = jax.local_devices(backend="cpu")[0]
-    except RuntimeError:
-        cpu = None
-    if cpu is not None and flat.devices() != {cpu}:
-        with jax.default_device(cpu):
-            out = jax.vmap(one)(jnp.asarray(np.asarray(flat)))
-    else:
+    # (UNIMPLEMENTED), so for CONCRETE inputs the math runs pinned to the
+    # host CPU backend — deterministic and faster than per-op TPU dispatch.
+    # Tracers (jit/vmap composition) skip the pin: device placement is the
+    # caller's choice there, and .devices()/np.asarray would not trace.
+    if isinstance(flat, jax.core.Tracer):
         out = jax.vmap(one)(flat)
+    else:
+        try:
+            cpu = jax.local_devices(backend="cpu")[0]
+        except RuntimeError:
+            cpu = None
+        if cpu is not None and flat.devices() != {cpu}:
+            with jax.default_device(cpu):
+                out = jax.vmap(one)(jnp.asarray(np.asarray(flat)))
+        else:
+            out = jax.vmap(one)(flat)
     return out.reshape(shape[:-1]) if len(shape) > 1 else out[0]

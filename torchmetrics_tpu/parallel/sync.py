@@ -33,6 +33,23 @@ from .reduction import Reduction
 Array = jax.Array
 StateDict = Dict[str, Any]
 
+# Process-global poison flag: set when a HostSync gather times out (the
+# leaked worker's collective may still complete later and pair with any new
+# collective from this process). Cleared only by clear_poison(), to be
+# called after jax.distributed has been torn down and re-initialized.
+_POISONED = False
+
+
+def clear_poison() -> None:
+    """Re-arm :class:`HostSync` after a gather timeout.
+
+    Call ONLY after tearing down and re-initializing ``jax.distributed`` —
+    clearing the flag while the timed-out collective is still in flight
+    re-exposes the silent-desequencing hazard the poison exists to prevent.
+    """
+    global _POISONED
+    _POISONED = False
+
 
 # ---------------------------------------------------------------------------
 # In-graph (SPMD) collectives — the hot path on TPU
@@ -177,11 +194,25 @@ class HostSync(SyncBackend):
 
         The gather blocks inside the runtime, so it cannot be interrupted;
         with ``timeout_s`` set it runs on a worker thread and the caller
-        raises once the deadline passes (the worker is leaked — the process
-        is expected to tear down / re-initialize after this error).
+        raises once the deadline passes. The worker is leaked and its
+        collective may still complete later, so a timeout POISONS this
+        process's backend: every further HostSync gather raises until
+        :func:`clear_poison` is called after ``jax.distributed`` has been
+        torn down and re-initialized — otherwise a new collective could
+        pair with the stale in-flight one and silently desequence all
+        following collectives (wrong merged states, no error).
         """
         from jax.experimental import multihost_utils
 
+        global _POISONED
+        if _POISONED:
+            raise RuntimeError(
+                "HostSync is poisoned by an earlier gather timeout: the timed-out "
+                "collective may still be in flight, and issuing another would race "
+                "it and silently corrupt every later collective. Tear down and "
+                "re-initialize jax.distributed, then call "
+                "torchmetrics_tpu.parallel.sync.clear_poison()."
+            )
         if self.timeout_s is None:
             return multihost_utils.process_allgather(value)
         import threading
@@ -199,13 +230,14 @@ class HostSync(SyncBackend):
         t.start()
         t.join(self.timeout_s)
         if t.is_alive():
+            _POISONED = True
             raise TimeoutError(
                 f"HostSync gather did not complete within {self.timeout_s}s — a peer "
                 f"process is likely stalled or dead (world_size={self.world_size()}). "
                 "Local metric state is intact: checkpoint it, then tear down and "
                 "re-initialize jax.distributed before syncing again (the timed-out "
-                "collective may still be in flight, so retrying in this process "
-                "would race it)."
+                "collective may still be in flight, so further HostSync gathers in "
+                "this process raise until clear_poison() is called)."
             )
         if err:
             raise err[0]
