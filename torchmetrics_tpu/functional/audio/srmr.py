@@ -48,6 +48,7 @@ N_GT = 23
 MOD_CENTERS_LO = 4.0
 MOD_CENTERS_HI = 128.0
 N_MOD = 8
+Q_MOD = 2.0  # modulation bandpass Q — shared by the responses AND the k* cutoffs
 NORM_DRANGE_DB = 30.0  # `norm=True` energy dynamic range (reference srmr.py:147-160)
 GTGRAM_WIN_S = 0.010  # `fast=True` gammatonegram window / hop (SRMRpy fft_gtgram)
 GTGRAM_HOP_S = 0.0025  # -> 400 Hz envelope rate
@@ -87,7 +88,7 @@ def _modulation_response(fs_env: int, n_fft: int, min_cf: float, max_cf: float, 
     """(n_mod, n_fft//2+1) 2nd-order bandpass (Q=2) magnitude responses."""
     centers = np.exp(np.linspace(np.log(min_cf), np.log(max_cf), n_mod))
     f = np.fft.rfftfreq(n_fft, 1.0 / fs_env)
-    q = 2.0
+    q = Q_MOD
     resp = []
     for fc in centers:
         # analog 2nd-order bandpass |H(jw)| = (w0/Q w) / sqrt((w0^2-w^2)^2 + (w0 w/Q)^2)
@@ -105,7 +106,7 @@ def _modulation_left_cutoffs(fs_env: int, min_cf: float, max_cf: float, n_mod: i
     ``_calc_cutoffs``: prewarped ``b0 = tan(w0/2)/q``, ``ll = cf - b0*fs/2pi``)."""
     centers = np.exp(np.linspace(np.log(min_cf), np.log(max_cf), n_mod))
     w0 = 2 * np.pi * centers / fs_env
-    b0 = np.tan(w0 / 2.0) / 2.0
+    b0 = np.tan(w0 / 2.0) / Q_MOD
     return centers - b0 * fs_env / (2 * np.pi)
 
 
