@@ -25,9 +25,12 @@ def _batches(n_batches=3, n=16, seed=123):
 
 def test_vmap_path_selected_for_jittable_multinomial():
     assert BootStrapper(MeanSquaredError(), sampling_strategy="multinomial")._vmap_path
-    assert not BootStrapper(MeanSquaredError(), sampling_strategy="poisson")._vmap_path
+    # poisson (the default) takes the WEIGHT fast path for pure-SUM bases (r5)
+    assert BootStrapper(MeanSquaredError(), sampling_strategy="poisson")._poisson_weight_path
     # warn-mode CatMetric filters eagerly (not trace-safe) -> loop path
     assert not BootStrapper(CatMetric(), sampling_strategy="multinomial")._vmap_path
+    # cat/list states cannot ride the weight contraction
+    assert not BootStrapper(CatMetric(), sampling_strategy="poisson")._poisson_weight_path
 
 
 def test_multinomial_vmap_matches_manual_replay():
@@ -164,9 +167,11 @@ def test_none_reduction_base_takes_loop_path():
 
 
 def test_poisson_loop_is_eager_no_retrace_hazard():
-    """Poisson copies run eagerly (``_use_jit=False``): distinct resample
-    lengths must not populate per-copy jit caches."""
+    """Replay-path poisson copies run eagerly (``_use_jit=False``): distinct
+    resample lengths must not populate per-copy jit caches."""
     boot = BootStrapper(MeanSquaredError(), num_bootstraps=4, sampling_strategy="poisson", seed=0)
+    boot._vmap_path = boot._poisson_weight_path = False
+    boot._make_replay_metrics()
     for p, t in _batches(n_batches=5, n=32):
         boot.update(jnp.asarray(p), jnp.asarray(t))
     for m in boot.metrics:
@@ -174,3 +179,176 @@ def test_poisson_loop_is_eager_no_retrace_hazard():
         assert len(m._jit_cache) == 0
     out = boot.compute()
     assert np.isfinite(float(out["mean"]))
+
+
+# ---------------------------------------------------------------------------
+# poisson weight fast path (round 5 — the DEFAULT sampling strategy)
+# ---------------------------------------------------------------------------
+
+def _poisson_pair(base_fn, B=6, seed=3):
+    """(fast, replay) wrappers over the same base and RandomState stream."""
+    fast = BootStrapper(base_fn(), num_bootstraps=B, sampling_strategy="poisson", seed=seed, raw=True)
+    slow = BootStrapper(base_fn(), num_bootstraps=B, sampling_strategy="poisson", seed=seed, raw=True)
+    slow._vmap_path = slow._poisson_weight_path = False
+    slow._make_replay_metrics()
+    return fast, slow
+
+
+def test_poisson_weight_path_matches_replay_loop():
+    """The (B, N) Poisson-weight contraction must reproduce the replay
+    loop's per-replica results (same RandomState stream, draw-then-expand)."""
+    fast, slow = _poisson_pair(MeanSquaredError)
+    assert fast._poisson_weight_path
+    for p, t in _batches(n_batches=4, n=24):
+        fast.update(jnp.asarray(p), jnp.asarray(t))
+        slow.update(jnp.asarray(p), jnp.asarray(t))
+    of, os_ = fast.compute(), slow.compute()
+    np.testing.assert_allclose(np.asarray(of["raw"]), np.asarray(os_["raw"]), rtol=1e-5)
+    np.testing.assert_allclose(float(of["mean"]), float(os_["mean"]), rtol=1e-5)
+    np.testing.assert_allclose(float(of["std"]), float(os_["std"]), rtol=1e-4)
+
+
+def test_poisson_weight_path_classification_base():
+    from torchmetrics_tpu.classification import MulticlassF1Score
+
+    fast, slow = _poisson_pair(
+        lambda: MulticlassF1Score(num_classes=5, average="macro", validate_args=False)
+    )
+    assert fast._poisson_weight_path
+    rng = np.random.RandomState(0)
+    for _ in range(3):
+        p = jnp.asarray(rng.rand(32, 5).astype(np.float32))
+        t = jnp.asarray(rng.randint(0, 5, 32))
+        fast.update(p, t)
+        slow.update(p, t)
+    np.testing.assert_allclose(
+        np.asarray(fast.compute()["raw"]), np.asarray(slow.compute()["raw"]), rtol=1e-5
+    )
+
+
+def test_poisson_weight_path_single_compile():
+    """trace_count must stay 1 across batches of the same shape — the
+    VERDICT r4 acceptance criterion for the default strategy."""
+    boot = BootStrapper(
+        MulticlassAccuracy(num_classes=4, validate_args=False),
+        num_bootstraps=8, sampling_strategy="poisson", seed=0,
+    )
+    rng = np.random.RandomState(1)
+    for _ in range(10):
+        boot.update(jnp.asarray(rng.rand(16, 4).astype(np.float32)), jnp.asarray(rng.randint(0, 4, 16)))
+    assert boot._poisson_weight_path
+    assert boot.trace_count == 1
+    assert np.isfinite(float(boot.compute()["mean"]))
+
+
+def test_poisson_non_additive_base_falls_back_to_replay():
+    """A pure-SUM state whose update is NOT sample-additive (adds the batch
+    max) must fail the first-update additivity check and fall back to the
+    replay loop with an untouched RandomState stream — results bit-match a
+    replay-only wrapper."""
+    from torchmetrics_tpu.metric import Metric
+
+    class BatchMaxSum(Metric):
+        jittable = True
+
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+        def update(self, x):
+            self.total = self.total + jnp.max(x)
+
+        def compute(self):
+            return self.total
+
+    boot = BootStrapper(BatchMaxSum(), num_bootstraps=5, sampling_strategy="poisson", seed=0)
+    assert boot._poisson_weight_path  # statically eligible...
+    oracle = BootStrapper(BatchMaxSum(), num_bootstraps=5, sampling_strategy="poisson", seed=0)
+    oracle._vmap_path = oracle._poisson_weight_path = False
+    oracle._make_replay_metrics()
+    rng = np.random.RandomState(2)
+    for _ in range(3):
+        x = jnp.asarray(rng.rand(16).astype(np.float32))
+        boot.update(x)
+        oracle.update(x)
+    assert not boot._poisson_weight_path  # ...but dynamically rejected
+    np.testing.assert_allclose(float(boot.compute()["mean"]), float(oracle.compute()["mean"]), rtol=1e-6)
+
+
+def test_poisson_non_additive_caught_even_on_single_sample_first_batch():
+    """The additivity check doubles the batch, so repetition-nonlinearity is
+    caught even when the first update has batch size 1 (a plain
+    batch-reconstruction check is vacuous there)."""
+    from torchmetrics_tpu.metric import Metric
+
+    class BatchMaxSum(Metric):
+        jittable = True
+
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+        def update(self, x):
+            self.total = self.total + jnp.max(x)
+
+        def compute(self):
+            return self.total
+
+    boot = BootStrapper(BatchMaxSum(), num_bootstraps=4, sampling_strategy="poisson", seed=0)
+    boot.update(jnp.asarray([2.5]))  # single-sample first batch
+    assert not boot._poisson_weight_path  # still rejected
+    oracle = BootStrapper(BatchMaxSum(), num_bootstraps=4, sampling_strategy="poisson", seed=0)
+    oracle._vmap_path = oracle._poisson_weight_path = False
+    oracle._make_replay_metrics()
+    oracle.update(jnp.asarray([2.5]))
+    np.testing.assert_allclose(float(boot.compute()["mean"]), float(oracle.compute()["mean"]), rtol=1e-6)
+
+
+def test_poisson_kwargs_only_update():
+    """Keyword-only batches must resample on both the fast path and the
+    replay loop (the loop's size probe also counts kwargs arrays)."""
+    fast, slow = _poisson_pair(MeanSquaredError, seed=5)
+    for p, t in _batches(n_batches=3, n=16):
+        fast.update(preds=jnp.asarray(p), target=jnp.asarray(t))
+        slow.update(preds=jnp.asarray(p), target=jnp.asarray(t))
+    of, os_ = fast.compute(), slow.compute()
+    assert float(os_["mean"]) > 0  # the loop actually updated
+    np.testing.assert_allclose(np.asarray(of["raw"]), np.asarray(os_["raw"]), rtol=1e-5)
+
+
+def test_poisson_weight_path_pickle_roundtrip():
+    import pickle
+
+    boot = BootStrapper(MeanSquaredError(), num_bootstraps=4, sampling_strategy="poisson", seed=0)
+    for p, t in _batches(n_batches=2, n=16):
+        boot.update(jnp.asarray(p), jnp.asarray(t))
+    clone = pickle.loads(pickle.dumps(boot))
+    np.testing.assert_allclose(float(clone.compute()["mean"]), float(boot.compute()["mean"]), rtol=1e-6)
+    # the restored wrapper keeps updating on the fast path
+    clone.update(jnp.asarray(np.ones(16, np.float32)), jnp.asarray(np.zeros(16, np.float32)))
+    assert clone._poisson_weight_path
+    assert np.isfinite(float(clone.compute()["mean"]))
+
+
+def test_poisson_weight_path_reset():
+    """reset() must clear the stacked state and keep the fast path live;
+    post-reset results must match a replay oracle whose RandomState is set
+    to the SAME stream position (reset clears state, not the stream)."""
+    fast, _ = _poisson_pair(MeanSquaredError, seed=11)
+    for p, t in _batches(n_batches=2, n=16):
+        fast.update(jnp.asarray(p), jnp.asarray(t))
+    fast.reset()
+    assert fast._stacked is None
+    assert fast._poisson_weight_path
+    oracle = BootStrapper(
+        MeanSquaredError(), num_bootstraps=6, sampling_strategy="poisson", seed=0, raw=True
+    )
+    oracle._vmap_path = oracle._poisson_weight_path = False
+    oracle._make_replay_metrics()
+    oracle._rng.set_state(fast._rng.get_state())
+    p, t = _batches(n_batches=1, n=16, seed=55)[0]
+    fast.update(jnp.asarray(p), jnp.asarray(t))
+    oracle.update(jnp.asarray(p), jnp.asarray(t))
+    np.testing.assert_allclose(
+        np.asarray(fast.compute()["raw"]), np.asarray(oracle.compute()["raw"]), rtol=1e-5
+    )

@@ -13,14 +13,24 @@ bit-identical for a given seed) and advances all replicas in a single jitted
 across batches, and the N resampled updates run as one batched XLA program
 on the MXU instead of N Python dispatches.
 
-Poisson resampling cannot ride the static-shape gather: each replica's total
-sample count is itself random (``sum_i Poisson(1)``), and a fixed-length
-gather always feeds exactly L samples, so no gather-only realization can
-reproduce the count distribution (e.g. ``SumMetric``'s state after one
-update would be deterministic where the reference's is random). Poisson
-therefore keeps the per-copy replay semantics, with the former retrace
-hazard removed: copies run their updates eagerly (op-by-op) instead of
-re-jitting per distinct resample length.
+Poisson resampling (the default) cannot ride the static-shape gather: each
+replica's total sample count is itself random (``sum_i Poisson(1)``), and a
+fixed-length gather always feeds exactly L samples, so no gather-only
+realization can reproduce the count distribution. It rides a *weight*
+formulation instead (round 5): for bases whose states all reduce by SUM and
+whose update is sample-additive — ``update(state, batch) = state + Σ_i
+delta(sample_i)``, true of stat-score/confusion/histogram/sum-style states
+— repeating sample i ``p`` times contributes ``p · delta_i`` exactly. Each
+update computes per-sample deltas ONCE via a vmapped one-sample
+``_pure_update`` (shared by all replicas, unlike the gather path's B×N
+resampled updates) and contracts them with the host-drawn ``(B, N)``
+Poisson count matrix on the MXU; the ``rng.poisson(1, (B, N))`` draw fills
+row-major, bit-identical to the replay loop's B sequential draws, so the
+RandomState stream stays bit-compatible. Sample-additivity is VERIFIED on
+the first update (batched state vs reconstructed Σ delta, before any RNG is
+consumed); a mismatch or trace failure falls back permanently to the
+per-copy replay loop, run eagerly so resample-length changes cannot
+retrace.
 """
 from copy import deepcopy
 from typing import Any, Dict, Optional, Sequence, Union
@@ -56,10 +66,13 @@ class BootStrapper(WrapperMetric):
     raw over the replicas. Resampling indices come from host numpy driven by
     ``seed`` (deterministic); the metric math runs on device.
 
-    Jittable base metrics with ``sampling_strategy="multinomial"`` take the
-    vmap fast path: one stacked state pytree, one jitted vmapped update for
-    all replicas (see module docstring). Other combinations replay updates
-    per replica copy, matching the reference design.
+    Jittable base metrics take a stacked fast path: ``"multinomial"`` runs
+    one jitted vmapped update over a ``(B, N)`` resample-index matrix;
+    ``"poisson"`` (the default) contracts once-computed per-sample state
+    deltas with a ``(B, N)`` Poisson count matrix (valid for pure-SUM
+    sample-additive states, verified on the first update — see module
+    docstring). Other combinations replay updates per replica copy,
+    matching the reference design.
 
     Example:
         >>> import jax.numpy as jnp
@@ -111,30 +124,44 @@ class BootStrapper(WrapperMetric):
         # Pearson moment merges — take the replay loop instead)
         from ..parallel.reduction import Reduction
 
-        self._vmap_path = (
-            bool(getattr(base_metric, "jittable", False))
-            and bool(getattr(base_metric, "_use_jit", False))
-            and sampling_strategy == "multinomial"
-            and all(
+        traceable = bool(getattr(base_metric, "jittable", False)) and bool(
+            getattr(base_metric, "_use_jit", False)
+        )
+        if sampling_strategy == "multinomial":
+            self._vmap_path = traceable and all(
                 not callable(r) and r != Reduction.NONE
                 for r in base_metric._reductions.values()
             )
-        )
+            self._poisson_weight_path = False
+        else:
+            # poisson: weight formulation needs every state to be a pure-SUM
+            # tensor state (sample-additivity is then verified at runtime on
+            # the first update — see _poisson_vmap_update)
+            self._poisson_weight_path = traceable and not base_metric._list_states and all(
+                r == Reduction.SUM for r in base_metric._reductions.values()
+            )
+            self._vmap_path = self._poisson_weight_path
         # how many times the stacked update body was traced (== XLA compiles
         # triggered by this wrapper); asserted to stay at 1 across batches
         self.trace_count = 0
         self._stacked_update_fn = None
         self._stacked_compute_fn = None
+        self._poisson_update_fn = None
+        self._additivity_verified = False
         self._stacked: Optional[Dict[str, Any]] = None  # vmap path state
         if self._vmap_path:
             self.metrics: list = []
         else:
-            self.metrics = [deepcopy(base_metric) for _ in range(num_bootstraps)]
-            if sampling_strategy == "poisson":
-                # poisson resample lengths differ per (copy, batch); jitted
-                # per-copy updates would recompile for every distinct length
-                for m in self.metrics:
-                    m._use_jit = False
+            self._make_replay_metrics()
+
+    def _make_replay_metrics(self) -> None:
+        """Per-copy replay path (the reference design)."""
+        self.metrics = [deepcopy(self.base_metric) for _ in range(self.num_bootstraps)]
+        if self.sampling_strategy == "poisson":
+            # poisson resample lengths differ per (copy, batch); jitted
+            # per-copy updates would recompile for every distinct length
+            for m in self.metrics:
+                m._use_jit = False
 
     # ------------------------------------------------------------------
     # vmap fast path
@@ -184,7 +211,136 @@ class BootStrapper(WrapperMetric):
         state = super().__getstate__()
         state["_stacked_update_fn"] = None  # jitted closures: not picklable
         state["_stacked_compute_fn"] = None
+        state["_poisson_update_fn"] = None
         return state
+
+    # ------------------------------------------------------------------
+    # poisson weight path (default sampling strategy)
+    # ------------------------------------------------------------------
+    def _delta_machinery(self, arr_args, arr_kwargs, static_args, static_kwargs):
+        """(init_state, per_sample): the default tensor state and the
+        one-sample delta closure — shared by the jitted weight update and
+        the first-batch additivity verifier so they can never drift."""
+        base = self.base_metric
+        init = {}
+        for k, v in base._defaults.items():
+            arr = jnp.asarray(v)
+            init[k] = jax.lax.convert_element_type(arr, arr.dtype)
+
+        def per_sample(i):
+            it_a = iter(arr_args)
+            g_args = tuple(
+                jax.lax.dynamic_slice_in_dim(next(it_a), i, 1, axis=0) if is_arr else a
+                for a, is_arr in static_args
+            )
+            g_kwargs = {
+                k: (jax.lax.dynamic_slice_in_dim(arr_kwargs[k], i, 1, axis=0) if k in arr_kwargs else v)
+                for k, v in static_kwargs
+            }
+            new_t, _ = base._pure_update(init, g_args, dict(g_kwargs))
+            return {k: new_t[k] - init[k] for k in new_t}
+
+        return init, per_sample
+
+    def _get_poisson_update(self):
+        if self._poisson_update_fn is None:
+
+            def poisson_update(tensors, weights, arr_args, arr_kwargs, static_args, static_kwargs):
+                self.trace_count += 1  # runs once per trace, not per call
+                _, per_sample = self._delta_machinery(arr_args, arr_kwargs, static_args, static_kwargs)
+                n = weights.shape[1]
+                deltas = jax.vmap(per_sample)(jnp.arange(n))  # {k: (N, ...state)}
+                return {
+                    k: tensors[k]
+                    + jnp.tensordot(weights.astype(deltas[k].dtype), deltas[k], axes=(1, 0)).astype(
+                        tensors[k].dtype
+                    )
+                    for k in tensors
+                }
+
+            self._poisson_update_fn = jax.jit(poisson_update, static_argnums=(4, 5))
+        return self._poisson_update_fn
+
+    @staticmethod
+    def _prep_batch(args: tuple, kwargs: dict):
+        """(size, static_args, arr_args, arr_kwargs, static_kwargs): the
+        traced-payload / static-structure partition shared by both stacked
+        fast paths."""
+        arrs = [a for a in args if isinstance(a, _ARRAY_TYPES)]
+        arrs += [v for v in kwargs.values() if isinstance(v, _ARRAY_TYPES)]
+        size = arrs[0].shape[0] if arrs else 0
+        static_args = tuple(
+            (None, True) if isinstance(a, _ARRAY_TYPES) else (a, False) for a in args
+        )
+        arr_args = tuple(jnp.asarray(a) for a in args if isinstance(a, _ARRAY_TYPES))
+        arr_kwargs = {k: jnp.asarray(v) for k, v in kwargs.items() if isinstance(v, _ARRAY_TYPES)}
+        static_kwargs = tuple(
+            (k, None if isinstance(v, _ARRAY_TYPES) else v) for k, v in sorted(kwargs.items())
+        )
+        return size, static_args, arr_args, arr_kwargs, static_kwargs
+
+    def _poisson_vmap_update(self, *args: Any, **kwargs: Any) -> None:
+        base = self.base_metric
+        args = tuple(base._to_array(a) for a in args)
+        kwargs = {k: base._to_array(v) for k, v in kwargs.items()}
+        base._eager_validate(*args, **kwargs)
+        size, static_args, arr_args, arr_kwargs, static_kwargs = self._prep_batch(args, kwargs)
+        if size == 0:
+            return
+        if self._stacked is None:
+            self._stacked = self._init_stacked()
+        if not self._additivity_verified and not self._verify_additivity(args, kwargs, size):
+            # not sample-additive (or one-sample update untraceable): fall
+            # back permanently to the replay loop. No RNG was consumed and
+            # no state accumulated, so the stream and semantics match the
+            # loop design from the first batch on.
+            self._vmap_path = self._poisson_weight_path = False
+            self._stacked = None
+            self._make_replay_metrics()
+            self.update(*args, **kwargs)
+            return
+        # one (B, N) draw == B sequential (N,) draws from the same
+        # RandomState (row-major fill): bit-identical to the loop design
+        weights = jnp.asarray(self._rng.poisson(1, (self.num_bootstraps, size)))
+        fn = self._get_poisson_update()
+        self._stacked = fn(
+            self._stacked, weights, arr_args, arr_kwargs, static_args, static_kwargs
+        )
+
+    def _verify_additivity(self, args, kwargs, size) -> bool:
+        """One-time check of the identity the weight contraction relies on:
+        updating with each sample repeated ``p_i`` times must equal
+        ``state + Σ_i p_i · delta(sample_i)``. Verified on the DOUBLED first
+        batch — ``update(init, batch ++ batch) == init + 2·Σ delta_i`` —
+        which tests repetition-linearity as well as cross-sample additivity
+        (a plain single-batch check is vacuous at batch size 1: e.g. an
+        update adding the batch max passes it trivially yet breaks under
+        p=2). Eagerly vmapped, no jit, so ``trace_count`` stays untouched.
+        """
+        base = self.base_metric
+        try:
+            doubled_args = tuple(
+                jnp.concatenate([jnp.asarray(a)] * 2, axis=0) if isinstance(a, _ARRAY_TYPES) else a
+                for a in args
+            )
+            doubled_kwargs = {
+                k: (jnp.concatenate([jnp.asarray(v)] * 2, axis=0) if isinstance(v, _ARRAY_TYPES) else v)
+                for k, v in kwargs.items()
+            }
+            _, static_args, arr_args, arr_kwargs, static_kwargs = self._prep_batch(args, kwargs)
+            init, per_sample = self._delta_machinery(arr_args, arr_kwargs, static_args, static_kwargs)
+            deltas = jax.vmap(per_sample)(jnp.arange(size))
+            truth, _ = base._pure_update(init, doubled_args, doubled_kwargs)
+            for k, t in truth.items():
+                r = jnp.asarray(init[k] + 2.0 * deltas[k].sum(axis=0), jnp.float32)
+                t = jnp.asarray(t, jnp.float32)
+                tol = 1e-3 * jnp.maximum(jnp.max(jnp.abs(t)), 1.0)
+                if not bool(jnp.all(jnp.abs(r - t) <= tol)):
+                    return False
+        except Exception:  # untraceable one-sample update: replay handles it
+            return False
+        self._additivity_verified = True
+        return True
 
     def _vmap_update(self, *args: Any, **kwargs: Any) -> None:
         base = self.base_metric
@@ -194,9 +350,7 @@ class BootStrapper(WrapperMetric):
         args = tuple(base._to_array(a) for a in args)
         kwargs = {k: base._to_array(v) for k, v in kwargs.items()}
         base._eager_validate(*args, **kwargs)
-        arrs = [a for a in args if isinstance(a, _ARRAY_TYPES)]
-        arrs += [v for v in kwargs.values() if isinstance(v, _ARRAY_TYPES)]
-        size = arrs[0].shape[0] if arrs else 0
+        size, static_args, arr_args, arr_kwargs, static_kwargs = self._prep_batch(args, kwargs)
         if size == 0:
             return
         # one (B, N) draw == B sequential (N,) draws from the same
@@ -206,15 +360,6 @@ class BootStrapper(WrapperMetric):
             self._stacked = self._init_stacked()
         tensors = {k: v for k, v in self._stacked.items() if k not in base._list_states}
         lists = {k: self._stacked[k] for k in base._list_states}
-        # static structure (hashable) + array payloads (traced)
-        static_args = tuple(
-            (None, True) if isinstance(a, _ARRAY_TYPES) else (a, False) for a in args
-        )
-        arr_args = tuple(jnp.asarray(a) for a in args if isinstance(a, _ARRAY_TYPES))
-        arr_kwargs = {k: jnp.asarray(v) for k, v in kwargs.items() if isinstance(v, _ARRAY_TYPES)}
-        static_kwargs = tuple(
-            (k, None if isinstance(v, _ARRAY_TYPES) else v) for k, v in sorted(kwargs.items())
-        )
         fn = self._get_stacked_update()
         new_tensors, new_lists = fn(
             tensors, lists, idx, arr_args, arr_kwargs, static_args, static_kwargs
@@ -285,10 +430,14 @@ class BootStrapper(WrapperMetric):
     # ------------------------------------------------------------------
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Resample the batch for every bootstrap replica."""
+        if self._poisson_weight_path:
+            self._poisson_vmap_update(*args, **kwargs)
+            return
         if self._vmap_path:
             self._vmap_update(*args, **kwargs)
             return
         arrs = [a for a in args if isinstance(a, _ARRAY_TYPES)]
+        arrs += [v for v in kwargs.values() if isinstance(v, _ARRAY_TYPES)]
         size = arrs[0].shape[0] if arrs else 0
         for idx in range(self.num_bootstraps):
             sample_idx = _bootstrap_sampler(size, self.sampling_strategy, self._rng)
