@@ -6,7 +6,7 @@ from .d_lambda import (
 )
 from .gradients import image_gradients
 from .lpips import learned_perceptual_image_patch_similarity
-from .perceptual_path_length import perceptual_path_length
+from .perceptual_path_length import GeneratorType, perceptual_path_length
 from .psnr import peak_signal_noise_ratio
 from .psnrb import peak_signal_noise_ratio_with_blocked_effect
 from .rmse_sw import (
@@ -25,6 +25,7 @@ from .uqi import universal_image_quality_index
 from .vif import visual_information_fidelity
 
 __all__ = [
+    "GeneratorType",
     "error_relative_global_dimensionless_synthesis",
     "image_gradients",
     "learned_perceptual_image_patch_similarity",

@@ -11,7 +11,7 @@ TPU note: the generator and distance network run as ordinary jitted JAX
 calls; the driver loop stays on host (data-dependent batch count), matching
 the reference's host-side batching at ``perceptual_path_length.py:236-252``.
 """
-from typing import Any, Callable, Optional, Tuple, Union
+from typing import Any, Callable, Optional, Protocol, Tuple, Union, runtime_checkable
 
 import jax
 import jax.numpy as jnp
@@ -19,7 +19,26 @@ import numpy as np
 
 Array = jax.Array
 
-__all__ = ["perceptual_path_length"]
+__all__ = ["GeneratorType", "perceptual_path_length"]
+
+
+@runtime_checkable
+class GeneratorType(Protocol):
+    """Structural protocol for PPL generators (parity: the reference's
+    ``GeneratorType`` base class, ``functional/image/perceptual_path_length.py:27``
+    — here a typing Protocol instead of an ``nn.Module`` subclass, since JAX
+    generators are plain callables/pytrees).
+
+    Must provide ``sample(num_samples) -> latents`` and be callable on
+    latents (plus integer labels when conditional); conditional generators
+    also expose an integer ``num_classes``.
+    """
+
+    def sample(self, num_samples: int) -> Array:  # pragma: no cover - protocol
+        ...
+
+    def __call__(self, *args: Any) -> Array:  # pragma: no cover - protocol
+        ...
 
 _EPS = 1e-7
 
