@@ -46,6 +46,23 @@ def pytest_collection_modifyitems(config, items):
                 item.add_marker(skip_needs_tpu)
         elif TPU_MODE:
             item.add_marker(skip_cpu_only)
+    # canonical-weights certification tests are a separate, explicitly
+    # requested layer (`-m weights` after tools/fetch_weights.py); in the
+    # default run they are DESELECTED, not skipped — every step short of the
+    # real download is covered by the always-on offline pipeline tests
+    mexpr = config.getoption("-m") or ""
+    if "weights" not in mexpr:
+        explicit = [a for a in config.args if "::" in a]  # node IDs named on the command line stay runnable
+        selected, deselected = [], []
+        for item in items:
+            requested_by_node_id = any(item.nodeid.startswith(a) for a in explicit)
+            if "weights" in item.keywords and not requested_by_node_id:
+                deselected.append(item)
+            else:
+                selected.append(item)
+        if deselected:
+            items[:] = selected
+            config.hook.pytest_deselected(items=deselected)
 
 NUM_PROCESSES = 2  # emulated ranks for DDP-style tests
 NUM_BATCHES = 4    # needs to be a multiple of NUM_PROCESSES

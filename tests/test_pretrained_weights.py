@@ -2,14 +2,17 @@
 
 Two layers:
 
-1. Offline (always runs): the full ``tools/fetch_weights.py`` convert →
-   npz-cache → loader → extractor pipeline, exercised with a RANDOM-weight
-   torch mirror standing in for the downloaded checkpoint. Proves the
-   plumbing end-to-end without network.
-2. ``-m weights`` (auto-skips unless ``tools/fetch_weights.py`` has filled
-   the cache): certifies the CANONICAL artifacts — FID/KID int-feature
-   ctors resolve, LPIPS pretrained backbones load, CLIP resolves through
-   the transformers cache.
+1. Offline (always runs, zero skips): the full ``tools/fetch_weights.py``
+   pipeline — its OWN ``fetch_fid``/``fetch_lpips`` code paths with a
+   stubbed download, the filename-hash checksum pin, convert → npz-cache →
+   loader → extractor — exercised with RANDOM-weight torch mirrors standing
+   in for the downloaded checkpoints and asserted numerically against
+   them. The only step not executed offline is the network transfer
+   itself.
+2. ``-m weights`` (DESELECTED from default runs by tests/conftest.py, run
+   explicitly after ``tools/fetch_weights.py``): certifies the CANONICAL
+   artifacts — FID/KID int-feature ctors resolve, LPIPS pretrained
+   backbones load, CLIP resolves through the transformers cache.
 """
 import os
 import sys
@@ -26,6 +29,46 @@ def _cache_has(name: str) -> bool:
     return os.path.exists(os.path.join(PT.weights_dir(), name))
 
 
+def _mirror_fid_net():
+    """Seed-0 torch FID-Inception mirror (tests/image oracle)."""
+    torch = pytest.importorskip("torch")
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "image"))
+    try:
+        from test_inception_parity import TFIDInception
+    finally:
+        sys.path.pop(0)
+    torch.manual_seed(0)
+    return TFIDInception().eval()
+
+
+def _assert_extractor_matches(net) -> None:
+    """The cached-weights extractor must reproduce the torch mirror's
+    2048-d features on seed-0 images."""
+    import torch
+
+    extract = PT.fid_inception_extractor(2048)
+    assert extract is not None
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 256, (2, 3, 96, 96)).astype(np.float32)
+    ours = np.asarray(extract(jnp.asarray(imgs)))
+    with torch.no_grad():
+        theirs = net(torch.tensor(imgs))[2048].numpy()
+    np.testing.assert_allclose(ours, theirs, atol=5e-3, rtol=1e-3)
+
+
+_ALEX_CFG = ((3, 64, 11), (64, 192, 5), (192, 384, 3), (384, 256, 3), (256, 256, 3))
+
+
+def _alex_state_np() -> dict:
+    """Seed-0 random torchvision-layout alex trunk state dict (numpy)."""
+    rng = np.random.RandomState(0)
+    state = {}
+    for i, (cin, cout, k) in enumerate(_ALEX_CFG):
+        state[f"features.{i}.weight"] = rng.randn(cout, cin, k, k).astype(np.float32) * 0.01
+        state[f"features.{i}.bias"] = rng.randn(cout).astype(np.float32) * 0.01
+    return state
+
+
 def test_flatten_unflatten_roundtrip():
     tree = {"params": {"a": np.ones((2, 2)), "b": {"c": np.zeros(3)}}, "batch_stats": {"m": np.asarray(1.0)}}
     flat = PT.flatten_pytree(tree)
@@ -38,35 +81,21 @@ def test_fid_pipeline_offline_with_mirror_checkpoint(tmp_path, monkeypatch):
     """convert -> npz cache -> loader -> extractor matches the torch mirror
     the state dict came from (random weights; same path the real
     checkpoint takes through tools/fetch_weights.py)."""
-    torch = pytest.importorskip("torch")
-    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "image"))
-    try:
-        from test_inception_parity import TFIDInception
-    finally:
-        sys.path.pop(0)
-
     from torchmetrics_tpu.models.inception import convert_torch_state_dict
 
-    torch.manual_seed(0)
-    net = TFIDInception().eval()
+    net = _mirror_fid_net()
     state = {k: v.numpy() for k, v in net.state_dict().items()}
     variables = convert_torch_state_dict(state)
 
     monkeypatch.setenv("TM_TPU_WEIGHTS_DIR", str(tmp_path))
     np.savez_compressed(os.path.join(str(tmp_path), PT.FID_NPZ), **PT.flatten_pytree(variables))
 
-    extract = PT.fid_inception_extractor(2048)
-    assert extract is not None
-    rng = np.random.RandomState(0)
-    imgs = rng.randint(0, 256, (2, 3, 96, 96)).astype(np.float32)
-    ours = np.asarray(extract(jnp.asarray(imgs)))
-    with torch.no_grad():
-        theirs = net(torch.tensor(imgs))[2048].numpy()
-    np.testing.assert_allclose(ours, theirs, atol=5e-3, rtol=1e-3)
+    _assert_extractor_matches(net)
 
     # the int-feature FID ctor now resolves through the cache
     from torchmetrics_tpu import FrechetInceptionDistance
 
+    imgs = np.random.RandomState(0).randint(0, 256, (2, 3, 96, 96)).astype(np.float32)
     fid = FrechetInceptionDistance(feature=2048)
     fid.update(jnp.asarray(imgs), real=True)
     fid.update(jnp.asarray(imgs), real=False)
@@ -84,16 +113,9 @@ def test_fid_int_feature_message_names_fetch_tool(tmp_path, monkeypatch):
 
 
 def test_inception_score_resolves_from_cache(tmp_path, monkeypatch):
-    torch = pytest.importorskip("torch")
-    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "image"))
-    try:
-        from test_inception_parity import TFIDInception
-    finally:
-        sys.path.pop(0)
     from torchmetrics_tpu.models.inception import convert_torch_state_dict
 
-    torch.manual_seed(0)
-    net = TFIDInception().eval()
+    net = _mirror_fid_net()
     variables = convert_torch_state_dict({k: v.numpy() for k, v in net.state_dict().items()})
     monkeypatch.setenv("TM_TPU_WEIGHTS_DIR", str(tmp_path))
     np.savez_compressed(os.path.join(str(tmp_path), PT.FID_NPZ), **PT.flatten_pytree(variables))
@@ -149,12 +171,7 @@ def _write_mirror_alex_cache(cache_dir: str) -> dict:
     cache, exactly as tools/fetch_weights.py would; returns the state."""
     from torchmetrics_tpu.models.lpips import convert_lpips_torch, lpips_head_params
 
-    rng = np.random.RandomState(0)
-    cfg = ((3, 64, 11), (64, 192, 5), (192, 384, 3), (384, 256, 3), (256, 256, 3))
-    state = {}
-    for i, (cin, cout, k) in enumerate(cfg):
-        state[f"features.{i}.weight"] = rng.randn(cout, cin, k, k).astype(np.float32) * 0.01
-        state[f"features.{i}.bias"] = rng.randn(cout).astype(np.float32) * 0.01
+    state = _alex_state_np()
     inner = dict(convert_lpips_torch(state, {}, net_type="alex")["params"])
     inner.update(lpips_head_params("alex"))
     np.savez_compressed(
@@ -185,6 +202,85 @@ def test_lpips_pretrained_requires_cache(tmp_path, monkeypatch):
     monkeypatch.setenv("TM_TPU_WEIGHTS_DIR", str(tmp_path))
     with pytest.raises(FileNotFoundError, match="fetch_weights"):
         make_lpips("alex", backbone="pretrained")
+
+
+# ------------------------------------------------------------- fetch tool
+import functools
+
+
+@functools.lru_cache(maxsize=1)
+def _import_fetch_tool():
+    """Load tools/fetch_weights.py once per session (its top level prepends
+    the repo to sys.path — re-executing per test would accumulate entries)."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools", "fetch_weights.py")
+    spec = importlib.util.spec_from_file_location("fetch_weights_under_test", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_fetch_tool_fid_end_to_end_with_stubbed_download(tmp_path, monkeypatch):
+    """tools/fetch_weights.py's OWN fetch_fid path (torch.load -> convert ->
+    npz cache) run against a synthetic checkpoint, asserted numerically
+    against the torch mirror — the only step left untested offline is the
+    network transfer inside _download."""
+    torch = pytest.importorskip("torch")
+    monkeypatch.setenv("TM_TPU_WEIGHTS_DIR", str(tmp_path))
+    fw = _import_fetch_tool()
+    net = _mirror_fid_net()
+    pth = tmp_path / "synthetic-fid.pth"
+    torch.save(net.state_dict(), str(pth))
+    monkeypatch.setattr(fw, "_download", lambda url: str(pth))
+    fw.fetch_fid()
+    _assert_extractor_matches(net)
+
+
+def test_fetch_tool_lpips_end_to_end_with_stubbed_download(tmp_path, monkeypatch):
+    """fetch_lpips' own path: torchvision-layout .pth (incl. classifier
+    tensors, exercising the features.-filter) -> convert -> cache -> the
+    pretrained LPIPS backbone loads with the exact converted kernels."""
+    torch = pytest.importorskip("torch")
+    from torchmetrics_tpu.models.lpips import make_lpips
+
+    monkeypatch.setenv("TM_TPU_WEIGHTS_DIR", str(tmp_path))
+    fw = _import_fetch_tool()
+    state = {k: torch.tensor(v) for k, v in _alex_state_np().items()}
+    state["classifier.1.weight"] = torch.tensor(np.random.RandomState(9).randn(10, 256).astype(np.float32))  # must be filtered out
+    pth = tmp_path / "synthetic-alex.pth"
+    torch.save(state, str(pth))
+    monkeypatch.setattr(fw, "TORCHVISION_URLS", {"alex": "stub://alex"})
+    monkeypatch.setattr(fw, "_download", lambda url: str(pth))
+    fw.fetch_lpips()
+
+    _, loaded, distance = make_lpips("alex", backbone="pretrained")
+    kern = np.asarray(loaded["params"]["net"]["conv0"]["kernel"])
+    np.testing.assert_allclose(kern, state["features.0.weight"].numpy().transpose(2, 3, 1, 0))
+    x = jnp.asarray(np.random.RandomState(3).rand(1, 3, 64, 64).astype(np.float32) * 2 - 1)
+    assert float(distance(x, x)[0]) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_fetch_tool_checksum_pin(tmp_path, monkeypatch):
+    """_download's filename-hash pin: a file whose sha256 matches its
+    embedded 8-hex pin verifies; a mismatching pin raises and removes the
+    corrupt file (file:// URLs keep the transfer itself local)."""
+    import hashlib
+
+    monkeypatch.setenv("TM_TPU_WEIGHTS_DIR", str(tmp_path / "cache"))
+    fw = _import_fetch_tool()
+    payload = b"synthetic checkpoint bytes"
+    digest = hashlib.sha256(payload).hexdigest()
+    good = tmp_path / f"weights-{digest[:8]}.pth"
+    good.write_bytes(payload)
+    dest = fw._download(good.as_uri())
+    assert os.path.exists(dest)
+
+    bad = tmp_path / "weights-deadbeef.pth"
+    bad.write_bytes(payload)
+    with pytest.raises(RuntimeError, match="checksum mismatch"):
+        fw._download(bad.as_uri())
+    assert not os.path.exists(os.path.join(str(tmp_path / "cache"), bad.name))
 
 
 # ---------------------------------------------------------------- canonical
