@@ -1,0 +1,139 @@
+"""Structured corpus families for SacreBLEU / CHRF / TER vs the reference.
+
+Earlier fixtures were short ASCII sentences; the host tokenizers are exactly
+where structure bites (unicode classes, punctuation splitting, empty
+segments, normalization). Each metric here runs four structurally distinct
+corpus families asserted against the reference implementation on identical
+inputs, across its tokenizer/normalization options.
+
+Input-family model (patterns, not code): reference
+``tests/unittests/text/_inputs.py`` (error-rate/long-sentence mixes).
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "helpers"))
+from lightning_utilities_stub import install_stub  # noqa: E402
+
+install_stub()
+sys.path.insert(0, "/root/reference/src")
+pytest.importorskip("torch")
+
+from torchmetrics.functional.text import (  # noqa: E402  (reference)
+    chrf_score as ref_chrf,
+    sacre_bleu_score as ref_sacre_bleu,
+    translation_edit_rate as ref_ter,
+)
+
+from torchmetrics_tpu.functional.text import (  # noqa: E402  (ours)
+    chrf_score,
+    sacre_bleu_score,
+    translation_edit_rate,
+)
+
+# --- corpus families ---------------------------------------------------------
+
+UNICODE = (
+    [
+        "Die Straße führt über die Brücke nach Köln",
+        "Ο γρήγορος σκύλος πηδά πάνω από τον φράχτη",
+        "Быстрая лиса прыгает через ленивую собаку",
+        "彼は東京の大学で物理を勉強している",
+        "naïve façade jalapeño résumé coöperate",
+    ],
+    [
+        ["Die Strasse führt über eine Brücke nach Köln", "Die Straße geht über die Brücke nach Köln"],
+        ["Ο σκύλος πηδά γρήγορα πάνω από τον φράχτη"],
+        ["Быстрая рыжая лиса перепрыгивает через ленивую собаку"],
+        ["彼は東京の大学で物理学を学んでいる"],
+        ["naive facade jalapeno resume cooperate"],
+    ],
+)
+
+PUNCT = (
+    [
+        'He said: "Don\'t—ever!—do that again…" (or else?)',
+        "Prices rose 5.3%, i.e. $12.40/unit; see p. 47, fig. 3-b.",
+        "Well...that's—quite literally—'state-of-the-art', isn't it?!",
+        "Email me at a.b@c.org, or call +1 (555) 123-4567!!",
+    ],
+    [
+        ['He said "never do that again" or else'],
+        ["Prices rose 5.3 percent, i.e. $12.40 per unit; see page 47, figure 3b."],
+        ["Well, that is quite literally state of the art, is it not?"],
+        ["Email me at a.b@c.org or call +1 555 123 4567."],
+    ],
+)
+
+_LONG_P = "the model translates long sentences with repeated phrases " * 12
+_LONG_T = "the model translated long sentences containing repeated phrases " * 12
+EMPTY_LONG = (
+    ["", _LONG_P.strip(), "short one here", ""],
+    [["a nonempty reference for an empty hypothesis"], [_LONG_T.strip()], ["a short one here"], ["another reference"]],
+)
+
+CASING_WS = (
+    [
+        "The  QUICK   Brown\tFox",
+        "MiXeD CaSe TeXt WiTh   ODD   SpAcInG",
+        "ALL CAPS SENTENCE HERE NOW",
+        "lower case only words again",
+    ],
+    [
+        ["the quick brown fox"],
+        ["mixed case text with odd spacing"],
+        ["All Caps Sentence Here Now"],
+        ["LOWER CASE ONLY WORDS AGAIN"],
+    ],
+)
+
+FAMILIES = [
+    ("unicode", UNICODE),
+    ("punct", PUNCT),
+    ("empty-long", EMPTY_LONG),
+    ("casing-ws", CASING_WS),
+]
+IDS = [f[0] for f in FAMILIES]
+
+
+@pytest.mark.parametrize(("name", "corpus"), FAMILIES, ids=IDS)
+@pytest.mark.parametrize("tokenize", ["13a", "intl", "char"])
+@pytest.mark.parametrize("lowercase", [False, True])
+def test_sacre_bleu_structured(name, corpus, tokenize, lowercase):
+    preds, targets = corpus
+    ref = float(ref_sacre_bleu(preds, targets, tokenize=tokenize, lowercase=lowercase))
+    got = float(sacre_bleu_score(preds, targets, tokenize=tokenize, lowercase=lowercase))
+    np.testing.assert_allclose(got, ref, atol=1e-6), (name, tokenize, lowercase)
+
+
+@pytest.mark.parametrize(("name", "corpus"), FAMILIES, ids=IDS)
+@pytest.mark.parametrize(("n_word_order", "whitespace"), [(2, False), (0, False), (2, True)])
+def test_chrf_structured(name, corpus, n_word_order, whitespace):
+    preds, targets = corpus
+    ref = float(ref_chrf(preds, targets, n_word_order=n_word_order, whitespace=whitespace))
+    got = float(chrf_score(preds, targets, n_word_order=n_word_order, whitespace=whitespace))
+    np.testing.assert_allclose(got, ref, atol=1e-6), (name, n_word_order, whitespace)
+
+
+@pytest.mark.parametrize(("name", "corpus"), FAMILIES, ids=IDS)
+@pytest.mark.parametrize(("normalize", "no_punctuation"), [(False, False), (True, False), (False, True)])
+def test_ter_structured(name, corpus, normalize, no_punctuation):
+    preds, targets = corpus
+    ref = float(ref_ter(preds, targets, normalize=normalize, no_punctuation=no_punctuation))
+    got = float(translation_edit_rate(preds, targets, normalize=normalize, no_punctuation=no_punctuation))
+    np.testing.assert_allclose(got, ref, atol=1e-6), (name, normalize, no_punctuation)
+
+
+def test_sentence_level_scores_match_on_structured_corpus():
+    """Per-sentence outputs (not just the corpus aggregate) agree on the
+    punctuation family — TER and CHRF both expose sentence-level scores."""
+    preds, targets = PUNCT
+    r_score, r_sent = ref_ter(preds, targets, return_sentence_level_score=True)
+    o_score, o_sent = translation_edit_rate(preds, targets, return_sentence_level_score=True)
+    np.testing.assert_allclose(np.ravel(np.asarray(o_sent)), np.ravel(np.asarray(r_sent)), atol=1e-6)
+    r_score, r_sent = ref_chrf(preds, targets, return_sentence_level_score=True)
+    o_score, o_sent = chrf_score(preds, targets, return_sentence_level_score=True)
+    np.testing.assert_allclose(np.ravel(np.asarray(o_sent)), np.ravel(np.asarray(r_sent)), atol=1e-6)

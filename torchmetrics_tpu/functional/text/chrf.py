@@ -91,20 +91,32 @@ def _chrf_update(
     sentence_scores: Optional[list] = None,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Corpus count accumulation; per-sample the best-matching reference
-    (highest sentence-level chrF) contributes its stats (sacrebleu rule)."""
+    (highest sentence-level chrF) contributes its stats (sacrebleu rule).
+
+    The best starts at F=0 with EMPTY stats and is replaced only by a
+    strictly greater F — so a sentence whose best F is 0 (e.g. an empty
+    hypothesis) contributes its prediction totals but NO reference or
+    matching counts, exactly as the reference accumulates (chrf.py:
+    ``_calculate_sentence_level_chrf_score`` initial ``best_f_score = 0``).
+    """
     k = n_char_order + n_word_order
     tot_match, tot_pred, tot_ref = np.zeros(k), np.zeros(k), np.zeros(k)
     for pred, refs in zip(preds, target):
         refs = [refs] if isinstance(refs, str) else list(refs)
-        best, best_score = None, -1.0
+        best_match, best_ref = np.zeros(k), np.zeros(k)
+        best_score = 0.0
+        pred_total = None
         for ref in refs:
             stats = _pair_stats(pred, ref, n_char_order, n_word_order, lowercase, whitespace)
+            pred_total = stats[1]  # identical across references
             score = float(_fscore_from_counts(jnp.asarray(stats[0]), jnp.asarray(stats[1]), jnp.asarray(stats[2]), beta))
             if score > best_score:
-                best, best_score = stats, score
-        tot_match += best[0]
-        tot_pred += best[1]
-        tot_ref += best[2]
+                best_match, best_ref, best_score = stats[0], stats[2], score
+        if pred_total is None:  # sample with an empty reference list
+            pred_total = _pair_stats(pred, "", n_char_order, n_word_order, lowercase, whitespace)[1]
+        tot_match += best_match
+        tot_pred += pred_total
+        tot_ref += best_ref
         if sentence_scores is not None:
             sentence_scores.append(best_score)
     return tot_match, tot_pred, tot_ref

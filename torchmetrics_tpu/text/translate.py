@@ -192,7 +192,11 @@ class TranslationEditRate(_HostTextMetric):
             self.sentence_ter.append(jnp.asarray(scores, dtype=jnp.float32))
 
     def compute(self) -> Union[Array, Tuple[Array, Array]]:
-        score = self.total_num_edits / jnp.maximum(self.total_tgt_length, 1.0)
+        # tercom conventions: 0 edits -> 0; edits with no reference mass -> 1
+        safe = self.total_num_edits / jnp.maximum(self.total_tgt_length, 1e-12)
+        score = jnp.where(
+            self.total_tgt_length > 0, safe, jnp.where(self.total_num_edits > 0, 1.0, 0.0)
+        )
         if self.return_sentence_level_score:
             return score, dim_zero_cat(self.sentence_ter)
         return score
