@@ -57,8 +57,18 @@ class _TercomTokenizer:
         self.no_punctuation = no_punctuation
         self.lowercase = lowercase
         self.asian_support = asian_support
+        self._memo: Dict[str, str] = {}
 
     def __call__(self, sentence: str) -> str:
+        hit = self._memo.get(sentence)
+        if hit is not None:
+            return hit
+        out = self._tokenize(sentence)
+        if len(self._memo) < 2**16:  # repeated references dominate MT eval
+            self._memo[sentence] = out
+        return out
+
+    def _tokenize(self, sentence: str) -> str:
         s = sentence.rstrip()
         if not s:
             return ""
@@ -345,8 +355,13 @@ def _ter_update(
         refs = [refs] if isinstance(refs, str) else list(refs)
         pred_words = tokenizer(pred).split()
         ref_words = [tokenizer(r).split() for r in refs]
-        edits = min(_tercom_edits(rw, pred_words) for rw in ref_words)
-        avg_len = float(np.mean([len(rw) for rw in ref_words]))
+        if ref_words:
+            edits = min(_tercom_edits(rw, pred_words) for rw in ref_words)
+            avg_len = float(np.mean([len(rw) for rw in ref_words]))
+        else:
+            # reference behavior for an empty reference list: sentinel edits
+            # + nan length, which every score branch then resolves to 0.0
+            edits, avg_len = 2e16, float("nan")
         total_edits += edits
         total_tgt_len += avg_len
         if sentence_scores is not None:

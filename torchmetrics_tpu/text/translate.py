@@ -195,7 +195,11 @@ class TranslationEditRate(_HostTextMetric):
         # tercom conventions: 0 edits -> 0; edits with no reference mass -> 1
         safe = self.total_num_edits / jnp.maximum(self.total_tgt_length, 1e-12)
         score = jnp.where(
-            self.total_tgt_length > 0, safe, jnp.where(self.total_num_edits > 0, 1.0, 0.0)
+            self.total_tgt_length > 0,
+            safe,
+            # nan tgt_length (empty-reference-list sample) falls to 0.0 here,
+            # matching the reference's score branches
+            jnp.where((self.total_tgt_length == 0) & (self.total_num_edits > 0), 1.0, 0.0),
         )
         if self.return_sentence_level_score:
             return score, dim_zero_cat(self.sentence_ter)
