@@ -144,7 +144,7 @@ def test_sdr_speech_shaped_vs_reference(name, gen, degrade):
         pred = np.clip(clean, -0.35, 0.35).astype(np.float32)
     ref = float(ref_sdr(torch.from_numpy(pred), torch.from_numpy(clean)))
     got = float(signal_distortion_ratio(jnp.asarray(pred), jnp.asarray(clean)))
-    np.testing.assert_allclose(got, ref, atol=5e-2, rtol=1e-3), (name, degrade)
+    np.testing.assert_allclose(got, ref, atol=5e-2, rtol=1e-3, err_msg=str((name, degrade)))
 
 
 @pytest.mark.parametrize(("name", "gen"), FAMILIES, ids=IDS)
@@ -154,7 +154,7 @@ def test_si_sdr_speech_shaped_vs_reference(name, gen):
     pred = _with_noise(clean, 5.0, rng)
     ref = float(ref_si_sdr(torch.from_numpy(pred), torch.from_numpy(clean)))
     got = float(scale_invariant_signal_distortion_ratio(jnp.asarray(pred), jnp.asarray(clean)))
-    np.testing.assert_allclose(got, ref, rtol=1e-4), name
+    np.testing.assert_allclose(got, ref, rtol=1e-4, err_msg=str(name))
 
 
 def test_sdr_two_speaker_mixture_vs_reference():

@@ -147,7 +147,7 @@ def test_ssim_structured(name, gen, degr):
     p, t = _pair(gen, degr, 96, 96, zlib.crc32(name.encode()) % 1000)
     ref = float(ref_ssim(torch.from_numpy(p), torch.from_numpy(t), data_range=1.0))
     got = float(structural_similarity_index_measure(jnp.asarray(p), jnp.asarray(t), data_range=1.0))
-    np.testing.assert_allclose(got, ref, atol=3e-4), name
+    np.testing.assert_allclose(got, ref, atol=3e-4, err_msg=str(name))
 
 
 @pytest.mark.parametrize(("name", "gen", "degr"), FAMILIES, ids=[f[0] for f in FAMILIES])
@@ -156,7 +156,7 @@ def test_ms_ssim_structured(name, gen, degr):
     p, t = _pair(gen, degr, 176, 176, zlib.crc32(name.encode()) % 1000)
     ref = float(ref_ms_ssim(torch.from_numpy(p), torch.from_numpy(t), data_range=1.0))
     got = float(multiscale_structural_similarity_index_measure(jnp.asarray(p), jnp.asarray(t), data_range=1.0))
-    np.testing.assert_allclose(got, ref, atol=5e-4), name
+    np.testing.assert_allclose(got, ref, atol=5e-4, err_msg=str(name))
 
 
 @pytest.mark.parametrize(("name", "gen", "degr"), FAMILIES, ids=[f[0] for f in FAMILIES])
@@ -164,7 +164,7 @@ def test_vif_structured(name, gen, degr):
     p, t = _pair(gen, degr, 96, 96, zlib.crc32(name.encode()) % 1000)
     ref = float(ref_vif(torch.from_numpy(p), torch.from_numpy(t)))
     got = float(visual_information_fidelity(jnp.asarray(p), jnp.asarray(t)))
-    np.testing.assert_allclose(got, ref, rtol=2e-3), name
+    np.testing.assert_allclose(got, ref, rtol=2e-3, err_msg=str(name))
 
 
 def test_ssim_ranks_degradations_like_reference():

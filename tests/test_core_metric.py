@@ -255,3 +255,30 @@ def test_shard_map_psum_and_gather():
     s1, s2 = jax.jit(fn)(data)
     assert float(msum.compute_state(s1)) == float(jnp.sum(data))
     assert float(mcat.compute_state(s2)) == float(jnp.sum(data))
+
+
+def test_checkpoint_roundtrip_respects_on_disk_format(tmp_path):
+    """save/restore must pair regardless of suffix: with orbax available a
+    path ending in .npz is still an orbax directory on disk (regression —
+    restore used to route any .npz suffix to np.load and crash)."""
+    import torchmetrics_tpu as tm
+    from torchmetrics_tpu.utils import checkpoint as ck
+
+    m = tm.SumMetric()
+    m.update(jnp.asarray([1.0, 2.0, 3.0]))
+    for suffix in ("state.npz", "state_plain"):
+        fresh = tm.SumMetric()
+        ck.save_metric_state(str(tmp_path / suffix), m)
+        ck.restore_metric_state(str(tmp_path / suffix), fresh)
+        assert float(fresh.compute()) == float(m.compute())
+    # npz fallback with the same suffixes
+    orig = ck._ORBAX
+    ck._ORBAX = False
+    try:
+        for suffix in ("f_state.npz", "f_state_plain"):
+            fresh = tm.SumMetric()
+            ck.save_metric_state(str(tmp_path / suffix), m)
+            ck.restore_metric_state(str(tmp_path / suffix), fresh)
+            assert float(fresh.compute()) == float(m.compute())
+    finally:
+        ck._ORBAX = orig

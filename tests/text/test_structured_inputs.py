@@ -106,7 +106,7 @@ def test_sacre_bleu_structured(name, corpus, tokenize, lowercase):
     preds, targets = corpus
     ref = float(ref_sacre_bleu(preds, targets, tokenize=tokenize, lowercase=lowercase))
     got = float(sacre_bleu_score(preds, targets, tokenize=tokenize, lowercase=lowercase))
-    np.testing.assert_allclose(got, ref, atol=1e-6), (name, tokenize, lowercase)
+    np.testing.assert_allclose(got, ref, atol=1e-6, err_msg=str((name, tokenize, lowercase)))
 
 
 @pytest.mark.parametrize(("name", "corpus"), FAMILIES, ids=IDS)
@@ -115,7 +115,7 @@ def test_chrf_structured(name, corpus, n_word_order, whitespace):
     preds, targets = corpus
     ref = float(ref_chrf(preds, targets, n_word_order=n_word_order, whitespace=whitespace))
     got = float(chrf_score(preds, targets, n_word_order=n_word_order, whitespace=whitespace))
-    np.testing.assert_allclose(got, ref, atol=1e-6), (name, n_word_order, whitespace)
+    np.testing.assert_allclose(got, ref, atol=1e-6, err_msg=str((name, n_word_order, whitespace)))
 
 
 @pytest.mark.parametrize(("name", "corpus"), FAMILIES, ids=IDS)
@@ -124,7 +124,7 @@ def test_ter_structured(name, corpus, normalize, no_punctuation):
     preds, targets = corpus
     ref = float(ref_ter(preds, targets, normalize=normalize, no_punctuation=no_punctuation))
     got = float(translation_edit_rate(preds, targets, normalize=normalize, no_punctuation=no_punctuation))
-    np.testing.assert_allclose(got, ref, atol=1e-6), (name, normalize, no_punctuation)
+    np.testing.assert_allclose(got, ref, atol=1e-6, err_msg=str((name, normalize, no_punctuation)))
 
 
 def test_sentence_level_scores_match_on_structured_corpus():

@@ -87,16 +87,21 @@ def save_metric_state(path: str, obj: Any) -> str:
 
 
 def restore_metric_state(path: str, obj: Any) -> Any:
-    """Restore state saved by :func:`save_metric_state` into ``obj`` in place."""
-    if _ORBAX and not (path.endswith(".npz") or os.path.isfile(path + ".npz")):
+    """Restore state saved by :func:`save_metric_state` into ``obj`` in place.
+
+    Dispatch follows what is on disk, not the suffix: with orbax available
+    the save path is an orbax *directory* even when it ends in ``.npz``, so
+    suffix-based routing would hand a directory to ``np.load``.
+    """
+    npz_path = path if path.endswith(".npz") else path + ".npz"
+    if _ORBAX and not os.path.isfile(npz_path):
         import orbax.checkpoint as ocp
 
         ckpt = ocp.PyTreeCheckpointer()
         tree = ckpt.restore(os.path.abspath(path))
         _apply_tree(obj, tree)
         return obj
-    if not path.endswith(".npz"):
-        path = path + ".npz"
+    path = npz_path
     data = np.load(path, allow_pickle=False)
     tree: Dict[str, Any] = {}
     lists: Dict[str, Dict[int, np.ndarray]] = {}
