@@ -272,7 +272,7 @@ class PerceptualEvaluationSpeechQuality(_MeanAudioMetric):
         >>> preds = target + 0.1 * jnp.sin(2 * jnp.pi * 1320.0 * t)
         >>> metric.update(preds, target)
         >>> round(float(metric.compute()), 2)
-        2.96
+        2.95
     """
 
     is_differentiable = False
@@ -345,7 +345,7 @@ class SpeechReverberationModulationEnergyRatio(_MeanAudioMetric):
         >>> t = jnp.linspace(0.0, 400.0, 4096)
         >>> metric.update(jnp.sin(t) * (1 + 0.5 * jnp.sin(0.05 * t)))
         >>> round(float(metric.compute()), 4)
-        34.3532
+        77.1469
     """
 
     is_differentiable = False
