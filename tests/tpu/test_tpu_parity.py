@@ -54,9 +54,24 @@ IMG_A = RNG.random((2, 3, 64, 64)).astype(np.float32)
 IMG_B = np.clip(IMG_A + 0.1 * RNG.standard_normal((2, 3, 64, 64)).astype(np.float32), 0, 1)
 
 
+def _structured_pair(h=64, w=64):
+    """Smooth gradient + checkerboard mix: near-constant windows make the
+    SSIM/VIF variance terms cancellation-heavy — the input family where a
+    dropped precision pin (f32 conv lowered to bf16) shows first, unlike
+    iid noise whose window variance is large everywhere."""
+    iy, ix = np.mgrid[0:h, 0:w]
+    grad = (0.7 * ix + 0.3 * iy) / max(h, w)
+    checker = 0.15 * ((iy // 8 + ix // 8) % 2)
+    base = np.clip(grad + checker, 0, 1).astype(np.float32)
+    a = np.broadcast_to(base, (2, 3, h, w)).copy()
+    b = np.clip(a + 0.05 * RNG.standard_normal(a.shape).astype(np.float32), 0, 1).astype(np.float32)
+    return a, b
+
+
 @pytest.mark.parametrize(
     ("name", "tol"),
-    [("ssim", 1e-4), ("ms_ssim", 1e-4), ("uqi", 1e-4), ("psnr", 1e-5)],
+    [("ssim", 1e-4), ("ssim_structured", 1e-4), ("ms_ssim", 1e-4), ("uqi", 1e-4),
+     ("psnr", 1e-5), ("vif", 5e-4)],
 )
 def test_image_conv_family(tpu_device, cpu_device, name, tol):
     from torchmetrics_tpu.functional import (
@@ -65,17 +80,22 @@ def test_image_conv_family(tpu_device, cpu_device, name, tol):
         structural_similarity_index_measure,
         universal_image_quality_index,
     )
+    from torchmetrics_tpu.functional.image import visual_information_fidelity
 
     fns = {
         "ssim": lambda p, t: structural_similarity_index_measure(p, t, data_range=1.0),
+        "ssim_structured": lambda p, t: structural_similarity_index_measure(p, t, data_range=1.0),
         "ms_ssim": lambda p, t: multiscale_structural_similarity_index_measure(p, t, data_range=1.0),
         "uqi": universal_image_quality_index,
         "psnr": lambda p, t: peak_signal_noise_ratio(p, t, data_range=1.0),
+        "vif": visual_information_fidelity,
     }
     fn = fns[name]
     if name == "ms_ssim":  # 5-beta pyramid requires >160 px per side
         a = RNG.random((2, 3, 192, 192)).astype(np.float32)
         b = np.clip(a + 0.1 * RNG.standard_normal(a.shape).astype(np.float32), 0, 1)
+    elif name in ("ssim_structured", "vif"):
+        a, b = _structured_pair()
     else:
         a, b = IMG_A, IMG_B
     got = run_on(tpu_device, fn, _f32(a), _f32(b))
