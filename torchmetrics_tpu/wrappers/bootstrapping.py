@@ -252,9 +252,14 @@ class BootStrapper(WrapperMetric):
                 deltas = jax.vmap(per_sample)(jnp.arange(n))  # {k: (N, ...state)}
                 return {
                     k: tensors[k]
-                    + jnp.tensordot(weights.astype(deltas[k].dtype), deltas[k], axes=(1, 0)).astype(
-                        tensors[k].dtype
-                    )
+                    + jnp.tensordot(
+                        weights.astype(deltas[k].dtype),
+                        deltas[k],
+                        axes=(1, 0),
+                        # bf16 MXU lowering would corrupt integer-valued
+                        # count states past 256; weights are small ints
+                        precision=jax.lax.Precision.HIGHEST,
+                    ).astype(tensors[k].dtype)
                     for k in tensors
                 }
 
