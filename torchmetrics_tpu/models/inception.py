@@ -220,7 +220,20 @@ def make_fid_inception(features: Any = 2048, rng_seed: int = 0):
     """
     feats = (features,) if not isinstance(features, (tuple, list)) else tuple(features)
     mod = FIDInceptionV3(features_list=feats)
-    params = mod.init(jax.random.PRNGKey(rng_seed), jnp.zeros((1, 3, 32, 32)))
+    # init on the host CPU backend: on a remote-attached TPU the eager init
+    # chain pays one tunnel round-trip per op (~300 s measured); on CPU it
+    # is milliseconds. Pull leaves to numpy so the jitted extract uploads
+    # them once at compile time on whatever backend runs it.
+    try:
+        cpu = jax.local_devices(backend="cpu")[0]
+    except RuntimeError:  # JAX_PLATFORMS pinned without cpu: init where we run
+        cpu = None
+    if cpu is not None:
+        with jax.default_device(cpu):
+            params = mod.init(jax.random.PRNGKey(rng_seed), jnp.zeros((1, 3, 32, 32)))
+        params = jax.tree.map(np.asarray, params)
+    else:
+        params = mod.init(jax.random.PRNGKey(rng_seed), jnp.zeros((1, 3, 32, 32)))
 
     @jax.jit
     def extract(imgs: Array) -> Array:
