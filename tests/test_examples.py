@@ -21,6 +21,7 @@ EXAMPLES = [
     ("distributed_train.py", 420),
     ("long_context_ring.py", 300),
     ("fid_ssim.py", 600),
+    ("bootstrap_ci.py", 300),
 ]
 
 
