@@ -1,0 +1,145 @@
+"""Geometry-structured embedding families for the intrinsic clustering metrics.
+
+CalinskiHarabasz / DaviesBouldin / DunnIndex read cluster GEOMETRY
+(dispersion ratios, centroid distances, diameters); the existing fixtures
+use one isotropic-blob layout. These families stress the geometric terms —
+anisotropic (elongated) clusters, unequal densities/sizes, nested shells,
+near-touching blobs, and a degenerate single-point cluster — each asserted
+against sklearn (CH/DB) or an independent numpy oracle of the reference's
+centroid-form Dunn (which sklearn lacks). Label metrics (V-measure etc.) get skewed/degenerate label
+distributions vs sklearn on the same scenarios.
+
+Input-family model (patterns, not code): reference
+``tests/unittests/clustering/`` uses sklearn as its oracle the same way.
+"""
+import numpy as np
+import pytest
+import sklearn.metrics as skm
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.clustering import (
+    calinski_harabasz_score,
+    davies_bouldin_score,
+    dunn_index,
+    homogeneity_score,
+    v_measure_score,
+)
+
+
+def _anisotropic(rng):
+    """Elongated clusters: same centroids, wildly different covariances."""
+    cov_a = np.array([[9.0, 0.0], [0.0, 0.05]])
+    cov_b = np.array([[0.05, 0.0], [0.0, 9.0]])
+    a = rng.multivariate_normal([0, 0], cov_a, 120)
+    b = rng.multivariate_normal([8, 8], cov_b, 120)
+    c = rng.multivariate_normal([16, 0], np.eye(2) * 0.3, 120)
+    return np.vstack([a, b, c]), np.repeat([0, 1, 2], 120)
+
+
+def _unequal(rng):
+    """One dense giant cluster + two tiny sparse ones."""
+    a = rng.randn(400, 3) * 0.3
+    b = rng.randn(12, 3) * 2.0 + np.array([6, 0, 0])
+    c = rng.randn(8, 3) * 1.5 + np.array([0, 7, -3])
+    return np.vstack([a, b, c]), np.concatenate([np.zeros(400), np.ones(12), np.full(8, 2)]).astype(int)
+
+
+def _shells(rng):
+    """Concentric shells: centroid distance misleads, diameters are huge."""
+    th = rng.rand(150) * 2 * np.pi
+    inner = np.stack([np.cos(th), np.sin(th)], 1) * (1 + 0.05 * rng.randn(150, 1))
+    th2 = rng.rand(150) * 2 * np.pi
+    outer = np.stack([np.cos(th2), np.sin(th2)], 1) * (6 + 0.05 * rng.randn(150, 1))
+    return np.vstack([inner, outer]), np.repeat([0, 1], 150)
+
+
+def _touching(rng):
+    """Two blobs whose boundaries nearly touch (inter/intra ratio ~1)."""
+    a = rng.randn(200, 4) + np.array([0, 0, 0, 0.0])
+    b = rng.randn(200, 4) + np.array([2.2, 0, 0, 0.0])
+    return np.vstack([a, b]), np.repeat([0, 1], 200)
+
+
+def _singleton(rng):
+    """A cluster with ONE point: zero intra-dispersion edge case."""
+    a = rng.randn(150, 3)
+    b = rng.randn(100, 3) + 5.0
+    c = np.array([[0.0, 10.0, -4.0]])
+    return np.vstack([a, b, c]), np.concatenate([np.zeros(150), np.ones(100), [2]]).astype(int)
+
+
+FAMILIES = [("anisotropic", _anisotropic), ("unequal", _unequal), ("shells", _shells),
+            ("touching", _touching), ("singleton", _singleton)]
+IDS = [f[0] for f in FAMILIES]
+
+
+def _case(name, gen):
+    import zlib
+
+    rng = np.random.RandomState(zlib.crc32(name.encode()) % 2**16)
+    data, labels = gen(rng)
+    return data.astype(np.float32), labels.astype(np.int64)
+
+
+def _np_dunn(data, labels, p=2.0):
+    """Dunn as the reference defines it (``dunn_index.py``): min pairwise
+    CENTROID distance over max (max distance-to-centroid) — not the
+    classical point-pair/diameter variant. Plain-numpy independent oracle."""
+    uniq = np.unique(labels)
+    cents = [data[labels == u].astype(np.float64).mean(0) for u in uniq]
+    inter = min(
+        np.linalg.norm(a - b, ord=p)
+        for i, a in enumerate(cents) for b in cents[i + 1:]
+    )
+    intra = max(
+        np.linalg.norm(data[labels == u].astype(np.float64) - c, ord=p, axis=1).max()
+        for u, c in zip(uniq, cents)
+    )
+    return inter / intra
+
+
+@pytest.mark.parametrize(("name", "gen"), FAMILIES, ids=IDS)
+def test_calinski_harabasz_structured(name, gen):
+    data, labels = _case(name, gen)
+    ref = skm.calinski_harabasz_score(data, labels)
+    got = float(calinski_harabasz_score(jnp.asarray(data), jnp.asarray(labels)))
+    np.testing.assert_allclose(got, ref, rtol=2e-4, err_msg=name)
+
+
+@pytest.mark.parametrize(("name", "gen"), FAMILIES, ids=IDS)
+def test_davies_bouldin_structured(name, gen):
+    data, labels = _case(name, gen)
+    ref = skm.davies_bouldin_score(data, labels)
+    got = float(davies_bouldin_score(jnp.asarray(data), jnp.asarray(labels)))
+    np.testing.assert_allclose(got, ref, rtol=2e-4, err_msg=name)
+
+
+@pytest.mark.parametrize(("name", "gen"), FAMILIES, ids=IDS)
+def test_dunn_index_structured(name, gen):
+    data, labels = _case(name, gen)
+    ref = _np_dunn(data, labels)
+    got = float(dunn_index(jnp.asarray(data), jnp.asarray(labels)))
+    np.testing.assert_allclose(got, ref, rtol=2e-4, err_msg=name)
+    # singleton cluster: its diameter term is exactly 0, must not nan/inf
+    assert np.isfinite(got), name
+
+
+@pytest.mark.parametrize(("name", "gen"), FAMILIES, ids=IDS)
+def test_label_metrics_on_structured_partitions(name, gen):
+    """V-measure / homogeneity under skewed partitions: predicted labels =
+    the true geometry labels with a block of the dominant cluster split off
+    (over-clustering) and the smallest merged away (under-clustering)."""
+    _, labels = _case(name, gen)
+    preds = labels.copy()
+    dominant = np.bincount(labels).argmax()
+    idx = np.where(preds == dominant)[0]
+    preds[idx[: len(idx) // 2]] = labels.max() + 1  # split dominant
+    smallest = np.bincount(labels).argmin()
+    preds[preds == smallest] = dominant  # merge smallest
+    ref_v = skm.v_measure_score(labels, preds)
+    got_v = float(v_measure_score(jnp.asarray(preds), jnp.asarray(labels)))
+    np.testing.assert_allclose(got_v, ref_v, atol=1e-5, err_msg=name)
+    ref_h = skm.homogeneity_score(labels, preds)
+    got_h = float(homogeneity_score(jnp.asarray(preds), jnp.asarray(labels)))
+    np.testing.assert_allclose(got_h, ref_h, atol=1e-5, err_msg=name)
