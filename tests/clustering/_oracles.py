@@ -1,0 +1,19 @@
+"""Shared numpy oracles for clustering tests (single source of truth)."""
+import numpy as np
+
+
+def np_dunn(data, labels, p=2.0):
+    """Dunn as the reference defines it (``dunn_index.py``): min pairwise
+    CENTROID distance over max (max distance-to-centroid) — not the
+    classical point-pair/diameter variant."""
+    uniq = np.unique(labels)
+    cents = [data[labels == u].astype(np.float64).mean(0) for u in uniq]
+    inter = min(
+        np.linalg.norm(a - b, ord=p)
+        for i, a in enumerate(cents) for b in cents[i + 1:]
+    )
+    intra = max(
+        np.linalg.norm(data[labels == u].astype(np.float64) - c, ord=p, axis=1).max()
+        for u, c in zip(uniq, cents)
+    )
+    return inter / intra

@@ -88,20 +88,11 @@ def test_functional_intrinsic():
     np.testing.assert_allclose(
         float(davies_bouldin_score(jnp.asarray(DATA), jnp.asarray(PREDS))),
         float(skm.davies_bouldin_score(DATA, PREDS)), rtol=1e-4)
-    # dunn index: hand-computed oracle (centroid distances / max dist to centroid)
-    centroids = np.stack([DATA[PREDS == c].mean(0) for c in np.unique(PREDS)])
-    inter = min(
-        np.linalg.norm(a - b)
-        for i, a in enumerate(centroids)
-        for j, b in enumerate(centroids)
-        if i != j
-    )
-    intra = max(
-        np.linalg.norm(DATA[PREDS == c] - centroids[i], axis=1).max()
-        for i, c in enumerate(np.unique(PREDS))
-    )
+    # dunn index vs the shared centroid-form oracle (tests/clustering/_oracles.py)
+    from tests.clustering._oracles import np_dunn
+
     np.testing.assert_allclose(
-        float(dunn_index(jnp.asarray(DATA), jnp.asarray(PREDS))), inter / intra, rtol=1e-4)
+        float(dunn_index(jnp.asarray(DATA), jnp.asarray(PREDS))), np_dunn(DATA, PREDS), rtol=1e-4)
 
 
 CLASS_CASES = [

@@ -82,21 +82,7 @@ def _case(name, gen):
     return data.astype(np.float32), labels.astype(np.int64)
 
 
-def _np_dunn(data, labels, p=2.0):
-    """Dunn as the reference defines it (``dunn_index.py``): min pairwise
-    CENTROID distance over max (max distance-to-centroid) — not the
-    classical point-pair/diameter variant. Plain-numpy independent oracle."""
-    uniq = np.unique(labels)
-    cents = [data[labels == u].astype(np.float64).mean(0) for u in uniq]
-    inter = min(
-        np.linalg.norm(a - b, ord=p)
-        for i, a in enumerate(cents) for b in cents[i + 1:]
-    )
-    intra = max(
-        np.linalg.norm(data[labels == u].astype(np.float64) - c, ord=p, axis=1).max()
-        for u, c in zip(uniq, cents)
-    )
-    return inter / intra
+from tests.clustering._oracles import np_dunn as _np_dunn  # noqa: E402  (shared oracle)
 
 
 @pytest.mark.parametrize(("name", "gen"), FAMILIES, ids=IDS)
@@ -135,7 +121,10 @@ def test_label_metrics_on_structured_partitions(name, gen):
     dominant = np.bincount(labels).argmax()
     idx = np.where(preds == dominant)[0]
     preds[idx[: len(idx) // 2]] = labels.max() + 1  # split dominant
-    smallest = np.bincount(labels).argmin()
+    counts = np.bincount(labels)
+    # smallest cluster EXCLUDING the dominant one: on equal-sized families
+    # argmin would pick the dominant itself and the merge would be a no-op
+    smallest = int(np.argmin(np.where(np.arange(len(counts)) == dominant, np.iinfo(np.int64).max, counts)))
     preds[preds == smallest] = dominant  # merge smallest
     ref_v = skm.v_measure_score(labels, preds)
     got_v = float(v_measure_score(jnp.asarray(preds), jnp.asarray(labels)))
