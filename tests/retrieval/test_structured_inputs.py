@@ -1,0 +1,157 @@
+"""Rank-structured query families for retrieval metrics vs the reference.
+
+The existing fixtures score random (preds, target) pairs against sklearn;
+retrieval metrics are functions of the RANK STRUCTURE, so these families
+place relevance deliberately — all-relevant-at-top, all-at-bottom,
+alternating, tie-heavy scores, graded NDCG gains, singleton queries — and
+assert the per-query functionals and the class-level grouped aggregation
+against the reference implementation (torch CPU) on identical inputs,
+including every ``empty_target_action`` on an all-irrelevant query mix.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "helpers"))
+from lightning_utilities_stub import install_stub  # noqa: E402
+
+install_stub()
+sys.path.insert(0, "/root/reference/src")
+torch = pytest.importorskip("torch")
+
+from torchmetrics.functional.retrieval import (  # noqa: E402  (reference)
+    retrieval_average_precision as ref_map,
+    retrieval_fall_out as ref_fall_out,
+    retrieval_hit_rate as ref_hit,
+    retrieval_normalized_dcg as ref_ndcg,
+    retrieval_precision as ref_precision,
+    retrieval_r_precision as ref_rprec,
+    retrieval_recall as ref_recall,
+    retrieval_reciprocal_rank as ref_mrr,
+)
+from torchmetrics.retrieval import RetrievalMAP as RefRetrievalMAP  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
+
+from torchmetrics_tpu.functional import (  # noqa: E402  (ours)
+    retrieval_average_precision,
+    retrieval_fall_out,
+    retrieval_hit_rate,
+    retrieval_normalized_dcg,
+    retrieval_precision,
+    retrieval_r_precision,
+    retrieval_recall,
+    retrieval_reciprocal_rank,
+)
+from torchmetrics_tpu.retrieval import RetrievalMAP  # noqa: E402
+
+N = 40
+
+
+def _top_heavy(rng):
+    """All 8 relevant docs occupy the top-scored ranks."""
+    preds = np.sort(rng.rand(N))[::-1].copy()
+    target = np.zeros(N, np.int64)
+    target[:8] = 1
+    return preds.astype(np.float32), target
+
+
+def _bottom_heavy(rng):
+    preds = np.sort(rng.rand(N))[::-1].copy()
+    target = np.zeros(N, np.int64)
+    target[-8:] = 1
+    return preds.astype(np.float32), target
+
+
+def _alternating(rng):
+    preds = np.sort(rng.rand(N))[::-1].copy()
+    target = (np.arange(N) % 2 == 0).astype(np.int64)
+    return preds.astype(np.float32), target
+
+
+def _tied_scores(rng):
+    """Quantized scores: big near-tie groups straddling top-k boundaries.
+
+    A per-doc epsilon (index-scaled, identical on both sides) disambiguates
+    the order INSIDE each quantized group: with exact ties the ranking is
+    implementation-incidental on both sides (torch's unstable sort vs our
+    stable one) and rank metrics would diverge arbitrarily."""
+    preds = np.round(rng.rand(N) * 4) / 4 + np.arange(N) * 1e-5
+    target = (rng.rand(N) < 0.3).astype(np.int64)
+    target[0] = 1
+    return preds.astype(np.float32), target
+
+
+def _singleton(rng):
+    return np.asarray([0.7], np.float32), np.asarray([1], np.int64)
+
+
+FAMILIES = [("top-heavy", _top_heavy), ("bottom-heavy", _bottom_heavy),
+            ("alternating", _alternating), ("quantized", _tied_scores), ("singleton", _singleton)]
+IDS = [f[0] for f in FAMILIES]
+
+PAIRS = [
+    (retrieval_average_precision, ref_map, {}),
+    (retrieval_reciprocal_rank, ref_mrr, {}),
+    (retrieval_precision, ref_precision, {"top_k": 5}),
+    (retrieval_recall, ref_recall, {"top_k": 5}),
+    (retrieval_hit_rate, ref_hit, {"top_k": 5}),
+    (retrieval_fall_out, ref_fall_out, {"top_k": 5}),
+    (retrieval_r_precision, ref_rprec, {}),
+    (retrieval_normalized_dcg, ref_ndcg, {}),
+]
+
+
+def _seed(name):
+    import zlib
+
+    return zlib.crc32(name.encode()) % 2**16
+
+
+@pytest.mark.parametrize(("name", "gen"), FAMILIES, ids=IDS)
+def test_rank_structured_functionals_vs_reference(name, gen):
+    preds, target = gen(np.random.RandomState(_seed(name)))
+    kwargs_skip = {"top_k"} if len(preds) < 5 else set()
+    for ours, ref, kw in PAIRS:
+        if kwargs_skip and kw:
+            kw = {k: min(v, len(preds)) for k, v in kw.items()}
+        r = float(ref(torch.from_numpy(preds), torch.from_numpy(target), **kw))
+        g = float(ours(jnp.asarray(preds), jnp.asarray(target), **kw))
+        np.testing.assert_allclose(g, r, atol=1e-6, err_msg=f"{name}/{ours.__name__}")
+
+
+def test_graded_ndcg_vs_reference():
+    """Graded (non-binary) relevance: the gain term, not just ordering."""
+    rng = np.random.RandomState(11)
+    preds = rng.rand(N).astype(np.float32)
+    grades = rng.randint(0, 5, N).astype(np.int64)
+    for k in (None, 3, 10):
+        r = float(ref_ndcg(torch.from_numpy(preds), torch.from_numpy(grades), top_k=k))
+        g = float(retrieval_normalized_dcg(jnp.asarray(preds), jnp.asarray(grades), top_k=k))
+        np.testing.assert_allclose(g, r, atol=1e-6, err_msg=f"k={k}")
+
+
+@pytest.mark.parametrize("action", ["neg", "pos", "skip"])
+def test_grouped_map_with_empty_queries_vs_reference(action):
+    """Class-level grouped aggregation over a structured query mix: one
+    top-heavy, one all-irrelevant (exercises empty_target_action), one
+    singleton, one tie-heavy — identical indexes on both sides."""
+    rng = np.random.RandomState(5)
+    chunks, idx_chunks, tgt_chunks = [], [], []
+    scenes = [_top_heavy(rng), (rng.rand(20).astype(np.float32), np.zeros(20, np.int64)),
+              _singleton(rng), _tied_scores(rng)]
+    for qi, (p, t) in enumerate(scenes):
+        chunks.append(p)
+        tgt_chunks.append(t)
+        idx_chunks.append(np.full(len(p), qi, np.int64))
+    preds = np.concatenate(chunks)
+    target = np.concatenate(tgt_chunks)
+    indexes = np.concatenate(idx_chunks)
+
+    ours = RetrievalMAP(empty_target_action=action)
+    ours.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(indexes))
+    ref = RefRetrievalMAP(empty_target_action=action)
+    ref.update(torch.from_numpy(preds), torch.from_numpy(target), indexes=torch.from_numpy(indexes))
+    np.testing.assert_allclose(float(ours.compute()), float(ref.compute()), atol=1e-6, err_msg=action)
