@@ -175,8 +175,10 @@ def bench_config1() -> dict:
         state = metric.update_state_batched(metric.init_state(), preds, target)
         return state, metric.compute_state(state)
 
+    t_compile = time.perf_counter()
     state, _ = epoch(preds, target, jnp.float32(0))
     jax.block_until_ready(state)
+    compile_s = round(time.perf_counter() - t_compile, 3)
 
     def run(salt_base: float) -> float:
         reps = 5
@@ -194,6 +196,7 @@ def bench_config1() -> dict:
     ref = _ref_config1()
     return {"value": round(ours, 2), "unit": "updates/s", "vs_baseline": round(ours / ref, 3),
             "r1_style_unsalted_value": round(unsalted, 2),
+            "compile_s": compile_s,
             "roofline": _roofline(epoch, (preds, target, jnp.float32(0)), ours / STEPS)}
 
 
@@ -265,8 +268,10 @@ def bench_config2() -> dict:
         state, _ = lax.scan(body, coll.init_state(), (preds, target))
         return state, coll.compute_state(state)
 
+    t_compile = time.perf_counter()
     state, _ = epoch(preds, target, jnp.float32(0))
     jax.block_until_ready(state)
+    compile_s = round(time.perf_counter() - t_compile, 3)
     reps = 3
     t0 = time.perf_counter()
     states = [epoch(preds, target, jnp.float32(_SALT_BASE + (r + 1) * 1e-9))[0] for r in range(reps)]
@@ -302,7 +307,84 @@ def bench_config2() -> dict:
         ref = ref_steps / (time.perf_counter() - t0)
     return {"value": round(ours, 2), "unit": "updates/s",
             "vs_baseline": round(ours / ref, 3) if ref else None,
+            "compile_s": compile_s,
             "roofline": _roofline(epoch, (preds, target, jnp.float32(0)), ours / steps)}
+
+
+def bench_smoke() -> dict:
+    """CPU-safe sanity pass: tiny shapes, one rep, no backend probe.
+
+    Exercises the paths the full bench depends on — the eager fused-dispatch
+    collection update (exactly one XLA dispatch per ``MetricCollection.update``
+    after warmup), the process-global executable cache (``clone()`` compiles
+    nothing new), and bucketed eager sync via ``FakeSync``. Emits one JSON
+    line; ``tests/test_bench_smoke.py`` runs it as a tier-1 guard so bench
+    breakage is caught before a TPU round burns its budget.
+    """
+    import jax
+    import jax.numpy as jnp  # noqa: F401 — backend init before metric imports
+
+    import torchmetrics_tpu.metric as M
+    from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassF1Score
+    from torchmetrics_tpu.collections import MetricCollection
+    from torchmetrics_tpu.parallel.sync import FakeSync
+
+    n_cls, batch, steps = 4, 8, 3
+    coll = MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=n_cls, average="micro", validate_args=False),
+            "f1": MulticlassF1Score(num_classes=n_cls, average="macro", validate_args=False),
+        }
+    )
+    preds = jax.nn.softmax(jax.random.normal(jax.random.PRNGKey(0), (steps, batch, n_cls)), axis=-1)
+    target = jax.random.randint(jax.random.PRNGKey(1), (steps, batch), 0, n_cls)
+
+    t0 = time.perf_counter()
+    coll.update(preds[0], target[0])  # group discovery: per-member updates
+    coll.update(preds[1], target[1])  # traces + compiles the fused program
+    compile_s = round(time.perf_counter() - t0, 3)
+
+    before = M.executable_cache_stats()["dispatches"]
+    t0 = time.perf_counter()
+    coll.update(preds[2], target[2])
+    update_s = round(time.perf_counter() - t0, 5)
+    dispatches = M.executable_cache_stats()["dispatches"] - before
+
+    miss_before = M.executable_cache_stats()["misses"]
+    clone = coll.clone()
+    clone.update(preds[0], target[0])
+    clone.update(preds[1], target[1])
+    clone_misses = M.executable_cache_stats()["misses"] - miss_before
+
+    values = {k: round(float(v), 6) for k, v in coll.compute().items()}
+
+    # bucketed eager sync: each rank's fixed-shape (SUM, dtype) states ride
+    # one concatenated FakeSync collective per bucket
+    ranks = [MulticlassAccuracy(num_classes=n_cls, average="micro", validate_args=False) for _ in range(2)]
+    for r, m in enumerate(ranks):
+        m.update(preds[r], target[r])
+    group = [m.metric_state for m in ranks]
+    for r, m in enumerate(ranks):
+        m.sync(sync_backend=FakeSync(group, r))
+    synced = round(float(ranks[0].compute()), 6)
+    per_rank = round(
+        float(
+            jnp.sum(jnp.argmax(preds[:2], axis=-1) == target[:2]) / (2 * batch)
+        ),
+        6,
+    )
+
+    return {
+        "mode": "smoke",
+        "ok": dispatches == 1 and clone_misses == 0 and synced == per_rank,
+        "dispatches_per_update": dispatches,
+        "clone_new_compilations": clone_misses,
+        "warmup_compile_s": compile_s,
+        "update_s": update_s,
+        "values": values,
+        "synced_accuracy": synced,
+        "expected_synced_accuracy": per_rank,
+    }
 
 
 # ---------------------------------------------------------------------- 3
@@ -919,6 +1001,11 @@ def main() -> None:
     # must still see the final line in time. A CPU-fallback re-exec carries
     # its pre-exec wall time in _TM_BENCH_ELAPSED_S for the same reason.
     main_t0 = time.perf_counter() - float(os.environ.get("_TM_BENCH_ELAPSED_S", "0") or 0)
+    if len(sys.argv) > 1 and sys.argv[1] == "--smoke":
+        # CPU-safe, probe-free: must work in CI / tier-1 without a TPU tunnel
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        print(json.dumps(bench_smoke()))
+        return
     _ensure_working_backend()
     if len(sys.argv) > 1 and sys.argv[1] == "--map-child":
         print(_map_epoch_seconds())

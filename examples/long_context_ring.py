@@ -17,6 +17,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+try:
+    shard_map = jax.shard_map  # jax >= 0.6
+except AttributeError:
+    from jax.experimental.shard_map import shard_map
+
 from torchmetrics_tpu.parallel import ring_attention
 from torchmetrics_tpu.text.perplexity import Perplexity
 
@@ -46,7 +51,7 @@ def main() -> None:
         return ppl.reduce_state(state, "sp")
 
     fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             eval_step,
             mesh=mesh,
             in_specs=(P(None, "sp", None), P(None, "sp"), P()),

@@ -11,6 +11,10 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tests.helpers.testers import _shard_map
+
+shard_map = _shard_map()
+
 from torchmetrics_tpu.parallel import (
     demo_param_shardings,
     expert_all_to_all,
@@ -34,7 +38,7 @@ def test_ring_attention_matches_full_attention(causal):
     v = jnp.asarray(rng.randn(B, T, D).astype(np.float32))
     mesh = _mesh1d("sp")
     ra = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda q, k, v: ring_attention(q, k, v, "sp", causal=causal),
             mesh=mesh, in_specs=(P(None, "sp", None),) * 3, out_specs=P(None, "sp", None),
         )
@@ -55,7 +59,7 @@ def test_ring_attention_bf16():
     v = jnp.asarray(rng.randn(B, T, D), jnp.bfloat16)
     mesh = _mesh1d("sp")
     ra = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda q, k, v: ring_attention(q, k, v, "sp"),
             mesh=mesh, in_specs=(P(None, "sp", None),) * 3, out_specs=P(None, "sp", None),
         )
@@ -76,7 +80,7 @@ def test_ring_attention_differentiable():
     mesh = _mesh1d("sp")
 
     def loss_ring(q, k, v):
-        f = jax.shard_map(
+        f = shard_map(
             lambda q, k, v: ring_attention(q, k, v, "sp"),
             mesh=mesh, in_specs=(P(None, "sp", None),) * 3, out_specs=P(None, "sp", None),
         )
@@ -101,9 +105,9 @@ def test_expert_all_to_all_dispatch_semantics():
     def once(x):
         return expert_all_to_all(x, "ep", split_axis=1, concat_axis=1)
 
-    f1 = jax.jit(jax.shard_map(once, mesh=mesh, in_specs=(P("ep", None, None),),
+    f1 = jax.jit(shard_map(once, mesh=mesh, in_specs=(P("ep", None, None),),
                                out_specs=P("ep", None, None)))
-    f2 = jax.jit(jax.shard_map(lambda x: once(once(x)), mesh=mesh,
+    f2 = jax.jit(shard_map(lambda x: once(once(x)), mesh=mesh,
                                in_specs=(P("ep", None, None),), out_specs=P("ep", None, None)))
     # dispatch: expert e receives group e from every source shard
     np.testing.assert_allclose(np.asarray(f1(x)), np.asarray(x.transpose(1, 0, 2)), atol=0)

@@ -1,0 +1,32 @@
+"""Tier-1 guard for ``bench.py --smoke``.
+
+The full bench only runs on the driver's TPU rounds; if an API change breaks
+it, the breakage surfaces only after a round's budget is already burned.
+``--smoke`` replays the bench's load-bearing paths (fused collection
+dispatch, global executable cache, bucketed FakeSync) on CPU with tiny
+shapes, so tier-1 catches bench rot immediately.
+"""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_smoke_passes():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--smoke"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    # last stdout line is the JSON payload
+    line = proc.stdout.strip().splitlines()[-1]
+    result = json.loads(line)
+    assert result["mode"] == "smoke"
+    assert result["ok"] is True, result
+    # the specific invariants, asserted individually for a readable failure
+    assert result["dispatches_per_update"] == 1, result
+    assert result["clone_new_compilations"] == 0, result
+    assert result["synced_accuracy"] == result["expected_synced_accuracy"], result

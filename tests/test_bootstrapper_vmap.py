@@ -176,7 +176,7 @@ def test_poisson_loop_is_eager_no_retrace_hazard():
         boot.update(jnp.asarray(p), jnp.asarray(t))
     for m in boot.metrics:
         assert not m._use_jit
-        assert len(m._jit_cache) == 0
+        assert not m.__dict__.get("_jit_bound")  # eager copies never bind a jitted entry
     out = boot.compute()
     assert np.isfinite(float(out["mean"]))
 

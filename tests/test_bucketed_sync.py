@@ -1,0 +1,188 @@
+"""Bucketed state sync: one collective per (Reduction, dtype) bucket.
+
+Pins the perf PR's collective-count contract with jaxpr inspection and its
+correctness contract bitwise: flattening elementwise-reduced leaves into one
+concatenated buffer must be bit-identical to reducing each leaf on its own
+(psum/pmean/pmax/pmin act elementwise), while cat/NONE/custom states stay
+per-leaf. Covers the in-graph SPMD path (``reduce_state_in_graph``), the
+eager path (``Metric.sync`` over ``FakeSync``), and mixed dtypes/shapes.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import core
+
+from torchmetrics_tpu import Metric
+from torchmetrics_tpu.parallel.reduction import ELEMENTWISE_REDUCTIONS, Reduction
+from torchmetrics_tpu.parallel.sync import FakeSync, reduce_state_in_graph, reduce_tensor_in_graph
+
+WORLD = 4
+
+
+def _count_primitives(closed_jaxpr) -> dict:
+    counts: dict = {}
+
+    def walk(jaxpr) -> None:
+        for eqn in jaxpr.eqns:
+            counts[eqn.primitive.name] = counts.get(eqn.primitive.name, 0) + 1
+            for val in eqn.params.values():
+                for v in val if isinstance(val, (list, tuple)) else (val,):
+                    if isinstance(v, core.ClosedJaxpr):
+                        walk(v.jaxpr)
+                    elif isinstance(v, core.Jaxpr):
+                        walk(v)
+
+    walk(closed_jaxpr.jaxpr)
+    return counts
+
+
+def _mixed_state(rank: int):
+    """Scalar/vector/matrix leaves across two dtypes + a cat tuple state."""
+    r = float(rank + 1)
+    state = {
+        "a": jnp.float32(r),                                   # SUM f32 scalar
+        "b": jnp.arange(6, dtype=jnp.float32).reshape(2, 3) * r,  # SUM f32 matrix
+        "c": jnp.asarray([r, -r, 0.5 * r], dtype=jnp.float32),    # MEAN f32 vector
+        "d": jnp.float32(1.0) / r,                             # MEAN f32 scalar
+        "e": jnp.asarray([rank, rank + 2], dtype=jnp.int32),   # SUM i32 vector
+        "f": jnp.asarray([[r, 2 * r]], dtype=jnp.float32),     # MAX f32 matrix
+        "g": (jnp.asarray([r, r + 1], dtype=jnp.float32),),    # CAT tuple state
+    }
+    reds = {
+        "a": Reduction.SUM, "b": Reduction.SUM, "c": Reduction.MEAN,
+        "d": Reduction.MEAN, "e": Reduction.SUM, "f": Reduction.MAX,
+        "g": Reduction.CAT,
+    }
+    return state, reds
+
+
+def _per_leaf_reduce(state, reds, axis_name):
+    """The pre-bucketing reference: one collective per state leaf."""
+    out = {}
+    for name, value in state.items():
+        red = reds[name]
+        if isinstance(value, (list, tuple)):
+            out[name] = type(value)(reduce_tensor_in_graph(v, red, axis_name) for v in value)
+        else:
+            out[name] = reduce_tensor_in_graph(value, red, axis_name)
+    return out
+
+
+def test_one_collective_per_bucket_in_jaxpr():
+    state, reds = _mixed_state(0)
+    jaxpr = jax.make_jaxpr(
+        lambda s: reduce_state_in_graph(s, reds, "dp"), axis_env=[("dp", WORLD)]
+    )(state)
+    counts = _count_primitives(jaxpr)
+    # buckets: (SUM,f32)={a,b} (MEAN,f32)={c,d} (SUM,i32)={e}. pmean lowers
+    # to one psum + divide, and the cat state's invariant gather is built on
+    # one psum of a masked buffer, so psum == 3 buckets + 1 gather
+    assert counts.get("psum", 0) == 4, counts
+    assert counts.get("pmax", 0) == 1, counts  # (MAX,f32)={f}
+    assert counts.get("pmin", 0) == 0, counts
+
+
+def test_per_leaf_reference_issues_one_collective_per_leaf():
+    # sanity for the comparison itself: without bucketing the same state
+    # costs one collective per elementwise LEAF (5: a,b,c,d,e) + 1 for the
+    # cat gather, instead of one per BUCKET (3) + 1
+    state, reds = _mixed_state(0)
+    jaxpr = jax.make_jaxpr(
+        lambda s: _per_leaf_reduce(s, reds, "dp"), axis_env=[("dp", WORLD)]
+    )(state)
+    counts = _count_primitives(jaxpr)
+    assert counts.get("psum", 0) == 6, counts
+    assert counts.get("pmax", 0) == 1, counts
+
+
+def test_bucketed_reduce_bitwise_identical_to_per_leaf():
+    states = [_mixed_state(r)[0] for r in range(WORLD)]
+    reds = _mixed_state(0)[1]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *states)
+
+    fused = jax.vmap(lambda s: reduce_state_in_graph(s, reds, "dp"), axis_name="dp")(stacked)
+    ref = jax.vmap(lambda s: _per_leaf_reduce(s, reds, "dp"), axis_name="dp")(stacked)
+
+    flat_f, tree_f = jax.tree_util.tree_flatten(fused)
+    flat_r, tree_r = jax.tree_util.tree_flatten(ref)
+    assert tree_f == tree_r
+    for a, b in zip(flat_f, flat_r):
+        assert a.dtype == b.dtype
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))  # bitwise
+
+
+def test_single_entry_bucket_matches_per_leaf():
+    state = {"x": jnp.asarray([1.0, 2.0], dtype=jnp.float32)}
+    reds = {"x": Reduction.SUM}
+    stacked = {"x": jnp.stack([jnp.asarray([1.0, 2.0]) * (r + 1) for r in range(WORLD)])}
+    fused = jax.vmap(lambda s: reduce_state_in_graph(s, reds, "dp"), axis_name="dp")(stacked)
+    np.testing.assert_array_equal(np.asarray(fused["x"][0]), np.asarray([10.0, 20.0]))
+    # and no concatenate detour for a lone leaf: exactly one psum, no reshapes needed
+    jaxpr = jax.make_jaxpr(
+        lambda s: reduce_state_in_graph(s, reds, "dp"), axis_env=[("dp", WORLD)]
+    )(state)
+    assert _count_primitives(jaxpr).get("concatenate", 0) == 0
+
+
+def test_elementwise_reductions_frozenset_contract():
+    assert ELEMENTWISE_REDUCTIONS == {Reduction.SUM, Reduction.MEAN, Reduction.MAX, Reduction.MIN}
+    assert Reduction.CAT not in ELEMENTWISE_REDUCTIONS
+    assert Reduction.NONE not in ELEMENTWISE_REDUCTIONS
+
+
+# ---------------------------------------------------------------- eager FakeSync
+class _MultiState(Metric):
+    full_state_update = False
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("count", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("peak", jnp.full((), -jnp.inf), dist_reduce_fx="max")
+        self.add_state("vec", jnp.zeros(3), dist_reduce_fx="sum")
+        self.add_state("vals", [], dist_reduce_fx="cat")
+
+    def update(self, x):
+        self.total = self.total + jnp.sum(x)
+        self.count = self.count + jnp.asarray(x.shape[0], dtype=jnp.int32)
+        self.peak = jnp.maximum(self.peak, jnp.max(x))
+        self.vec = self.vec + x[:3]
+        self.vals.append(x)
+
+    def compute(self):
+        return self.total / self.count
+
+
+def test_fake_sync_bucketed_matches_manual_merge():
+    ranks = [_MultiState() for _ in range(WORLD)]
+    data = [jnp.asarray(np.random.RandomState(r).rand(5).astype(np.float32)) for r in range(WORLD)]
+    for m, x in zip(ranks, data):
+        m.update(x)
+    # FakeSync worlds pre-concat cat states (the backend gathers tensors)
+    group = [
+        {**{k: v for k, v in m.metric_state.items() if k != "vals"},
+         "vals": jnp.concatenate([jnp.asarray(e) for e in m.metric_state["vals"]])}
+        for m in ranks
+    ]
+    for r, m in enumerate(ranks):
+        m.sync(sync_backend=FakeSync(group, r))
+
+    total = sum(float(jnp.sum(x)) for x in data)
+    count = sum(x.shape[0] for x in data)
+    peak = max(float(jnp.max(x)) for x in data)
+    vec = np.sum([np.asarray(x[:3]) for x in data], axis=0)
+    for m in ranks:
+        assert float(m.total) == pytest.approx(total, rel=1e-6)
+        assert int(m.count) == count
+        assert m.count.dtype == jnp.int32  # i32 bucket must round-trip its dtype
+        assert float(m.peak) == pytest.approx(peak, rel=1e-6)
+        np.testing.assert_allclose(np.asarray(m.vec), vec, rtol=1e-6)
+        gathered = np.concatenate([np.asarray(v) for v in m.vals]) if isinstance(m.vals, list) \
+            else np.asarray(m.vals)
+        assert gathered.size == sum(x.size for x in data)  # cat state: gathered, not bucketed
+        assert float(m.compute()) == pytest.approx(total / count, rel=1e-6)
+        m.unsync()
+    # unsync restores the local (pre-sync) state
+    assert float(ranks[0].total) == pytest.approx(float(jnp.sum(data[0])), rel=1e-6)

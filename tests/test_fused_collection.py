@@ -1,0 +1,248 @@
+"""Fused single-dispatch collection updates + the process-global executable cache.
+
+Regression pins for the perf PR:
+- ``MetricCollection.update`` costs exactly ONE XLA dispatch after warmup
+  (group discovery on call 1, fused trace on call 2);
+- ``clone()`` / pickled copies / BootStrapper replay copies compile NOTHING
+  new — equal (class, config, avals) keys hit the global executable cache;
+- donation of the state buffers is safe across reset/update/forward cycles;
+- ``reset()`` restores the constructor-time compute groups after
+  ``forward()``'s ``_ungroup``;
+- ``update_state_batched`` MEAN states fold the prior state in via
+  ``update_count`` instead of silently discarding it.
+"""
+import pickle
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import torchmetrics_tpu.metric as M
+from torchmetrics_tpu import BootStrapper, MeanMetric, Metric
+from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassF1Score
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.regression import PearsonCorrCoef
+
+N_CLS = 5
+
+
+def _data(steps=4, batch=16, seed=0):
+    preds = jax.nn.softmax(
+        jax.random.normal(jax.random.PRNGKey(seed), (steps, batch, N_CLS)), axis=-1
+    )
+    target = jax.random.randint(jax.random.PRNGKey(seed + 1), (steps, batch), 0, N_CLS)
+    return preds, target
+
+
+def _coll(**kw):
+    return MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=N_CLS, average="micro", validate_args=False),
+            "f1": MulticlassF1Score(num_classes=N_CLS, average="macro", validate_args=False),
+        },
+        **kw,
+    )
+
+
+def _warm(coll, preds, target):
+    coll.update(preds[0], target[0])  # group discovery: per-member eager
+    coll.update(preds[1], target[1])  # traces + compiles the fused program
+    return coll
+
+
+# ---------------------------------------------------------------- dispatch count
+def test_collection_update_is_single_dispatch_after_warmup():
+    preds, target = _data()
+    coll = _warm(_coll(), preds, target)
+    assert any(len(g) > 1 for g in coll.compute_groups.values())  # acc+f1 merged
+    for i in (2, 3):
+        before = M.executable_cache_stats()["dispatches"]
+        coll.update(preds[i], target[i])
+        delta = M.executable_cache_stats()["dispatches"] - before
+        assert delta == 1, f"update {i}: {delta} dispatches, expected exactly 1"
+
+
+def test_fused_update_matches_per_member_eager():
+    preds, target = _data()
+    coll = _coll()
+    acc = MulticlassAccuracy(num_classes=N_CLS, average="micro", validate_args=False)
+    f1 = MulticlassF1Score(num_classes=N_CLS, average="macro", validate_args=False)
+    acc._use_jit = f1._use_jit = False  # reference path: fully eager, unfused
+    for i in range(4):
+        coll.update(preds[i], target[i])
+        acc.update(preds[i], target[i])
+        f1.update(preds[i], target[i])
+    out = coll.compute()
+    np.testing.assert_allclose(np.asarray(out["acc"]), np.asarray(acc.compute()), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out["f1"]), np.asarray(f1.compute()), rtol=1e-6)
+
+
+def test_string_inputs_fall_back_to_per_member_loop():
+    # numpy-of-objects / str args can't be traced; the fused path must bow out
+    class StrMetric(Metric):
+        full_state_update = False
+
+        def __init__(self):
+            super().__init__()
+            self.add_state("hits", jnp.zeros(()), dist_reduce_fx="sum")
+
+        def update(self, x, mode="exact"):  # noqa: ARG002 — str kwarg blocks tracing
+            self.hits = self.hits + jnp.sum(x)
+
+        def compute(self):
+            return self.hits
+
+    coll = MetricCollection({"s": StrMetric()})
+    for _ in range(3):
+        coll.update(jnp.ones(2), mode="fuzzy")
+    assert float(coll.compute()["s"]) == 6.0
+
+
+# ---------------------------------------------------------------- global cache
+def test_clone_compiles_nothing_new():
+    preds, target = _data()
+    coll = _warm(_coll(), preds, target)
+    coll.update(preds[2], target[2])
+
+    before = M.executable_cache_stats()["misses"]
+    clone = coll.clone()
+    clone.reset()
+    for i in range(4):
+        clone.update(preds[i], target[i])
+    out = clone.compute()
+    assert M.executable_cache_stats()["misses"] == before, "clone() must not recompile"
+    assert 0.0 <= float(out["acc"]) <= 1.0
+
+
+def test_pickle_roundtrip_shares_executables():
+    preds, target = _data()
+    coll = _warm(_coll(), preds, target)
+    copy = pickle.loads(pickle.dumps(coll))
+    copy.reset()
+    before = M.executable_cache_stats()["misses"]
+    _warm(copy, preds, target)
+    copy.update(preds[2], target[2])
+    assert M.executable_cache_stats()["misses"] == before
+    np.testing.assert_allclose(
+        np.asarray(copy.compute()["f1"]),
+        np.asarray(_eager_f1(preds[:3], target[:3])),
+        rtol=1e-6,
+    )
+
+
+def _eager_f1(preds, target):
+    f1 = MulticlassF1Score(num_classes=N_CLS, average="macro", validate_args=False)
+    for p, t in zip(preds, target):
+        f1.update(p, t)
+    return f1.compute()
+
+
+def test_bootstrapper_replay_copies_share_one_executable():
+    # NONE-reduction moment states keep Pearson off the vmap fast path, so
+    # this exercises the replay loop: B jitted per-copy updates
+    boot = BootStrapper(PearsonCorrCoef(), num_bootstraps=5, sampling_strategy="multinomial", seed=3)
+    assert not boot._vmap_path and len(boot.metrics) == 5
+    rng = np.random.RandomState(0)
+
+    def batch():
+        return jnp.asarray(rng.rand(32).astype(np.float32)), jnp.asarray(rng.rand(32).astype(np.float32))
+
+    p, t = batch()
+    before = M.executable_cache_stats()
+    boot.update(p, t)
+    after = M.executable_cache_stats()
+    assert after["misses"] - before["misses"] == 1, "5 equal-config copies must share 1 executable"
+    assert after["dispatches"] - before["dispatches"] == 5
+    p2, t2 = batch()
+    boot.update(p2, t2)
+    assert M.executable_cache_stats()["misses"] == after["misses"]
+    out = boot.compute()
+    assert np.isfinite(float(out["mean"]))
+
+
+# ---------------------------------------------------------------- donation safety
+def test_donated_updates_survive_reset_cycles():
+    m = MeanMetric()
+    for _ in range(3):
+        m.reset()
+        for v in (1.0, 2.0, 3.5, 4.5):
+            m.update(jnp.asarray(v))
+        assert float(m.compute()) == pytest.approx(2.75)
+
+
+def test_donated_forward_batch_and_global_values():
+    m = MeanMetric()
+    assert float(m.forward(jnp.asarray([2.0, 4.0]))) == pytest.approx(3.0)
+    assert float(m.forward(jnp.asarray([5.0, 7.0]))) == pytest.approx(6.0)
+    assert float(m.compute()) == pytest.approx(4.5)
+
+
+# ---------------------------------------------------------------- reset/regroup
+def test_reset_restores_compute_groups_after_forward():
+    preds, target = _data()
+    coll = _warm(_coll(), preds, target)
+    assert any(len(g) > 1 for g in coll.compute_groups.values())
+
+    coll.forward(preds[2], target[2])  # _ungroup: members need their own batch values
+    assert not coll._enable_compute_groups
+    assert all(len(g) == 1 for g in coll.compute_groups.values())
+
+    coll.reset()
+    assert coll._enable_compute_groups, "reset() must restore the constructor-time grouping"
+    _warm(coll, preds, target)
+    assert any(len(g) > 1 for g in coll.compute_groups.values())
+    # and the fused single-dispatch path is back too
+    before = M.executable_cache_stats()["dispatches"]
+    coll.update(preds[2], target[2])
+    assert M.executable_cache_stats()["dispatches"] - before == 1
+
+
+def test_reset_respects_manual_and_disabled_groups():
+    preds, target = _data()
+    coll = _coll(compute_groups=False)
+    _warm(coll, preds, target)
+    coll.forward(preds[2], target[2])
+    coll.reset()
+    assert not coll._enable_compute_groups  # False stays False
+
+    manual = _coll(compute_groups=[["acc", "f1"]])
+    _warm(manual, preds, target)
+    manual.forward(preds[2], target[2])
+    manual.reset()
+    assert manual._manual_groups == [["acc", "f1"]]
+    _warm(manual, preds, target)
+    assert any(len(g) > 1 for g in manual.compute_groups.values())
+
+
+# ---------------------------------------------------------------- batched MEAN fix
+class _BatchMean(Metric):
+    full_state_update = False
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("avg", jnp.zeros(()), dist_reduce_fx="mean")
+
+    def update(self, x):
+        self.avg = jnp.mean(x)
+
+    def compute(self):
+        return self.avg
+
+
+def test_update_state_batched_mean_folds_prior_state():
+    m = _BatchMean()
+    state = m.update_state(m.init_state(), jnp.asarray([3.0]))
+    assert float(state["avg"]) == pytest.approx(3.0)
+    stacked = (jnp.asarray([[10.0], [4.0]]),)  # S=2 steps with means 10 and 4
+    merged = m.update_state_batched(state, *stacked, update_count=1)
+    # (3*1 + 10 + 4) / (1 + 2): prior mean weighted by its update count
+    assert float(merged["avg"]) == pytest.approx(17.0 / 3.0)
+
+
+def test_update_state_batched_mean_default_matches_fresh_state():
+    m = _BatchMean()
+    stacked = (jnp.asarray([[10.0], [4.0]]),)
+    out = m.update_state_batched(m.init_state(), *stacked)
+    assert float(out["avg"]) == pytest.approx(7.0)  # mean of the step means

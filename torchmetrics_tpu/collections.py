@@ -5,13 +5,33 @@ Parity: reference ``src/torchmetrics/collections.py`` — class :34, forward/
 update :191-226, compute-group discovery :228-308, ``_compute_and_reduce``
 :314-359, copy-on-read ``items/values`` :515-529.
 
-TPU-first divergence (SURVEY.md §7 decision 4): the collection traces ALL
-member updates into ONE jitted function over the dict-of-state-dicts pytree,
-so per-step overhead is one dispatch regardless of member count — the
-reference pays a Python loop per metric per step (``collections.py:200``).
+TPU-first divergence (SURVEY.md §7 decision 4), on BOTH call paths:
+
+- **Eager class API** (:meth:`MetricCollection.update`): after the first
+  update discovers compute groups, every jit-capable group representative's
+  ``_pure_update`` body is traced into ONE jitted program over the
+  dict-of-state-dicts pytree, so a training step pays a single XLA dispatch
+  regardless of member count — the reference pays a Python loop per metric
+  per step (``collections.py:200``). The state pytree is donated
+  (``donate_argnums``) so XLA reuses the state's HBM buffers in place of
+  allocating fresh ones every step, and the fused program lives in the
+  process-global executable cache (``metric._EXECUTABLE_CACHE``), so
+  ``clone()``'d collections reuse the compiled program instead of retracing.
+  Host-side (non-jittable) members keep their eager per-member path, and
+  inputs that aren't valid jit arguments (e.g. strings) fall back to the
+  per-representative loop.
+- **Pure SPMD API** (:meth:`update_state` / :meth:`reduce_state` /
+  :meth:`compute_state`): explicit state pytrees for ``shard_map``/``pjit``
+  loops; ``reduce_state`` flattens every member's elementwise-reduced leaves
+  into one buffer per ``(Reduction, dtype)`` bucket, issuing one collective
+  per bucket for the WHOLE collection (see ``docs/fused_dispatch.md``).
+
 Compute groups additionally alias member state dicts to the group
 representative's (literal state sharing; arrays are immutable so aliasing the
 dict is safe), giving the reference's documented 2-3× update saving on top.
+``reset()`` restores the constructor-time grouping config, so a collection
+used via ``forward`` (which must un-share states) regains group sharing for
+the next epoch.
 """
 from collections import OrderedDict
 from copy import deepcopy
@@ -21,7 +41,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .metric import Metric, _filter_kwargs
+from .metric import Metric, _filter_kwargs, _global_jit, _jit_safe_inputs
+from .parallel.reduction import Reduction
+from .parallel.sync import reduce_state_in_graph
 from .utils.exceptions import TorchMetricsUserError
 
 
@@ -66,11 +88,15 @@ class MetricCollection:
         self._metrics: "OrderedDict[str, Metric]" = OrderedDict()
         self.prefix = self._check_arg(prefix, "prefix")
         self.postfix = self._check_arg(postfix, "postfix")
+        # constructor-time grouping config is kept so reset() can restore it
+        # after forward()'s _ungroup disabled sharing for the epoch
+        self._initial_compute_groups = compute_groups
         self._enable_compute_groups = bool(compute_groups) or isinstance(compute_groups, list)
         self._manual_groups = compute_groups if isinstance(compute_groups, list) else None
         self._groups: Dict[int, List[str]] = {}
         self._groups_checked = False
         self._state_is_copy = False
+        self._fused_plan: Optional[tuple] = None
         self.add_metrics(metrics, *additional_metrics)
 
     @staticmethod
@@ -124,6 +150,7 @@ class MetricCollection:
 
     def _init_compute_groups(self) -> None:
         self._groups_checked = False
+        self._fused_plan = None
         if not self._enable_compute_groups:
             self._groups = {i: [n] for i, n in enumerate(self._metrics)}
             return
@@ -203,23 +230,107 @@ class MetricCollection:
     # lifecycle
     # ------------------------------------------------------------------
     def update(self, *args: Any, **kwargs: Any) -> None:
-        """Update members; after group discovery only representatives run."""
+        """Update members with ONE jitted dispatch after group discovery.
+
+        The first call runs every member eagerly (group discovery compares
+        post-update states); afterwards all jit-capable group
+        representatives' update bodies run inside a single fused jitted
+        program over the dict-of-state-dicts pytree with donated input
+        buffers. Host-side members and non-jittable inputs fall back to the
+        per-representative loop.
+        """
         if self._state_is_copy:
             self._create_state_refs()  # re-alias after a copy-on-read
-        if self._groups_checked:
-            for members in self._groups.values():
-                rep = self._metrics[members[0]]
-                rep.update(*args, **_filter_kwargs(rep._update_impl, **kwargs))
-                for name in members[1:]:
-                    self._metrics[name]._update_count = rep._update_count
-                    self._metrics[name]._computed = None
-        else:
+        if not self._groups_checked:
             for name, m in self._metrics.items():
                 m.update(*args, **_filter_kwargs(m._update_impl, **kwargs))
             if self._enable_compute_groups:
                 self._merge_compute_groups()
                 self._create_state_refs()
             self._groups_checked = True
+            self._fused_plan = None  # groups may have changed
+            return
+        fused, eager, fused_fn = self._fused_update_plan()
+        if fused and _jit_safe_inputs(args, kwargs):
+            self._run_fused_update(fused, fused_fn, args, kwargs)
+            pending = eager
+        else:
+            pending = fused + eager
+        for _name, rep in pending:
+            rep.update(*args, **_filter_kwargs(rep._update_impl, **kwargs))
+        for members in self._groups.values():
+            rep = self._metrics[members[0]]
+            for name in members[1:]:
+                self._metrics[name]._update_count = rep._update_count
+                self._metrics[name]._computed = None
+
+    def _fused_update_plan(self) -> tuple:
+        """(jit-fusable reps, eager reps, fused jitted fn) — cached per grouping."""
+        if self._fused_plan is None:
+            fused: List[Tuple[str, Metric]] = []
+            eager: List[Tuple[str, Metric]] = []
+            for members in self._groups.values():
+                rep = self._metrics[members[0]]
+                (fused if rep._use_jit else eager).append((members[0], rep))
+            fused_fn = self._build_fused_update(tuple(fused)) if fused else None
+            self._fused_plan = (fused, eager, fused_fn)
+        return self._fused_plan
+
+    def _build_fused_update(self, reps: Tuple[Tuple[str, Metric], ...]):
+        """One jitted program running every representative's update body.
+
+        Cached process-globally under the tuple of (member name, member
+        executable key): a clone()'d collection — equal names, equal member
+        configs — reuses the compiled program without retracing. The traced
+        function closes over a snapshot of the representatives, so later
+        mutations of this instance's grouping can't change what an
+        already-cached entry traces.
+        """
+        key = ("mc_fused_update", tuple((name, rep._executable_cache_key()) for name, rep in reps))
+
+        def fused_update(states: Dict[str, Any], args: tuple, kwargs: Dict[str, Any]):
+            new_states: Dict[str, Any] = {}
+            new_appends: Dict[str, Any] = {}
+            for name, rep in reps:
+                fkw = _filter_kwargs(rep._update_impl, **kwargs)
+                tensors, appends = rep._pure_update(states[name], args, fkw)
+                new_states[name] = tensors
+                new_appends[name] = appends
+            return new_states, new_appends
+
+        return _global_jit(key, fused_update, donate_state=True)
+
+    def _run_fused_update(self, fused, fused_fn, args: tuple, kwargs: Dict[str, Any]) -> None:
+        for _name, rep in fused:
+            if rep._is_synced:
+                raise TorchMetricsUserError(
+                    "The Metric is currently synced; call `unsync()` before `update`."
+                )
+        conv = fused[0][1]._to_array
+        args = tuple(conv(a) for a in args)
+        kwargs = {k: conv(v) for k, v in kwargs.items()}
+        states: Dict[str, Any] = {}
+        seen: set = set()  # guards against donating one buffer twice
+        for name, rep in fused:
+            rep._computed = None
+            rep._update_count += 1
+            rep._eager_validate(*args, **_filter_kwargs(rep._update_impl, **kwargs))
+            st: Dict[str, Any] = {}
+            for k, v in rep.__dict__["_state"].items():
+                if k in rep._list_states:
+                    continue
+                if isinstance(v, jax.Array):
+                    if v is rep._defaults.get(k) or id(v) in seen:
+                        v = jnp.array(v, copy=True)
+                    seen.add(id(v))
+                st[k] = v
+            states[name] = st
+        new_states, appends = fused_fn(states, args, kwargs)
+        for name, rep in fused:
+            st = rep.__dict__["_state"]  # shared dict: members see it too
+            for k, v in new_states[name].items():
+                st[k] = v
+            rep._extend_list_states(appends[name])
 
     def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         """Batch values for every member + state accumulation.
@@ -245,6 +356,7 @@ class MetricCollection:
         self._manual_groups = None
         self._groups = {i: [n] for i, n in enumerate(self._metrics)}
         self._groups_checked = True
+        self._fused_plan = None
 
     def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         return self.forward(*args, **kwargs)
@@ -268,11 +380,34 @@ class MetricCollection:
         return out
 
     def reset(self) -> None:
+        # restore the constructor-time grouping config: forward()'s _ungroup
+        # disables sharing (each member needs its own batch value), but once
+        # every state is back at its default, sharing is safe again — without
+        # this, one forward() would cost the collection its compute groups
+        # (and the fused update's state aliasing) for the rest of its life.
+        # A collection whose grouping is intact keeps it: rediscovery over
+        # still-shared state dicts would double-count the discovery update.
+        cg = self._initial_compute_groups
+        enable = bool(cg) or isinstance(cg, list)
+        manual = cg if isinstance(cg, list) else None
+        regroup = enable != self._enable_compute_groups or manual != self._manual_groups
         for m in self._metrics.values():
+            if regroup:
+                m.__dict__["_state"] = {}  # un-share: discovery needs independent states
             m.reset()
-        if self._enable_compute_groups and self._groups_checked and self._manual_groups is None:
-            # regroup from scratch on next update (states may diverge again)
+        if regroup:
+            self._enable_compute_groups = enable
+            self._manual_groups = manual
+            self._state_is_copy = False
             self._init_compute_groups()
+
+    def __getstate__(self) -> Dict[str, Any]:
+        # the fused plan holds jitted closures (unpicklable) and references
+        # the live member objects; clones/unpickles rebuild it lazily and hit
+        # the process-global executable cache
+        state = self.__dict__.copy()
+        state["_fused_plan"] = None
+        return state
 
     def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MetricCollection":
         mc = deepcopy(self)
@@ -459,5 +594,46 @@ class MetricCollection:
         return {self._set_name(name): m.compute_state(states[name]) for name, m in self._metrics.items()}
 
     def reduce_state(self, states: Dict[str, Any], axis_name: str) -> Dict[str, Any]:
-        """Per-member collective reduction; signature groups reduce once."""
-        return self._grouped_apply(states, lambda m, s: m.reduce_state(s, axis_name))
+        """Collective reduction, bucketed across the WHOLE collection.
+
+        Every distinct member subtree's leaves go into one flat state dict
+        handed to a single :func:`reduce_state_in_graph` call, which buckets
+        all elementwise-reduced leaves by ``(Reduction, dtype)`` — one
+        collective per bucket for the entire collection, instead of one per
+        member per state. Signature groups (equal ``update_signature`` +
+        identical input leaves, as in :meth:`_grouped_apply`) contribute one
+        subtree and share the reduced result.
+        """
+        import jax.tree_util as jtu
+
+        flat_state: Dict[str, Any] = {}
+        flat_reds: Dict[str, Any] = {}
+        owners: Dict[str, str] = {}  # member -> member whose result it shares
+        flat_keys: Dict[str, List[Tuple[str, str]]] = {}  # owner -> [(state, flat key)]
+        shared: Dict[Any, Tuple[tuple, str]] = {}
+        for idx, (name, m) in enumerate(self._metrics.items()):
+            sig = m.update_signature
+            if sig is not None:
+                leaf_ids = tuple(id(leaf) for leaf in jtu.tree_leaves(states[name]))
+                cached = shared.get(sig)
+                if cached is not None and cached[0] == leaf_ids:
+                    owners[name] = cached[1]
+                    continue
+                shared[sig] = (leaf_ids, name)
+            owners[name] = name
+            keys = []
+            for k, v in states[name].items():
+                fk = f"{idx}~{k}"  # index-prefixed: member names may collide
+                flat_state[fk] = v
+                flat_reds[fk] = m._reductions.get(k, Reduction.NONE)
+                keys.append((k, fk))
+            flat_keys[name] = keys
+        reduced = reduce_state_in_graph(flat_state, flat_reds, axis_name)
+        out: Dict[str, Any] = {}
+        for name in self._metrics:
+            owner = owners[name]
+            if owner != name:
+                out[name] = out[owner]
+                continue
+            out[name] = {k: reduced[fk] for k, fk in flat_keys[name]}
+        return out

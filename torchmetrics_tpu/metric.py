@@ -32,8 +32,10 @@ TPU-first architecture (NOT a port — see SURVEY.md §7):
 from __future__ import annotations
 
 import copy
+import enum
 import functools
 import inspect
+import itertools
 from contextlib import contextmanager
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -41,7 +43,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .parallel.reduction import Reduction, resolve_reduction
+from .parallel.reduction import ELEMENTWISE_REDUCTIONS, Reduction, resolve_reduction
 from .parallel.sync import NoSync, SyncBackend, default_sync_backend, reduce_state_in_graph
 from .utils.data import dim_zero_cat
 from .utils.exceptions import TorchMetricsUserError
@@ -87,6 +89,131 @@ def _filter_kwargs(fn: Callable, **kwargs: Any) -> Dict[str, Any]:
 def jit_update_disabled():
     """Context manager disabling jitted update paths globally (debugging aid)."""
     return jax.disable_jit()
+
+
+def _jit_safe_inputs(*trees: Any) -> bool:
+    """True iff every pytree leaf can be passed as a jit argument."""
+    for leaf in jax.tree_util.tree_leaves(trees):
+        if not isinstance(leaf, (jax.Array, np.ndarray, np.generic, int, float, bool, complex)):
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# process-global executable cache
+#
+# Equal-config metric instances (clone(), BootStrapper's B replay copies,
+# MetricTracker epochs, MetricCollection.clone()) share one compiled program
+# instead of retracing per instance. Keys are derived from
+# (class, frozen config attributes, frozen state defaults); jit's own aval
+# cache layered underneath handles per-input-shape specialization.
+# ---------------------------------------------------------------------------
+
+_EXECUTABLE_CACHE: Dict[Any, Callable] = {}
+_CACHE_STATS = {"hits": 0, "misses": 0}
+_DISPATCH_COUNT = [0]
+_INSTANCE_KEY_COUNTER = itertools.count()
+
+_MAX_KEY_ARRAY_BYTES = 4096
+
+# attributes that never change the traced program (pure host-side bookkeeping)
+_RUNTIME_ATTRS = frozenset(
+    {
+        "_state",
+        "_defaults",
+        "_reductions",
+        "_persistent",
+        "_list_states",
+        "_cache",
+        "_computed",
+        "_update_count",
+        "_is_synced",
+        "_in_pure_update",
+        "_sync_backend",
+        "_jit_bound",
+        "_exec_key_cache",
+        "_exec_nonce",
+        "_use_jit",
+        "_compute_jittable",
+        "compute_on_cpu",
+        "dist_sync_on_step",
+        "sync_on_compute",
+        "compute_with_cache",
+    }
+)
+
+
+class _Unkeyable(Exception):
+    """Config value cannot be part of a process-shared cache key."""
+
+
+def _freeze_config_value(v: Any) -> Any:
+    if v is None or isinstance(v, (bool, int, float, complex, str, bytes)):
+        return v
+    if isinstance(v, enum.Enum):
+        return v
+    if isinstance(v, type):
+        return v
+    if isinstance(v, np.dtype):
+        return ("dtype", str(v))
+    if isinstance(v, np.generic):
+        return ("npscalar", str(v.dtype), v.item())
+    if isinstance(v, (jax.Array, np.ndarray)):
+        arr = np.asarray(v)
+        if arr.nbytes > _MAX_KEY_ARRAY_BYTES:
+            raise _Unkeyable("array attribute too large for a shared cache key")
+        return ("arr", arr.shape, str(arr.dtype), arr.tobytes())
+    if isinstance(v, (tuple, list)):
+        return ("seq", type(v).__name__, tuple(_freeze_config_value(x) for x in v))
+    if isinstance(v, (set, frozenset)):
+        return ("set", frozenset(_freeze_config_value(x) for x in v))
+    if isinstance(v, dict):
+        return ("map", tuple(sorted((k, _freeze_config_value(x)) for k, x in v.items())))
+    if isinstance(v, Metric):
+        # Metric.__eq__ builds a CompositionalMetric, so instances must never
+        # participate in key equality — fall back to a per-instance key
+        raise _Unkeyable("Metric-valued attribute")
+    if callable(v):
+        # identity-keyed: deepcopy keeps function objects, so clones share
+        return ("fn", id(v))
+    raise _Unkeyable(f"unkeyable config attribute of type {type(v).__name__}")
+
+
+def _global_jit(key: Any, fn: Callable, donate_state: bool = False) -> Callable:
+    """jit ``fn`` under a process-global key; count dispatches per call."""
+    key = (key, donate_state)
+    entry = _EXECUTABLE_CACHE.get(key)
+    if entry is None:
+        _CACHE_STATS["misses"] += 1
+        jitted = jax.jit(fn, donate_argnums=(0,) if donate_state else ())
+
+        def entry(*args: Any, **kwargs: Any) -> Any:
+            _DISPATCH_COUNT[0] += 1
+            return jitted(*args, **kwargs)
+
+        entry._jitted = jitted  # type: ignore[attr-defined]
+        _EXECUTABLE_CACHE[key] = entry
+    else:
+        _CACHE_STATS["hits"] += 1
+    return entry
+
+
+def clear_executable_cache() -> None:
+    """Drop all cached executables and reset counters (tests/benchmarks)."""
+    _EXECUTABLE_CACHE.clear()
+    _CACHE_STATS["hits"] = 0
+    _CACHE_STATS["misses"] = 0
+    _DISPATCH_COUNT[0] = 0
+
+
+def executable_cache_stats() -> Dict[str, int]:
+    """Cache size, hit/miss counts, and jitted dispatch count."""
+    return {
+        "size": len(_EXECUTABLE_CACHE),
+        "hits": _CACHE_STATS["hits"],
+        "misses": _CACHE_STATS["misses"],
+        "dispatches": _DISPATCH_COUNT[0],
+    }
 
 
 class Metric:
@@ -197,7 +324,6 @@ class Metric:
         self._computed: Any = None
         self._is_synced = False
         self._cache: Optional[StateDict] = None
-        self._jit_cache: Dict[str, Any] = {}
         self._dtype = jnp.float32
 
     # ------------------------------------------------------------------
@@ -241,6 +367,7 @@ class Metric:
         self._reductions[name] = red
         self._persistent[name] = persistent
         self._state[name] = [] if name in self._list_states else value
+        self._invalidate_executable_key()
 
     # attribute routing: registered states live in self._state
     def __getattr__(self, name: str) -> Any:
@@ -274,7 +401,16 @@ class Metric:
         self._cache = None
         self._is_synced = False
         for name, default in self._defaults.items():
-            self._state[name] = [] if name in self._list_states else default
+            if name in self._list_states:
+                self._state[name] = []
+            elif isinstance(default, jax.Array):
+                # fresh buffer, never an alias: grouped members share one
+                # state dict, so aliasing defaults here would let a later
+                # donated update delete ANOTHER member's default buffers
+                # (the donation guard can only recognise its own defaults)
+                self._state[name] = jnp.array(default, copy=True)
+            else:
+                self._state[name] = default
 
     def forward(self, *args: Any, **kwargs: Any) -> Any:
         """Accumulate global state AND return the batch-local value.
@@ -317,12 +453,13 @@ class Metric:
         kwargs = {k: self._to_array(v) for k, v in kwargs.items()}
         self._eager_validate(*args, **kwargs)
 
-        gstate = self._tensor_state()
         if self._use_jit and self._compute_jittable:
-            fwd = self._get_jitted("forward", self._pure_forward)
-            value, merged, appends = fwd(gstate, jnp.asarray(n_prev), args, kwargs)
+            fwd = self._get_jitted("forward", self._pure_forward, donate_state=True)
+            value, merged, appends = fwd(
+                self._donation_safe_tensor_state(), jnp.asarray(n_prev), args, kwargs
+            )
         else:
-            value, merged, appends = self._pure_forward(gstate, n_prev, args, kwargs)
+            value, merged, appends = self._pure_forward(self._tensor_state(), n_prev, args, kwargs)
         for k, v in merged.items():
             self._state[k] = v
         self._extend_list_states(appends)
@@ -411,7 +548,9 @@ class Metric:
             out[k] = tuple(state.get(k, ())) + appends[k]
         return out
 
-    def update_state_batched(self, state: StateDict, *args: Any, **kwargs: Any) -> StateDict:
+    def update_state_batched(
+        self, state: StateDict, *args: Any, update_count: Any = 0, **kwargs: Any
+    ) -> StateDict:
         """Bulk update over a leading steps axis: ``args`` are (S, ...) stacks.
 
         TPU-native alternative to a sequential ``lax.scan`` over updates:
@@ -420,6 +559,12 @@ class Metric:
         associative). Not available for metrics with ``None``/custom
         reductions whose update reads prior state (e.g. Pearson) — use
         ``update_state`` in a scan for those.
+
+        ``update_count`` is the number of updates already folded into
+        ``state``; MEAN states merge the new steps with the prior value
+        weighted by it (the closed form of S sequential
+        ``_merge_tensor_states`` applications). With the default of 0 the
+        prior MEAN value is ignored, matching a fresh state.
         """
         for red in self._reductions.values():
             if red == Reduction.NONE or callable(red):
@@ -448,7 +593,14 @@ class Metric:
             if red == Reduction.SUM:
                 out[name] = state[name] + jnp.sum(v, axis=0)
             elif red == Reduction.MEAN:
-                out[name] = jnp.mean(v, axis=0)  # equal-weight steps from a fresh state
+                # weighted merge with the prior state: with n prior updates
+                # the running mean becomes (prior * n + sum(steps)) / (n + S)
+                n = jnp.asarray(update_count, dtype=jnp.float32)
+                steps = jnp.asarray(v.shape[0], dtype=jnp.float32)
+                total = jnp.sum(v, axis=0)
+                out[name] = jnp.where(
+                    n == 0, total / steps, (state[name] * n + total) / (n + steps)
+                )
             elif red == Reduction.MAX:
                 out[name] = jnp.maximum(state[name], jnp.max(v, axis=0))
             elif red == Reduction.MIN:
@@ -534,10 +686,76 @@ class Metric:
     def _eager_validate(self, *args: Any, **kwargs: Any) -> None:
         """Hook: subclasses may override for host-side value validation."""
 
-    def _get_jitted(self, key: str, fn: Callable) -> Callable:
-        if key not in self._jit_cache:
-            self._jit_cache[key] = jax.jit(fn)
-        return self._jit_cache[key]
+    # ------------------------------------------------------------------
+    # executable cache plumbing
+    # ------------------------------------------------------------------
+    def _invalidate_executable_key(self) -> None:
+        self.__dict__.pop("_exec_key_cache", None)
+        self.__dict__.pop("_jit_bound", None)
+
+    def _executable_cache_key(self) -> tuple:
+        """Process-global cache key: equal keys guarantee equal traced programs.
+
+        Built from (class, frozen non-runtime config attributes, frozen state
+        defaults + reduction tags). Instances whose config cannot be frozen
+        (huge array attrs, Metric-valued attrs, exotic objects) fall back to a
+        private per-instance key from a monotonic counter — never ``id(self)``
+        (ids are reused after gc) and never the instance itself
+        (``Metric.__eq__`` is overloaded to build compositions).
+        """
+        cached = self.__dict__.get("_exec_key_cache")
+        if cached is not None:
+            return cached
+        try:
+            cfg = tuple(
+                (k, _freeze_config_value(v))
+                for k, v in sorted(self.__dict__.items())
+                if k not in _RUNTIME_ATTRS
+            )
+            defaults = []
+            for k in sorted(self._defaults):
+                v = self._defaults[k]
+                frozen = "list" if isinstance(v, list) else _freeze_config_value(v)
+                defaults.append((k, frozen, str(self._reductions[k])))
+            key: tuple = ("cfg", type(self), cfg, tuple(defaults))
+        except (_Unkeyable, TypeError, ValueError):
+            nonce = self.__dict__.get("_exec_nonce")
+            if nonce is None:
+                nonce = next(_INSTANCE_KEY_COUNTER)
+                object.__setattr__(self, "_exec_nonce", nonce)
+            key = ("instance", type(self), nonce)
+        object.__setattr__(self, "_exec_key_cache", key)
+        return key
+
+    def _get_jitted(self, key: str, fn: Callable, donate_state: bool = False) -> Callable:
+        bound = self.__dict__.get("_jit_bound")
+        if bound is None:
+            bound = {}
+            object.__setattr__(self, "_jit_bound", bound)
+        entry = bound.get(key)
+        if entry is None:
+            entry = _global_jit((key, self._executable_cache_key()), fn, donate_state)
+            bound[key] = entry
+        return entry
+
+    def _donation_safe_tensor_state(self) -> StateDict:
+        """Tensor states safe to pass to a ``donate_argnums`` jit call.
+
+        Leaves that alias ``_defaults`` (first update after reset) or repeat
+        within the dict are copied first: donating them would delete the
+        buffer ``reset()`` re-installs, or double-donate one buffer.
+        """
+        out: StateDict = {}
+        seen: set = set()
+        for k, v in self._state.items():
+            if k in self._list_states:
+                continue
+            if isinstance(v, jax.Array):
+                if v is self._defaults.get(k) or id(v) in seen:
+                    v = jnp.array(v, copy=True)
+                seen.add(id(v))
+            out[k] = v
+        return out
 
     # ------------------------------------------------------------------
     # sync protocol (eager, class API)
@@ -557,7 +775,12 @@ class Metric:
 
         Parity: reference ``metric.py:490-532``. List states are
         pre-concatenated to one tensor so one gather happens per state
-        (reference ``metric.py:430-433``).
+        (reference ``metric.py:430-433``). Fixed-shape states with an
+        elementwise reduction (sum/mean/max/min) are additionally *bucketed*:
+        all leaves sharing a ``(Reduction, dtype)`` pair are flattened into
+        one buffer and synced with a single ``sync_tensor`` call — one
+        latency-bound small-message collective per bucket instead of one per
+        state name. ``cat``/``NONE``/custom-reduction states stay per-leaf.
         """
         if self._is_synced:
             raise TorchMetricsUserError("The Metric has already been synced.")
@@ -570,22 +793,45 @@ class Metric:
         # state intact — a half-synced state dict would be checkpointed or
         # double-counted by the recovery path
         synced: Dict[str, Any] = {}
+        addressed = hasattr(backend, "set_current")  # FakeSync group addressing
         try:
+            buckets: Dict[Tuple[Any, str], List[str]] = {}
             for name in self._state:
-                if hasattr(backend, "set_current"):  # FakeSync group addressing
-                    backend.set_current(name)
-                if name in self._list_states and self._reductions[name] == Reduction.NONE:
+                red = self._reductions[name]
+                if name in self._list_states and red == Reduction.NONE:
                     # ragged object list states (dist_reduce_fx=None: per-image
                     # arrays, COCO RLE dicts) — gather whole per-rank lists and
                     # extend in rank order, preserving element boundaries
                     # (reference detection/mean_ap.py:1007-1032 all_gather_object)
+                    if addressed:
+                        backend.set_current(name)
                     gathered = backend.all_gather_object(list(self._state[name]))
                     merged: list = []
                     for rank_list in gathered:
                         merged.extend(rank_list)
                     synced[name] = merged
+                elif name not in self._list_states and isinstance(red, Reduction) and red in ELEMENTWISE_REDUCTIONS:
+                    arr = jnp.asarray(self._state[name])
+                    buckets.setdefault((red, str(arr.dtype)), []).append(name)
                 else:
-                    synced[name] = backend.sync_tensor(self._precat(name), self._reductions[name])
+                    if addressed:
+                        backend.set_current(name)
+                    synced[name] = backend.sync_tensor(self._precat(name), red)
+            for (red, _dtype), names in buckets.items():
+                arrs = [jnp.asarray(self._state[n]) for n in names]
+                if len(arrs) == 1:
+                    if addressed:
+                        backend.set_current(names[0])
+                    synced[names[0]] = backend.sync_tensor(arrs[0], red)
+                    continue
+                flat = jnp.concatenate([a.reshape(-1) for a in arrs])
+                if addressed:
+                    backend.set_current(tuple(names))
+                reduced = backend.sync_tensor(flat, red)
+                offset = 0
+                for n, a in zip(names, arrs):
+                    synced[n] = reduced[offset : offset + a.size].reshape(a.shape)
+                    offset += a.size
         except Exception:
             self._cache = None
             raise
@@ -664,7 +910,7 @@ class Metric:
                 ]
             elif isinstance(v, jax.Array) and jnp.issubdtype(v.dtype, jnp.floating):
                 self._state[k] = v.astype(dtype)
-        self._jit_cache.clear()
+        self._invalidate_executable_key()
         return self
 
     def persistent(self, mode: bool = False) -> None:
@@ -697,7 +943,13 @@ class Metric:
 
     def __getstate__(self) -> Dict[str, Any]:
         state = self.__dict__.copy()
-        state["_jit_cache"] = {}
+        # bound jitted entries hold unpicklable closures; the per-instance
+        # nonce must not leak across processes (a fresh process hands the
+        # same counter values to different configs). Clones/unpickles with a
+        # keyable config recompute the same key and still share executables.
+        state.pop("_jit_bound", None)
+        state.pop("_exec_key_cache", None)
+        state.pop("_exec_nonce", None)
         state["_sync_backend"] = None if not isinstance(state.get("_sync_backend"), NoSync) else state["_sync_backend"]
         return state
 
@@ -868,9 +1120,9 @@ def _wrap_update(update_fn: Callable) -> Callable:
         args = tuple(self._to_array(a) for a in args)
         kwargs = {k: self._to_array(v) for k, v in kwargs.items()}
         self._eager_validate(*args, **kwargs)
-        if self._use_jit:
-            upd = self._get_jitted("update", self._pure_update)
-            new_tensors, appends = upd(self._tensor_state(), args, kwargs)
+        if self._use_jit and _jit_safe_inputs(args, kwargs):
+            upd = self._get_jitted("update", self._pure_update, donate_state=True)
+            new_tensors, appends = upd(self._donation_safe_tensor_state(), args, kwargs)
             for k, v in new_tensors.items():
                 self._state[k] = v
             self._extend_list_states(appends)

@@ -24,6 +24,11 @@ class Reduction(str, Enum):
         return self.value
 
 
+#: Reductions that act elementwise on fixed-shape states. Leaves sharing a
+#: ``(Reduction, dtype)`` pair can be flattened into one buffer and reduced by
+#: a single collective (bucketing), bitwise-identically to per-leaf reduction.
+ELEMENTWISE_REDUCTIONS = frozenset({Reduction.SUM, Reduction.MEAN, Reduction.MAX, Reduction.MIN})
+
 ReduceFx = Union[str, Reduction, Callable, None]
 
 

@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .sync import axis_size
+
 Array = jax.Array
 
 __all__ = ["ring_attention", "expert_all_to_all"]
@@ -49,7 +51,7 @@ def ring_attention(
     Returns:
         Attention output ``(..., T_local, D)`` for the local query block.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     t_loc = q.shape[-2]
     d = q.shape[-1]

@@ -11,6 +11,8 @@ TPU-native strategies:
   O(world·state) all_gather-then-reduce); ``cat`` states use ``all_gather``
   with ``tiled=True`` (the SPMD equivalent of the reference pad-to-max
   protocol, which becomes unnecessary because SPMD shapes are uniform).
+  Elementwise-reduced leaves are bucketed by ``(Reduction, dtype)`` into one
+  flattened collective per bucket (see ``docs/fused_dispatch.md``).
 - :class:`HostSync` — **eager multi-host** gather via
   ``jax.experimental.multihost_utils.process_allgather`` over DCN, for the
   class-API ``Metric.sync()`` path when running multi-process (parity with the
@@ -28,7 +30,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .reduction import Reduction
+from .reduction import ELEMENTWISE_REDUCTIONS, Reduction
 
 Array = jax.Array
 StateDict = Dict[str, Any]
@@ -55,6 +57,15 @@ def clear_poison() -> None:
 # In-graph (SPMD) collectives — the hot path on TPU
 # ---------------------------------------------------------------------------
 
+def axis_size(axis_name: str) -> int:
+    """Static size of a named mesh axis (compat: ``lax.axis_size`` is newer
+    than some supported jax versions; ``psum`` of the constant 1 is
+    special-cased to fold to the static axis size on all of them)."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
 def _invariant_all_gather(value: Array, axis_name: str, stack: bool = False) -> Array:
     """All-gather whose output is replication-*invariant* (VMA-typed).
 
@@ -65,7 +76,7 @@ def _invariant_all_gather(value: Array, axis_name: str, stack: bool = False) -> 
     an all-gather; for zero-copy epilogues prefer returning the un-gathered
     ``cat`` shards with ``out_specs=P(axis)`` — see ``cat_out_specs``.)
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     i = lax.axis_index(axis_name)
     # psum promotes bool to an integer sum; round-trip through uint8 so
     # boolean mask states (e.g. exact-mode `valid`) keep their dtype —
@@ -109,16 +120,41 @@ def reduce_state_in_graph(
 ) -> StateDict:
     """Sync a whole state dict across ``axis_name``. Pure & jittable.
 
+    Fixed-shape leaves with an elementwise reduction (sum/mean/max/min) are
+    *bucketed*: every leaf sharing a ``(Reduction, dtype)`` pair is flattened
+    into one concatenated buffer and reduced with a single
+    ``lax.psum/pmean/pmax/pmin``, then split and reshaped back exactly. The
+    collectives are elementwise, so bucketing is bitwise-identical to
+    per-leaf reduction while issuing one collective per bucket instead of one
+    per state name (small-message all-reduce is latency-bound; see EQuARX).
+
     List (``cat``) states may be tuples of arrays: each element is gathered
-    (tiled) independently, preserving tuple structure.
+    (tiled) independently, preserving tuple structure; ``cat``/``NONE``/
+    custom reductions stay per-leaf (their output shape depends on the
+    gather, so they cannot share a flat buffer).
     """
-    out = {}
+    out: StateDict = {}
+    buckets: Dict[Any, list] = {}  # (Reduction, dtype) -> [(name, array)]
     for name, value in state.items():
         red = reductions.get(name, Reduction.NONE)
         if isinstance(value, (list, tuple)):
             out[name] = type(value)(reduce_tensor_in_graph(v, red, axis_name) for v in value)
+        elif isinstance(red, Reduction) and red in ELEMENTWISE_REDUCTIONS:
+            arr = jnp.asarray(value)
+            buckets.setdefault((red, str(arr.dtype)), []).append((name, arr))
         else:
             out[name] = reduce_tensor_in_graph(value, red, axis_name)
+    for (red, _dtype), entries in buckets.items():
+        if len(entries) == 1:
+            name, arr = entries[0]
+            out[name] = reduce_tensor_in_graph(arr, red, axis_name)
+            continue
+        flat = jnp.concatenate([arr.reshape(-1) for _, arr in entries])
+        reduced = reduce_tensor_in_graph(flat, red, axis_name)
+        offset = 0
+        for name, arr in entries:
+            out[name] = reduced[offset : offset + arr.size].reshape(arr.shape)
+            offset += arr.size
     return out
 
 
@@ -372,7 +408,7 @@ class FakeSync(SyncBackend):
     def __init__(self, group_states: list, rank: int):
         self._group = group_states  # list of state dicts, one per emulated rank
         self._rank = rank
-        self._current_name: Optional[str] = None
+        self._current_name: Union[str, tuple, None] = None
 
     def is_available(self) -> bool:
         return True
@@ -380,11 +416,21 @@ class FakeSync(SyncBackend):
     def world_size(self) -> int:
         return len(self._group)
 
-    def set_current(self, name: str) -> None:
+    def set_current(self, name: Union[str, tuple]) -> None:
+        """Address the next ``sync_tensor`` call: a state name, or a tuple of
+        names for a bucketed call (each rank's leaves are flattened and
+        concatenated in the given order, mirroring ``Metric.sync``)."""
         self._current_name = name
 
     def sync_tensor(self, value: Array, reduction) -> Array:
-        peers = [jnp.asarray(s[self._current_name]) for s in self._group]
+        name = self._current_name
+        if isinstance(name, tuple):
+            peers = [
+                jnp.concatenate([jnp.asarray(s[n]).reshape(-1) for n in name])
+                for s in self._group
+            ]
+        else:
+            peers = [jnp.asarray(s[name]) for s in self._group]
         if reduction == Reduction.CAT:
             # ranks may hold different sample counts (the reference's
             # pad-to-max gather, utilities/distributed.py:124-147) —

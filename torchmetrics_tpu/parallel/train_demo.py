@@ -26,6 +26,8 @@ from typing import Dict, Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from .sync import axis_size
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 try:
@@ -86,7 +88,7 @@ def _stage(stage_params: Dict[str, Array], x: Array, tp_axis: str) -> Array:
 
     # expert-parallel MoE: each tp shard hosts ONE expert (its local we1/we2
     # slice); static round-robin routing by token position keeps shapes fixed
-    ep = lax.axis_size(tp_axis)
+    ep = axis_size(tp_axis)
     mb, t, d = x.shape
     groups = x.reshape(mb, ep, t // ep, d).transpose(1, 0, 2, 3)  # (ep, mb, t/ep, d)
     dispatched = expert_all_to_all(groups, tp_axis)               # tokens for MY expert
@@ -104,7 +106,7 @@ def _pipeline(stage_params: Dict[str, Array], inputs: Array, pp_axis: str, tp_ax
     microbatch ``m`` at tick ``m + p``; the last rank collects finished
     microbatches. ``M + pp - 1`` ticks total (the pipeline bubble).
     """
-    pp = lax.axis_size(pp_axis)
+    pp = axis_size(pp_axis)
     idx = lax.axis_index(pp_axis)
     m_count = inputs.shape[0]
     perm = [(i, (i + 1) % pp) for i in range(pp)]
