@@ -3,8 +3,9 @@
 The full bench only runs on the driver's TPU rounds; if an API change breaks
 it, the breakage surfaces only after a round's budget is already burned.
 ``--smoke`` replays the bench's load-bearing paths (fused collection
-dispatch, global executable cache, bucketed FakeSync) on CPU with tiny
-shapes, so tier-1 catches bench rot immediately.
+dispatch, global executable cache, bucketed FakeSync, buffered streaming
+staging + scanned flush) on CPU with tiny shapes, so tier-1 catches bench
+rot immediately.
 """
 import json
 import os
@@ -30,3 +31,10 @@ def test_bench_smoke_passes():
     assert result["dispatches_per_update"] == 1, result
     assert result["clone_new_compilations"] == 0, result
     assert result["synced_accuracy"] == result["expected_synced_accuracy"], result
+    # buffered streaming: 10 staged steps at window=4 auto-flush twice (at 4
+    # and 8 staged), so 2 scanned dispatches cover 10 steps of metric work;
+    # the 2 leftover staged steps flush under compute() and the result must
+    # be bitwise-identical to an eager twin collection
+    assert result["buffered_staged_dispatches"] == 2, result
+    assert result["buffered_pending_before_compute"] == 2, result
+    assert result["buffered_matches_eager"] is True, result

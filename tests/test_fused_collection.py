@@ -147,7 +147,10 @@ def test_bootstrapper_replay_copies_share_one_executable():
     rng = np.random.RandomState(0)
 
     def batch():
-        return jnp.asarray(rng.rand(32).astype(np.float32)), jnp.asarray(rng.rand(32).astype(np.float32))
+        # 33, not BATCH_SIZE: the executable cache is process-global, and the
+        # regression suite compiles Pearson's (32,) update long before this
+        # test in a full run — a fresh shape keeps `misses == 1` meaningful
+        return jnp.asarray(rng.rand(33).astype(np.float32)), jnp.asarray(rng.rand(33).astype(np.float32))
 
     p, t = batch()
     before = M.executable_cache_stats()

@@ -1,0 +1,396 @@
+"""Buffered streaming updates: stage K steps, flush one scanned executable.
+
+Equivalence suite for the streaming tentpole — the buffered path must be
+BITWISE-identical to eager per-step updates (the flush scans the exact
+per-step update body sequentially; no reassociation), across:
+
+- MEAN / SUM / cat (list-append) state reductions;
+- short final windows (``valid`` masking, shared executable);
+- forced flush on every state observation: compute, sync, reset, pickling,
+  ``metric_state`` access, an interleaved eager ``update()``;
+- compute groups (flush writes through the shared group state dict) and
+  donation safety across update/flush/reset cycles;
+- dispatch economics: K staged steps cost ONE executable-cache dispatch.
+"""
+import pickle
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import torchmetrics_tpu.metric as M
+from torchmetrics_tpu import (
+    BufferedMetric,
+    BufferedMetricCollection,
+    CatMetric,
+    MeanMetric,
+    SumMetric,
+)
+from torchmetrics_tpu.classification import (
+    MulticlassAccuracy,
+    MulticlassAUROC,
+    MulticlassF1Score,
+)
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.parallel.sync import FakeSync
+from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
+
+N_CLS = 5
+
+
+def _batches(steps=11, batch=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return [jnp.asarray(rng.rand(batch).astype(np.float32)) for _ in range(steps)]
+
+
+def _cls_data(steps=9, batch=16, seed=0):
+    preds = jax.nn.softmax(
+        jax.random.normal(jax.random.PRNGKey(seed), (steps, batch, N_CLS)), axis=-1
+    )
+    target = jax.random.randint(jax.random.PRNGKey(seed + 1), (steps, batch), 0, N_CLS)
+    return preds, target
+
+
+def _assert_state_bitwise(a, b):
+    sa, sb = a.metric_state, b.metric_state
+    assert set(sa) == set(sb)
+    for k in sa:
+        va, vb = sa[k], sb[k]
+        if isinstance(va, (list, tuple)):
+            assert len(va) == len(vb), k
+            for xa, xb in zip(va, vb):
+                np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb), err_msg=k)
+        else:
+            np.testing.assert_array_equal(np.asarray(va), np.asarray(vb), err_msg=k)
+
+
+# ------------------------------------------------------------- equivalence
+@pytest.mark.parametrize(
+    "factory",
+    [MeanMetric, SumMetric, lambda: CatMetric(nan_strategy="disable")],
+    ids=["mean", "sum", "cat"],
+)
+@pytest.mark.parametrize("window", [1, 4, 32], ids=["K1", "K4", "K32"])
+def test_buffered_bitwise_identical_to_eager(factory, window):
+    # 11 steps at K=4 exercises two full windows + a short 3-step flush;
+    # K=1 is the degenerate flush-per-step cadence; K=32 a single short window
+    data = _batches()
+    eager, buffered = factory(), factory().buffered(window=window)
+    for x in data:
+        eager.update(x)
+        buffered.update(x)
+    _assert_state_bitwise(eager, buffered)
+    np.testing.assert_array_equal(
+        np.asarray(eager.compute()), np.asarray(buffered.compute())
+    )
+    assert buffered.update_count == eager.update_count
+
+
+def test_short_final_window_single_step():
+    eager, buffered = SumMetric(), SumMetric().buffered(window=8)
+    eager.update(jnp.asarray([1.0, 2.0]))
+    buffered.update(jnp.asarray([1.0, 2.0]))
+    assert buffered.pending == 1
+    assert float(buffered.compute()) == float(eager.compute())
+    assert buffered.pending == 0
+
+
+# ---------------------------------------------------------- forced flushes
+def test_compute_forces_flush():
+    buffered = MeanMetric().buffered(window=8)
+    for x in _batches(steps=3):
+        buffered.update(x)
+    assert buffered.pending == 3
+    buffered.compute()
+    assert buffered.pending == 0
+
+
+def test_reset_forces_flush_then_clears():
+    m = SumMetric()
+    buffered = m.buffered(window=8)
+    buffered.update(jnp.asarray([5.0]))
+    buffered.reset()
+    assert buffered.pending == 0
+    assert float(m.value) == 0.0
+    # post-reset staging still works (donated buffers were not resurrected)
+    buffered.update(jnp.asarray([2.0]))
+    assert float(buffered.compute()) == 2.0
+
+
+def test_metric_state_access_forces_flush():
+    m = SumMetric()
+    buffered = m.buffered(window=8)
+    buffered.update(jnp.asarray([4.0]))
+    # observation on the WRAPPED metric, not the handle: the _flush_pending
+    # hook in metric.py must drain the ring first
+    assert float(m.metric_state["value"]) == 4.0
+    assert buffered.pending == 0
+
+
+def test_interleaved_eager_update_preserves_order():
+    data = _batches(steps=6)
+    eager, m = MeanMetric(), MeanMetric()
+    buffered = m.buffered(window=8)
+    for x in data[:3]:
+        eager.update(x)
+        buffered.update(x)
+    # a direct eager update on the wrapped metric flushes staged work first
+    eager.update(data[3])
+    m.update(data[3])
+    assert buffered.pending == 0
+    for x in data[4:]:
+        eager.update(x)
+        buffered.update(x)
+    _assert_state_bitwise(eager, buffered)
+
+
+def test_pickle_forces_flush_and_roundtrips():
+    data = _batches(steps=5)
+    eager, buffered = SumMetric(), SumMetric().buffered(window=8)
+    for x in data:
+        eager.update(x)
+        buffered.update(x)
+    assert buffered.pending == 5
+    clone = pickle.loads(pickle.dumps(buffered))
+    assert isinstance(clone, BufferedMetric)
+    assert clone.window == 8 and clone.pending == 0
+    np.testing.assert_array_equal(
+        np.asarray(clone.compute()), np.asarray(eager.compute())
+    )
+
+
+def test_sync_forces_flush():
+    preds, target = _cls_data(steps=2)
+    world = 2
+    ranks = [
+        MulticlassAccuracy(num_classes=N_CLS, average="micro", validate_args=False)
+        for _ in range(world)
+    ]
+    handles = [m.buffered(window=8) for m in ranks]
+    for r, h in enumerate(handles):
+        h.update(preds[r], target[r])
+        assert h.pending == 1
+    # metric_state in the group build forces each rank's flush
+    group = [m.metric_state for m in ranks]
+    assert all(h.pending == 0 for h in handles)
+    for r, m in enumerate(ranks):
+        m.sync(sync_backend=FakeSync(group, r))
+    expected = float(
+        jnp.sum(jnp.argmax(preds[:world], axis=-1) == target[:world])
+        / (world * target.shape[1])
+    )
+    assert float(ranks[0].compute()) == expected
+
+
+def test_sync_while_staged_via_handle():
+    m = MulticlassAccuracy(num_classes=N_CLS, average="micro", validate_args=False)
+    preds, target = _cls_data(steps=1)
+    h = m.buffered(window=8)
+    h.update(preds[0], target[0])
+    h.sync(sync_backend=FakeSync([m.metric_state], 0))
+    assert h.pending == 0
+    with pytest.raises(TorchMetricsUserError):
+        h.update(preds[0], target[0])  # synced metric refuses updates
+    h.unsync()
+    h.update(preds[0], target[0])
+    h.compute()
+
+
+# --------------------------------------------------------------- signatures
+def test_signature_change_forces_flush():
+    eager, buffered = SumMetric(), SumMetric().buffered(window=8)
+    a, b = jnp.asarray([1.0, 2.0, 3.0]), jnp.asarray([10.0])
+    for x in (a, a, b, a):  # shape change at step 3 drains the (a, a) window
+        eager.update(x)
+        buffered.update(x)
+    assert buffered.pending == 1  # the trailing `a` only
+    _assert_state_bitwise(eager, buffered)
+
+
+def test_python_scalar_inputs_stage():
+    eager, buffered = SumMetric(), SumMetric().buffered(window=4)
+    for v in (1.5, 2.5, 3.5):
+        eager.update(v)
+        buffered.update(v)
+    np.testing.assert_array_equal(
+        np.asarray(eager.compute()), np.asarray(buffered.compute())
+    )
+
+
+# ---------------------------------------------------------------- dispatch
+def test_k_staged_steps_cost_one_dispatch():
+    buffered = SumMetric().buffered(window=8)
+    buffered.update(jnp.asarray([0.0]))  # warm the flush executable
+    buffered.compute()
+    data = _batches(steps=8, seed=3)
+    before = M.executable_cache_stats()["dispatches"]
+    for x in data:
+        buffered.update(x)
+    assert M.executable_cache_stats()["dispatches"] - before == 1
+    assert buffered.pending == 0
+
+
+def test_equal_config_buffered_metrics_share_flush_executable():
+    a = SumMetric().buffered(window=4)
+    for x in _batches(steps=4, seed=4):
+        a.update(x)
+    miss_before = M.executable_cache_stats()["misses"]
+    b = SumMetric().buffered(window=4)
+    for x in _batches(steps=4, seed=5):
+        b.update(x)
+    assert M.executable_cache_stats()["misses"] - miss_before == 0
+
+
+# -------------------------------------------------------------- collections
+def test_buffered_collection_bitwise_identical_with_groups():
+    preds, target = _cls_data()
+
+    def mk():
+        return MetricCollection(
+            {
+                "acc": MulticlassAccuracy(num_classes=N_CLS, average="micro", validate_args=False),
+                "f1": MulticlassF1Score(num_classes=N_CLS, average="macro", validate_args=False),
+                "auroc": MulticlassAUROC(num_classes=N_CLS, thresholds=16, validate_args=False),
+            }
+        )
+
+    eager, coll = mk(), mk()
+    buffered = coll.buffered(window=4)
+    for i in range(preds.shape[0]):
+        eager.update(preds[i], target[i])
+        buffered.update(preds[i], target[i])
+    assert any(len(g) > 1 for g in coll.compute_groups.values())  # acc+f1 merged
+    ev, bv = eager.compute(), buffered.compute()
+    for k in ev:
+        np.testing.assert_array_equal(np.asarray(ev[k]), np.asarray(bv[k]), err_msg=k)
+    # group members observe the flush through the shared state dict
+    for members in coll._groups.values():
+        rep = coll._metrics[members[0]]
+        for name in members[1:]:
+            assert coll._metrics[name].__dict__["_state"] is rep.__dict__["_state"]
+
+
+def test_buffered_collection_window_dispatches():
+    preds, target = _cls_data()
+    coll = MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=N_CLS, average="micro", validate_args=False),
+            "f1": MulticlassF1Score(num_classes=N_CLS, average="macro", validate_args=False),
+        }
+    )
+    buffered = coll.buffered(window=4)
+    buffered.update(preds[0], target[0])  # eager group discovery
+    for i in range(1, 5):  # warm the flush executable (one full window)
+        buffered.update(preds[i], target[i])
+    before = M.executable_cache_stats()["dispatches"]
+    for i in range(5, 9):  # 4 staged steps -> exactly one scanned flush
+        buffered.update(preds[i], target[i])
+    assert M.executable_cache_stats()["dispatches"] - before == 1
+    assert buffered.pending == 0
+
+
+def test_buffered_collection_reset_and_observation():
+    preds, target = _cls_data()
+    coll = MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=N_CLS, average="micro", validate_args=False),
+            "f1": MulticlassF1Score(num_classes=N_CLS, average="macro", validate_args=False),
+        }
+    )
+    buffered = coll.buffered(window=8)
+    for i in range(3):
+        buffered.update(preds[i], target[i])
+    assert buffered.pending == 2  # step 0 was the eager discovery update
+    # observation through the COLLECTION (items() walks member state) flushes
+    dict(coll.items())
+    assert buffered.pending == 0
+    buffered.update(preds[3], target[3])
+    coll.reset()
+    assert buffered.pending == 0
+    # post-reset: stage a fresh epoch and match an eager twin bitwise
+    eager = MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=N_CLS, average="micro", validate_args=False),
+            "f1": MulticlassF1Score(num_classes=N_CLS, average="macro", validate_args=False),
+        }
+    )
+    for i in range(4):
+        eager.update(preds[i], target[i])
+        buffered.update(preds[i], target[i])
+    ev, bv = eager.compute(), buffered.compute()
+    for k in ev:
+        np.testing.assert_array_equal(np.asarray(ev[k]), np.asarray(bv[k]), err_msg=k)
+
+
+def test_buffered_collection_pickle_roundtrip():
+    preds, target = _cls_data()
+    coll = MetricCollection(
+        {"acc": MulticlassAccuracy(num_classes=N_CLS, average="micro", validate_args=False)}
+    )
+    buffered = coll.buffered(window=4)
+    for i in range(3):
+        buffered.update(preds[i], target[i])
+    clone = pickle.loads(pickle.dumps(buffered))
+    assert isinstance(clone, BufferedMetricCollection)
+    assert clone.pending == 0 and clone.window == 4
+    np.testing.assert_array_equal(
+        np.asarray(clone.compute()["acc"]), np.asarray(coll.compute()["acc"])
+    )
+
+
+# ---------------------------------------------------------- donation safety
+def test_donation_safety_across_cycles():
+    data = _batches(steps=12, seed=7)
+    eager, m = MeanMetric(), MeanMetric()
+    buffered = m.buffered(window=4)
+    for cycle in range(3):  # update -> flush -> compute -> reset, repeatedly
+        for x in data[cycle * 4 : cycle * 4 + 4]:
+            eager.update(x)
+            buffered.update(x)
+        np.testing.assert_array_equal(
+            np.asarray(eager.compute()), np.asarray(buffered.compute())
+        )
+        eager.reset()
+        buffered.reset()
+    # defaults must have survived three rounds of donated flushes
+    buffered.update(jnp.asarray([1.0]))
+    assert float(buffered.compute()) == 1.0
+
+
+def test_forward_flushes_and_returns_batch_value():
+    data = _batches(steps=4, seed=9)
+    eager, m = MeanMetric(), MeanMetric()
+    buffered = m.buffered(window=8)
+    for x in data[:3]:
+        eager.update(x)
+        buffered.update(x)
+    expected_batch = eager.forward(data[3])
+    got_batch = buffered.forward(data[3])
+    assert buffered.pending == 0
+    np.testing.assert_array_equal(np.asarray(expected_batch), np.asarray(got_batch))
+    _assert_state_bitwise(eager, buffered)
+
+
+# ---------------------------------------------------------------- validation
+@pytest.mark.parametrize("window", [0, -1, 2.5, True], ids=["zero", "neg", "float", "bool"])
+def test_invalid_window_raises(window):
+    with pytest.raises(ValueError):
+        SumMetric().buffered(window=window)
+
+
+def test_non_jittable_metric_raises():
+    m = CatMetric(nan_strategy="ignore")  # dynamic-shape filter: _use_jit=False
+    with pytest.raises(TorchMetricsUserError):
+        m.buffered(window=4)
+
+
+def test_rebuffering_flushes_prior_handle():
+    m = SumMetric()
+    first = m.buffered(window=8)
+    first.update(jnp.asarray([3.0]))
+    second = m.buffered(window=4)
+    assert first.pending == 0  # drained when the new handle took over
+    second.update(jnp.asarray([4.0]))
+    assert float(second.compute()) == 7.0

@@ -134,8 +134,8 @@ class SignalDistortionRatio(_MeanAudioMetric):
         >>> target = jnp.sin(t)
         >>> preds = target + 0.1 * jnp.cos(3.0 * t)
         >>> metric.update(preds, target)
-        >>> round(float(metric.compute()), 4)
-        20.3963
+        >>> round(float(metric.compute()), 3)  # 3 digits: the 4th varies per backend
+        20.396
     """
 
     is_differentiable = True
@@ -224,8 +224,8 @@ class PermutationInvariantTraining(_MeanAudioMetric):
         >>> target = jnp.stack([jnp.sin(t), jnp.cos(t)])[None]
         >>> preds = target[:, ::-1, :] + 0.05
         >>> metric.update(preds, target)
-        >>> round(float(metric.compute()), 4)
-        92.2472
+        >>> round(float(metric.compute()), 3)  # 3 digits: the 4th varies per backend
+        92.247
     """
 
     is_differentiable = True
@@ -263,15 +263,20 @@ class PerceptualEvaluationSpeechQuality(_MeanAudioMetric):
     (``implementation="auto"``).
 
     Example (tones inside the narrow-band 300-3100 Hz telephone band — the
-    P.862 input filter removes anything below it):
+    P.862 input filter removes anything below it; the computation is pinned
+    to the CPU device so the golden stays exact on accelerator backends,
+    whose fused FFT/filterbank arithmetic differs in the last digit):
+        >>> import jax
         >>> import jax.numpy as jnp
         >>> from torchmetrics_tpu import PerceptualEvaluationSpeechQuality
         >>> metric = PerceptualEvaluationSpeechQuality(fs=8000, mode="nb", implementation="native")
-        >>> t = jnp.arange(8000) / 8000.0
-        >>> target = jnp.sin(2 * jnp.pi * 440.0 * t)
-        >>> preds = target + 0.1 * jnp.sin(2 * jnp.pi * 1320.0 * t)
-        >>> metric.update(preds, target)
-        >>> round(float(metric.compute()), 2)
+        >>> with jax.default_device(jax.devices("cpu")[0]):
+        ...     t = jnp.arange(8000) / 8000.0
+        ...     target = jnp.sin(2 * jnp.pi * 440.0 * t)
+        ...     preds = target + 0.1 * jnp.sin(2 * jnp.pi * 1320.0 * t)
+        ...     metric.update(preds, target)
+        ...     value = metric.compute()
+        >>> round(float(value), 2)
         2.95
     """
 
@@ -338,13 +343,18 @@ class SpeechReverberationModulationEnergyRatio(_MeanAudioMetric):
     """Parity: reference ``audio/srmr.py``. First-party implementation
     (``functional/audio/srmr.py``) — no gammatone/torchaudio dependency.
 
-    Example:
+    Example (pinned to the CPU device so the 4-digit golden stays exact on
+    accelerator backends, whose filterbank arithmetic differs in the final
+    digit):
+        >>> import jax
         >>> import jax.numpy as jnp
         >>> from torchmetrics_tpu import SpeechReverberationModulationEnergyRatio
         >>> metric = SpeechReverberationModulationEnergyRatio(fs=8000)
-        >>> t = jnp.linspace(0.0, 400.0, 4096)
-        >>> metric.update(jnp.sin(t) * (1 + 0.5 * jnp.sin(0.05 * t)))
-        >>> round(float(metric.compute()), 4)
+        >>> with jax.default_device(jax.devices("cpu")[0]):
+        ...     t = jnp.linspace(0.0, 400.0, 4096)
+        ...     metric.update(jnp.sin(t) * (1 + 0.5 * jnp.sin(0.05 * t)))
+        ...     value = metric.compute()
+        >>> round(float(value), 4)
         77.1469
     """
 

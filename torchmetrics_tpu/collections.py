@@ -227,6 +227,30 @@ class MetricCollection:
         self._state_is_copy = copy
 
     # ------------------------------------------------------------------
+    # streaming buffer protocol (streaming.py)
+    # ------------------------------------------------------------------
+    def _flush_member_buffers(self) -> None:
+        """Drain any staged streaming updates before state is read or
+        rewritten (members carry the ``_stream_buffer`` hook; a
+        :class:`~torchmetrics_tpu.streaming.BufferedMetricCollection`
+        installs ONE shared buffer on every member)."""
+        seen: set = set()
+        for m in self._metrics.values():
+            buf = m.__dict__.get("_stream_buffer")
+            if buf is not None and id(buf) not in seen:
+                seen.add(id(buf))
+                if buf.pending:
+                    buf.flush()
+
+    def buffered(self, window: int = 32) -> "Any":
+        """Return a :class:`~torchmetrics_tpu.streaming.BufferedMetricCollection`
+        staging ``window`` steps for the WHOLE collection and flushing them
+        in one scanned XLA dispatch riding the fused update program."""
+        from .streaming import BufferedMetricCollection
+
+        return BufferedMetricCollection(self, window)
+
+    # ------------------------------------------------------------------
     # lifecycle
     # ------------------------------------------------------------------
     def update(self, *args: Any, **kwargs: Any) -> None:
@@ -239,6 +263,7 @@ class MetricCollection:
         buffers. Host-side members and non-jittable inputs fall back to the
         per-representative loop.
         """
+        self._flush_member_buffers()
         if self._state_is_copy:
             self._create_state_refs()  # re-alias after a copy-on-read
         if not self._groups_checked:
@@ -340,6 +365,7 @@ class MetricCollection:
         each member's own batch value, so aliased states are un-shared
         (copied) and grouping is disabled for this collection.
         """
+        self._flush_member_buffers()
         self._ungroup()
         res = {
             name: m.forward(*args, **_filter_kwargs(m._update_impl, **kwargs))
@@ -387,6 +413,10 @@ class MetricCollection:
         # (and the fused update's state aliasing) for the rest of its life.
         # A collection whose grouping is intact keeps it: rediscovery over
         # still-shared state dicts would double-count the discovery update.
+        # Staged streaming updates are drained BEFORE any member state is
+        # cleared — a member-level flush hook firing mid-loop would trace
+        # against an already-emptied state dict.
+        self._flush_member_buffers()
         cg = self._initial_compute_groups
         enable = bool(cg) or isinstance(cg, list)
         manual = cg if isinstance(cg, list) else None
@@ -455,6 +485,7 @@ class MetricCollection:
     def items(self, keep_base: bool = False, copy_state: bool = True) -> Iterable[Tuple[str, Metric]]:
         """Copy-on-read protects aliased compute-group state
         (reference ``collections.py:515-529``)."""
+        self._flush_member_buffers()
         if copy_state and self._groups_checked and not self._state_is_copy:
             self._create_state_refs(copy=True)
         if keep_base:
@@ -462,11 +493,13 @@ class MetricCollection:
         return [(self._set_name(k), v) for k, v in self._metrics.items()]
 
     def values(self, copy_state: bool = True) -> Iterable[Metric]:
+        self._flush_member_buffers()
         if copy_state and self._groups_checked and not self._state_is_copy:
             self._create_state_refs(copy=True)
         return list(self._metrics.values())
 
     def __getitem__(self, key: str) -> Metric:
+        self._flush_member_buffers()
         if self._groups_checked and not self._state_is_copy:
             self._create_state_refs(copy=True)
         return self._metrics[key]

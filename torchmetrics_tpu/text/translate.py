@@ -156,6 +156,17 @@ class CHRFScore(_HostTextMetric):
 class TranslationEditRate(_HostTextMetric):
     """Parity: reference ``text/ter.py:TranslationEditRate``.
 
+    .. note::
+        Tokenization is memoized: the metric's ``_TercomTokenizer`` caches
+        each distinct input sentence's tokenized form in a per-instance dict
+        capped at ``2**16`` entries (``functional/text/ter.py``; entries
+        past the cap are computed but not cached). The memo persists across
+        ``update()`` and ``reset()`` calls for the lifetime of the metric
+        object — worst-case host memory is therefore bounded by 65 536
+        cached sentences, not by epoch length — and is NOT part of the
+        metric state: it is excluded from ``state_dict()`` and distributed
+        sync (it only serves to skip re-tokenizing repeated references).
+
     Example:
         >>> import jax.numpy as jnp
         >>> from torchmetrics_tpu import TranslationEditRate
