@@ -350,6 +350,21 @@ def bench_smoke() -> dict:
     update_s = round(time.perf_counter() - t0, 5)
     dispatches = M.executable_cache_stats()["dispatches"] - before
 
+    # steady state must not retrace, compile, or host-transfer: one extra
+    # update under the armed runtime guard (torchmetrics_tpu.debug) proves the
+    # fused path stays on-device end to end
+    from torchmetrics_tpu.debug import StrictModeViolation, strict_mode
+
+    p2, t2 = preds[2], target[2]  # slice outside the guard (h2d of the index)
+    retrace_before = M.executable_cache_stats()["retraces"]
+    try:
+        with strict_mode(max_new_executables=0):
+            coll.update(p2, t2)
+        strict_ok = True
+    except StrictModeViolation:
+        strict_ok = False
+    steady_retraces = M.executable_cache_stats()["retraces"] - retrace_before
+
     miss_before = M.executable_cache_stats()["misses"]
     clone = coll.clone()
     clone.update(preds[0], target[0])
@@ -410,18 +425,35 @@ def bench_smoke() -> dict:
         float(eager_vals[k]) == float(buf_vals[k]) for k in eager_vals
     )
 
+    # static gate: the corpus must lint clean against the committed baseline
+    repo_dir = os.path.dirname(os.path.abspath(__file__))
+    try:
+        from tools.tpulint import run_lint
+
+        lint = run_lint([os.path.join(repo_dir, "torchmetrics_tpu")], root=repo_dir)
+        tpulint_new = len(lint.new_violations)
+    except Exception:
+        tpulint_new = -1
+    tpulint_ok = tpulint_new == 0
+
     return {
         "mode": "smoke",
         "ok": (
             dispatches == 1
             and clone_misses == 0
+            and strict_ok
+            and steady_retraces == 0
             and synced == per_rank
             and staged_dispatches == 2
             and pending == 2
             and buffered_matches_eager
+            and tpulint_ok
         ),
         "dispatches_per_update": dispatches,
         "clone_new_compilations": clone_misses,
+        "strict_mode_ok": strict_ok,
+        "steady_state_retraces": steady_retraces,
+        "tpulint_new_violations": tpulint_new,
         "warmup_compile_s": compile_s,
         "update_s": update_s,
         "values": values,

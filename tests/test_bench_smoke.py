@@ -30,6 +30,11 @@ def test_bench_smoke_passes():
     # the specific invariants, asserted individually for a readable failure
     assert result["dispatches_per_update"] == 1, result
     assert result["clone_new_compilations"] == 0, result
+    # runtime guard: a steady-state update under strict_mode() must neither
+    # retrace nor host-transfer; static guard: the corpus lints clean
+    assert result["strict_mode_ok"] is True, result
+    assert result["steady_state_retraces"] == 0, result
+    assert result["tpulint_new_violations"] == 0, result
     assert result["synced_accuracy"] == result["expected_synced_accuracy"], result
     # buffered streaming: 10 staged steps at window=4 auto-flush twice (at 4
     # and 8 staged), so 2 scanned dispatches cover 10 steps of metric work;

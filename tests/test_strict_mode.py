@@ -1,0 +1,134 @@
+"""strict_mode(): runtime enforcement of the dispatch contract.
+
+The static analyzer (tests/test_tpulint.py) proves the code can't host-sync
+or retrace; these tests prove the armed runtime actually catches injected
+violations — an eager op slipping past the jit path trips the transfer guard,
+and a shape change against a warm executable trips the retrace counter.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torchmetrics_tpu.metric as M
+from torchmetrics_tpu import MeanMetric, MeanSquaredError
+from torchmetrics_tpu.debug import StrictModeViolation, StrictStats, strict_mode
+
+RNG = np.random.RandomState(7)
+
+
+def _pair(n=16):
+    return (
+        jnp.asarray(RNG.randn(n).astype(np.float32)),
+        jnp.asarray(RNG.randn(n).astype(np.float32)),
+    )
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    M.clear_executable_cache()
+    yield
+    M.clear_executable_cache()
+
+
+def _warm(metric, *args):
+    # two updates: the first compiles against weak-typed initial state, the
+    # second against the settled concrete-typed state
+    metric.update(*args)
+    metric.update(*args)
+
+
+def test_steady_state_passes_with_guard_armed():
+    m = MeanSquaredError()
+    p, t = _pair()
+    _warm(m, p, t)
+    with strict_mode(max_new_executables=0) as stats:
+        for _ in range(3):
+            m.update(p, t)
+    assert stats.compiles == 0
+    assert stats.retraces == 0
+    assert stats.new_executables == 0
+
+
+def test_compute_steady_state_passes():
+    m = MeanSquaredError()
+    p, t = _pair()
+    _warm(m, p, t)
+    float(m.compute())  # warm the compute executable outside the guard
+    m.update(p, t)
+    with strict_mode():
+        m.update(p, t)
+        m.compute()
+
+
+def test_injected_retrace_raises():
+    m = MeanSquaredError()
+    p, t = _pair()
+    _warm(m, p, t)
+    # transfer_guard="allow": compilation itself moves constants host->device,
+    # and the point here is the retrace counter, not the transfer guard
+    with pytest.raises(StrictModeViolation, match="retrace"):
+        with strict_mode(transfer_guard="allow"):
+            m.update(*_pair(n=8))  # new input shape against a warm executable
+
+
+def test_retrace_budget_tolerates_expected_churn():
+    m = MeanSquaredError()
+    p, t = _pair()
+    _warm(m, p, t)
+    with strict_mode(transfer_guard="allow", max_retraces=2) as stats:
+        m.update(*_pair(n=8))
+    assert stats.retraces >= 1
+
+
+def test_injected_host_transfer_raises():
+    m = MeanSquaredError()
+    p, t = _pair()
+    _warm(m, p, t)
+    with pytest.raises(StrictModeViolation, match="transfer"):
+        with strict_mode():
+            # an eager op that escaped the jit path: the Python constant is
+            # implicitly transferred host->device at dispatch time
+            m.sum_squared_error + 1.0
+
+
+def test_new_executable_budget_raises():
+    p, t = _pair()
+    m = MeanSquaredError()
+    _warm(m, p, t)
+    m2 = MeanMetric()
+    with pytest.raises(StrictModeViolation, match="compile"):
+        with strict_mode(transfer_guard="allow", max_new_executables=0):
+            m2.update(jnp.asarray([1.0, 2.0]))  # cold metric compiles
+
+
+def test_observer_removed_after_exit():
+    before = len(M._COMPILE_OBSERVERS)
+    with strict_mode():
+        assert len(M._COMPILE_OBSERVERS) == before + 1
+    assert len(M._COMPILE_OBSERVERS) == before
+    # also removed when the body raises
+    with pytest.raises(ValueError):
+        with strict_mode():
+            raise ValueError("boom")
+    assert len(M._COMPILE_OBSERVERS) == before
+
+
+def test_retrace_counter_in_cache_stats():
+    m = MeanSquaredError()
+    p, t = _pair()
+    _warm(m, p, t)
+    base = M.executable_cache_stats()["retraces"]
+    m.update(*_pair(n=8))  # one genuine retrace
+    after = M.executable_cache_stats()
+    assert after["retraces"] == base + 1
+    assert after["compiles"] >= after["retraces"]
+
+
+def test_stats_object_counts_compiles():
+    m = MeanSquaredError()
+    p, t = _pair()
+    with strict_mode(transfer_guard="allow", max_retraces=2) as stats:
+        _warm(m, p, t)  # first compile + the weak-type settling recompile
+    assert isinstance(stats, StrictStats)
+    assert stats.new_executables == 1
+    assert stats.compiles >= 1

@@ -1,0 +1,432 @@
+"""tpulint rule-by-rule fixtures + the full-corpus zero-new-violations gate.
+
+Each rule gets a positive fixture (violating code that must be flagged) and a
+negative fixture (the idiomatic traceable rewrite that must pass). Fixtures
+are tiny synthetic modules laid out so the analyzer's root detection sees
+them: kernels live in a ``*.functional.*`` module, Metric subclasses import a
+stub ``torchmetrics_tpu.metric.Metric`` (the corpus is pure-AST, so a stub is
+enough for MRO resolution).
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tools.tpulint import run_lint
+from tools.tpulint.baseline import load_baseline, save_baseline
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+METRIC_STUB = """
+class Metric:
+    def add_state(self, name, default, dist_reduce_fx=None):
+        pass
+
+    def update(self, *args, **kwargs):
+        pass
+
+    def reset(self):
+        pass
+"""
+
+
+def _lint_fixture(tmp_path, kernel_src=None, metrics_src=None, root_kinds=("update", "kernel")):
+    (tmp_path / "torchmetrics_tpu").mkdir()
+    (tmp_path / "torchmetrics_tpu" / "metric.py").write_text(METRIC_STUB)
+    paths = [str(tmp_path / "torchmetrics_tpu")]
+    if kernel_src is not None:
+        (tmp_path / "pkg" / "functional").mkdir(parents=True)
+        (tmp_path / "pkg" / "functional" / "kern.py").write_text(textwrap.dedent(kernel_src))
+        paths.append(str(tmp_path / "pkg"))
+    if metrics_src is not None:
+        (tmp_path / "mpkg").mkdir(exist_ok=True)
+        (tmp_path / "mpkg" / "metrics.py").write_text(textwrap.dedent(metrics_src))
+        paths.append(str(tmp_path / "mpkg"))
+    return run_lint(paths, root=str(tmp_path), baseline_path=None, root_kinds=root_kinds)
+
+
+def _rules(result):
+    return sorted({v.rule for v in result.new_violations})
+
+
+# ---------------------------------------------------------------------------
+# TPU001 — host sync in a traced path
+# ---------------------------------------------------------------------------
+
+
+def test_tpu001_item_in_kernel_flagged(tmp_path):
+    res = _lint_fixture(tmp_path, kernel_src="""
+        def _foo_update(preds, target):
+            return preds.sum().item()
+    """)
+    assert "TPU001" in _rules(res)
+
+
+def test_tpu001_np_asarray_flagged(tmp_path):
+    res = _lint_fixture(tmp_path, kernel_src="""
+        import numpy as np
+
+        def _foo_update(preds, target):
+            return np.asarray(preds) + 1
+    """)
+    assert "TPU001" in _rules(res)
+
+
+def test_tpu001_clean_kernel_passes(tmp_path):
+    res = _lint_fixture(tmp_path, kernel_src="""
+        import jax.numpy as jnp
+
+        def _foo_update(preds, target):
+            return jnp.sum(preds * target)
+    """)
+    assert not res.new_violations
+
+
+def test_tpu001_tracing_guard_suppresses(tmp_path):
+    res = _lint_fixture(tmp_path, kernel_src="""
+        from torchmetrics_tpu.utils.checks import is_tracing
+
+        def _foo_update(preds, target):
+            if is_tracing(preds):
+                return preds
+            return preds.sum().item()
+    """)
+    assert not res.new_violations
+
+
+def test_tpu001_transitive_callee_flagged(tmp_path):
+    res = _lint_fixture(tmp_path, kernel_src="""
+        import jax
+
+        Array = jax.Array
+
+        def _helper(x: Array):
+            return float(x)
+
+        def _foo_update(preds, target):
+            return _helper(preds)
+    """)
+    assert "TPU001" in _rules(res)
+
+
+# ---------------------------------------------------------------------------
+# TPU002 — recompile hazards (data-dependent shapes)
+# ---------------------------------------------------------------------------
+
+
+def test_tpu002_nonzero_without_size_flagged(tmp_path):
+    res = _lint_fixture(tmp_path, kernel_src="""
+        import jax.numpy as jnp
+
+        def _foo_update(preds, target):
+            return jnp.nonzero(preds)[0]
+    """)
+    assert "TPU002" in _rules(res)
+
+
+def test_tpu002_nonzero_with_size_passes(tmp_path):
+    res = _lint_fixture(tmp_path, kernel_src="""
+        import jax.numpy as jnp
+
+        def _foo_update(preds, target):
+            return jnp.nonzero(preds, size=16, fill_value=0)[0]
+    """)
+    assert not res.new_violations
+
+
+def test_tpu002_boolean_mask_indexing_flagged(tmp_path):
+    res = _lint_fixture(tmp_path, kernel_src="""
+        import jax.numpy as jnp
+
+        def _foo_update(preds, target):
+            keep = ~jnp.isnan(preds)
+            return preds[keep]
+    """)
+    assert "TPU002" in _rules(res)
+
+
+def test_tpu002_where_rewrite_passes(tmp_path):
+    res = _lint_fixture(tmp_path, kernel_src="""
+        import jax.numpy as jnp
+
+        def _foo_update(preds, target):
+            keep = ~jnp.isnan(preds)
+            return jnp.where(keep, preds, 0.0)
+    """)
+    assert not res.new_violations
+
+
+# ---------------------------------------------------------------------------
+# TPU003 — Python control flow on tracer values
+# ---------------------------------------------------------------------------
+
+
+def test_tpu003_if_on_array_flagged(tmp_path):
+    res = _lint_fixture(tmp_path, kernel_src="""
+        import jax.numpy as jnp
+
+        def _foo_update(preds, target):
+            if preds.sum() > 0:
+                return preds
+            return target
+    """)
+    assert "TPU003" in _rules(res)
+
+
+def test_tpu003_dtype_query_passes(tmp_path):
+    res = _lint_fixture(tmp_path, kernel_src="""
+        import jax.numpy as jnp
+
+        def _foo_update(preds, target):
+            if jnp.issubdtype(preds.dtype, jnp.floating):
+                return preds
+            return target
+    """)
+    assert not res.new_violations
+
+
+def test_tpu003_dict_annotation_not_seeded(tmp_path):
+    # `target: dict` must override name-based array seeding (membership tests
+    # on a dict are host control flow, not tracer control flow)
+    res = _lint_fixture(tmp_path, kernel_src="""
+        def _foo_update(preds, target: dict):
+            if "ms" not in target:
+                raise ValueError("bad")
+            return preds
+    """)
+    assert not res.new_violations
+
+
+# ---------------------------------------------------------------------------
+# TPU004 — state contract
+# ---------------------------------------------------------------------------
+
+
+def test_tpu004_mutation_in_compute_flagged(tmp_path):
+    res = _lint_fixture(tmp_path, metrics_src="""
+        import jax.numpy as jnp
+        from torchmetrics_tpu.metric import Metric
+
+        class M(Metric):
+            def __init__(self):
+                self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+            def update(self, preds, target):
+                self.total = self.total + jnp.sum(preds)
+
+            def compute(self):
+                self.total = self.total / 2.0
+                return self.total
+    """)
+    assert "TPU004" in _rules(res)
+
+
+def test_tpu004_mutation_in_update_passes(tmp_path):
+    res = _lint_fixture(tmp_path, metrics_src="""
+        import jax.numpy as jnp
+        from torchmetrics_tpu.metric import Metric
+
+        class M(Metric):
+            def __init__(self):
+                self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+            def update(self, preds, target):
+                self.total = self.total + jnp.sum(preds)
+
+            def compute(self):
+                return self.total
+    """)
+    assert "TPU004" not in _rules(res)
+
+
+def test_tpu004_list_state_needs_cat(tmp_path):
+    res = _lint_fixture(tmp_path, metrics_src="""
+        from torchmetrics_tpu.metric import Metric
+
+        class M(Metric):
+            def __init__(self):
+                self.add_state("chunks", [], dist_reduce_fx="sum")
+
+            def update(self, preds, target):
+                self.chunks.append(preds)
+    """)
+    assert "TPU004" in _rules(res)
+
+
+# ---------------------------------------------------------------------------
+# TPU005 — use after donation
+# ---------------------------------------------------------------------------
+
+
+def test_tpu005_use_after_donation_flagged(tmp_path):
+    res = _lint_fixture(tmp_path, kernel_src="""
+        import jax
+
+        def _foo_update(preds, target):
+            step = jax.jit(lambda s, x: s + x, donate_argnums=(0,))
+            state = preds * 0.0
+            out = step(state, preds)
+            return state.sum() + out
+    """)
+    assert "TPU005" in _rules(res)
+
+
+def test_tpu005_no_reuse_passes(tmp_path):
+    res = _lint_fixture(tmp_path, kernel_src="""
+        import jax
+
+        def _foo_update(preds, target):
+            step = jax.jit(lambda s, x: s + x, donate_argnums=(0,))
+            state = preds * 0.0
+            state = step(state, preds)
+            return state
+    """)
+    assert "TPU005" not in _rules(res)
+
+
+# ---------------------------------------------------------------------------
+# TPU006 — implicit float64
+# ---------------------------------------------------------------------------
+
+
+def test_tpu006_float64_flagged(tmp_path):
+    res = _lint_fixture(tmp_path, kernel_src="""
+        import jax.numpy as jnp
+
+        def _foo_update(preds, target):
+            return jnp.zeros((4,), dtype=jnp.float64)
+    """)
+    assert "TPU006" in _rules(res)
+
+
+def test_tpu006_float32_passes(tmp_path):
+    res = _lint_fixture(tmp_path, kernel_src="""
+        import jax.numpy as jnp
+
+        def _foo_update(preds, target):
+            return jnp.zeros((4,), dtype=jnp.float32)
+    """)
+    assert not res.new_violations
+
+
+# ---------------------------------------------------------------------------
+# waivers
+# ---------------------------------------------------------------------------
+
+
+def test_waiver_with_reason_suppresses(tmp_path):
+    res = _lint_fixture(tmp_path, kernel_src="""
+        def _foo_update(preds, target):
+            return preds.sum().item()  # tpulint: disable=TPU001(eager-only helper, guarded by caller)
+    """)
+    assert not res.new_violations
+    assert len(res.waived) == 1
+    assert res.waived[0].rule == "TPU001"
+
+
+def test_waiver_without_reason_is_malformed(tmp_path):
+    res = _lint_fixture(tmp_path, kernel_src="""
+        def _foo_update(preds, target):
+            return preds.sum().item()  # tpulint: disable=TPU001
+    """)
+    assert "TPU000" in _rules(res)
+
+
+def test_def_line_waiver_covers_function(tmp_path):
+    res = _lint_fixture(tmp_path, kernel_src="""
+        # tpulint: disable=TPU001(host-orchestrated by design),TPU002(host-orchestrated by design)
+        def _foo_update(preds, target):
+            import jax.numpy as jnp
+            vals = jnp.nonzero(preds)[0]
+            return vals.tolist()
+    """)
+    assert not res.new_violations
+    assert len(res.waived) >= 1
+
+
+def test_wrong_rule_waiver_does_not_suppress(tmp_path):
+    res = _lint_fixture(tmp_path, kernel_src="""
+        def _foo_update(preds, target):
+            return preds.sum().item()  # tpulint: disable=TPU002(not the right rule)
+    """)
+    assert "TPU001" in _rules(res)
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_roundtrip(tmp_path):
+    res = _lint_fixture(tmp_path, kernel_src="""
+        def _foo_update(preds, target):
+            return preds.sum().item()
+    """)
+    assert res.new_violations
+    baseline_file = tmp_path / "baseline.json"
+    save_baseline(str(baseline_file), res.violations)
+    assert load_baseline(str(baseline_file))
+
+    res2 = run_lint(
+        [str(tmp_path / "torchmetrics_tpu"), str(tmp_path / "pkg")],
+        root=str(tmp_path),
+        baseline_path=str(baseline_file),
+    )
+    assert not res2.new_violations
+    assert res2.baselined
+
+
+def test_baseline_reports_stale_entries(tmp_path):
+    baseline_file = tmp_path / "baseline.json"
+    baseline_file.write_text(
+        '{"version": 1, "tool": "tpulint", "entries": '
+        '[{"file": "pkg/functional/kern.py", "symbol": "pkg.functional.kern:_gone_update", '
+        '"rule": "TPU001", "count": 1}]}'
+    )
+    result = run_lint([str(tmp_path)], root=str(tmp_path), baseline_path=str(baseline_file))
+    assert result.stale_baseline
+
+
+# ---------------------------------------------------------------------------
+# full-corpus gate + CLI
+# ---------------------------------------------------------------------------
+
+
+def test_corpus_has_no_new_violations():
+    """The committed gate: the real corpus is clean against the baseline."""
+    result = run_lint(
+        [os.path.join(REPO_ROOT, "torchmetrics_tpu")],
+        root=REPO_ROOT,
+        baseline_path=os.path.join(REPO_ROOT, "tools", "tpulint", "baseline.json"),
+    )
+    assert not result.new_violations, "\n".join(v.format() for v in result.new_violations)
+    assert result.n_roots > 100, "root detection collapsed — gate would be vacuous"
+    assert result.n_reachable >= result.n_roots
+
+
+def test_cli_exits_zero_on_clean_corpus():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.tpulint", "torchmetrics_tpu"],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_exits_one_on_violation(tmp_path):
+    bad = tmp_path / "pkg" / "functional"
+    bad.mkdir(parents=True)
+    (bad / "kern.py").write_text("def _foo_update(preds, target):\n    return preds.item()\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.tpulint", "--no-baseline", str(tmp_path)],
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "TPU001" in proc.stdout

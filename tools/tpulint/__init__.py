@@ -1,0 +1,116 @@
+"""tpulint — tracer-hygiene static analyzer for the torchmetrics_tpu corpus.
+
+Builds a lightweight call graph rooted at every jit-capable ``update`` body
+and functional ``_*_update``/``_*_format`` kernel, then enforces the dispatch
+contract the fused single-dispatch and ``lax.scan`` streaming paths rely on:
+no host syncs, no data-dependent shapes, no Python control flow on tracers,
+sane state registration, no use-after-donation, no float64.
+
+Programmatic entry point::
+
+    from tools.tpulint import run_lint
+    result = run_lint(["torchmetrics_tpu"])
+    assert not result.new_violations
+
+CLI::
+
+    python -m tools.tpulint torchmetrics_tpu/ [--update-baseline] [--json]
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .baseline import BaselineKey, apply_baseline, load_baseline, save_baseline
+from .callgraph import find_roots, reach
+from .corpus import Corpus
+from .rules import (
+    ALL_RULES,
+    RULE_TITLES,
+    Violation,
+    check_state_contract,
+    check_traced_rules,
+    check_use_after_donation,
+)
+from .waivers import apply_waivers, collect_waivers
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+@dataclass
+class LintResult:
+    violations: List[Violation] = field(default_factory=list)
+    stale_baseline: List[BaselineKey] = field(default_factory=list)
+    n_files: int = 0
+    n_roots: int = 0
+    n_reachable: int = 0
+
+    @property
+    def new_violations(self) -> List[Violation]:
+        return [v for v in self.violations if not v.waived and not v.baselined]
+
+    @property
+    def waived(self) -> List[Violation]:
+        return [v for v in self.violations if v.waived]
+
+    @property
+    def baselined(self) -> List[Violation]:
+        return [v for v in self.violations if v.baselined]
+
+    def summary(self) -> Dict[str, int]:
+        per_rule: Dict[str, int] = {}
+        for v in self.new_violations:
+            per_rule[v.rule] = per_rule.get(v.rule, 0) + 1
+        return per_rule
+
+
+def run_lint(
+    paths: Sequence[str],
+    root: str = ".",
+    baseline_path: Optional[str] = DEFAULT_BASELINE,
+    root_kinds: Tuple[str, ...] = ("update", "kernel"),
+) -> LintResult:
+    corpus = Corpus.build(list(paths), root=root)
+    roots = find_roots(corpus, kinds=root_kinds)
+    reachability = reach(corpus, roots)
+
+    violations: List[Violation] = []
+    for qn, fn in sorted(reachability.reachable.items()):
+        violations.extend(check_traced_rules(fn, corpus, reachability.roots_of.get(qn, set())))
+    for cinfo in sorted(corpus.classes.values(), key=lambda c: c.qualname):
+        if corpus.is_metric_subclass(cinfo):
+            violations.extend(check_state_contract(cinfo, corpus))
+    for fn in sorted(corpus.functions.values(), key=lambda f: f.qualname):
+        violations.extend(check_use_after_donation(fn))
+
+    waivers_by_path = {}
+    for mod in corpus.modules.values():
+        w = collect_waivers(mod)
+        waivers_by_path[mod.path] = w
+        violations.extend(w.malformed)
+    apply_waivers(violations, waivers_by_path)
+
+    violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    stale: List[BaselineKey] = []
+    if baseline_path:
+        stale = apply_baseline(violations, load_baseline(baseline_path))
+
+    return LintResult(
+        violations=violations,
+        stale_baseline=stale,
+        n_files=len(corpus.modules),
+        n_roots=len(roots),
+        n_reachable=len(reachability.reachable),
+    )
+
+
+__all__ = [
+    "ALL_RULES",
+    "RULE_TITLES",
+    "DEFAULT_BASELINE",
+    "LintResult",
+    "Violation",
+    "run_lint",
+    "save_baseline",
+]

@@ -1,0 +1,84 @@
+"""CLI: ``python -m tools.tpulint [paths...]``.
+
+Exit codes: 0 = clean (no non-baselined violations), 1 = new violations
+found, 2 = usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import DEFAULT_BASELINE, RULE_TITLES, run_lint, save_baseline
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpulint",
+        description="tracer-hygiene static analyzer for the torchmetrics_tpu corpus",
+    )
+    ap.add_argument("paths", nargs="*", default=["torchmetrics_tpu"],
+                    help="files or directories to scan (default: torchmetrics_tpu)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline JSON of triaged legacy violations")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every violation, ignoring the baseline")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from this scan and exit 0")
+    ap.add_argument("--roots", default="update,kernel",
+                    help="comma-separated root kinds: update,kernel,compute")
+    ap.add_argument("--json", action="store_true", help="emit one JSON object instead of text")
+    ap.add_argument("--show-waived", action="store_true", help="also list waived/baselined hits")
+    args = ap.parse_args(argv)
+
+    paths = args.paths or ["torchmetrics_tpu"]
+    root_kinds = tuple(k.strip() for k in args.roots.split(",") if k.strip())
+    if not set(root_kinds) <= {"update", "kernel", "compute"}:
+        ap.error(f"unknown root kind in --roots={args.roots}")
+
+    result = run_lint(
+        paths,
+        baseline_path=None if (args.no_baseline or args.update_baseline) else args.baseline,
+        root_kinds=root_kinds,
+    )
+
+    if args.update_baseline:
+        save_baseline(args.baseline, result.violations)
+        print(f"tpulint: baseline updated with {len([v for v in result.violations if not v.waived])} "
+              f"violations -> {args.baseline}")
+        return 0
+
+    new = result.new_violations
+    if args.json:
+        print(json.dumps({
+            "files": result.n_files,
+            "roots": result.n_roots,
+            "reachable": result.n_reachable,
+            "new": [v.__dict__ for v in new],
+            "waived": len(result.waived),
+            "baselined": len(result.baselined),
+            "stale_baseline": [list(k) for k in result.stale_baseline],
+            "summary": result.summary(),
+        }))
+        return 1 if new else 0
+
+    for v in new:
+        print(v.format())
+    if args.show_waived:
+        for v in result.waived:
+            print(f"{v.format()}  (waived: {v.waive_reason})")
+        for v in result.baselined:
+            print(f"{v.format()}  (baselined)")
+    for key in result.stale_baseline:
+        print(f"tpulint: stale baseline entry {key} — violation fixed, run --update-baseline")
+    counts = ", ".join(f"{r} {n}" for r, n in sorted(result.summary().items())) or "none"
+    print(
+        f"tpulint: {result.n_files} files, {result.n_roots} jit roots, "
+        f"{result.n_reachable} reachable functions; new violations: {counts} "
+        f"({len(result.waived)} waived, {len(result.baselined)} baselined)"
+    )
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,55 @@
+"""Committed-baseline handling: the gate is zero NEW violations.
+
+Entries are keyed ``(file, symbol, rule)`` with an allowed count — line
+numbers churn on every edit, function identity doesn't. A scan producing
+more violations than the baselined count for a key reports the excess as
+new; producing fewer flags the entry as stale (informational) so the
+backlog visibly burns down.
+"""
+from __future__ import annotations
+
+import json
+import os
+from collections import Counter
+from typing import Dict, List, Tuple
+
+from .rules import Violation
+
+BaselineKey = Tuple[str, str, str]
+
+
+def load_baseline(path: str) -> Dict[BaselineKey, int]:
+    if not path or not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    out: Dict[BaselineKey, int] = {}
+    for e in data.get("entries", []):
+        out[(e["file"], e["symbol"], e["rule"])] = int(e.get("count", 1))
+    return out
+
+
+def save_baseline(path: str, violations: List[Violation]) -> None:
+    counts: Counter = Counter(v.key() for v in violations if not v.waived)
+    entries = [
+        {"file": f, "symbol": s, "rule": r, "count": n}
+        for (f, s, r), n in sorted(counts.items())
+    ]
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": 1, "tool": "tpulint", "entries": entries}, fh, indent=2)
+        fh.write("\n")
+
+
+def apply_baseline(
+    violations: List[Violation], baseline: Dict[BaselineKey, int]
+) -> List[BaselineKey]:
+    """Mark baselined violations in place; return stale baseline keys."""
+    budget = dict(baseline)
+    for v in violations:
+        if v.waived:
+            continue
+        k = v.key()
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            v.baselined = True
+    return [k for k, n in budget.items() if n > 0]

@@ -1,0 +1,375 @@
+"""Jit-reachability: roots, call-graph BFS, tracer-guard regions, taint.
+
+Roots of the traced world (what ``metric.py`` actually jits):
+
+- ``update`` methods of every jittable :class:`Metric` subclass — the body
+  handed to ``_pure_update`` and traced into one XLA program.
+- private functional kernels ``_*_update`` / ``_*_format`` in
+  ``functional/`` — the same bodies reached through the pure
+  ``update_state`` / ``shard_map`` path.
+- optionally ``compute`` methods of classes that never set
+  ``_compute_jittable = False`` (the forward fast path traces batch-compute).
+
+Code dominated by a tracer guard (``if is_tracing(x): return`` /
+``if not isinstance(x, jax.core.Tracer): ...``) is host-only by construction
+and excluded from traced-path rules.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .corpus import ClassInfo, Corpus, FunctionInfo, _dotted_name
+
+KERNEL_ROOT_RE = re.compile(r"^_\w+_(update|format)$")
+
+# attribute reads that return host metadata, not device data
+_META_ATTRS = {"shape", "ndim", "size", "dtype", "at", "T"}
+_META_VALUE_ATTRS = {"shape", "ndim", "size", "dtype"}
+
+# --- tracer-guard classification -------------------------------------------
+
+TRACING = "tracing"
+NOT_TRACING = "not_tracing"
+UNKNOWN = "unknown"
+
+
+def _classify_guard(test: ast.expr) -> str:
+    """Classify a condition as true-only-while-tracing / -while-eager."""
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        inner = _classify_guard(test.operand)
+        if inner == TRACING:
+            return NOT_TRACING
+        if inner == NOT_TRACING:
+            return TRACING
+        return UNKNOWN
+    if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And):
+        kinds = [_classify_guard(v) for v in test.values]
+        if NOT_TRACING in kinds:
+            return NOT_TRACING  # conjunction can only hold outside a trace
+        if TRACING in kinds:
+            return TRACING
+        return UNKNOWN
+    if isinstance(test, ast.Call):
+        fname = _dotted_name(test.func) or ""
+        if fname.split(".")[-1] == "is_tracing":
+            return TRACING
+        if fname.split(".")[-1] == "isinstance" and len(test.args) == 2:
+            cls_src = ast.dump(test.args[1])
+            if "Tracer" in cls_src:
+                return TRACING
+    return UNKNOWN
+
+
+def _terminates(body: List[ast.stmt]) -> bool:
+    return bool(body) and isinstance(body[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break))
+
+
+def host_only_lines(fn_node: ast.AST) -> Set[int]:
+    """Line numbers inside ``fn_node`` that only execute outside a trace."""
+    out: Set[int] = set()
+
+    def mark(node: ast.AST) -> None:
+        end = getattr(node, "end_lineno", None) or node.lineno
+        out.update(range(node.lineno, end + 1))
+
+    def walk_block(body: List[ast.stmt]) -> None:
+        host_rest = False
+        for stmt in body:
+            if host_rest:
+                mark(stmt)
+                continue
+            if isinstance(stmt, ast.If):
+                kind = _classify_guard(stmt.test)
+                if kind == TRACING:
+                    # body runs while tracing (still checked); else-branch is
+                    # host-only; an early-exit body makes the rest host-only
+                    for s in stmt.orelse:
+                        mark(s)
+                    walk_block(stmt.body)
+                    if _terminates(stmt.body):
+                        host_rest = True
+                    continue
+                if kind == NOT_TRACING:
+                    for s in stmt.body:
+                        mark(s)
+                    walk_block(stmt.orelse)
+                    continue
+                walk_block(stmt.body)
+                walk_block(stmt.orelse)
+                continue
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.IfExp):
+                    kind = _classify_guard(child.test)
+                    if kind == NOT_TRACING:
+                        mark(child.body)
+                    elif kind == TRACING:
+                        mark(child.orelse)
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.IfExp):
+                    kind = _classify_guard(sub.test)
+                    if kind == NOT_TRACING:
+                        mark(sub.body)
+                    elif kind == TRACING:
+                        mark(sub.orelse)
+            for field_name in ("body", "orelse", "finalbody"):
+                sub_body = getattr(stmt, field_name, None)
+                if isinstance(sub_body, list) and sub_body and isinstance(sub_body[0], ast.stmt) and not isinstance(stmt, ast.If):
+                    walk_block(sub_body)
+
+    walk_block(list(getattr(fn_node, "body", [])))
+    return out
+
+
+# --- array-taint -----------------------------------------------------------
+
+_ARRAY_ANNOTATIONS = ("Array", "ndarray", "jax.Array", "jnp.ndarray")
+_ARRAY_PARAM_NAMES = {"preds", "target"}
+
+
+@dataclass
+class Taint:
+    """Per-function value classification (array-like / boolean-mask)."""
+
+    arrays: Set[str] = field(default_factory=set)
+    boolmasks: Set[str] = field(default_factory=set)
+
+    def is_array_expr(self, node: ast.expr) -> bool:
+        return _expr_is_array(node, self)
+
+    def is_boolmask_expr(self, node: ast.expr) -> bool:
+        return _expr_is_boolmask(node, self)
+
+
+def _is_jnp_call(node: ast.expr, imports: Dict[str, str]) -> bool:
+    """Call whose target lives in jax/jax.numpy (returns device arrays)."""
+    if not isinstance(node, ast.Call):
+        return False
+    dotted = _dotted_name(node.func)
+    if not dotted:
+        return False
+    head = dotted.split(".")[0]
+    target = imports.get(head, head)
+    return target.split(".")[0] == "jax" or target in ("jax.numpy", "jax.nn", "jax.lax")
+
+
+def _expr_is_array(node: ast.expr, taint: "Taint") -> bool:
+    if isinstance(node, ast.Name):
+        return node.id in taint.arrays
+    if isinstance(node, ast.Attribute):
+        if node.attr in _META_VALUE_ATTRS:
+            return False
+        return _expr_is_array(node.value, taint)
+    if isinstance(node, ast.Subscript):
+        return _expr_is_array(node.value, taint)
+    if isinstance(node, ast.BinOp):
+        return _expr_is_array(node.left, taint) or _expr_is_array(node.right, taint)
+    if isinstance(node, ast.UnaryOp):
+        return _expr_is_array(node.operand, taint)
+    if isinstance(node, ast.Call):
+        if getattr(node, "_tpulint_array_call", False):
+            return True
+        # method call on an array-valued receiver (x.astype(...), x.reshape(...))
+        if isinstance(node.func, ast.Attribute) and node.func.attr not in _META_VALUE_ATTRS:
+            return _expr_is_array(node.func.value, taint)
+        return False
+    return False
+
+
+# jnp predicates returning boolean arrays (a data-dependent mask when indexed)
+_BOOL_PREDICATE_FNS = {
+    "isnan", "isinf", "isfinite", "isposinf", "isneginf", "isclose", "isin",
+    "logical_and", "logical_or", "logical_not", "logical_xor",
+    "equal", "not_equal", "greater", "greater_equal", "less", "less_equal",
+}
+
+
+def _expr_is_boolmask(node: ast.expr, taint: "Taint") -> bool:
+    if isinstance(node, ast.Compare):
+        if all(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn)) for op in node.ops):
+            return False
+        sides = [node.left] + list(node.comparators)
+        return any(_expr_is_array(s, taint) for s in sides)
+    if isinstance(node, ast.Name):
+        return node.id in taint.boolmasks
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Invert):
+        return _expr_is_boolmask(node.operand, taint)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, (ast.BitAnd, ast.BitOr, ast.BitXor)):
+        return _expr_is_boolmask(node.left, taint) or _expr_is_boolmask(node.right, taint)
+    if isinstance(node, ast.Call) and getattr(node, "_tpulint_array_call", False):
+        dotted = _dotted_name(node.func) or ""
+        return dotted.split(".")[-1] in _BOOL_PREDICATE_FNS
+    return False
+
+
+def _annotation_is_array(ann: Optional[ast.expr]) -> bool:
+    if ann is None:
+        return False
+    src = ast.dump(ann)
+    return any(tok in src for tok in ("'Array'", "'ndarray'"))
+
+
+_NON_ARRAY_ANNOTATIONS = {
+    "dict", "Dict", "Mapping", "str", "int", "float", "bool", "bytes",
+    "list", "List", "tuple", "Tuple", "Sequence", "set", "Set",
+}
+
+
+def _annotation_is_non_array(ann: Optional[ast.expr]) -> bool:
+    """A plain container/scalar annotation overrides name-based seeding."""
+    if ann is None:
+        return False
+    head = ann
+    if isinstance(head, ast.Subscript):
+        head = head.value
+    if isinstance(head, ast.Attribute):
+        return head.attr in _NON_ARRAY_ANNOTATIONS
+    return isinstance(head, ast.Name) and head.id in _NON_ARRAY_ANNOTATIONS
+
+
+def compute_taint(fn: FunctionInfo, imports: Dict[str, str]) -> Taint:
+    """Two-pass local taint: which names hold device arrays / bool masks."""
+    taint = Taint()
+    node = fn.node
+    args = getattr(node, "args", None)
+    if args is not None:
+        all_args = list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+        for a in all_args:
+            if _annotation_is_array(a.annotation) or (
+                a.arg in _ARRAY_PARAM_NAMES and not _annotation_is_non_array(a.annotation)
+            ):
+                taint.arrays.add(a.arg)
+
+    # pre-mark jax/jnp calls so _expr_is_array can see them
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and _is_jnp_call(sub, imports):
+            sub._tpulint_array_call = True  # type: ignore[attr-defined]
+
+    for _ in range(2):  # fixpoint-ish: two passes cover realistic chains
+        for sub in ast.walk(node):
+            targets: List[ast.expr] = []
+            value: Optional[ast.expr] = None
+            if isinstance(sub, ast.Assign):
+                targets, value = sub.targets, sub.value
+            elif isinstance(sub, ast.AnnAssign) and sub.value is not None:
+                targets, value = [sub.target], sub.value
+            elif isinstance(sub, ast.AugAssign):
+                targets, value = [sub.target], sub.value
+            if value is None:
+                continue
+            names: List[str] = []
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    names.append(t.id)
+                elif isinstance(t, ast.Tuple):
+                    names.extend(e.id for e in t.elts if isinstance(e, ast.Name))
+            if not names:
+                continue
+            if _expr_is_boolmask(value, taint):
+                taint.boolmasks.update(names)
+                taint.arrays.update(names)
+            elif _expr_is_array(value, taint):
+                taint.arrays.update(names)
+            elif isinstance(value, ast.Tuple) and any(_expr_is_array(e, taint) for e in value.elts):
+                taint.arrays.update(names)
+    return taint
+
+
+# --- roots + reachability --------------------------------------------------
+
+
+@dataclass
+class Reachability:
+    """Which corpus functions are reachable from a jit root, and why."""
+
+    reachable: Dict[str, FunctionInfo] = field(default_factory=dict)
+    roots_of: Dict[str, Set[str]] = field(default_factory=dict)  # qualname -> root qualnames
+
+
+def _class_is_jittable(corpus: Corpus, cinfo: ClassInfo) -> bool:
+    attr = corpus.class_attr(cinfo, "jittable")
+    if isinstance(attr, ast.Constant) and attr.value is False:
+        return False
+    return True
+
+
+def _class_compute_unjittable(corpus: Corpus, cinfo: ClassInfo) -> bool:
+    """True when any method in the MRO sets ``self._compute_jittable = False``."""
+    for c in corpus.class_mro(cinfo):
+        for m in c.methods.values():
+            for sub in ast.walk(m.node):
+                if (
+                    isinstance(sub, ast.Assign)
+                    and any(
+                        isinstance(t, ast.Attribute)
+                        and t.attr == "_compute_jittable"
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                        for t in sub.targets
+                    )
+                    and isinstance(sub.value, ast.Constant)
+                    and sub.value.value is False
+                ):
+                    return True
+    return False
+
+
+def find_roots(corpus: Corpus, kinds: Tuple[str, ...] = ("update", "kernel")) -> Dict[str, FunctionInfo]:
+    roots: Dict[str, FunctionInfo] = {}
+    if "update" in kinds or "compute" in kinds:
+        for cinfo in corpus.classes.values():
+            if not corpus.is_metric_subclass(cinfo) or not _class_is_jittable(corpus, cinfo):
+                continue
+            if "update" in kinds:
+                m = corpus.lookup_method(cinfo, "update")
+                if m is not None and m.cls is not None and m.cls.qualname != "torchmetrics_tpu.metric:Metric":
+                    roots[m.qualname] = m
+            if "compute" in kinds and not _class_compute_unjittable(corpus, cinfo):
+                m = corpus.lookup_method(cinfo, "compute")
+                if m is not None and m.cls is not None and m.cls.qualname != "torchmetrics_tpu.metric:Metric":
+                    roots[m.qualname] = m
+    if "kernel" in kinds:
+        for qn, fn in corpus.functions.items():
+            if fn.cls is None and ".functional." in fn.module.name and KERNEL_ROOT_RE.match(fn.name):
+                roots[qn] = fn
+    return roots
+
+
+def reach(corpus: Corpus, roots: Dict[str, FunctionInfo]) -> Reachability:
+    r = Reachability()
+    _edges_cache: Dict[str, Set[str]] = {}
+    queue: List[Tuple[FunctionInfo, str]] = [(fn, qn) for qn, fn in roots.items()]
+    while queue:
+        fn, root = queue.pop(0)
+        roots_seen = r.roots_of.setdefault(fn.qualname, set())
+        if root in roots_seen:
+            continue
+        first_visit = fn.qualname not in r.reachable
+        roots_seen.add(root)
+        r.reachable[fn.qualname] = fn
+        if not first_visit:
+            # edges already expanded; just propagate the new root
+            for callee_qn in _edges_cache.get(fn.qualname, ()):
+                callee = corpus.functions.get(callee_qn)
+                if callee is not None:
+                    queue.append((callee, root))
+            continue
+        edges: Set[str] = set()
+        for sub in ast.walk(fn.node):
+            if not isinstance(sub, ast.Call):
+                continue
+            callee = corpus.resolve_call(fn.module, sub.func, fn.cls)
+            if callee is not None:
+                edges.add(callee.qualname)
+                queue.append((callee, root))
+            # bare function references passed as values (vmap(fn), scan(fn, ...))
+            for arg in sub.args:
+                if isinstance(arg, ast.Name):
+                    ref = corpus.resolve_call(fn.module, arg, fn.cls)
+                    if ref is not None:
+                        edges.add(ref.qualname)
+                        queue.append((ref, root))
+        _edges_cache[fn.qualname] = edges
+    return r

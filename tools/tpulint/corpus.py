@@ -1,0 +1,277 @@
+"""Corpus indexing: parse every module once, resolve imports and classes.
+
+The analyzer never imports the code under analysis — everything is pure
+``ast`` so a lint run can't be poisoned by import-time side effects (backend
+probes, weight downloads) and runs in milliseconds on the ~300-file corpus.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+METRIC_BASE = "torchmetrics_tpu.metric:Metric"
+
+
+@dataclass
+class FunctionInfo:
+    """A function or method definition."""
+
+    qualname: str  # "pkg.mod:func" or "pkg.mod:Class.method"
+    module: "ModuleInfo"
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    cls: Optional["ClassInfo"] = None
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    @property
+    def path(self) -> str:
+        return self.module.path
+
+
+@dataclass
+class ClassInfo:
+    qualname: str  # "pkg.mod:Class"
+    module: "ModuleInfo"
+    node: ast.ClassDef
+    base_names: List[str] = field(default_factory=list)  # dotted, import-resolved
+    methods: Dict[str, FunctionInfo] = field(default_factory=dict)
+    class_attrs: Dict[str, ast.expr] = field(default_factory=dict)
+
+
+@dataclass
+class ModuleInfo:
+    name: str  # dotted module name
+    path: str  # repo-relative path
+    tree: ast.Module
+    source_lines: List[str]
+    # local alias -> dotted target; target may be a module ("jax.numpy") or a
+    # module attribute ("torchmetrics_tpu.utils.checks.is_tracing")
+    imports: Dict[str, str] = field(default_factory=dict)
+    functions: Dict[str, FunctionInfo] = field(default_factory=dict)
+    classes: Dict[str, ClassInfo] = field(default_factory=dict)
+
+
+def _module_name_for(path: str) -> str:
+    rel = path[:-3] if path.endswith(".py") else path
+    parts = rel.replace(os.sep, "/").split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(p for p in parts if p)
+
+
+def _collect_imports(tree: ast.Module, module_name: str) -> Dict[str, str]:
+    """Map local aliases to dotted targets, resolving relative imports."""
+    out: Dict[str, str] = {}
+    pkg_parts = module_name.split(".")
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                out[alias.asname or alias.name.split(".")[0]] = (
+                    alias.name if alias.asname else alias.name.split(".")[0]
+                )
+                if alias.asname:
+                    out[alias.asname] = alias.name
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                # relative: strip (level) trailing components of this module
+                base = pkg_parts[: len(pkg_parts) - node.level]
+                prefix = ".".join(base + ([node.module] if node.module else []))
+            else:
+                prefix = node.module or ""
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                out[alias.asname or alias.name] = f"{prefix}.{alias.name}" if prefix else alias.name
+    return out
+
+
+class Corpus:
+    """All parsed modules plus symbol/class resolution helpers."""
+
+    def __init__(self) -> None:
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.functions: Dict[str, FunctionInfo] = {}  # qualname -> info
+        self.classes: Dict[str, ClassInfo] = {}  # qualname -> info
+
+    # -- construction ---------------------------------------------------
+    @classmethod
+    def build(cls, paths: List[str], root: str = ".") -> "Corpus":
+        corpus = cls()
+        for p in _iter_py_files(paths, root):
+            corpus.add_file(p, root)
+        return corpus
+
+    def add_file(self, path: str, root: str = ".") -> Optional[ModuleInfo]:
+        full = os.path.join(root, path)
+        try:
+            with open(full, "r", encoding="utf-8") as fh:
+                src = fh.read()
+            tree = ast.parse(src, filename=path)
+        except (OSError, SyntaxError):
+            return None
+        name = _module_name_for(path)
+        mod = ModuleInfo(
+            name=name,
+            path=path,
+            tree=tree,
+            source_lines=src.splitlines(),
+            imports=_collect_imports(tree, name),
+        )
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = f"{name}:{node.name}"
+                info = FunctionInfo(qn, mod, node)
+                mod.functions[node.name] = info
+                self.functions[qn] = info
+            elif isinstance(node, ast.ClassDef):
+                cqn = f"{name}:{node.name}"
+                cinfo = ClassInfo(cqn, mod, node)
+                for base in node.bases:
+                    dotted = _dotted_name(base)
+                    if dotted:
+                        cinfo.base_names.append(mod.imports.get(dotted.split(".")[0], dotted.split(".")[0]) + dotted[len(dotted.split(".")[0]):])
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        fqn = f"{name}:{node.name}.{item.name}"
+                        finfo = FunctionInfo(fqn, mod, item, cinfo)
+                        cinfo.methods[item.name] = finfo
+                        self.functions[fqn] = finfo
+                    elif isinstance(item, ast.Assign) and len(item.targets) == 1 and isinstance(item.targets[0], ast.Name):
+                        cinfo.class_attrs[item.targets[0].id] = item.value
+                    elif isinstance(item, ast.AnnAssign) and isinstance(item.target, ast.Name) and item.value is not None:
+                        cinfo.class_attrs[item.target.id] = item.value
+                mod.classes[node.name] = cinfo
+                self.classes[cqn] = cinfo
+        self.modules[name] = mod
+        return mod
+
+    # -- resolution -----------------------------------------------------
+    def resolve_class(self, dotted: str) -> Optional[ClassInfo]:
+        """Resolve a dotted name ("pkg.mod.Class") to a corpus class."""
+        if ":" in dotted:
+            return self.classes.get(dotted)
+        parts = dotted.split(".")
+        for split in range(len(parts) - 1, 0, -1):
+            mod = self.modules.get(".".join(parts[:split]))
+            if mod is not None and parts[split] in mod.classes:
+                if split == len(parts) - 1:
+                    return mod.classes[parts[split]]
+                return None
+        # re-exports: "torchmetrics_tpu.Metric" via package __init__ imports
+        for split in range(len(parts) - 1, 0, -1):
+            mod = self.modules.get(".".join(parts[:split]))
+            if mod is not None and parts[split] in mod.imports and split == len(parts) - 1:
+                target = mod.imports[parts[split]]
+                if target != dotted:
+                    return self.resolve_class(target)
+        return None
+
+    def class_mro(self, cinfo: ClassInfo) -> List[ClassInfo]:
+        """Linearized corpus-internal ancestry (BFS; external bases skipped)."""
+        out: List[ClassInfo] = []
+        seen = set()
+        queue = [cinfo]
+        while queue:
+            c = queue.pop(0)
+            if c.qualname in seen:
+                continue
+            seen.add(c.qualname)
+            out.append(c)
+            mod = c.module
+            for base in c.node.bases:
+                dotted = _dotted_name(base)
+                if not dotted:
+                    continue
+                head, rest = dotted.split(".")[0], dotted.split(".")[1:]
+                target = mod.imports.get(head, head)
+                resolved = self.resolve_class(".".join([target] + rest))
+                if resolved is None and not rest and head in mod.classes:
+                    resolved = mod.classes[head]
+                if resolved is not None:
+                    queue.append(resolved)
+        return out
+
+    def is_metric_subclass(self, cinfo: ClassInfo) -> bool:
+        return any(c.qualname == METRIC_BASE for c in self.class_mro(cinfo)) and cinfo.qualname != METRIC_BASE
+
+    def class_attr(self, cinfo: ClassInfo, name: str) -> Optional[ast.expr]:
+        for c in self.class_mro(cinfo):
+            if name in c.class_attrs:
+                return c.class_attrs[name]
+        return None
+
+    def lookup_method(self, cinfo: ClassInfo, name: str) -> Optional[FunctionInfo]:
+        for c in self.class_mro(cinfo):
+            if name in c.methods:
+                return c.methods[name]
+        return None
+
+    def resolve_call(self, mod: ModuleInfo, func: ast.expr, cls: Optional[ClassInfo]) -> Optional[FunctionInfo]:
+        """Resolve a call expression to a corpus function, best effort."""
+        if isinstance(func, ast.Name):
+            if func.id in mod.functions:
+                return mod.functions[func.id]
+            target = mod.imports.get(func.id)
+            if target:
+                return self._function_by_dotted(target)
+            return None
+        if isinstance(func, ast.Attribute):
+            # self.method(...)
+            if isinstance(func.value, ast.Name) and func.value.id == "self" and cls is not None:
+                return self.lookup_method(cls, func.attr)
+            dotted = _dotted_name(func)
+            if dotted:
+                head = dotted.split(".")[0]
+                target = mod.imports.get(head)
+                if target:
+                    return self._function_by_dotted(target + dotted[len(head):])
+        return None
+
+    def _function_by_dotted(self, dotted: str) -> Optional[FunctionInfo]:
+        parts = dotted.split(".")
+        for split in range(len(parts) - 1, 0, -1):
+            mod = self.modules.get(".".join(parts[:split]))
+            if mod is None:
+                continue
+            rest = parts[split:]
+            if len(rest) == 1 and rest[0] in mod.functions:
+                return mod.functions[rest[0]]
+            if len(rest) == 2 and rest[0] in mod.classes:
+                return mod.classes[rest[0]].methods.get(rest[1])
+            # chase one level of re-export
+            if len(rest) == 1 and rest[0] in mod.imports:
+                target = mod.imports[rest[0]]
+                if target != dotted:
+                    return self._function_by_dotted(target)
+        return None
+
+
+def _dotted_name(node: ast.expr) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _iter_py_files(paths: List[str], root: str) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        full = os.path.join(root, p)
+        if os.path.isfile(full) and p.endswith(".py"):
+            out.append(os.path.normpath(p))
+        elif os.path.isdir(full):
+            for dirpath, dirnames, filenames in os.walk(full):
+                dirnames[:] = [d for d in dirnames if d not in ("__pycache__", ".git")]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        rel = os.path.relpath(os.path.join(dirpath, fn), root)
+                        out.append(os.path.normpath(rel))
+    return sorted(set(out))

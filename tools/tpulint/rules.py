@@ -1,0 +1,440 @@
+"""The tpulint rule catalog.
+
+Every rule reports :class:`Violation` records anchored to (file, line,
+enclosing function). Traced-path rules (TPU001/002/003/006) only fire inside
+functions reachable from a jit root and skip statements dominated by a tracer
+guard (``callgraph.host_only_lines``). TPU004 inspects Metric classes
+directly; TPU005 scans all functions (donation misuse is an eager-layer bug).
+
+| rule   | contract                                                          |
+|--------|-------------------------------------------------------------------|
+| TPU000 | waiver hygiene: ``# tpulint: disable=...`` must carry a reason    |
+| TPU001 | no host sync in a traced path (.item/.tolist/np.asarray/float())  |
+| TPU002 | no data-dependent shapes (nonzero/unique w/o size=, bool masking) |
+| TPU003 | no Python control flow on tracer values                           |
+| TPU004 | state contract (add_state reduction/dtype vs. use, mutation site) |
+| TPU005 | no use of a buffer after donating it to a jitted call             |
+| TPU006 | TPU dtype hygiene: no implicit/explicit float64                   |
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .callgraph import (
+    Reachability,
+    Taint,
+    _dotted_name,
+    _is_jnp_call,
+    compute_taint,
+    host_only_lines,
+)
+from .corpus import ClassInfo, Corpus, FunctionInfo
+
+ALL_RULES = ("TPU000", "TPU001", "TPU002", "TPU003", "TPU004", "TPU005", "TPU006")
+
+RULE_TITLES = {
+    "TPU000": "malformed waiver",
+    "TPU001": "host sync in traced path",
+    "TPU002": "recompile hazard (data-dependent shape)",
+    "TPU003": "Python control flow on tracer value",
+    "TPU004": "metric state-contract violation",
+    "TPU005": "use after donation",
+    "TPU006": "TPU dtype hygiene (float64)",
+}
+
+
+@dataclass
+class Violation:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    symbol: str  # enclosing "module:qualname" (or class for TPU004)
+    waived: bool = False
+    waive_reason: str = ""
+    baselined: bool = False
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.path, self.symbol, self.rule)
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message} [{self.symbol}]"
+
+
+# data-dependent-shape jnp functions and the kwarg that makes them static
+_DYN_SHAPE_FNS = {
+    "nonzero": "size",
+    "flatnonzero": "size",
+    "argwhere": "size",
+    "unique": "size",
+    "unique_values": "size",
+    "unique_counts": "size",
+    "unique_inverse": "size",
+    "unique_all": "size",
+}
+
+_HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_HOST_SAFE_JNP_QUERIES = {
+    "issubdtype", "isdtype", "result_type", "can_cast", "promote_types", "iterable",
+}
+_NUMPY_SYNC_FNS = {"asarray", "array", "ascontiguousarray", "copy"}
+_SCALAR_CASTS = {"float", "int", "bool", "complex"}
+_FLOAT64_ATTRS = {"float64", "double"}
+
+
+def _alias_targets(mod_imports: Dict[str, str], node: ast.expr) -> str:
+    """Fully-resolved dotted name of an attribute/name expr ('' if opaque)."""
+    dotted = _dotted_name(node)
+    if not dotted:
+        return ""
+    head = dotted.split(".")[0]
+    target = mod_imports.get(head, head)
+    return target + dotted[len(head):]
+
+
+class _FunctionContext:
+    """Shared per-function analysis state for the traced-path rules."""
+
+    def __init__(self, fn: FunctionInfo, corpus: Corpus) -> None:
+        self.fn = fn
+        self.corpus = corpus
+        self.imports = fn.module.imports
+        self.host_lines = host_only_lines(fn.node)
+        self.taint: Taint = compute_taint(fn, self.imports)
+
+    def traced(self, node: ast.AST) -> bool:
+        return getattr(node, "lineno", 0) not in self.host_lines
+
+
+def check_traced_rules(fn: FunctionInfo, corpus: Corpus, roots: Set[str]) -> List[Violation]:
+    """TPU001/TPU002/TPU003/TPU006 over one jit-reachable function."""
+    ctx = _FunctionContext(fn, corpus)
+    out: List[Violation] = []
+    root_note = "" if fn.qualname in roots else f" (reachable from {sorted(roots)[0]})"
+
+    def emit(rule: str, node: ast.AST, msg: str) -> None:
+        out.append(
+            Violation(rule, fn.path, getattr(node, "lineno", fn.node.lineno),
+                      getattr(node, "col_offset", 0), msg + root_note, fn.qualname)
+        )
+
+    for node in ast.walk(fn.node):
+        if not ctx.traced(node):
+            continue
+
+        # ---- TPU001: host sync --------------------------------------
+        if isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Attribute) and func.attr in _HOST_SYNC_METHODS:
+                emit("TPU001", node, f"`.{func.attr}()` forces a device→host sync in a traced path")
+            dotted = _alias_targets(ctx.imports, func) if isinstance(func, (ast.Attribute, ast.Name)) else ""
+            if dotted == "jax.device_get":
+                emit("TPU001", node, "`jax.device_get` in a traced path blocks on device→host transfer")
+            if dotted.startswith("numpy.") and dotted.split(".")[-1] in _NUMPY_SYNC_FNS:
+                if any(ctx.taint.is_array_expr(a) for a in node.args):
+                    emit("TPU001", node, f"`{_dotted_name(func)}(...)` materializes a traced array on host")
+            if (
+                isinstance(func, ast.Name)
+                and func.id in _SCALAR_CASTS
+                and func.id not in ctx.imports
+                and len(node.args) == 1
+                and ctx.taint.is_array_expr(node.args[0])
+            ):
+                emit("TPU001", node, f"`{func.id}()` on an array value concretizes (host sync) in a traced path")
+
+            # ---- TPU002: data-dependent output shapes ----------------
+            if isinstance(func, ast.Attribute):
+                target = _alias_targets(ctx.imports, func)
+                if target.startswith(("jax.numpy.", "numpy.")) and func.attr in _DYN_SHAPE_FNS:
+                    kw = _DYN_SHAPE_FNS[func.attr]
+                    if not any(k.arg == kw for k in node.keywords):
+                        emit(
+                            "TPU002", node,
+                            f"`{_dotted_name(func)}` without `{kw}=` has a data-dependent output shape"
+                            " (retrace/ConcretizationError under jit)",
+                        )
+                if target == "jax.numpy.where" and len(node.args) == 1 and not node.keywords:
+                    emit("TPU002", node, "single-argument `jnp.where` has a data-dependent output shape")
+
+            # ---- TPU006: float64 creation ----------------------------
+            for kwarg in node.keywords:
+                if kwarg.arg == "dtype":
+                    v = kwarg.value
+                    vd = _alias_targets(ctx.imports, v) if isinstance(v, (ast.Attribute, ast.Name)) else ""
+                    if vd.split(".")[-1] in _FLOAT64_ATTRS or (
+                        isinstance(v, ast.Constant) and v.value in ("float64", "double")
+                    ):
+                        emit("TPU006", node, "explicit float64 dtype: TPUs emulate f64 in software")
+                    elif isinstance(v, ast.Name) and v.id == "float" and "float" not in ctx.imports:
+                        emit("TPU006", node, "`dtype=float` resolves to float64 under x64; use jnp.float32")
+            if isinstance(func, ast.Attribute) and func.attr == "astype" and node.args:
+                a = node.args[0]
+                ad = _alias_targets(ctx.imports, a) if isinstance(a, (ast.Attribute, ast.Name)) else ""
+                if ad.split(".")[-1] in _FLOAT64_ATTRS or (isinstance(a, ast.Name) and a.id == "float"):
+                    emit("TPU006", node, "`.astype(float64)` upcast in a traced path")
+
+        # ---- TPU002: boolean-mask indexing --------------------------
+        if isinstance(node, ast.Subscript) and ctx.taint.is_array_expr(node.value):
+            idx = node.slice
+            if ctx.taint.is_boolmask_expr(idx):
+                emit(
+                    "TPU002", node,
+                    "boolean-mask indexing produces a data-dependent shape; use jnp.where/weighting",
+                )
+
+        # ---- TPU003: Python control flow on tracers -----------------
+        if isinstance(node, (ast.If, ast.While)):
+            if _test_depends_on_array(node.test, ctx):
+                kw = "if" if isinstance(node, ast.If) else "while"
+                emit("TPU003", node, f"`{kw}` on an array value concretizes the tracer (host sync + trace break)")
+        if isinstance(node, ast.Assert) and _test_depends_on_array(node.test, ctx):
+            emit("TPU003", node, "`assert` on an array value concretizes the tracer")
+
+    return out
+
+
+def _test_depends_on_array(test: ast.expr, ctx: _FunctionContext) -> bool:
+    """Condition whose truth value would concretize a traced array."""
+    if isinstance(test, ast.Name):
+        return test.id in ctx.taint.arrays
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _test_depends_on_array(test.operand, ctx)
+    if isinstance(test, ast.BoolOp):
+        return any(_test_depends_on_array(v, ctx) for v in test.values)
+    if isinstance(test, ast.Compare):
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+            return False
+        sides = [test.left] + list(test.comparators)
+        return any(ctx.taint.is_array_expr(s) for s in sides)
+    if isinstance(test, ast.Call):
+        # jnp.any(x) / jnp.all(x) / x.any() style reductions used as truth;
+        # dtype/shape metaprogramming queries are host-side and exempt
+        if _is_jnp_call(test, ctx.imports):
+            name = (_dotted_name(test.func) or "").split(".")[-1]
+            return name not in _HOST_SAFE_JNP_QUERIES
+        f = test.func
+        if isinstance(f, ast.Attribute) and f.attr in ("any", "all") and ctx.taint.is_array_expr(f.value):
+            return True
+    if isinstance(test, ast.Attribute) or isinstance(test, ast.Subscript):
+        return ctx.taint.is_array_expr(test)
+    return False
+
+
+# --- TPU004: metric state contract -----------------------------------------
+
+_STATE_MUTATION_METHODS = {"__init__", "update", "reset"}
+_INT_DTYPE_TOKENS = {"int8", "int16", "int32", "int64", "uint8", "uint16", "uint32", "uint64", "bool_", "bool"}
+
+
+def check_state_contract(cinfo: ClassInfo, corpus: Corpus) -> List[Violation]:
+    out: List[Violation] = []
+    path = cinfo.module.path
+
+    # collect add_state registrations declared by THIS class (not bases —
+    # bases are audited at their own definition site)
+    states: Dict[str, Tuple[ast.Call, Optional[str]]] = {}
+    for m in cinfo.methods.values():
+        for node in ast.walk(m.node):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "add_state"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"
+            ):
+                continue
+            if not node.args or not isinstance(node.args[0], ast.Constant) or not isinstance(node.args[0].value, str):
+                continue
+            name = node.args[0].value
+            fx = _reduce_fx_of(node)
+            states[name] = (node, fx)
+
+            default = node.args[1] if len(node.args) > 1 else _kwarg(node, "default")
+            if isinstance(default, ast.List):
+                if fx not in (None, "cat"):
+                    out.append(Violation(
+                        "TPU004", path, node.lineno, node.col_offset,
+                        f"list state `{name}` must use dist_reduce_fx='cat' (or None), got {fx!r}",
+                        cinfo.qualname,
+                    ))
+            elif fx == "mean" and default is not None and _default_is_integer(default, cinfo.module.imports):
+                out.append(Violation(
+                    "TPU004", path, node.lineno, node.col_offset,
+                    f"MEAN-reduced state `{name}` has an integer default: the running-mean merge "
+                    "produces fractional values that an int buffer silently truncates",
+                    cinfo.qualname,
+                ))
+
+    if not states:
+        return out
+
+    # state writes outside __init__/update/reset (or helpers they call) break
+    # the pure-update model: compute() runs OUTSIDE the traced update, so
+    # mutations there are invisible to the cached executable and desync
+    # grouped/donated state
+    # helpers may be driven by a subclass's update() (abstract-engine pattern:
+    # the base registers states + mutates in _update_state, concrete classes
+    # own update) — union the allowed sites over every corpus descendant
+    allowed = _mutation_sites(cinfo, corpus)
+    for other in corpus.classes.values():
+        if other is not cinfo and any(c is cinfo for c in corpus.class_mro(other)):
+            allowed |= _mutation_sites(other, corpus)
+    for mname, m in cinfo.methods.items():
+        if mname in allowed:
+            continue
+        for node in ast.walk(m.node):
+            targets: List[ast.expr] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for t in targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                    and t.attr in states
+                ):
+                    out.append(Violation(
+                        "TPU004", path, node.lineno, node.col_offset,
+                        f"state `{t.attr}` mutated in `{mname}` — states may only change in "
+                        "update()/reset() (and registration in __init__)",
+                        f"{cinfo.qualname}.{mname}",
+                    ))
+    return out
+
+
+def _mutation_sites(cinfo: ClassInfo, corpus: Corpus) -> Set[str]:
+    """Method names where state writes are legal: update/reset/__init__ plus
+    any helper they (transitively) call through ``self.``."""
+    allowed = set(_STATE_MUTATION_METHODS)
+    queue = [m for m in allowed if corpus.lookup_method(cinfo, m) is not None]
+    while queue:
+        m = corpus.lookup_method(cinfo, queue.pop())
+        if m is None:
+            continue
+        for node in ast.walk(m.node):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"
+                and node.func.attr not in allowed
+                and corpus.lookup_method(cinfo, node.func.attr) is not None
+            ):
+                allowed.add(node.func.attr)
+                queue.append(node.func.attr)
+    return allowed
+
+
+def _kwarg(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _reduce_fx_of(call: ast.Call) -> Optional[str]:
+    node = _kwarg(call, "dist_reduce_fx")
+    if node is None and len(call.args) > 2:
+        node = call.args[2]
+    if isinstance(node, ast.Constant):
+        return node.value if isinstance(node.value, str) else None
+    return None
+
+
+def _default_is_integer(default: ast.expr, imports: Dict[str, str]) -> bool:
+    if isinstance(default, ast.Call):
+        dt = _kwarg(default, "dtype")
+        if dt is not None:
+            dotted = _dotted_name(dt) or ""
+            return dotted.split(".")[-1] in _INT_DTYPE_TOKENS
+        if default.args and isinstance(default.args[0], ast.Constant):
+            return isinstance(default.args[0].value, (int, bool)) and not isinstance(default.args[0].value, float)
+    if isinstance(default, ast.Constant):
+        return isinstance(default.value, (int, bool)) and not isinstance(default.value, float)
+    return False
+
+
+# --- TPU005: use-after-donation --------------------------------------------
+
+
+def check_use_after_donation(fn: FunctionInfo) -> List[Violation]:
+    """Flag reads of a variable after it was passed to a donating jit call.
+
+    Donated buffers are deallocated by XLA on dispatch; a later host read
+    raises ``RuntimeError: Array has been deleted`` only at runtime — and only
+    on backends that honor donation, so CPU tests never catch it.
+    """
+    out: List[Violation] = []
+    donating: Set[str] = set()  # names bound to donating jitted callables
+
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Assign) and _is_donating_jit(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    donating.add(t.id)
+
+    if not donating and not any(
+        isinstance(n, ast.Call) and _is_donating_jit(n.func) for n in ast.walk(fn.node)
+    ):
+        return out
+
+    donated: Dict[str, int] = {}  # var name -> line of the donating call
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Call):
+            is_donating_call = (
+                isinstance(node.func, ast.Name) and node.func.id in donating
+            ) or _is_donating_jit(node.func)
+            if is_donating_call and node.args and isinstance(node.args[0], ast.Name):
+                donated.setdefault(node.args[0].id, node.lineno)
+
+    if not donated:
+        return out
+    # a rebind at-or-after the donating call (commonly the donating call's own
+    # assignment, `state = step(state, ...)`) gives the name a fresh buffer
+    rebound: Dict[str, List[int]] = {}
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            rebound.setdefault(node.id, []).append(node.lineno)
+    for node in ast.walk(fn.node):
+        if (
+            isinstance(node, ast.Name)
+            and isinstance(node.ctx, ast.Load)
+            and node.id in donated
+            and node.lineno > donated[node.id]
+            and not any(donated[node.id] <= r < node.lineno for r in rebound.get(node.id, []))
+        ):
+            out.append(Violation(
+                "TPU005", fn.path, node.lineno, node.col_offset,
+                f"`{node.id}` was donated to a jitted call on line {donated[node.id]} and is "
+                "read afterwards — the buffer is deleted on backends that honor donation",
+                fn.qualname,
+            ))
+    return out
+
+
+def _is_donating_jit(expr: ast.expr) -> bool:
+    """``jax.jit(..., donate_argnums=...)`` / ``*._get_jitted(..., donate_state=True)``
+    / ``_global_jit(..., donate_state=True)``."""
+    if not isinstance(expr, ast.Call):
+        return False
+    dotted = _dotted_name(expr.func) or ""
+    tail = dotted.split(".")[-1]
+    if tail == "jit":
+        return any(k.arg == "donate_argnums" and not _is_empty_tuple(k.value) for k in expr.keywords)
+    if tail in ("_get_jitted", "_global_jit"):
+        for k in expr.keywords:
+            if k.arg == "donate_state" and isinstance(k.value, ast.Constant) and k.value.value is True:
+                return True
+        pos = 2 if tail == "_get_jitted" else 2
+        if len(expr.args) > pos and isinstance(expr.args[pos], ast.Constant) and expr.args[pos].value is True:
+            return True
+    return False
+
+
+def _is_empty_tuple(node: ast.expr) -> bool:
+    return isinstance(node, ast.Tuple) and not node.elts

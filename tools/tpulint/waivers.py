@@ -1,0 +1,82 @@
+"""Inline waiver parsing: ``# tpulint: disable=TPU002(reason text)``.
+
+A waiver suppresses matching violations on its own line; placed on a ``def``
+line (or the line directly above it) it covers the whole function. Reasons
+are mandatory — a bare ``disable=TPU002`` is itself reported as TPU000 so
+waivers stay auditable.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Set, Tuple
+
+from .corpus import ModuleInfo
+from .rules import Violation
+
+_WAIVER_LINE_RE = re.compile(r"#\s*tpulint:\s*disable=(.*)$")
+_WAIVER_ITEM_RE = re.compile(r"(TPU\d{3})\s*(?:\(([^)]*)\))?")
+
+
+@dataclass
+class Waivers:
+    # line -> {rule -> reason}
+    by_line: Dict[int, Dict[str, str]] = field(default_factory=dict)
+    # (start_line, end_line) function spans carrying waivers
+    by_span: List[Tuple[int, int, Dict[str, str]]] = field(default_factory=list)
+    malformed: List[Violation] = field(default_factory=list)
+    used: Set[Tuple[int, str]] = field(default_factory=set)
+
+    def lookup(self, line: int, rule: str) -> Tuple[bool, str]:
+        rules = self.by_line.get(line)
+        if rules and rule in rules:
+            self.used.add((line, rule))
+            return True, rules[rule]
+        for start, end, span_rules in self.by_span:
+            if start <= line <= end and rule in span_rules:
+                self.used.add((start, rule))
+                return True, span_rules[rule]
+        return False, ""
+
+
+def collect_waivers(mod: ModuleInfo) -> Waivers:
+    w = Waivers()
+    for idx, text in enumerate(mod.source_lines, start=1):
+        m = _WAIVER_LINE_RE.search(text)
+        if not m:
+            continue
+        rules: Dict[str, str] = {}
+        for rule, reason in _WAIVER_ITEM_RE.findall(m.group(1)):
+            reason = (reason or "").strip()
+            if not reason:
+                w.malformed.append(Violation(
+                    "TPU000", mod.path, idx, text.index("#"),
+                    f"waiver for {rule} is missing a reason: use `# tpulint: disable={rule}(why)`",
+                    mod.name,
+                ))
+                continue
+            rules[rule] = reason
+        if rules:
+            w.by_line[idx] = rules
+
+    # promote def-line (or line-above-def) waivers to whole-function spans
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            end = getattr(node, "end_lineno", node.lineno)
+            for line in (node.lineno, node.lineno - 1):
+                rules = w.by_line.get(line)
+                if rules:
+                    w.by_span.append((node.lineno, end, rules))
+    return w
+
+
+def apply_waivers(violations: List[Violation], waivers_by_path: Dict[str, Waivers]) -> None:
+    for v in violations:
+        w = waivers_by_path.get(v.path)
+        if w is None:
+            continue
+        waived, reason = w.lookup(v.line, v.rule)
+        if waived:
+            v.waived = True
+            v.waive_reason = reason

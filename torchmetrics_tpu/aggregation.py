@@ -173,7 +173,7 @@ class CatMetric(BaseAggregator):
     def update(self, value: Array) -> None:
         value = jnp.atleast_1d(self._impute(jnp.asarray(value, dtype=jnp.float32)))
         if self.nan_strategy in ("ignore", "warn"):
-            value = value[~jnp.isnan(value)]
+            value = value[~jnp.isnan(value)]  # tpulint: disable=TPU002(eager-only: __init__ sets _use_jit=False whenever this strategy drops values)
         if value.size:
             self.value.append(value)
 

@@ -1,0 +1,111 @@
+"""Runtime tracer-hygiene guards — the dynamic complement to ``tools/tpulint``.
+
+The static analyzer proves the *code* can't host-sync or retrace; this module
+proves the *process* didn't. ``strict_mode()`` arms ``jax.transfer_guard`` so
+any implicit device↔host transfer raises at the offending line, and registers
+a compile observer on the process-global executable cache
+(``metric._COMPILE_OBSERVERS``) so an unexpected retrace — a new input
+shape/dtype hitting an already-warm executable — fails fast instead of
+silently recompiling every step.
+
+Usage::
+
+    from torchmetrics_tpu.debug import strict_mode
+
+    metric.update(p, t)           # warm-up: compiles are expected here
+    with strict_mode():           # steady state: no transfers, no retraces
+        metric.update(p, t)
+        metric.update(p, t)
+
+Used by ``tests/test_strict_mode.py`` and ``bench.py --smoke``.
+"""
+from __future__ import annotations
+
+import contextlib
+from dataclasses import dataclass
+from typing import Any, Iterator, Optional
+
+import jax
+
+from . import metric as _metric
+
+
+class StrictModeViolation(RuntimeError):
+    """A dispatch-contract violation observed at runtime under strict_mode()."""
+
+
+@dataclass
+class StrictStats:
+    """Counters accumulated while a ``strict_mode()`` context is active."""
+
+    compiles: int = 0
+    retraces: int = 0
+    new_executables: int = 0
+
+
+def _looks_like_transfer_guard_error(exc: BaseException) -> bool:
+    msg = str(exc).lower()
+    return "transfer" in msg and ("disallow" in msg or "guard" in msg)
+
+
+@contextlib.contextmanager
+def strict_mode(
+    *,
+    transfer_guard: Optional[str] = "disallow",
+    max_retraces: int = 0,
+    max_new_executables: Optional[int] = None,
+) -> Iterator[StrictStats]:
+    """Context that raises :class:`StrictModeViolation` on contract breaks.
+
+    Args:
+        transfer_guard: value for ``jax.transfer_guard`` (``"disallow"``,
+            ``"log"``, ``"allow"``, ...) or ``None`` to leave transfers
+            unguarded. Compilation itself transfers constants host→device, so
+            pass ``"allow"`` (or warm up first) when compiles are expected
+            inside the context.
+        max_retraces: how many retraces (recompiles of an already-compiled
+            executable under a new input signature) to tolerate. Default 0:
+            steady-state code must not retrace.
+        max_new_executables: budget for first-time compiles inside the
+            context, or ``None`` for unlimited. Set to 0 to assert fully-warm
+            steady state.
+    """
+    stats = StrictStats()
+
+    def _observe(key: Any, new_compiles: int, retraces: int) -> None:
+        stats.compiles += new_compiles
+        stats.retraces += retraces
+        stats.new_executables += new_compiles - retraces
+        if stats.retraces > max_retraces:
+            raise StrictModeViolation(
+                f"unexpected retrace under strict_mode (executable key={key!r}): "
+                f"{stats.retraces} retrace(s) > budget {max_retraces}. Input "
+                "shapes/dtypes are churning against a warm executable — pad or "
+                "bucket inputs, or raise max_retraces if this churn is intended."
+            )
+        if max_new_executables is not None and stats.new_executables > max_new_executables:
+            raise StrictModeViolation(
+                f"unexpected compile under strict_mode (executable key={key!r}): "
+                f"{stats.new_executables} new executable(s) > budget "
+                f"{max_new_executables}. Warm the metric up before entering "
+                "strict_mode, or raise max_new_executables."
+            )
+
+    _metric._COMPILE_OBSERVERS.append(_observe)
+    guard = jax.transfer_guard(transfer_guard) if transfer_guard is not None else contextlib.nullcontext()
+    try:
+        with guard:
+            yield stats
+    except StrictModeViolation:
+        raise
+    except Exception as exc:
+        if _looks_like_transfer_guard_error(exc):
+            raise StrictModeViolation(
+                f"implicit device<->host transfer under strict_mode: {exc}"
+            ) from exc
+        raise
+    finally:
+        _metric._COMPILE_OBSERVERS.remove(_observe)
+
+
+__all__ = ["StrictModeViolation", "StrictStats", "strict_mode"]

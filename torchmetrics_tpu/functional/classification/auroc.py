@@ -39,11 +39,15 @@ def _binary_auroc_compute(
     fpr, tpr, _ = _binary_roc_compute(state, thresholds, pos_label)
     if max_fpr is None or max_fpr == 1.0:
         return _trapz(tpr, fpr)
-    # partial AUC up to max_fpr with interpolation + McClish standardization
-    stop = jnp.searchsorted(fpr, max_fpr, side="right")
-    x_interp = jnp.interp(jnp.asarray(max_fpr), fpr, tpr)
-    fpr_part = jnp.concatenate([fpr[: int(stop)], jnp.asarray([max_fpr])])
-    tpr_part = jnp.concatenate([tpr[: int(stop)], jnp.atleast_1d(x_interp)])
+    # partial AUC up to max_fpr with interpolation + McClish standardization.
+    # Clamping x at max_fpr and substituting the interpolated y beyond it is
+    # the static-shape equivalent of slicing at searchsorted(fpr, max_fpr):
+    # segments past the cut collapse to zero width, and the crossing segment
+    # ends exactly at (max_fpr, interp(max_fpr)).
+    x0 = jnp.asarray(max_fpr, dtype=fpr.dtype)
+    y0 = jnp.interp(x0, fpr, tpr)
+    fpr_part = jnp.minimum(fpr, x0)
+    tpr_part = jnp.where(fpr <= x0, tpr, y0)
     partial_auc = _trapz(tpr_part, fpr_part)
     min_area = 0.5 * max_fpr**2
     max_area = max_fpr

@@ -16,6 +16,7 @@ from typing import List, Optional, Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 
+from ...utils.checks import is_tracing
 from ...utils.compute import _safe_divide, normalize_logits_if_needed
 
 Array = jax.Array
@@ -52,13 +53,21 @@ def _binary_clf_curve(
     Parity: reference ``precision_recall_curve.py:28`` (sklearn-equivalent).
     Eager-only (data-dependent output length).
     """
+    if is_tracing(preds) or is_tracing(target):
+        raise RuntimeError(
+            "_binary_clf_curve is host-only: the exact (thresholds=None) curve has a "
+            "data-dependent length. Pass bounded `thresholds=` to stay on the jit path."
+        )
     w = 1.0 if sample_weights is None else jnp.asarray(sample_weights, dtype=jnp.float32)
     desc = jnp.argsort(preds)[::-1]
     preds = preds[desc]
     target = target[desc]
     weight = w[desc] if sample_weights is not None else jnp.ones_like(preds)
 
-    distinct = jnp.nonzero(jnp.diff(preds))[0]
+    # the curve's output length IS the number of distinct scores; a bounded
+    # `size=` would pad/truncate the curve, so this stays host-only behind the
+    # is_tracing guard above.
+    distinct = jnp.nonzero(jnp.diff(preds))[0]  # tpulint: disable=TPU002(host-only exact path, guarded by is_tracing raise above)
     threshold_idxs = jnp.concatenate([distinct, jnp.asarray([target.shape[0] - 1])])
 
     tps = jnp.cumsum(target * weight)[threshold_idxs]

@@ -56,6 +56,7 @@ def _encode(colors: np.ndarray, offset: np.int64) -> np.ndarray:
     return colors[:, 0].astype(np.int64) * offset + colors[:, 1].astype(np.int64)
 
 
+# tpulint: disable=TPU001(host-orchestrated numpy instance matching; eager by design),TPU002(per-sample segment counts are inherently data-dependent; eager by design)
 def _panoptic_update_sample(
     pred: np.ndarray,
     target: np.ndarray,

@@ -30,7 +30,9 @@ def _as_labels(x: Array) -> Array:
 
 
 def _num_classes(*arrays: Array) -> int:
-    return int(max(int(jnp.max(a)) for a in arrays)) + 1
+    # nanmax: with nan_strategy="drop" the arrays keep NaN markers for rows
+    # that are excluded downstream by `_confmat_update`
+    return int(max(int(jnp.nanmax(a)) for a in arrays)) + 1
 
 
 def _nominal_confmat(
@@ -38,8 +40,6 @@ def _nominal_confmat(
 ) -> np.ndarray:
     preds, target = _as_labels(preds), _as_labels(target)
     preds, target = _handle_nan_in_data(preds, target, nan_strategy, nan_replace_value)
-    preds = preds.astype(jnp.int32)
-    target = target.astype(jnp.int32)
     nc = _num_classes(preds, target)
     return np.asarray(_confmat_update(preds, target, nc))
 

@@ -40,15 +40,27 @@ def _handle_nan_in_data(
     t = target.astype(jnp.float32)
     if nan_strategy == "replace":
         return jnp.nan_to_num(p, nan=nan_replace_value), jnp.nan_to_num(t, nan=nan_replace_value)
-    keep = ~(jnp.isnan(p) | jnp.isnan(t))
-    return p[keep], t[keep]
+    # "drop": keep the NaN markers in both arrays (static shape) — the rows are
+    # excluded downstream by `_confmat_update`, which routes any observation
+    # containing NaN to an out-of-range bincount bucket that XLA drops.
+    mask = jnp.isnan(p) | jnp.isnan(t)
+    return jnp.where(mask, jnp.nan, p), jnp.where(mask, jnp.nan, t)
 
 
 def _confmat_update(preds: Array, target: Array, num_classes: int) -> Array:
-    """(num_classes, num_classes) co-occurrence counts via one flat bincount."""
-    p = preds.reshape(-1).astype(jnp.int32)
-    t = target.reshape(-1).astype(jnp.int32)
-    joint = p * num_classes + t
+    """(num_classes, num_classes) co-occurrence counts via one flat bincount.
+
+    Observations containing NaN (the ``nan_strategy="drop"`` marker from
+    ``_handle_nan_in_data``) are routed to index ``num_classes**2``, which
+    ``jnp.bincount(..., length=num_classes**2)`` drops — a static-shape
+    equivalent of row dropping that works under jit.
+    """
+    p = preds.reshape(-1)
+    t = target.reshape(-1)
+    joint = p.astype(jnp.int32) * num_classes + t.astype(jnp.int32)
+    if jnp.issubdtype(p.dtype, jnp.floating) or jnp.issubdtype(t.dtype, jnp.floating):
+        invalid = jnp.isnan(p.astype(jnp.float32)) | jnp.isnan(t.astype(jnp.float32))
+        joint = jnp.where(invalid, num_classes * num_classes, joint)
     return jnp.bincount(joint, length=num_classes * num_classes).reshape(num_classes, num_classes).astype(jnp.float32)
 
 

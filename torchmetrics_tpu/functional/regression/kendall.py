@@ -35,12 +35,11 @@ def _kendall_tau_1d(preds: Array, target: Array, variant: str = "b") -> Array:
     if variant == "b":
         denom = jnp.sqrt((n_pairs - (ties_x + ties_both)) * (n_pairs - (ties_y + ties_both)))
         return c_minus_d / denom
-    # tau-c (Stuart's)
-    # m = min(#distinct x, #distinct y); eager-only (data dependent) → approximate with n
-    m = jnp.minimum(
-        jnp.asarray(len(jnp.unique(preds)) if not isinstance(preds, jax.core.Tracer) else n),
-        jnp.asarray(len(jnp.unique(target)) if not isinstance(target, jax.core.Tracer) else n),
-    ).astype(jnp.float32)
+    # tau-c (Stuart's): m = min(#distinct x, #distinct y). Distinct counts via
+    # sort + diff keep the shape static, so this traces cleanly under jit.
+    distinct_x = jnp.sum(jnp.diff(jnp.sort(preds)) != 0) + 1
+    distinct_y = jnp.sum(jnp.diff(jnp.sort(target)) != 0) + 1
+    m = jnp.minimum(distinct_x, distinct_y).astype(jnp.float32)
     return 2 * c_minus_d / (n**2 * (m - 1) / m)
 
 
@@ -64,18 +63,17 @@ def kendall_rank_corrcoef(
         tau = jnp.stack([_kendall_tau_1d(preds[:, i], target[:, i], variant) for i in range(preds.shape[1])])
     if not t_test:
         return tau
-    # normal-approximation p-value (reference `_calculate_p_value`)
-    import scipy.stats as st
+    # normal-approximation p-value (reference `_calculate_p_value`), kept on
+    # device via jax.scipy so the t_test path stays traceable
+    from jax.scipy.stats import norm
 
     n = preds.shape[0]
     var = 2 * (2 * n + 5) / (9 * n * (n - 1))
-    z = jnp.asarray(tau) / jnp.sqrt(var)
-    import numpy as np
-
+    z = tau / jnp.sqrt(jnp.asarray(var, dtype=jnp.float32))
     if alternative == "two-sided":
-        p = 2 * st.norm.sf(abs(np.asarray(z)))
+        p = 2 * norm.sf(jnp.abs(z))
     elif alternative == "greater":
-        p = st.norm.sf(np.asarray(z))
+        p = norm.sf(z)
     else:
-        p = st.norm.cdf(np.asarray(z))
-    return tau, jnp.asarray(p)
+        p = norm.cdf(z)
+    return tau, jnp.clip(p, 0.0, 1.0)

@@ -110,8 +110,11 @@ def _jit_safe_inputs(*trees: Any) -> bool:
 # ---------------------------------------------------------------------------
 
 _EXECUTABLE_CACHE: Dict[Any, Callable] = {}
-_CACHE_STATS = {"hits": 0, "misses": 0}
+_CACHE_STATS = {"hits": 0, "misses": 0, "compiles": 0, "retraces": 0}
 _DISPATCH_COUNT = [0]
+# observers called as cb(key, new_compiles, retraces) whenever a dispatch
+# triggers XLA compilation; used by debug.strict_mode() to fail fast
+_COMPILE_OBSERVERS: List[Callable[[Any, int, int], None]] = []
 _INSTANCE_KEY_COUNTER = itertools.count()
 
 _MAX_KEY_ARRAY_BYTES = 4096
@@ -158,7 +161,9 @@ def _freeze_config_value(v: Any) -> Any:
     if isinstance(v, np.dtype):
         return ("dtype", str(v))
     if isinstance(v, np.generic):
-        return ("npscalar", str(v.dtype), v.item())
+        # tobytes() keys the exact bit pattern without a host scalar
+        # materialization (and distinguishes NaN payloads, unlike .item())
+        return ("npscalar", str(v.dtype), v.tobytes())
     if isinstance(v, (jax.Array, np.ndarray)):
         arr = np.asarray(v)
         if arr.nbytes > _MAX_KEY_ARRAY_BYTES:
@@ -180,6 +185,14 @@ def _freeze_config_value(v: Any) -> Any:
     raise _Unkeyable(f"unkeyable config attribute of type {type(v).__name__}")
 
 
+def _jit_compile_count(jitted: Callable) -> int:
+    """Number of compiled specializations held by a ``jax.jit`` wrapper."""
+    try:
+        return jitted._cache_size()
+    except Exception:  # pragma: no cover - jax without the private API
+        return 0
+
+
 def _global_jit(key: Any, fn: Callable, donate_state: bool = False) -> Callable:
     """jit ``fn`` under a process-global key; count dispatches per call."""
     key = (key, donate_state)
@@ -187,10 +200,24 @@ def _global_jit(key: Any, fn: Callable, donate_state: bool = False) -> Callable:
     if entry is None:
         _CACHE_STATS["misses"] += 1
         jitted = jax.jit(fn, donate_argnums=(0,) if donate_state else ())
+        seen_compiles = [0]
 
         def entry(*args: Any, **kwargs: Any) -> Any:
             _DISPATCH_COUNT[0] += 1
-            return jitted(*args, **kwargs)
+            before = _jit_compile_count(jitted)
+            out = jitted(*args, **kwargs)
+            new = _jit_compile_count(jitted) - before
+            if new > 0:
+                # the first compile of an entry is the expected cost of a
+                # cache miss; every further compile is a retrace (new input
+                # shape/dtype against an already-warm executable)
+                retraces = new if seen_compiles[0] else new - 1
+                seen_compiles[0] += new
+                _CACHE_STATS["compiles"] += new
+                _CACHE_STATS["retraces"] += retraces
+                for cb in list(_COMPILE_OBSERVERS):
+                    cb(key, new, retraces)
+            return out
 
         entry._jitted = jitted  # type: ignore[attr-defined]
         _EXECUTABLE_CACHE[key] = entry
@@ -204,15 +231,19 @@ def clear_executable_cache() -> None:
     _EXECUTABLE_CACHE.clear()
     _CACHE_STATS["hits"] = 0
     _CACHE_STATS["misses"] = 0
+    _CACHE_STATS["compiles"] = 0
+    _CACHE_STATS["retraces"] = 0
     _DISPATCH_COUNT[0] = 0
 
 
 def executable_cache_stats() -> Dict[str, int]:
-    """Cache size, hit/miss counts, and jitted dispatch count."""
+    """Cache size, hit/miss counts, compile/retrace counts, and dispatches."""
     return {
         "size": len(_EXECUTABLE_CACHE),
         "hits": _CACHE_STATS["hits"],
         "misses": _CACHE_STATS["misses"],
+        "compiles": _CACHE_STATS["compiles"],
+        "retraces": _CACHE_STATS["retraces"],
         "dispatches": _DISPATCH_COUNT[0],
     }
 

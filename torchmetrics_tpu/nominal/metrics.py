@@ -51,8 +51,9 @@ class _ConfmatNominalMetric(Metric):
         self.nan_strategy = nan_strategy
         self.nan_replace_value = nan_replace_value
         self._compute_jittable = False
-        if nan_strategy == "drop":  # row-dropping is data-dependent-shape
-            self._use_jit = False
+        # nan_strategy="drop" is traceable: NaN rows are routed out of range by
+        # `_confmat_update` instead of being dropped by shape, so update stays
+        # jit-capable for every strategy.
         self.add_state("confmat", jnp.zeros((num_classes, num_classes)), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:

@@ -38,19 +38,25 @@ class MinMaxMetric(WrapperMetric):
         self.add_state("max_val", jnp.asarray(-jnp.inf), dist_reduce_fx="max")
 
     def update(self, *args: Any, **kwargs: Any) -> None:
+        # Fold the running min/max here rather than in compute(): state may
+        # only change inside update()/reset(), and compute() must stay a pure
+        # read so cached/synced results are consistent (tpulint TPU004).
         self._base_metric.update(*args, **kwargs)
-
-    def compute(self) -> Dict[str, Array]:
         val = self._base_metric.compute()
         if not self._is_suitable_val(val):
             raise RuntimeError(f"Returned value from base metric should be a float or scalar tensor, but got {val}")
         val = jnp.asarray(val)
         self.max_val = jnp.where(val > self.max_val, val, self.max_val)
         self.min_val = jnp.where(val < self.min_val, val, self.min_val)
-        return {"raw": val, "max": self.max_val, "min": self.min_val}
+
+    def compute(self) -> Dict[str, Array]:
+        val = self._base_metric.compute()
+        if not self._is_suitable_val(val):
+            raise RuntimeError(f"Returned value from base metric should be a float or scalar tensor, but got {val}")
+        return {"raw": jnp.asarray(val), "max": self.max_val, "min": self.min_val}
 
     def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Array]:
-        self._base_metric.update(*args, **kwargs)
+        self.update(*args, **kwargs)
         self._update_count += 1
         self._computed = None
         return self.compute()
