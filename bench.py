@@ -375,12 +375,19 @@ def bench_smoke() -> dict:
 
     # bucketed eager sync: each rank's fixed-shape (SUM, dtype) states ride
     # one concatenated FakeSync collective per bucket
+    wire_before = M.executable_cache_stats()
     ranks = [MulticlassAccuracy(num_classes=n_cls, average="micro", validate_args=False) for _ in range(2)]
     for r, m in enumerate(ranks):
         m.update(preds[r], target[r])
     group = [m.metric_state for m in ranks]
     for r, m in enumerate(ranks):
         m.sync(sync_backend=FakeSync(group, r))
+    wire_after = M.executable_cache_stats()
+    sync_collectives = wire_after["collectives_issued"] - wire_before["collectives_issued"]
+    sync_wire_bytes = (
+        wire_after["bytes_reduced"] + wire_after["bytes_gathered"]
+        - wire_before["bytes_reduced"] - wire_before["bytes_gathered"]
+    )
     synced = round(float(ranks[0].compute()), 6)
     per_rank = round(
         float(
@@ -425,6 +432,39 @@ def bench_smoke() -> dict:
         float(eager_vals[k]) == float(buf_vals[k]) for k in eager_vals
     )
 
+    # wire byte model (sync-strategy stack): trace the in-graph state sync of
+    # a CAT-heavy state under the default policy vs SyncPolicy(gather=
+    # "all_gather") and compare the modeled bytes-on-wire the counters record
+    # at trace time. The all_gather strategy replaces the 2(n-1)·S
+    # zeros+psum invariant gather with a (n-1)·S true gather, so a CAT/NONE-
+    # heavy collection must show >= 40% fewer modeled bytes (the MULTICHIP
+    # acceptance bar; here it gates on the model, no mesh needed).
+    from torchmetrics_tpu.parallel.reduction import Reduction
+    from torchmetrics_tpu.parallel.strategies import SyncPolicy
+    from torchmetrics_tpu.parallel.sync import reduce_state_in_graph
+
+    def _model_wire_bytes(policy):
+        state = {
+            "confmat": jnp.zeros((n_cls, n_cls), jnp.float32),
+            "seen": jnp.zeros((256,), jnp.float32),
+            "scores": jnp.zeros((512,), jnp.float32),
+        }
+        reds = {"confmat": Reduction.SUM, "seen": Reduction.CAT, "scores": Reduction.CAT}
+        before = M.executable_cache_stats()
+        jax.vmap(
+            lambda s: reduce_state_in_graph(s, reds, "dp", policy=policy), axis_name="dp"
+        )(jax.tree_util.tree_map(lambda x: jnp.stack([x] * 4), state))
+        after = M.executable_cache_stats()
+        return (
+            after["bytes_reduced"] + after["bytes_gathered"]
+            - before["bytes_reduced"] - before["bytes_gathered"]
+        )
+
+    default_bytes = _model_wire_bytes(SyncPolicy(gather="psum"))
+    ag_bytes = _model_wire_bytes(SyncPolicy(gather="all_gather"))
+    gather_reduction_pct = round(100.0 * (1 - ag_bytes / default_bytes), 1) if default_bytes else 0.0
+    wire_ok = sync_collectives >= 2 and sync_wire_bytes > 0 and gather_reduction_pct >= 40.0
+
     # static gate: the corpus must lint clean against the committed baseline
     repo_dir = os.path.dirname(os.path.abspath(__file__))
     try:
@@ -447,6 +487,7 @@ def bench_smoke() -> dict:
             and staged_dispatches == 2
             and pending == 2
             and buffered_matches_eager
+            and wire_ok
             and tpulint_ok
         ),
         "dispatches_per_update": dispatches,
@@ -459,6 +500,11 @@ def bench_smoke() -> dict:
         "values": values,
         "synced_accuracy": synced,
         "expected_synced_accuracy": per_rank,
+        "wire_ok": wire_ok,
+        "sync_collectives_issued": sync_collectives,
+        "sync_wire_bytes": sync_wire_bytes,
+        "gather_model_bytes": {"zeros_psum": default_bytes, "all_gather": ag_bytes},
+        "gather_reduction_pct": gather_reduction_pct,
         "buffered_staged_dispatches": staged_dispatches,
         "buffered_pending_before_compute": pending,
         "buffered_matches_eager": buffered_matches_eager,
@@ -1076,6 +1122,34 @@ def _run_child(name: str, timeout: int = 900, retries: int = 1) -> dict:
 _CURRENT_CHILD = None
 
 
+def _rep_stats(vals: list) -> dict:
+    """Variance treatment for one config's chronological rep values: the
+    FIRST rep is discarded as warmup when at least 3 completed (first-touch
+    costs — page cache, tunnel session, XLA autotuning — land on it even
+    with in-process warmups), the center is the median of the rest, and the
+    spread is IQR/median. ``noisy`` (IQR > 15%) is a fail-soft annotation:
+    the number still ships, flagged so round-over-round tooling discounts
+    it instead of reading contention as a regression."""
+    used = list(vals[1:]) if len(vals) >= 3 else list(vals)
+    used.sort()
+    med = used[len(used) // 2] if used else None
+    iqr_pct = None
+    if len(used) >= 4 and med:
+        # below 4 used reps an IQR degenerates to ~0 and would misreport a
+        # truncated run as stable
+        import statistics
+
+        q1, _, q3 = statistics.quantiles(used, n=4, method="inclusive")
+        iqr_pct = round(100 * (q3 - q1) / med, 2)
+    return {
+        "median": med,
+        "iqr_pct": iqr_pct,
+        "noisy": (iqr_pct > 15.0) if iqr_pct is not None else None,
+        "n_used": len(used),
+        "warmup_discarded": len(vals) >= 3,
+    }
+
+
 def _median_payload(c1_runs: list, extra: dict, budget_s: float, bench_t0: float) -> dict:
     """Assemble the full result object from whatever has completed so far.
 
@@ -1084,47 +1158,46 @@ def _median_payload(c1_runs: list, extra: dict, budget_s: float, bench_t0: float
     so the driver's timeout (rc 124) lost the whole round's numbers. The
     growing object is re-printed each time — the driver parses the tail, so
     a kill loses only the in-flight config."""
-    ok_runs = sorted((r for r in c1_runs if "value" in r), key=lambda r: r["value"])
-    if ok_runs:
-        c1 = ok_runs[len(ok_runs) // 2]
-        vals = [r["value"] for r in ok_runs]
+    ok_chrono = [r for r in c1_runs if "value" in r]
+    if ok_chrono:
+        stats = _rep_stats([r["value"] for r in ok_chrono])
+        pool = ok_chrono[1:] if stats["warmup_discarded"] else ok_chrono
+        pool = sorted(pool, key=lambda r: r["value"])
+        c1 = pool[len(pool) // 2]
+        vals = [r["value"] for r in pool]
         # a 1-rep "spread" of 0.0 would misreport a truncated run as stable
         spread = round(100 * (vals[-1] - vals[0]) / c1["value"], 2) if len(vals) >= 2 else None
-        if len(vals) >= 4:
-            # below 4 reps an IQR would degenerate to 0 and misreport a
-            # truncated run as stable
-            import statistics
-
-            q1, _, q3 = statistics.quantiles(vals, n=4, method="inclusive")
-            iqr_pct = round(100 * (q3 - q1) / c1["value"], 2)
-        else:
-            iqr_pct = None
+        iqr_pct = stats["iqr_pct"]
+        noisy = stats["noisy"]
     elif c1_runs:
         c1 = {"value": 0.0, "unit": "updates/s", "vs_baseline": 0.0, **c1_runs[0]}
-        spread = iqr_pct = None
+        spread = iqr_pct = noisy = None
     else:
         c1 = {"value": 0.0, "unit": "updates/s", "vs_baseline": 0.0, "error": "no headline rep completed"}
-        spread = iqr_pct = None
+        spread = iqr_pct = noisy = None
     extra = dict(extra)
     extra["methodology"] = {
-        "version": "v4-streaming-hard-budget",
+        "version": "v5-wire-variance",
         "budget_s": budget_s,
         "elapsed_s": round(time.perf_counter() - bench_t0, 1),
         "headline_runs": [r.get("value") for r in c1_runs],
         "headline_spread_pct": spread,
         "headline_iqr_pct": iqr_pct,
+        "headline_noisy": noisy,
         "r1_style_unsalted_value": c1.get("r1_style_unsalted_value"),
         "note": (
             "each config runs in a fresh subprocess; headline = median of up "
-            "to 7 reps (budget-bounded, see headline_runs for the count); "
-            "headline_iqr_pct = interquartile range / median. The budget is "
-            "HARD: configs that would not fit are recorded as skipped and the "
-            "partial object is re-printed after every completed config. "
-            "r1_style_unsalted_value re-times config1 with the pre-r2 constant "
-            "salt base, where the remote-TPU layer can serve memoized dispatches "
-            "across runs — the BENCH_r01 60.5k headline was inflated by exactly "
-            "this effect, so r02's salted 48.4k was a measurement fix, not a "
-            "regression."
+            "to 7 reps (budget-bounded, see headline_runs for the count), the "
+            "FIRST rep discarded as warmup when >= 3 completed; "
+            "headline_iqr_pct = interquartile range / median over the kept "
+            "reps, headline_noisy flags IQR > 15% (fail-soft annotation, the "
+            "number still ships). The budget is HARD: configs that would not "
+            "fit are recorded as skipped and the partial object is re-printed "
+            "after every completed config. r1_style_unsalted_value re-times "
+            "config1 with the pre-r2 constant salt base, where the remote-TPU "
+            "layer can serve memoized dispatches across runs — the BENCH_r01 "
+            "60.5k headline was inflated by exactly this effect, so r02's "
+            "salted 48.4k was a measurement fix, not a regression."
         ),
     }
     payload = {
@@ -1289,6 +1362,11 @@ def main() -> None:
             denom = max(abs(a), abs(b))
             result[f"rep2_{metric_key}"] = b
             result["spread_pct"] = round(100.0 * abs(a - b) / denom, 2) if denom else None
+            # fail-soft noise annotation (2-rep spread stands in for IQR
+            # where the budget only buys two reps per extra config)
+            result["noisy"] = (
+                (result["spread_pct"] > 15.0) if result["spread_pct"] is not None else None
+            )
         _emit()
 
     while len(c1_runs) < 7:

@@ -32,7 +32,8 @@ class Metric:
 """
 
 
-def _lint_fixture(tmp_path, kernel_src=None, metrics_src=None, root_kinds=("update", "kernel")):
+def _lint_fixture(tmp_path, kernel_src=None, metrics_src=None, sync_src=None,
+                  root_kinds=("update", "kernel")):
     (tmp_path / "torchmetrics_tpu").mkdir()
     (tmp_path / "torchmetrics_tpu" / "metric.py").write_text(METRIC_STUB)
     paths = [str(tmp_path / "torchmetrics_tpu")]
@@ -44,6 +45,10 @@ def _lint_fixture(tmp_path, kernel_src=None, metrics_src=None, root_kinds=("upda
         (tmp_path / "mpkg").mkdir(exist_ok=True)
         (tmp_path / "mpkg" / "metrics.py").write_text(textwrap.dedent(metrics_src))
         paths.append(str(tmp_path / "mpkg"))
+    if sync_src is not None:
+        (tmp_path / "spkg" / "parallel").mkdir(parents=True)
+        (tmp_path / "spkg" / "parallel" / "sync.py").write_text(textwrap.dedent(sync_src))
+        paths.append(str(tmp_path / "spkg"))
     return run_lint(paths, root=str(tmp_path), baseline_path=None, root_kinds=root_kinds)
 
 
@@ -387,6 +392,77 @@ def test_baseline_reports_stale_entries(tmp_path):
     )
     result = run_lint([str(tmp_path)], root=str(tmp_path), baseline_path=str(baseline_file))
     assert result.stale_baseline
+
+
+# ---------------------------------------------------------------------------
+# TPU007 — per-leaf collective in a loop over states
+# ---------------------------------------------------------------------------
+
+
+def test_tpu007_per_leaf_psum_flagged(tmp_path):
+    res = _lint_fixture(tmp_path, sync_src="""
+        from jax import lax
+
+        def reduce_state_in_graph(state, reductions, axis_name):
+            out = {}
+            for name, value in state.items():
+                out[name] = lax.psum(value, axis_name)
+            return out
+    """, root_kinds=("update", "kernel", "sync"))
+    assert "TPU007" in _rules(res)
+
+
+def test_tpu007_transitive_helper_flagged(tmp_path):
+    res = _lint_fixture(tmp_path, sync_src="""
+        import jax
+
+        def reduce_tensor_in_graph(value, axis_name):
+            return jax.lax.psum(value, axis_name)
+
+        def reduce_state_in_graph(state, reductions, axis_name):
+            out = {}
+            for name, value in state.items():
+                out[name] = reduce_tensor_in_graph(value, axis_name)
+            return out
+    """, root_kinds=("update", "kernel", "sync"))
+    assert "TPU007" in _rules(res)
+
+
+def test_tpu007_bucketed_loop_passes(tmp_path):
+    res = _lint_fixture(tmp_path, sync_src="""
+        import jax.numpy as jnp
+        from jax import lax
+
+        def reduce_state_in_graph(state, reductions, axis_name):
+            buckets = {}
+            for name, value in state.items():
+                buckets.setdefault(value.dtype, []).append(value.ravel())
+            out = {}
+            for dt, flats in buckets.items():
+                out[dt] = lax.psum(jnp.concatenate(flats), axis_name)
+            return out
+    """, root_kinds=("update", "kernel", "sync"))
+    assert "TPU007" not in _rules(res)
+    assert not res.new_violations
+
+
+def test_tpu007_host_loop_without_collective_passes(tmp_path):
+    res = _lint_fixture(tmp_path, sync_src="""
+        def reduce_state_in_graph(state, reductions, axis_name):
+            out = {}
+            for name, value in state.items():
+                out[name] = value
+            return out
+    """, root_kinds=("update", "kernel", "sync"))
+    assert not res.new_violations
+
+
+def test_sync_roots_detected(tmp_path):
+    res = _lint_fixture(tmp_path, sync_src="""
+        def reduce_state_in_graph(state, reductions, axis_name):
+            return state
+    """, root_kinds=("sync",))
+    assert res.n_roots >= 1
 
 
 # ---------------------------------------------------------------------------

@@ -1,10 +1,12 @@
 """tpulint — tracer-hygiene static analyzer for the torchmetrics_tpu corpus.
 
-Builds a lightweight call graph rooted at every jit-capable ``update`` body
-and functional ``_*_update``/``_*_format`` kernel, then enforces the dispatch
-contract the fused single-dispatch and ``lax.scan`` streaming paths rely on:
-no host syncs, no data-dependent shapes, no Python control flow on tracers,
-sane state registration, no use-after-donation, no float64.
+Builds a lightweight call graph rooted at every jit-capable ``update`` body,
+functional ``_*_update``/``_*_format`` kernel, and in-graph sync entry point
+under ``parallel/`` (``reduce_*_in_graph`` + the strategy kernels), then
+enforces the dispatch contract the fused single-dispatch and ``lax.scan``
+streaming paths rely on: no host syncs, no data-dependent shapes, no Python
+control flow on tracers, sane state registration, no use-after-donation, no
+float64, no per-leaf collectives looped over state dicts.
 
 Programmatic entry point::
 
@@ -69,7 +71,7 @@ def run_lint(
     paths: Sequence[str],
     root: str = ".",
     baseline_path: Optional[str] = DEFAULT_BASELINE,
-    root_kinds: Tuple[str, ...] = ("update", "kernel"),
+    root_kinds: Tuple[str, ...] = ("update", "kernel", "sync"),
 ) -> LintResult:
     corpus = Corpus.build(list(paths), root=root)
     roots = find_roots(corpus, kinds=root_kinds)

@@ -25,6 +25,14 @@ from .corpus import ClassInfo, Corpus, FunctionInfo, _dotted_name
 
 KERNEL_ROOT_RE = re.compile(r"^_\w+_(update|format)$")
 
+# public in-graph sync entry points under parallel/ (reduce_state_in_graph,
+# reduce_tensor_in_graph, the strategy kernels) — traced inside the user's
+# shard_map/pjit eval step, so they are jit roots like functional kernels
+SYNC_ROOT_RE = re.compile(
+    r"^(reduce_\w+_in_graph|invariant_all_gather|gather_bucket|"
+    r"reduce_scatter_sum|quantized_allreduce|quantize_chunks|dequantize_chunks)$"
+)
+
 # attribute reads that return host metadata, not device data
 _META_ATTRS = {"shape", "ndim", "size", "dtype", "at", "T"}
 _META_VALUE_ATTRS = {"shape", "ndim", "size", "dtype"}
@@ -333,6 +341,10 @@ def find_roots(corpus: Corpus, kinds: Tuple[str, ...] = ("update", "kernel")) ->
     if "kernel" in kinds:
         for qn, fn in corpus.functions.items():
             if fn.cls is None and ".functional." in fn.module.name and KERNEL_ROOT_RE.match(fn.name):
+                roots[qn] = fn
+    if "sync" in kinds:
+        for qn, fn in corpus.functions.items():
+            if fn.cls is None and ".parallel." in fn.module.name and SYNC_ROOT_RE.match(fn.name):
                 roots[qn] = fn
     return roots
 

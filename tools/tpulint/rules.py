@@ -15,6 +15,7 @@ directly; TPU005 scans all functions (donation misuse is an eager-layer bug).
 | TPU004 | state contract (add_state reduction/dtype vs. use, mutation site) |
 | TPU005 | no use of a buffer after donating it to a jitted call             |
 | TPU006 | TPU dtype hygiene: no implicit/explicit float64                   |
+| TPU007 | no per-leaf collective inside a Python loop over state dicts      |
 """
 from __future__ import annotations
 
@@ -32,7 +33,7 @@ from .callgraph import (
 )
 from .corpus import ClassInfo, Corpus, FunctionInfo
 
-ALL_RULES = ("TPU000", "TPU001", "TPU002", "TPU003", "TPU004", "TPU005", "TPU006")
+ALL_RULES = ("TPU000", "TPU001", "TPU002", "TPU003", "TPU004", "TPU005", "TPU006", "TPU007")
 
 RULE_TITLES = {
     "TPU000": "malformed waiver",
@@ -42,6 +43,7 @@ RULE_TITLES = {
     "TPU004": "metric state-contract violation",
     "TPU005": "use after donation",
     "TPU006": "TPU dtype hygiene (float64)",
+    "TPU007": "per-leaf collective in a loop over states",
 }
 
 
@@ -77,6 +79,12 @@ _DYN_SHAPE_FNS = {
 }
 
 _HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+
+# in-graph collectives (jax.lax.*) — one issued per loop iteration is the
+# O(n_states) latency antipattern TPU007 guards against
+_COLLECTIVE_FNS = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "psum_scatter", "ppermute", "all_to_all",
+}
 _HOST_SAFE_JNP_QUERIES = {
     "issubdtype", "isdtype", "result_type", "can_cast", "promote_types", "iterable",
 }
@@ -193,7 +201,46 @@ def check_traced_rules(fn: FunctionInfo, corpus: Corpus, roots: Set[str]) -> Lis
         if isinstance(node, ast.Assert) and _test_depends_on_array(node.test, ctx):
             emit("TPU003", node, "`assert` on an array value concretizes the tracer")
 
+        # ---- TPU007: per-leaf collective in a loop over states -------
+        if isinstance(node, ast.For) and _mentions_state_name(node.iter):
+            for stmt in node.body:
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.Call):
+                        cname = _collective_name(sub, ctx.imports)
+                        if cname:
+                            emit(
+                                "TPU007", sub,
+                                f"`{cname}` issued per loop iteration over a state dict: one"
+                                " small-message collective PER LEAF is latency-bound — bucket"
+                                " leaves by (reduction, dtype) and issue one collective per"
+                                " bucket (see reduce_state_in_graph)",
+                            )
+
     return out
+
+
+def _mentions_state_name(expr: ast.expr) -> bool:
+    """Loop iterable that ranges over metric state (a name containing 'state')."""
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Name) and "state" in sub.id.lower():
+            return True
+        if isinstance(sub, ast.Attribute) and "state" in sub.attr.lower():
+            return True
+    return False
+
+
+def _collective_name(call: ast.Call, imports: Dict[str, str]) -> str:
+    """'' unless the call is a jax.lax collective or a per-leaf sync helper."""
+    f = call.func
+    if not isinstance(f, (ast.Attribute, ast.Name)):
+        return ""
+    dotted = _alias_targets(imports, f)
+    last = dotted.split(".")[-1]
+    if dotted.startswith("jax.lax.") and last in _COLLECTIVE_FNS:
+        return last
+    if last == "reduce_tensor_in_graph":
+        return last
+    return ""
 
 
 def _test_depends_on_array(test: ast.expr, ctx: _FunctionContext) -> bool:

@@ -43,6 +43,7 @@ import numpy as np
 
 from .metric import Metric, _filter_kwargs, _global_jit, _jit_safe_inputs
 from .parallel.reduction import Reduction
+from .parallel.strategies import SyncPolicy
 from .parallel.sync import reduce_state_in_graph
 from .utils.exceptions import TorchMetricsUserError
 
@@ -626,7 +627,9 @@ class MetricCollection:
     def compute_state(self, states: Dict[str, Any]) -> Dict[str, Any]:
         return {self._set_name(name): m.compute_state(states[name]) for name, m in self._metrics.items()}
 
-    def reduce_state(self, states: Dict[str, Any], axis_name: str) -> Dict[str, Any]:
+    def reduce_state(
+        self, states: Dict[str, Any], axis_name: str, policy: Optional["SyncPolicy"] = None
+    ) -> Dict[str, Any]:
         """Collective reduction, bucketed across the WHOLE collection.
 
         Every distinct member subtree's leaves go into one flat state dict
@@ -636,6 +639,10 @@ class MetricCollection:
         member per state. Signature groups (equal ``update_signature`` +
         identical input leaves, as in :meth:`_grouped_apply`) contribute one
         subtree and share the reduced result.
+
+        ``policy`` selects the wire strategy (see
+        :class:`~torchmetrics_tpu.parallel.SyncPolicy`); ``None`` uses the
+        process default.
         """
         import jax.tree_util as jtu
 
@@ -661,7 +668,7 @@ class MetricCollection:
                 flat_reds[fk] = m._reductions.get(k, Reduction.NONE)
                 keys.append((k, fk))
             flat_keys[name] = keys
-        reduced = reduce_state_in_graph(flat_state, flat_reds, axis_name)
+        reduced = reduce_state_in_graph(flat_state, flat_reds, axis_name, policy)
         out: Dict[str, Any] = {}
         for name in self._metrics:
             owner = owners[name]

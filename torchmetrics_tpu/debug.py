@@ -28,6 +28,7 @@ from typing import Any, Iterator, Optional
 import jax
 
 from . import metric as _metric
+from .parallel import strategies as _strategies
 
 
 class StrictModeViolation(RuntimeError):
@@ -36,11 +37,21 @@ class StrictModeViolation(RuntimeError):
 
 @dataclass
 class StrictStats:
-    """Counters accumulated while a ``strict_mode()`` context is active."""
+    """Counters accumulated while a ``strict_mode()`` context is active.
+
+    The ``bytes_*``/``collectives_issued`` fields are wire-counter deltas
+    (``parallel.strategies.wire_stats``) captured between entering and
+    leaving the context: modelled sync traffic issued while it was active
+    (in-graph collectives count once per trace, eager backend gathers once
+    per call). Filled in at context exit — read them after the ``with``.
+    """
 
     compiles: int = 0
     retraces: int = 0
     new_executables: int = 0
+    bytes_reduced: int = 0
+    bytes_gathered: int = 0
+    collectives_issued: int = 0
 
 
 def _looks_like_transfer_guard_error(exc: BaseException) -> bool:
@@ -93,6 +104,7 @@ def strict_mode(
 
     _metric._COMPILE_OBSERVERS.append(_observe)
     guard = jax.transfer_guard(transfer_guard) if transfer_guard is not None else contextlib.nullcontext()
+    wire_before = _strategies.wire_stats()
     try:
         with guard:
             yield stats
@@ -106,6 +118,12 @@ def strict_mode(
         raise
     finally:
         _metric._COMPILE_OBSERVERS.remove(_observe)
+        wire_after = _strategies.wire_stats()
+        stats.bytes_reduced = wire_after["bytes_reduced"] - wire_before["bytes_reduced"]
+        stats.bytes_gathered = wire_after["bytes_gathered"] - wire_before["bytes_gathered"]
+        stats.collectives_issued = (
+            wire_after["collectives_issued"] - wire_before["collectives_issued"]
+        )
 
 
 __all__ = ["StrictModeViolation", "StrictStats", "strict_mode"]

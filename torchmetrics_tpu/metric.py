@@ -44,6 +44,16 @@ import jax.numpy as jnp
 import numpy as np
 
 from .parallel.reduction import ELEMENTWISE_REDUCTIONS, Reduction, resolve_reduction
+from .parallel.strategies import (
+    SyncPolicy,
+    begin_sync,
+    default_policy,
+    dequantize_chunks,
+    quantize_chunks,
+    record_collective,
+    reset_wire_stats,
+    wire_stats,
+)
 from .parallel.sync import NoSync, SyncBackend, default_sync_backend, reduce_state_in_graph
 from .utils.data import dim_zero_cat
 from .utils.exceptions import TorchMetricsUserError
@@ -133,6 +143,8 @@ _RUNTIME_ATTRS = frozenset(
         "_is_synced",
         "_in_pure_update",
         "_sync_backend",
+        "_sync_policy",
+        "_sync_residuals",
         "_jit_bound",
         "_exec_key_cache",
         "_exec_nonce",
@@ -234,10 +246,15 @@ def clear_executable_cache() -> None:
     _CACHE_STATS["compiles"] = 0
     _CACHE_STATS["retraces"] = 0
     _DISPATCH_COUNT[0] = 0
+    reset_wire_stats()
 
 
 def executable_cache_stats() -> Dict[str, int]:
-    """Cache size, hit/miss counts, compile/retrace counts, and dispatches."""
+    """Cache size, hit/miss counts, compile/retrace counts, dispatches, and
+    wire-level sync counters (modelled bytes reduced/gathered + collectives
+    issued; in-graph collectives count once per trace, eager once per call —
+    see ``parallel.strategies.record_collective``)."""
+    wire = wire_stats()
     return {
         "size": len(_EXECUTABLE_CACHE),
         "hits": _CACHE_STATS["hits"],
@@ -245,6 +262,10 @@ def executable_cache_stats() -> Dict[str, int]:
         "compiles": _CACHE_STATS["compiles"],
         "retraces": _CACHE_STATS["retraces"],
         "dispatches": _DISPATCH_COUNT[0],
+        "bytes_reduced": wire["bytes_reduced"],
+        "bytes_gathered": wire["bytes_gathered"],
+        "collectives_issued": wire["collectives_issued"],
+        "syncs": wire["syncs"],
     }
 
 
@@ -268,6 +289,10 @@ class Metric:
         sync_backend: a :class:`SyncBackend`; default picks HostSync when
             multi-process else NoSync. Replaces ``dist_sync_fn`` /
             ``process_group`` / ``distributed_available_fn``.
+        sync_policy: a :class:`~torchmetrics_tpu.parallel.SyncPolicy`
+            selecting the wire strategy for state sync (gather mode,
+            reduce-scatter decomposition, opt-in quantized collectives);
+            ``None`` uses the process default — exact, full precision.
         jit: trace update/forward with ``jax.jit`` (per input-shape cache).
 
     Example (defining a custom metric):
@@ -332,6 +357,7 @@ class Metric:
         sync_on_compute: bool = True,
         compute_with_cache: bool = True,
         sync_backend: Optional[SyncBackend] = None,
+        sync_policy: Optional[SyncPolicy] = None,
         jit: bool = True,
         **kwargs: Any,
     ) -> None:
@@ -349,6 +375,8 @@ class Metric:
         self.sync_on_compute = sync_on_compute
         self.compute_with_cache = compute_with_cache
         self._sync_backend = sync_backend
+        self._sync_policy = sync_policy
+        self._sync_residuals: Dict[Any, Array] = {}  # quantized-sync error feedback
         self._use_jit = bool(jit) and type(self).jittable
 
         self._update_count = 0
@@ -438,16 +466,22 @@ class Metric:
         if buf is not None and buf.pending:
             buf.flush()
 
-    def buffered(self, window: int = 32) -> "Any":
+    def buffered(self, window: int = 32, overlap_sync: bool = False) -> "Any":
         """Return a :class:`~torchmetrics_tpu.streaming.BufferedMetric` that
         stages ``window`` updates on device and flushes them in ONE scanned
         XLA dispatch — K steps of metric work per dispatch instead of K
         dispatches. Results are bitwise-identical to eager updates; any
         state observation (``compute``/``sync``/``reset``/state access/
-        pickling) forces a flush first."""
+        pickling) forces a flush first.
+
+        ``overlap_sync=True`` additionally gathers each previous window's
+        cat-state increments right after the asynchronous flush dispatch, so
+        sync communication hides under the next window's scan; the remaining
+        states sync at the :meth:`compute` barrier (see
+        ``docs/streaming_pipeline.md``)."""
         from .streaming import BufferedMetric
 
-        return BufferedMetric(self, window)
+        return BufferedMetric(self, window, overlap_sync=overlap_sync)
 
     def reset(self) -> None:
         """Restore default states. Parity: reference ``metric.py:673-688``."""
@@ -670,9 +704,17 @@ class Metric:
         lists = {k: tuple(state.get(k, ())) for k in self._list_states}
         return _squeeze_if_scalar(self._pure_compute(tensors, lists))
 
-    def reduce_state(self, state: StateDict, axis_name: str) -> StateDict:
-        """In-graph cross-device sync over a mesh axis (psum/pmax/.../gather)."""
-        return reduce_state_in_graph(state, self._reductions, axis_name)
+    def reduce_state(
+        self, state: StateDict, axis_name: str, policy: Optional[SyncPolicy] = None
+    ) -> StateDict:
+        """In-graph cross-device sync over a mesh axis (psum/pmax/.../gather).
+
+        ``policy`` (or the metric's ``sync_policy`` ctor kwarg) selects the
+        wire strategy; ``None`` falls back to the exact process default.
+        """
+        return reduce_state_in_graph(
+            state, self._reductions, axis_name, policy or self._sync_policy
+        )
 
     def merge_states(self, states: Sequence[StateDict]) -> StateDict:
         """Eagerly merge per-rank state pytrees (host-side DDP emulation)."""
@@ -850,51 +892,120 @@ class Metric:
         # (e.g. HostSync TimeoutError on a stalled peer) must leave local
         # state intact — a half-synced state dict would be checkpointed or
         # double-counted by the recovery path
-        synced: Dict[str, Any] = {}
-        addressed = hasattr(backend, "set_current")  # FakeSync group addressing
         try:
-            buckets: Dict[Tuple[Any, str], List[str]] = {}
-            for name in self._state:
-                red = self._reductions[name]
-                if name in self._list_states and red == Reduction.NONE:
-                    # ragged object list states (dist_reduce_fx=None: per-image
-                    # arrays, COCO RLE dicts) — gather whole per-rank lists and
-                    # extend in rank order, preserving element boundaries
-                    # (reference detection/mean_ap.py:1007-1032 all_gather_object)
-                    if addressed:
-                        backend.set_current(name)
-                    gathered = backend.all_gather_object(list(self._state[name]))
-                    merged: list = []
-                    for rank_list in gathered:
-                        merged.extend(rank_list)
-                    synced[name] = merged
-                elif name not in self._list_states and isinstance(red, Reduction) and red in ELEMENTWISE_REDUCTIONS:
-                    arr = jnp.asarray(self._state[name])
-                    buckets.setdefault((red, str(arr.dtype)), []).append(name)
-                else:
-                    if addressed:
-                        backend.set_current(name)
-                    synced[name] = backend.sync_tensor(self._precat(name), red)
-            for (red, _dtype), names in buckets.items():
-                arrs = [jnp.asarray(self._state[n]) for n in names]
-                if len(arrs) == 1:
-                    if addressed:
-                        backend.set_current(names[0])
-                    synced[names[0]] = backend.sync_tensor(arrs[0], red)
-                    continue
-                flat = jnp.concatenate([a.reshape(-1) for a in arrs])
-                if addressed:
-                    backend.set_current(tuple(names))
-                reduced = backend.sync_tensor(flat, red)
-                offset = 0
-                for n, a in zip(names, arrs):
-                    synced[n] = reduced[offset : offset + a.size].reshape(a.shape)
-                    offset += a.size
+            begin_sync()
+            synced = self._gather_synced(backend)
         except Exception:
             self._cache = None
             raise
         self._state.update(synced)
         self._is_synced = True
+
+    def _quantized_bucket_sync(
+        self, backend: SyncBackend, names: List[str], flat: Array, red, policy: SyncPolicy
+    ) -> Array:
+        """Eager quantized all-reduce of one float SUM/MEAN bucket.
+
+        int8/int16 payload + per-chunk scales travel instead of the
+        full-precision buffer; each rank's shard is dequantized and summed
+        host-side. Error feedback: the local quantization residual is keyed
+        by the bucket's name tuple in ``_sync_residuals`` and folded into the
+        next sync of the same bucket.
+        """
+        bits = policy.quantize_bits or 8
+        key = tuple(names)
+        residual = self._sync_residuals.get(key)
+        x = flat if residual is None or residual.size != flat.size else flat + residual
+        q, scales, pad = quantize_chunks(x, bits, policy.quantize_chunk)
+        dq = dequantize_chunks(q, scales, flat.dtype)
+        self._sync_residuals[key] = (jnp.pad(x, (0, pad)) - dq)[: flat.size]
+        record_collective(
+            "eager_gather",
+            q.size * q.dtype.itemsize + scales.size * scales.dtype.itemsize,
+            backend.world_size(),
+        )
+        gq = backend.sync_tensor(q, Reduction.NONE)  # (world, Q)
+        gs = backend.sync_tensor(scales, Reduction.NONE)  # (world, C)
+        total = sum(
+            dequantize_chunks(gq[r], gs[r], flat.dtype) for r in range(gq.shape[0])
+        )[: flat.size]
+        if red == Reduction.MEAN:
+            total = total / gq.shape[0]
+        return total
+
+    def _gather_synced(self, backend: SyncBackend, skip: frozenset = frozenset()) -> Dict[str, Any]:
+        """Gather every state (except ``skip``) into a scratch dict.
+
+        List states are pre-concatenated to one tensor so one gather happens
+        per state (reference ``metric.py:430-433``); fixed-shape elementwise
+        states are bucketed by ``(Reduction, dtype)``. Used by :meth:`sync`
+        and by the overlapped-flush barrier (``streaming.py``), which passes
+        the cat states it already gathered incrementally as ``skip``.
+        """
+        policy = self._sync_policy or default_policy()
+        synced: Dict[str, Any] = {}
+        addressed = hasattr(backend, "set_current")  # FakeSync group addressing
+        buckets: Dict[Tuple[Any, str], List[str]] = {}
+        for name in self._state:
+            if name in skip:
+                continue
+            red = self._reductions[name]
+            if name in self._list_states and red == Reduction.NONE:
+                # ragged object list states (dist_reduce_fx=None: per-image
+                # arrays, COCO RLE dicts) — gather whole per-rank lists and
+                # extend in rank order, preserving element boundaries
+                # (reference detection/mean_ap.py:1007-1032 all_gather_object)
+                if addressed:
+                    backend.set_current(name)
+                gathered = backend.all_gather_object(list(self._state[name]))
+                merged: list = []
+                for rank_list in gathered:
+                    merged.extend(rank_list)
+                synced[name] = merged
+            elif name not in self._list_states and isinstance(red, Reduction) and red in ELEMENTWISE_REDUCTIONS:
+                arr = jnp.asarray(self._state[name])
+                buckets.setdefault((red, str(arr.dtype)), []).append(name)
+            else:
+                if addressed:
+                    backend.set_current(name)
+                synced[name] = backend.sync_tensor(self._precat(name), red)
+        for (red, _dtype), names in buckets.items():
+            arrs = [jnp.asarray(self._state[n]) for n in names]
+            flat = arrs[0] if len(arrs) == 1 else jnp.concatenate([a.reshape(-1) for a in arrs])
+            # opt-in quantized wire format for float SUM/MEAN buckets above
+            # the size threshold; addressed (state-reading) test backends
+            # can't transport an ad-hoc payload, so they stay full-precision.
+            # (unlike the in-graph path this needs no all_gather version gate
+            # — the payload travels as a plain NONE-gather of int8/int16)
+            if (
+                not addressed
+                and not policy.exact
+                and policy.quantize_bits is not None
+                and red in (Reduction.SUM, Reduction.MEAN)
+                and flat.size >= policy.quantize_threshold
+                and jnp.issubdtype(jnp.asarray(flat).dtype, jnp.floating)
+            ):
+                reduced = self._quantized_bucket_sync(
+                    backend, names, flat.reshape(-1), red, policy
+                )
+                offset = 0
+                for n, a in zip(names, arrs):
+                    synced[n] = reduced[offset : offset + a.size].reshape(a.shape)
+                    offset += a.size
+                continue
+            if len(arrs) == 1:
+                if addressed:
+                    backend.set_current(names[0])
+                synced[names[0]] = backend.sync_tensor(arrs[0], red)
+                continue
+            if addressed:
+                backend.set_current(tuple(names))
+            reduced = backend.sync_tensor(flat, red)
+            offset = 0
+            for n, a in zip(names, arrs):
+                synced[n] = reduced[offset : offset + a.size].reshape(a.shape)
+                offset += a.size
+        return synced
 
     def _precat(self, name: str) -> Array:
         value = self._state[name]

@@ -4,6 +4,7 @@ dp x pp x tp (+ep) train-step template."""
 from .reduction import Reduction, resolve_reduction
 from .ring import expert_all_to_all, ring_attention
 from .train_demo import demo_param_shardings, init_demo_params, make_demo_train_step
+from .strategies import SyncPolicy, reset_wire_stats, use_policy, wire_stats
 from .sync import (
     FakeSync,
     HostSync,
@@ -29,4 +30,8 @@ __all__ = [
     "default_sync_backend",
     "reduce_state_in_graph",
     "reduce_tensor_in_graph",
+    "SyncPolicy",
+    "use_policy",
+    "wire_stats",
+    "reset_wire_stats",
 ]

@@ -31,6 +31,20 @@ import jax.numpy as jnp
 from jax import lax
 
 from .reduction import ELEMENTWISE_REDUCTIONS, Reduction
+from .strategies import (  # noqa: F401  (re-exported: stable import surface)
+    SyncPolicy,
+    axis_size,
+    begin_sync,
+    default_policy,
+    gather_bucket,
+    invariant_all_gather,
+    quantized_allreduce,
+    record_collective,
+    reduce_scatter_sum,
+    reset_wire_stats,
+    use_policy,
+    wire_stats,
+)
 
 Array = jax.Array
 StateDict = Dict[str, Any]
@@ -57,104 +71,216 @@ def clear_poison() -> None:
 # In-graph (SPMD) collectives — the hot path on TPU
 # ---------------------------------------------------------------------------
 
-def axis_size(axis_name: str) -> int:
-    """Static size of a named mesh axis (compat: ``lax.axis_size`` is newer
-    than some supported jax versions; ``psum`` of the constant 1 is
-    special-cased to fold to the static axis size on all of them)."""
-    if hasattr(lax, "axis_size"):
-        return lax.axis_size(axis_name)
-    return lax.psum(1, axis_name)
-
-
 def _invariant_all_gather(value: Array, axis_name: str, stack: bool = False) -> Array:
-    """All-gather whose output is replication-*invariant* (VMA-typed).
+    """Back-compat wrapper over :func:`strategies.invariant_all_gather`.
 
-    ``lax.all_gather`` output is still typed device-varying under shard_map's
-    VMA checks, so it can't leave the region with ``out_specs=P()``. We
-    instead scatter each shard into its slot of a zeros buffer and ``psum`` —
-    one collective, invariant result. (Ring-allreduce moves ~2x the bytes of
-    an all-gather; for zero-copy epilogues prefer returning the un-gathered
-    ``cat`` shards with ``out_specs=P(axis)`` — see ``cat_out_specs``.)
+    Policy-routed: the zeros-scatter+psum gather (replication-invariant on
+    every jax version) by default, a true ``lax.all_gather`` (half the wire
+    bytes) when the active :class:`SyncPolicy` selects it and the version
+    gate allows.
     """
-    n = axis_size(axis_name)
-    i = lax.axis_index(axis_name)
-    # psum promotes bool to an integer sum; round-trip through uint8 so
-    # boolean mask states (e.g. exact-mode `valid`) keep their dtype —
-    # otherwise downstream `preds[mask]` silently becomes integer indexing
-    is_bool = value.dtype == jnp.bool_
-    v = value.astype(jnp.uint8) if is_bool else value
-    buf = jnp.zeros((n,) + v.shape, v.dtype).at[i].set(v)
-    buf = lax.psum(buf, axis_name)
-    if is_bool:
-        buf = buf.astype(jnp.bool_)
-    if stack:
-        return buf  # (world, ...) — parity with reference gather-no-reduce
-    return buf.reshape((n * value.shape[0],) + value.shape[1:]) if value.ndim else buf
+    return invariant_all_gather(value, axis_name, stack=stack)
 
 
-def reduce_tensor_in_graph(value: Array, reduction: Union[Reduction, Callable], axis_name: str) -> Array:
-    """Merge one per-device state leaf across a named mesh axis, in-graph."""
-    if reduction in (Reduction.SUM,):
+_PLAIN_KIND = {
+    Reduction.SUM: "psum",
+    Reduction.MEAN: "pmean",
+    Reduction.MAX: "pmax",
+    Reduction.MIN: "pmin",
+}
+
+
+def _plain_reduce(value: Array, reduction: Reduction, axis_name: str) -> Array:
+    """Full-precision elementwise collective (the dense strategy)."""
+    record_collective(
+        _PLAIN_KIND[reduction], value.size * value.dtype.itemsize, axis_size(axis_name)
+    )
+    if reduction == Reduction.SUM:
         return lax.psum(value, axis_name)
     if reduction == Reduction.MEAN:
         return lax.pmean(value, axis_name)
     if reduction == Reduction.MAX:
         return lax.pmax(value, axis_name)
-    if reduction == Reduction.MIN:
-        return lax.pmin(value, axis_name)
+    return lax.pmin(value, axis_name)
+
+
+def _route_elementwise(
+    value: Array, reduction: Reduction, axis_name: str, policy: SyncPolicy
+) -> Array:
+    """Pick the wire strategy for one elementwise leaf/bucket.
+
+    Dense psum/pmean/pmax/pmin unless the policy opts a SUM/MEAN bucket into
+    the quantized collective (floats only — integer states always take an
+    exact path) or the reduce-scatter decomposition (exact for integer SUM;
+    float results match psum to summation-order tolerance).
+    """
+    if reduction in (Reduction.SUM, Reduction.MEAN):
+        if policy.wants_quantize(value.dtype, value.size):
+            out, _ = quantized_allreduce(
+                value.reshape(-1), axis_name, mean=reduction == Reduction.MEAN, policy=policy
+            )
+            return out.reshape(value.shape)
+        if (
+            reduction == Reduction.SUM or jnp.issubdtype(value.dtype, jnp.floating)
+        ) and policy.wants_reduce_scatter(value.size):
+            out = reduce_scatter_sum(
+                value.reshape(-1), axis_name, mean=reduction == Reduction.MEAN, policy=policy
+            )
+            return out.reshape(value.shape)
+    return _plain_reduce(value, reduction, axis_name)
+
+
+def reduce_tensor_in_graph(
+    value: Array,
+    reduction: Union[Reduction, Callable],
+    axis_name: str,
+    policy: Optional[SyncPolicy] = None,
+) -> Array:
+    """Merge one per-device state leaf across a named mesh axis, in-graph."""
+    policy = policy or default_policy()
+    if isinstance(reduction, Reduction) and reduction in ELEMENTWISE_REDUCTIONS:
+        return _route_elementwise(value, reduction, axis_name, policy)
     if reduction == Reduction.CAT:
-        return _invariant_all_gather(jnp.atleast_1d(value), axis_name)
+        return invariant_all_gather(jnp.atleast_1d(value), axis_name, policy=policy)
     if reduction == Reduction.NONE:
         # parity with reference gather-without-reduce (metric.py:456): compute
         # sees a (world, ...) stack and merges itself (e.g. Pearson moments)
-        return _invariant_all_gather(value, axis_name, stack=True)
+        return invariant_all_gather(value, axis_name, stack=True, policy=policy)
     if callable(reduction):
-        return reduction(_invariant_all_gather(value, axis_name, stack=True))
+        return reduction(invariant_all_gather(value, axis_name, stack=True, policy=policy))
     raise ValueError(f"Unknown reduction {reduction}")
+
+
+class _GatherLeaf:
+    """One cat/NONE/custom leaf queued into a per-dtype gather bucket."""
+
+    __slots__ = ("red", "shape", "is_bool", "wire")
+
+    def __init__(self, red, value: Array):
+        v = jnp.asarray(value)
+        if red == Reduction.CAT:
+            v = jnp.atleast_1d(v)
+        self.red = red
+        self.shape = v.shape
+        self.is_bool = v.dtype == jnp.bool_
+        # psum promotes bool to an integer sum; round-trip through uint8 so
+        # boolean mask states (e.g. exact-mode `valid`) keep their dtype
+        self.wire = v.astype(jnp.uint8) if self.is_bool else v
+
+    def finish(self, seg: Array, n: int) -> Array:
+        """Epilogue: slice of the gathered ``(n, total)`` matrix → leaf result."""
+        r = seg.reshape((n,) + self.shape)
+        if self.is_bool:
+            r = r.astype(jnp.bool_)
+        if self.red == Reduction.CAT:
+            return r.reshape((n * self.shape[0],) + self.shape[1:])
+        if self.red == Reduction.NONE:
+            return r  # (world, ...) — parity with reference gather-no-reduce
+        return self.red(r)  # custom callable over the (world, ...) stack
 
 
 def reduce_state_in_graph(
     state: StateDict,
     reductions: Mapping[str, Union[Reduction, Callable]],
     axis_name: str,
+    policy: Optional[SyncPolicy] = None,
 ) -> StateDict:
     """Sync a whole state dict across ``axis_name``. Pure & jittable.
 
     Fixed-shape leaves with an elementwise reduction (sum/mean/max/min) are
     *bucketed*: every leaf sharing a ``(Reduction, dtype)`` pair is flattened
-    into one concatenated buffer and reduced with a single
-    ``lax.psum/pmean/pmax/pmin``, then split and reshaped back exactly. The
-    collectives are elementwise, so bucketing is bitwise-identical to
-    per-leaf reduction while issuing one collective per bucket instead of one
-    per state name (small-message all-reduce is latency-bound; see EQuARX).
+    into one concatenated buffer and reduced with a single collective, then
+    split and reshaped back exactly. The collectives are elementwise, so
+    bucketing is bitwise-identical to per-leaf reduction while issuing one
+    collective per bucket instead of one per state name (small-message
+    all-reduce is latency-bound; see EQuARX).
 
-    List (``cat``) states may be tuples of arrays: each element is gathered
-    (tiled) independently, preserving tuple structure; ``cat``/``NONE``/
-    custom reductions stay per-leaf (their output shape depends on the
-    gather, so they cannot share a flat buffer).
+    ``cat``/``NONE``/custom leaves — including every element of list
+    (``cat``) states — are likewise bucketed by *wire dtype*: each leaf is
+    flattened, leaves sharing a dtype are concatenated, ONE gather moves the
+    whole bucket as an ``(world, total)`` matrix, and per-leaf epilogues
+    slice/reshape (cat), stack (``NONE``) or apply the custom callable.
+    Gathering is pure data movement, so bucketed results are bitwise-equal to
+    the per-leaf reference while scalar-heavy cat states (text/retrieval)
+    stop issuing per-leaf collectives.
+
+    ``policy`` selects the wire strategy per bucket (dense / reduce-scatter /
+    quantized, zeros+psum vs true all_gather); ``None`` uses the process
+    default. The default policy is exact and reproduces the dense collective
+    schedule bitwise.
     """
+    policy = policy or default_policy()
+    begin_sync()
     out: StateDict = {}
     buckets: Dict[Any, list] = {}  # (Reduction, dtype) -> [(name, array)]
+    gather_buckets: Dict[str, list] = {}  # wire dtype -> [_GatherLeaf]
+    plan: Dict[str, Any] = {}  # name -> ("leaf", dt, idx) | ("seq", type, parts)
+
+    def _enqueue(red, value):
+        leaf = _GatherLeaf(red, value)
+        dt = str(leaf.wire.dtype)
+        lst = gather_buckets.setdefault(dt, [])
+        lst.append(leaf)
+        return (dt, len(lst) - 1)
+
+    fallbacks: list = []  # (name, value, red) — per-leaf path (odd reductions)
     for name, value in state.items():
         red = reductions.get(name, Reduction.NONE)
+        gatherish = red in (Reduction.CAT, Reduction.NONE) or (
+            not isinstance(red, Reduction) and callable(red)
+        )
         if isinstance(value, (list, tuple)):
-            out[name] = type(value)(reduce_tensor_in_graph(v, red, axis_name) for v in value)
+            if gatherish:
+                plan[name] = ("seq", type(value), [_enqueue(red, v) for v in value])
+            else:
+                fallbacks.append((name, value, red))
         elif isinstance(red, Reduction) and red in ELEMENTWISE_REDUCTIONS:
             arr = jnp.asarray(value)
             buckets.setdefault((red, str(arr.dtype)), []).append((name, arr))
+        elif gatherish:
+            plan[name] = ("leaf", *_enqueue(red, value))
         else:
-            out[name] = reduce_tensor_in_graph(value, red, axis_name)
+            fallbacks.append((name, value, red))
+    for name, value, red in fallbacks:
+        if isinstance(value, (list, tuple)):
+            out[name] = type(value)(
+                reduce_tensor_in_graph(v, red, axis_name, policy) for v in value
+            )
+        else:
+            out[name] = reduce_tensor_in_graph(value, red, axis_name, policy)
+
     for (red, _dtype), entries in buckets.items():
         if len(entries) == 1:
             name, arr = entries[0]
-            out[name] = reduce_tensor_in_graph(arr, red, axis_name)
+            out[name] = _route_elementwise(arr, red, axis_name, policy)
             continue
         flat = jnp.concatenate([arr.reshape(-1) for _, arr in entries])
-        reduced = reduce_tensor_in_graph(flat, red, axis_name)
+        reduced = _route_elementwise(flat, red, axis_name, policy)
         offset = 0
         for name, arr in entries:
             out[name] = reduced[offset : offset + arr.size].reshape(arr.shape)
             offset += arr.size
+
+    n = axis_size(axis_name)
+    results: Dict[Any, Array] = {}  # (dtype, idx) -> gathered leaf
+    for dt, leaves in gather_buckets.items():
+        if len(leaves) == 1:
+            mat = gather_bucket(leaves[0].wire.reshape(-1), axis_name, policy)
+            results[(dt, 0)] = leaves[0].finish(mat, n)
+            continue
+        flat = jnp.concatenate([leaf.wire.reshape(-1) for leaf in leaves])
+        mat = gather_bucket(flat, axis_name, policy)
+        offset = 0
+        for idx, leaf in enumerate(leaves):
+            size = int(leaf.wire.size)
+            results[(dt, idx)] = leaf.finish(mat[:, offset : offset + size], n)
+            offset += size
+
+    for name, spec in plan.items():
+        if spec[0] == "leaf":
+            out[name] = results[(spec[1], spec[2])]
+        else:
+            out[name] = spec[1](results[h] for h in spec[2])
     return out
 
 
@@ -280,6 +406,9 @@ class HostSync(SyncBackend):
         return result[0]
 
     def sync_tensor(self, value: Array, reduction) -> Array:
+        nbytes = value.size * value.dtype.itemsize
+        kind = "eager_reduce" if reduction in ELEMENTWISE_REDUCTIONS else "eager_gather"
+        record_collective(kind, nbytes, self.world_size())
         if reduction == Reduction.CAT:
             return self._gather_uneven_cat(jnp.atleast_1d(value))
         gathered = self._gather(value)  # (world, ...)
@@ -417,13 +546,43 @@ class FakeSync(SyncBackend):
         return len(self._group)
 
     def set_current(self, name: Union[str, tuple]) -> None:
-        """Address the next ``sync_tensor`` call: a state name, or a tuple of
+        """Address the next ``sync_tensor`` call: a state name, a tuple of
         names for a bucketed call (each rank's leaves are flattened and
-        concatenated in the given order, mirroring ``Metric.sync``)."""
+        concatenated in the given order, mirroring ``Metric.sync``), or an
+        ``(name, start, stop)`` range into a list (``cat``) state — each
+        rank contributes ``concat(state[name][start:stop])``, the addressing
+        the overlapped-flush path uses to gather only the increments a
+        window appended (see ``streaming.py``)."""
         self._current_name = name
+
+    @staticmethod
+    def _is_range(name) -> bool:
+        return (
+            isinstance(name, tuple)
+            and len(name) == 3
+            and isinstance(name[0], str)
+            and isinstance(name[1], int)
+            and isinstance(name[2], int)
+        )
 
     def sync_tensor(self, value: Array, reduction) -> Array:
         name = self._current_name
+        record_collective(
+            "eager_reduce" if reduction in ELEMENTWISE_REDUCTIONS else "eager_gather",
+            value.size * value.dtype.itemsize,
+            self.world_size(),
+        )
+        if self._is_range(name):
+            key, start, stop = name
+            peers = []
+            for s in self._group:
+                rows = list(s[key])[start:stop]
+                peers.append(
+                    jnp.concatenate([jnp.atleast_1d(jnp.asarray(r)) for r in rows], axis=0)
+                    if rows
+                    else jnp.asarray(value)[:0]
+                )
+            return jnp.concatenate(peers, axis=0)
         if isinstance(name, tuple):
             peers = [
                 jnp.concatenate([jnp.asarray(s[n]).reshape(-1) for n in name])

@@ -50,6 +50,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from .metric import Metric, StateDict, _filter_kwargs, _global_jit, _jit_safe_inputs
+from .parallel.reduction import Reduction
+from .parallel.strategies import begin_sync
 from .utils.exceptions import TorchMetricsUserError
 
 __all__ = ["BufferedMetric", "BufferedMetricCollection"]
@@ -143,6 +145,15 @@ class BufferedMetric:
     OR the wrapped metric) applies all staged steps in one scanned XLA
     dispatch. Created via :meth:`Metric.buffered`.
 
+    With ``overlap_sync=True`` each flush additionally gathers the cat-state
+    increments the *previous* windows appended, eagerly, right after the
+    asynchronous scan dispatch — the host-side DCN gather runs while the
+    device is still executing the new window's scan, so sync communication
+    hides under compute. Elementwise states (one small bucket) and the final
+    window's increments are synced at the :meth:`compute` barrier. Requires
+    every rank to drive its handle in lockstep (same flush points), the
+    invariant eager multi-host sync already demands.
+
     Example:
         >>> import jax.numpy as jnp
         >>> from torchmetrics_tpu import SumMetric
@@ -153,7 +164,7 @@ class BufferedMetric:
         15.0
     """
 
-    def __init__(self, metric: Metric, window: int = 32) -> None:
+    def __init__(self, metric: Metric, window: int = 32, overlap_sync: bool = False) -> None:
         if not isinstance(window, int) or isinstance(window, bool) or window < 1:
             raise ValueError(f"Expected `window` to be a positive integer, got {window!r}")
         if not getattr(metric, "_use_jit", False):
@@ -168,6 +179,12 @@ class BufferedMetric:
         self.__dict__["_window"] = window
         self.__dict__["_ring"] = _Ring(window)
         self.__dict__["_flushing"] = False
+        self.__dict__["_overlap"] = bool(overlap_sync)
+        # overlapped-sync bookkeeping: per cat list state, the merged
+        # (already gathered across ranks) window increments and how many
+        # LOCAL rows have been covered by issued gathers
+        self.__dict__["_ov_gathered"] = {}
+        self.__dict__["_ov_synced_idx"] = {}
         object.__setattr__(metric, "_stream_buffer", self)
 
     # -- staging --------------------------------------------------------
@@ -239,6 +256,15 @@ class BufferedMetric:
         self.__dict__["_flushing"] = True
         try:
             m = self.__dict__["_metric"]
+            # snapshot the cat-state row counts the PREVIOUS windows produced
+            # before this flush appends more: those rows exist on every rank
+            # that reached this flush point, so they are safe to gather while
+            # the new window's scan is still executing on device
+            pre_counts = (
+                {name: len(m.__dict__["_state"][name]) for name in self._ov_cat_names()}
+                if self.__dict__["_overlap"]
+                else None
+            )
             steps, valid = ring.take()
             fn = self._flush_fn()
             new_tensors, appends = fn(
@@ -254,13 +280,95 @@ class BufferedMetric:
                 m._extend_list_states(
                     {k: tuple(a[i] for a in arrs) for k, arrs in appends.items()}
                 )
+            if pre_counts is not None:
+                backend = m.sync_backend
+                if backend.is_available() and not m._is_synced:
+                    self._ov_issue(backend, pre_counts)
         finally:
             self.__dict__["_flushing"] = False
 
+    # -- sync/compute overlap -------------------------------------------
+    def _ov_cat_names(self) -> List[str]:
+        m = self.__dict__["_metric"]
+        return [
+            name
+            for name in m._list_states
+            if m._reductions.get(name) == Reduction.CAT
+        ]
+
+    def _ov_issue(self, backend, counts: Dict[str, int]) -> None:
+        """Gather each cat state's rows in ``[synced_idx, counts[name])``.
+
+        Called right after the (asynchronous) flush dispatch: the device is
+        busy scanning the new window while the host gather moves the
+        previous windows' increments over DCN. A gather is issued even for
+        an empty range so every rank executes the same collective sequence.
+        """
+        m = self.__dict__["_metric"]
+        idx = self.__dict__["_ov_synced_idx"]
+        gathered = self.__dict__["_ov_gathered"]
+        addressed = hasattr(backend, "set_current")
+        for name in self._ov_cat_names():
+            start, stop = idx.get(name, 0), counts.get(name, 0)
+            if stop < start:  # state shrank (reset/load) — resync from zero
+                start = 0
+                gathered.pop(name, None)
+            rows = list(m.__dict__["_state"][name])[start:stop]
+            if rows:
+                local = jnp.concatenate([jnp.atleast_1d(jnp.asarray(r)) for r in rows])
+            else:
+                probe = m._precat(name)
+                local = probe[:0]
+            if addressed:
+                backend.set_current((name, start, stop))
+            piece = backend.sync_tensor(local, Reduction.CAT)
+            if piece.shape[0]:
+                gathered.setdefault(name, []).append(piece)
+            idx[name] = stop
+
+    def _ov_barrier(self, backend) -> None:
+        """Final sync point: gather the tail increments plus every remaining
+        state bucket, then install the merged states exactly as
+        :meth:`Metric.sync` would (cache local, ``_is_synced=True``).
+
+        The merged cat order interleaves windows (window-major, rank-major
+        within a window) rather than the plain rank-major order of
+        ``merge_states`` — metric results are order-independent over cat
+        states, only the row multiset matters.
+        """
+        m = self.__dict__["_metric"]
+        if m._is_synced:
+            raise TorchMetricsUserError("The Metric has already been synced.")
+        cat_names = self._ov_cat_names()
+        m._cache = m._snapshot_state()
+        try:
+            begin_sync()
+            self._ov_issue(
+                backend, {name: len(m.__dict__["_state"][name]) for name in cat_names}
+            )
+            synced = m._gather_synced(backend, skip=frozenset(cat_names))
+            for name in cat_names:
+                synced[name] = list(self.__dict__["_ov_gathered"].get(name, []))
+        except Exception:
+            m._cache = None
+            raise
+        m.__dict__["_state"].update(synced)
+        m._is_synced = True
+
     # -- observation (flush-first delegation) ---------------------------
     def compute(self) -> Any:
+        m = self.__dict__["_metric"]
+        if self.__dict__["_overlap"] and not m._is_synced and m.sync_on_compute:
+            backend = m.sync_backend
+            if backend.is_available():
+                self.flush()
+                self._ov_barrier(backend)
+                try:
+                    return m.compute()
+                finally:
+                    m.unsync()
         self.flush()
-        return self._metric.compute()
+        return m.compute()
 
     def forward(self, *args: Any, **kwargs: Any) -> Any:
         """Per-step batch values defeat buffering; flush and run eagerly."""
@@ -272,11 +380,19 @@ class BufferedMetric:
 
     def reset(self) -> None:
         self.flush()
+        self.__dict__["_ov_gathered"] = {}
+        self.__dict__["_ov_synced_idx"] = {}
         self._metric.reset()
 
-    def sync(self, *args: Any, **kwargs: Any) -> None:
+    def sync(self, should_sync: bool = True, sync_backend: Any = None) -> None:
         self.flush()
-        self._metric.sync(*args, **kwargs)
+        m = self.__dict__["_metric"]
+        if self.__dict__["_overlap"] and should_sync:
+            backend = sync_backend or m.sync_backend
+            if backend.is_available():
+                self._ov_barrier(backend)
+                return
+        m.sync(should_sync=should_sync, sync_backend=sync_backend)
 
     def unsync(self, *args: Any, **kwargs: Any) -> None:
         self._metric.unsync(*args, **kwargs)
@@ -296,10 +412,14 @@ class BufferedMetric:
 
     def __getstate__(self) -> Dict[str, Any]:
         self.flush()
-        return {"_metric": self.__dict__["_metric"], "_window": self._window}
+        return {
+            "_metric": self.__dict__["_metric"],
+            "_window": self._window,
+            "_overlap": self.__dict__["_overlap"],
+        }
 
     def __setstate__(self, state: Dict[str, Any]) -> None:
-        self.__init__(state["_metric"], state["_window"])
+        self.__init__(state["_metric"], state["_window"], state.get("_overlap", False))
 
     def __getattr__(self, name: str) -> Any:
         # any other attribute (including registered state leaves) is a state
