@@ -17,6 +17,7 @@ from jax import core
 from torchmetrics_tpu import Metric
 from torchmetrics_tpu.parallel.reduction import ELEMENTWISE_REDUCTIONS, Reduction
 from torchmetrics_tpu.parallel.sync import FakeSync, reduce_state_in_graph, reduce_tensor_in_graph
+from torchmetrics_tpu.utils.data import dim_zero_cat
 
 WORLD = 4
 
@@ -160,10 +161,11 @@ def test_fake_sync_bucketed_matches_manual_merge():
     data = [jnp.asarray(np.random.RandomState(r).rand(5).astype(np.float32)) for r in range(WORLD)]
     for m, x in zip(ranks, data):
         m.update(x)
-    # FakeSync worlds pre-concat cat states (the backend gathers tensors)
+    # FakeSync worlds pre-concat cat states (the backend gathers tensors);
+    # dim_zero_cat masks a padded CatBuffer to its valid prefix
     group = [
         {**{k: v for k, v in m.metric_state.items() if k != "vals"},
-         "vals": jnp.concatenate([jnp.asarray(e) for e in m.metric_state["vals"]])}
+         "vals": dim_zero_cat(m.metric_state["vals"])}
         for m in ranks
     ]
     for r, m in enumerate(ranks):

@@ -1,0 +1,379 @@
+"""Padded geometric cat-state buffers (buffers.CatBuffer).
+
+Covers the shape-stable cat-state contract:
+
+- bitwise equivalence between the padded layout (default) and the legacy
+  ``list_layout="list"`` fallback on every tier-1 cat-state metric family,
+  locally and after sync under the eager (FakeSync) and in-graph routes;
+- geometric doubling boundaries (count == capacity, empty, single element);
+- donation safety + zero steady-state retraces/transfers under strict_mode;
+- the O(log n) executable budget across a 1,000-update run;
+- the incremental ``Metric.__hash__`` digest (cost must not scale with the
+  number of stored updates);
+- the ``_precat`` empty-state dtype fix (declared integer cat states survive
+  reset + sync with their dtype).
+"""
+import contextlib
+import copy
+import math
+import pickle
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu import CatBuffer, CatLayoutError, Metric
+from torchmetrics_tpu.aggregation import CatMetric
+from torchmetrics_tpu.buffers import MIN_CAPACITY, _capacity_for
+from torchmetrics_tpu.classification import BinaryAUROC, BinaryPrecisionRecallCurve
+from torchmetrics_tpu.debug import strict_mode
+from torchmetrics_tpu.metric import _HASH_STATS, executable_cache_stats
+from torchmetrics_tpu.parallel.reduction import Reduction
+from torchmetrics_tpu.parallel.strategies import SyncPolicy, use_policy
+from torchmetrics_tpu.parallel.sync import FakeSync, reduce_state_in_graph
+from torchmetrics_tpu.regression import SpearmanCorrCoef
+from torchmetrics_tpu.retrieval import RetrievalMRR
+from torchmetrics_tpu.utils.data import dim_zero_cat, padded_cat
+
+
+def _as_tuple(x):
+    return x if isinstance(x, (tuple, list)) else (x,)
+
+
+def _assert_bitwise(a, b, ctx=""):
+    for x, y in zip(_as_tuple(a), _as_tuple(b)):
+        x, y = np.asarray(x), np.asarray(y)
+        assert x.shape == y.shape and x.dtype == y.dtype, (ctx, x.dtype, y.dtype, x.shape, y.shape)
+        np.testing.assert_array_equal(x, y, err_msg=ctx)
+
+
+# ---------------------------------------------------------------------------
+# CatBuffer unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_capacity_is_power_of_two_with_floor():
+    assert _capacity_for(1) == MIN_CAPACITY
+    assert _capacity_for(MIN_CAPACITY) == MIN_CAPACITY
+    assert _capacity_for(MIN_CAPACITY + 1) == 2 * MIN_CAPACITY
+    assert _capacity_for(1000) == 1024
+
+
+def test_append_at_exact_capacity_boundary():
+    cb = CatBuffer.allocate(jnp.arange(float(MIN_CAPACITY)))  # fills capacity exactly
+    assert cb.count == cb.capacity == MIN_CAPACITY
+    cb.append(jnp.asarray([99.0]))  # count == capacity → grow
+    assert cb.capacity == 2 * MIN_CAPACITY and cb.count == MIN_CAPACITY + 1
+    np.testing.assert_array_equal(
+        np.asarray(cb.materialize()), list(range(MIN_CAPACITY)) + [99.0]
+    )
+
+
+def test_single_element_and_scalar_increments():
+    cb = CatBuffer.allocate(jnp.asarray(3.5))  # scalar → one row
+    assert cb.count == 1 and cb.trailing == ()
+    cb.append(jnp.asarray([1.0, 2.0]))
+    np.testing.assert_array_equal(np.asarray(cb.materialize()), [3.5, 1.0, 2.0])
+
+
+def test_empty_increment_is_a_noop():
+    cb = CatBuffer.allocate(jnp.asarray([1.0]))
+    before = cb.buffer
+    cb.append(jnp.zeros((0,)))
+    assert cb.count == 1 and cb.buffer is before
+
+
+def test_ragged_trailing_raises_layout_error():
+    cb = CatBuffer.allocate(jnp.zeros((2, 3)))
+    with pytest.raises(CatLayoutError):
+        cb.append(jnp.zeros((2, 4)))
+    with pytest.raises(CatLayoutError):
+        CatBuffer.from_increments([jnp.zeros((1, 3)), jnp.zeros((1, 4))])
+
+
+def test_dtype_widening_promotes_buffer():
+    cb = CatBuffer.allocate(jnp.asarray([1, 2], dtype=jnp.int32))
+    cb.append(jnp.asarray([0.5], dtype=jnp.float32))
+    assert cb.dtype == jnp.promote_types(jnp.int32, jnp.float32)
+    np.testing.assert_array_equal(np.asarray(cb.materialize()), [1.0, 2.0, 0.5])
+
+
+def test_snapshot_is_copy_on_write_under_donation():
+    cb = CatBuffer.allocate(jnp.arange(4.0))
+    snap = cb.snapshot()
+    for _ in range(3):  # donating in-place appends must not clobber the snapshot
+        cb.append(jnp.ones(2))
+    np.testing.assert_array_equal(np.asarray(snap.materialize()), np.arange(4.0))
+    assert cb.count == 10
+
+
+def test_pickle_and_deepcopy_roundtrip():
+    cb = CatBuffer.allocate(jnp.arange(5.0))
+    cb2 = pickle.loads(pickle.dumps(cb))
+    assert cb2 == cb and cb2.capacity == _capacity_for(cb.count)
+    cb3 = copy.deepcopy(cb)
+    assert cb3 == cb
+    cb3.append(jnp.zeros(1))  # independent after CoW
+    assert cb3 != cb and cb.count == 5
+
+
+def test_equality_against_increment_lists():
+    cb = CatBuffer.allocate(jnp.asarray([1.0, 2.0]))
+    cb.append(jnp.asarray([3.0]))
+    assert cb == [jnp.asarray([1.0, 2.0]), jnp.asarray([3.0])]
+    assert cb == [jnp.asarray([1.0, 2.0, 3.0])]  # grouping-agnostic
+    assert cb != [jnp.asarray([1.0, 2.0])]
+    assert CatBuffer.allocate(jnp.zeros(1)).snapshot().materialize().shape == (1,)
+
+
+def test_dim_zero_cat_and_padded_cat_mask_the_tail():
+    cb = CatBuffer.allocate(jnp.asarray([1.0, 2.0, 3.0]))
+    assert cb.capacity > cb.count  # a garbage tail exists
+    values, n = padded_cat(cb)
+    assert n == 3 and values.shape == (3,)
+    np.testing.assert_array_equal(np.asarray(dim_zero_cat(cb)), [1.0, 2.0, 3.0])
+
+
+# ---------------------------------------------------------------------------
+# padded vs list layout: bitwise equivalence
+# ---------------------------------------------------------------------------
+
+
+def _drive_pair(make, feed, n_updates=6, seed=11):
+    pair = {}
+    for layout in ("padded", "list"):
+        rng = np.random.RandomState(seed)
+        m = make(layout)
+        for _ in range(n_updates):
+            feed(m, rng)
+        pair[layout] = m
+    return pair["padded"], pair["list"]
+
+
+def _feed_binary(m, rng):
+    n = int(rng.randint(1, 9))
+    m.update(
+        jnp.asarray(rng.rand(n).astype(np.float32)),
+        jnp.asarray((rng.rand(n) > 0.5).astype(np.int32)),
+    )
+
+
+def _feed_cat(m, rng):
+    m.update(jnp.asarray(rng.rand(int(rng.randint(1, 9))).astype(np.float32)))
+
+
+def _feed_spearman(m, rng):
+    n = int(rng.randint(2, 9))
+    m.update(jnp.asarray(rng.rand(n).astype(np.float32)), jnp.asarray(rng.rand(n).astype(np.float32)))
+
+
+def _feed_retrieval(m, rng):
+    n = int(rng.randint(2, 9))
+    m.update(
+        jnp.asarray(rng.rand(n).astype(np.float32)),
+        jnp.asarray((rng.rand(n) > 0.5).astype(np.int32)),
+        jnp.asarray(rng.randint(0, 3, size=n).astype(np.int32)),
+    )
+
+
+_FAMILIES = [
+    (lambda layout: BinaryPrecisionRecallCurve(thresholds=None, list_layout=layout), _feed_binary),
+    (lambda layout: BinaryAUROC(thresholds=None, list_layout=layout), _feed_binary),
+    (lambda layout: CatMetric(list_layout=layout), _feed_cat),
+    (lambda layout: SpearmanCorrCoef(list_layout=layout), _feed_spearman),
+    (lambda layout: RetrievalMRR(list_layout=layout), _feed_retrieval),
+]
+
+
+@pytest.mark.parametrize("make,feed", _FAMILIES, ids=["prc", "auroc", "cat", "spearman", "retrieval"])
+def test_padded_matches_list_layout_bitwise(make, feed):
+    mp, ml = _drive_pair(make, feed)
+    _assert_bitwise(mp.compute(), ml.compute(), ctx=type(mp).__name__)
+    # reset + a fresh round must also agree (learned dtype/meta survives reset)
+    rng_p, rng_l = np.random.RandomState(3), np.random.RandomState(3)
+    mp.reset(), ml.reset()
+    feed(mp, rng_p), feed(ml, rng_l)
+    _assert_bitwise(mp.compute(), ml.compute(), ctx=type(mp).__name__ + " after reset")
+
+
+@pytest.mark.parametrize("make,feed", _FAMILIES, ids=["prc", "auroc", "cat", "spearman", "retrieval"])
+@pytest.mark.parametrize("policy", [None, SyncPolicy(exact=True)], ids=["default", "exact"])
+def test_padded_matches_list_layout_after_sync(make, feed, policy):
+    world = 3
+
+    def build(layout):
+        rng = np.random.RandomState(21)
+        ms = [make(layout) for _ in range(world)]
+        for m in ms:
+            for _ in range(3):
+                feed(m, rng)
+        group = [m.metric_state for m in ms]
+        for r, m in enumerate(ms):
+            m._sync_backend = FakeSync(group, r)
+        return ms
+
+    ctx = use_policy(policy) if policy is not None else contextlib.nullcontext()
+    with ctx:
+        for mp, ml in zip(build("padded"), build("list")):
+            _assert_bitwise(mp.compute(), ml.compute(), ctx=type(mp).__name__ + " synced")
+
+
+def test_rank_without_updates_participates_in_padded_sync():
+    # rank 1 never updates: its state is still a plain [] under lazy
+    # conversion, but the layout-config-driven sync branch must gather it
+    m0, m1 = CatMetric(), CatMetric()
+    m0.update(jnp.asarray([1.0, 2.0]))
+    group = [m0.metric_state, m1.metric_state]
+    m0._sync_backend = FakeSync(group, 0)
+    m1._sync_backend = FakeSync(group, 1)
+    np.testing.assert_array_equal(np.asarray(m0.compute()), [1.0, 2.0])
+    np.testing.assert_array_equal(np.asarray(m1.compute()), [1.0, 2.0])
+
+
+# ---------------------------------------------------------------------------
+# in-graph gather route: valid-count masking
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("gather", ["psum", "all_gather"])
+def test_in_graph_padded_gather_masks_invalid_tail(gather):
+    world, valid, cap = 4, 3, MIN_CAPACITY
+    bufs = np.full((world, cap), -1.0, np.float32)
+    for r in range(world):
+        bufs[r, :valid] = np.arange(valid) + 10.0 * r  # tail stays garbage (-1)
+
+    def f(buf):
+        state = {"vals": CatBuffer(buf, valid)}
+        out = reduce_state_in_graph(state, {"vals": Reduction.CAT}, "dp")
+        return out["vals"]
+
+    with use_policy(SyncPolicy(gather=gather)):
+        got = jax.vmap(f, axis_name="dp")(jnp.asarray(bufs))
+    expect = np.concatenate([bufs[r, :valid] for r in range(world)])
+    assert got.shape == (world, world * valid)
+    for r in range(world):  # every rank sees all valid rows, no -1 garbage
+        np.testing.assert_array_equal(np.asarray(got[r]), expect)
+
+
+# ---------------------------------------------------------------------------
+# executable budget + donation safety
+# ---------------------------------------------------------------------------
+
+
+def test_thousand_updates_stay_within_log_executable_budget():
+    n_updates, batch = 1000, 8
+    m = BinaryPrecisionRecallCurve(thresholds=None)
+    rng = np.random.RandomState(5)
+    before = executable_cache_stats()
+    for _ in range(n_updates):
+        m.update(
+            jnp.asarray(rng.rand(batch).astype(np.float32)),
+            jnp.asarray((rng.rand(batch) > 0.5).astype(np.int32)),
+        )
+    after = executable_cache_stats()
+    rows = n_updates * batch
+    # O(log n) distinct shapes: per (state, kernel-kind) pair one executable
+    # per power-of-two capacity — 2 states x {append, grow} x ceil(log2 rows)
+    # plus a constant for the update dispatch itself
+    budget = 4 * math.ceil(math.log2(rows)) + 8
+    new_execs = after["size"] - before["size"]
+    assert new_execs <= budget, (new_execs, budget)
+    assert after["retraces"] == before["retraces"], "appends must never retrace"
+
+
+class _JitCat(Metric):
+    """Minimal jit-path cat metric (CatMetric's nan filter is eager-only)."""
+
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("vals", [], dist_reduce_fx="cat")
+
+    def update(self, x):
+        self.vals.append(x)
+
+    def compute(self):
+        return dim_zero_cat(self.vals)
+
+
+def test_steady_state_appends_are_donation_safe_under_strict_mode():
+    m = _JitCat()
+    warm = jnp.asarray(np.arange(8.0, dtype=np.float32))
+    for _ in range(130):  # warm past the 1024-capacity boundary (1040 rows)
+        m.update(warm)
+    # 120 more appends stay under capacity 2048: zero compiles, zero
+    # retraces, zero host<->device transfers, donated in-place writes only
+    with strict_mode(max_retraces=0, max_new_executables=0):
+        for _ in range(120):
+            m.update(warm)
+    out = np.asarray(m.compute())
+    np.testing.assert_array_equal(out, np.tile(np.arange(8.0), 250))
+
+
+def test_forward_snapshot_survives_donating_appends():
+    # forward() caches a snapshot for the batch-value restore; the donated
+    # in-place append must not clobber it (copy-on-write)
+    m = CatMetric()
+    m.update(jnp.asarray([1.0, 2.0]))
+    batch_val = m(jnp.asarray([3.0]))  # forward: global + batch-only compute
+    np.testing.assert_array_equal(np.asarray(batch_val), [3.0])
+    np.testing.assert_array_equal(np.asarray(m.compute()), [1.0, 2.0, 3.0])
+
+
+# ---------------------------------------------------------------------------
+# incremental hash digest
+# ---------------------------------------------------------------------------
+
+
+def test_hash_cost_does_not_scale_with_update_count():
+    m = CatMetric()
+    inc = jnp.asarray(np.arange(16.0, dtype=np.float32))
+    for _ in range(50):
+        m.update(inc)
+    _HASH_STATS["bytes_hashed"] = 0
+    h1 = hash(m)
+    first = _HASH_STATS["bytes_hashed"]
+    assert first >= 50 * 16 * 4  # the initial digest covers the whole state
+    h2 = hash(m)
+    assert h2 == h1
+    assert _HASH_STATS["bytes_hashed"] == first, "second hash must feed 0 new bytes"
+    m.update(inc)
+    hash(m)
+    delta = _HASH_STATS["bytes_hashed"] - first
+    assert delta <= 2 * inc.size * 4, "re-hash after one append must only feed the new rows"
+
+
+def test_hash_invalidates_on_reset():
+    m = CatMetric()
+    m.update(jnp.asarray([1.0]))
+    h1 = hash(m)
+    m.reset()
+    m2 = CatMetric()
+    assert hash(m) == hash(m2)
+    m.update(jnp.asarray([2.0]))
+    assert hash(m) != h1
+
+
+# ---------------------------------------------------------------------------
+# _precat empty-state dtype fix
+# ---------------------------------------------------------------------------
+
+
+def test_empty_cat_state_keeps_declared_integer_dtype():
+    m = RetrievalMRR()
+    assert m._precat("indexes").dtype == jnp.int32  # declared, never updated
+    m.update(jnp.asarray([0.2, 0.9]), jnp.asarray([0, 1]), jnp.asarray([0, 0]))
+    m.reset()
+    # after reset the state is empty again — the declared dtype must survive
+    assert m._precat("indexes").dtype == jnp.int32
+    assert m._precat("preds").dtype == jnp.float32
+
+
+def test_empty_cat_state_learns_dtype_from_increments():
+    m = _JitCat()
+    m.update(jnp.asarray([1, 2], dtype=jnp.int32))
+    m.reset()
+    assert m._precat("vals").dtype == jnp.int32  # learned from the increments

@@ -64,7 +64,8 @@ def test_cat_state(jit):
     m.update(jnp.ones(3))
     m.update(jnp.arange(3.0))
     assert float(m.compute()) == 6.0
-    assert len(m.vals) == 2
+    # padded layout: len() counts valid rows (3 + 3), not increments
+    assert len(m.vals) == 6
 
 
 def test_forward_cat_state():
@@ -137,7 +138,9 @@ def test_fake_sync_sum_and_cat():
         sums[r].update((r + 1) * jnp.ones(2))
         cats[r].update((r + 1) * jnp.ones(2))
     group_s = [m.metric_state for m in sums]
-    group_c = [{k: jnp.concatenate(v) for k, v in m.metric_state.items()} for m in cats]
+    # padded layout: the backend masks each peer's valid prefix itself, so
+    # the group can hold the raw CatBuffer states
+    group_c = [m.metric_state for m in cats]
     for r in range(world):
         sums[r].sync(sync_backend=FakeSync(group_s, r))
         assert float(sums[r].total) == 2.0 * (1 + 2 + 3)

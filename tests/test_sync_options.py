@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from torchmetrics_tpu import CatMetric, MeanMetric, MeanSquaredError, SumMetric
 from torchmetrics_tpu.aggregation import MaxMetric
 from torchmetrics_tpu.parallel.sync import FakeSync
+from torchmetrics_tpu.utils.data import dim_zero_cat
 
 
 def _group(metrics):
@@ -296,8 +297,9 @@ def test_failed_sync_leaves_local_state_intact(monkeypatch):
             m.sync()
         assert not m._is_synced
         assert m._cache is None
-        # local state is untouched and still usable
-        np.testing.assert_array_equal(np.asarray(jnp.concatenate(m.metric_state["value"])), [1.0, 2.0])
+        # local state is untouched and still usable (dim_zero_cat masks the
+        # padded buffer to its valid prefix)
+        np.testing.assert_array_equal(np.asarray(dim_zero_cat(m.metric_state["value"])), [1.0, 2.0])
         m.update(jnp.asarray([3.0]))
         m._sync_backend = None  # back to NoSync
         np.testing.assert_array_equal(np.asarray(m.compute()), [1.0, 2.0, 3.0])

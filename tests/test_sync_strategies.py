@@ -595,7 +595,8 @@ def test_overlap_issues_gathers_before_barrier():
     m0, m1, h0, h1 = _overlap_pair(window=2)
     _drive(h0, h1, steps=4, seed=72)
     # two full windows flushed; the second flush gathered window 1's rows
-    assert h0.__dict__["_ov_synced_idx"].get("vals", 0) == 2
+    # (padded layout: the index counts buffer ROWS — 2 steps x 3 rows)
+    assert h0.__dict__["_ov_synced_idx"].get("vals", 0) == 6
     assert sum(p.shape[0] for p in h0.__dict__["_ov_gathered"]["vals"]) == 2 * 2 * 3
     h1.flush()
     h0.sync()

@@ -506,3 +506,53 @@ def test_cli_exits_one_on_violation(tmp_path):
     )
     assert proc.returncode == 1, proc.stdout + proc.stderr
     assert "TPU001" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# TPU008 — list-state concat in a traced path
+# ---------------------------------------------------------------------------
+
+
+def test_tpu008_concat_over_state_flagged(tmp_path):
+    res = _lint_fixture(tmp_path, sync_src="""
+        import jax.numpy as jnp
+
+        def reduce_state_in_graph(state, reductions, axis_name):
+            out = {}
+            for name in state:
+                out[name] = jnp.concatenate(state[name], axis=0)
+            return out
+    """, root_kinds=("update", "kernel", "sync"))
+    assert "TPU008" in _rules(res)
+
+
+def test_tpu008_dim_zero_cat_over_state_flagged(tmp_path):
+    res = _lint_fixture(tmp_path, kernel_src="""
+        from torchmetrics_tpu.utils.data import dim_zero_cat
+
+        def _foo_update(state, preds):
+            return dim_zero_cat(state["preds"]) + preds
+    """)
+    assert "TPU008" in _rules(res)
+
+
+def test_tpu008_concat_of_locals_passes(tmp_path):
+    res = _lint_fixture(tmp_path, kernel_src="""
+        import jax.numpy as jnp
+
+        def _foo_update(preds, target):
+            parts = [preds, target]
+            return jnp.concatenate(parts, axis=0)
+    """)
+    assert "TPU008" not in _rules(res)
+
+
+def test_tpu008_masked_buffer_read_passes(tmp_path):
+    res = _lint_fixture(tmp_path, sync_src="""
+        def reduce_state_in_graph(state, counts, axis_name):
+            out = {}
+            for name in state:
+                out[name] = state[name][: counts[name]]
+            return out
+    """, root_kinds=("update", "kernel", "sync"))
+    assert "TPU008" not in _rules(res)

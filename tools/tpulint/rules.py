@@ -16,6 +16,7 @@ directly; TPU005 scans all functions (donation misuse is an eager-layer bug).
 | TPU005 | no use of a buffer after donating it to a jitted call             |
 | TPU006 | TPU dtype hygiene: no implicit/explicit float64                   |
 | TPU007 | no per-leaf collective inside a Python loop over state dicts      |
+| TPU008 | no list-state concat in a traced path (use the padded layout)     |
 """
 from __future__ import annotations
 
@@ -33,7 +34,7 @@ from .callgraph import (
 )
 from .corpus import ClassInfo, Corpus, FunctionInfo
 
-ALL_RULES = ("TPU000", "TPU001", "TPU002", "TPU003", "TPU004", "TPU005", "TPU006", "TPU007")
+ALL_RULES = ("TPU000", "TPU001", "TPU002", "TPU003", "TPU004", "TPU005", "TPU006", "TPU007", "TPU008")
 
 RULE_TITLES = {
     "TPU000": "malformed waiver",
@@ -44,6 +45,7 @@ RULE_TITLES = {
     "TPU005": "use after donation",
     "TPU006": "TPU dtype hygiene (float64)",
     "TPU007": "per-leaf collective in a loop over states",
+    "TPU008": "list-state concat in a traced path",
 }
 
 
@@ -201,6 +203,19 @@ def check_traced_rules(fn: FunctionInfo, corpus: Corpus, roots: Set[str]) -> Lis
         if isinstance(node, ast.Assert) and _test_depends_on_array(node.test, ctx):
             emit("TPU003", node, "`assert` on an array value concretizes the tracer")
 
+        # ---- TPU008: list-state concat in a traced path --------------
+        if isinstance(node, ast.Call):
+            cat = _cat_call_name(node, ctx.imports)
+            if cat and any(_mentions_state_name(a) for a in node.args):
+                emit(
+                    "TPU008", node,
+                    f"`{cat}` over a raw list state in a jit-reachable path: the"
+                    " executable specializes on the running increment count"
+                    " (O(n) retraces across a run) — store the state as a padded"
+                    " CatBuffer and read its masked valid prefix"
+                    " (dim_zero_cat/padded_cat on the buffer, see buffers.py)",
+                )
+
         # ---- TPU007: per-leaf collective in a loop over states -------
         if isinstance(node, ast.For) and _mentions_state_name(node.iter):
             for stmt in node.body:
@@ -227,6 +242,21 @@ def _mentions_state_name(expr: ast.expr) -> bool:
         if isinstance(sub, ast.Attribute) and "state" in sub.attr.lower():
             return True
     return False
+
+
+def _cat_call_name(call: ast.Call, imports: Dict[str, str]) -> str:
+    """'' unless the call concatenates a list of increments: jnp/np
+    ``concatenate``/``stack``/``hstack`` or the ``dim_zero_cat`` helper."""
+    f = call.func
+    if not isinstance(f, (ast.Attribute, ast.Name)):
+        return ""
+    dotted = _alias_targets(imports, f)
+    last = dotted.split(".")[-1]
+    if dotted.startswith(("jax.numpy.", "numpy.")) and last in ("concatenate", "stack", "hstack"):
+        return _dotted_name(f) or last
+    if last == "dim_zero_cat":
+        return "dim_zero_cat"
+    return ""
 
 
 def _collective_name(call: ast.Call, imports: Dict[str, str]) -> str:

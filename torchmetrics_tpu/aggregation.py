@@ -244,6 +244,10 @@ class RunningMean(BaseAggregator):
     jittable = False
 
     def __init__(self, window: int = 5, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        # window cropping pops whole per-update increments: len()/pop(0)
+        # count increments, not rows, so this state needs the list layout
+        # (the padded CatBuffer only supports appends + masked reads)
+        kwargs.setdefault("list_layout", "list")
         super().__init__("cat", [], nan_strategy, **kwargs)
         if not (isinstance(window, int) and window > 0):
             raise ValueError(f"Arg `window` should be a positive integer but got {window}")

@@ -1,0 +1,287 @@
+"""Padded geometric cat-state buffers.
+
+List/``cat`` states historically stored one device array per ``update`` and
+re-concatenated the whole list at compute/sync time — every jitted consumer
+specialized on the running total length (O(n) retraces across an n-step run)
+and every observation copied O(n) elements. ``CatBuffer`` replaces the list
+with a ``(buffer, count)`` pair: ``buffer`` has power-of-two row capacity
+(doubling on overflow, so only O(log n) distinct shapes ever exist) and
+appends are in-place ``lax.dynamic_update_slice`` writes into a donated
+buffer — O(1) amortized. The valid prefix is ``buffer[:count]``; rows at or
+past ``count`` are garbage and must be masked by every reader.
+
+Append/grow kernels go through the process-global executable cache
+(``metric._global_jit``), so the number of cat-path executables for an
+n-append run is O(log n) (one per capacity) and steady-state appends are
+pure cache hits. ``count`` rides into the kernels as a weak-typed ``int32``
+scalar, so it never causes a retrace.
+
+Snapshots are copy-on-write: ``snapshot()`` aliases the device buffer and
+marks both sides unowned; the next append first copies, so a cached snapshot
+(``Metric._cache``, forward full-state restore) is never clobbered by buffer
+donation.
+"""
+from typing import Any, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+Array = jax.Array
+
+MIN_CAPACITY = 8
+
+
+class CatLayoutError(TypeError):
+    """An increment is incompatible with the padded buffer's row layout.
+
+    Raised when the trailing (non-concatenated) dimensions of an increment
+    differ from the buffer's; the owning metric degrades that state to the
+    list layout, which tolerates ragged increments until concat time.
+    """
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(n - 1, 1).bit_length() if n > 1 else 1
+
+
+def _capacity_for(rows: int) -> int:
+    return max(_next_pow2(rows), MIN_CAPACITY)
+
+
+def _row_form(inc: Any) -> Array:
+    """Increment as (rows,) + trailing — scalars become a single row,
+    matching ``dim_zero_cat``'s ``atleast_1d`` semantics."""
+    arr = inc if isinstance(inc, jax.Array) else jnp.asarray(inc)
+    return arr[None] if arr.ndim == 0 else arr
+
+
+def _jit(key: Any, fn: Any, donate: bool = False) -> Any:
+    from .metric import _global_jit  # deferred: metric.py imports this module
+
+    return _global_jit(key, fn, donate_state=donate)
+
+
+def _append_kernel(buf: Array, inc: Array, count: Array) -> Tuple[Array, Array]:
+    """(new_buf, new_count). ``count`` rides as a DEVICE scalar and the
+    increment is folded in on-device, so a steady-state append issues zero
+    host→device transfers (strict_mode transfer_guard clean)."""
+    start = (count,) + (0,) * (buf.ndim - 1)
+    return lax.dynamic_update_slice(buf, inc, start), count + inc.shape[0]
+
+
+def _make_grow_append(new_capacity: int) -> Any:
+    def grow_append(buf: Array, inc: Array, count: Array) -> Tuple[Array, Array]:
+        pad = jnp.zeros((new_capacity - buf.shape[0],) + buf.shape[1:], buf.dtype)
+        grown = jnp.concatenate([buf, pad], axis=0)
+        return _append_kernel(grown, inc, count)
+
+    return grow_append
+
+
+class CatBuffer:
+    """Growable padded cat state: ``(buffer, count)`` with pow2 capacity.
+
+    Mutation rebinds ``buffer``/``count`` on the *same* object, so aliases
+    held by compute groups and the incremental hash cache stay current.
+    Equality compares the valid prefix (a list/tuple compares as its
+    concatenation); hashing is by identity, as for lists.
+    """
+
+    __slots__ = ("buffer", "count", "_count_dev", "_owns")
+
+    def __init__(self, buffer: Array, count: int, owns: bool = True) -> None:
+        self.buffer = buffer
+        self.count = int(count)
+        # device mirror of `count`, fed to the append kernels so steady-state
+        # appends never transfer a host scalar; created lazily on first append
+        self._count_dev: Optional[Array] = None
+        self._owns = owns
+
+    # ------------------------------------------------------------- creation
+
+    @classmethod
+    def allocate(cls, first_inc: Any) -> "CatBuffer":
+        inc = _row_form(first_inc)
+        cap = _capacity_for(inc.shape[0])
+        buf = cls(jnp.zeros((cap,) + inc.shape[1:], inc.dtype), 0)
+        buf.append(inc)
+        return buf
+
+    @classmethod
+    def from_increments(cls, increments: Sequence[Any]) -> "CatBuffer":
+        rows = [_row_form(e) for e in increments]
+        trailings = {r.shape[1:] for r in rows}
+        if len(trailings) > 1:
+            raise CatLayoutError(f"ragged increment trailing shapes {sorted(trailings)}")
+        return cls.allocate(rows[0] if len(rows) == 1 else jnp.concatenate(rows, axis=0))
+
+    # ------------------------------------------------------------ properties
+
+    @property
+    def capacity(self) -> int:
+        return self.buffer.shape[0]
+
+    @property
+    def dtype(self) -> Any:
+        return self.buffer.dtype
+
+    @property
+    def trailing(self) -> Tuple[int, ...]:
+        return self.buffer.shape[1:]
+
+    # -------------------------------------------------------------- mutation
+
+    def append(self, inc: Any) -> None:
+        """In-place append of one increment (O(1) amortized device writes)."""
+        inc = _row_form(inc)
+        if inc.shape[1:] != self.trailing:
+            raise CatLayoutError(
+                f"increment trailing shape {inc.shape[1:]} != buffer trailing {self.trailing}"
+            )
+        if inc.dtype != self.dtype:
+            promoted = jnp.promote_types(self.dtype, inc.dtype)
+            if promoted != self.dtype:
+                # rare dtype widening: eager cast of the whole buffer
+                self.buffer = self.buffer.astype(promoted)
+                self._owns = True
+            if promoted != inc.dtype:
+                inc = inc.astype(promoted)
+        rows = inc.shape[0]
+        if rows == 0:
+            return
+        needed = self.count + rows
+        count = self._count_dev
+        if count is None:
+            count = jnp.asarray(self.count, jnp.int32)
+        if needed > self.capacity:
+            new_cap = _capacity_for(needed)
+            # no donation: the old capacity can't back the larger output
+            # buffer anyway, and XLA warns on unusable donations
+            fn = _jit(
+                ("catbuf_grow_append", self.capacity, new_cap, inc.shape, str(inc.dtype)),
+                _make_grow_append(new_cap),
+            )
+            self.buffer, self._count_dev = fn(self.buffer, inc, count)
+        else:
+            if not self._owns:
+                # copy-on-write: a snapshot aliases this buffer, so the
+                # donating append must not clobber it
+                self.buffer = jnp.array(self.buffer, copy=True)
+            fn = _jit(
+                ("catbuf_append", self.capacity, inc.shape, str(inc.dtype)),
+                _append_kernel,
+                donate=True,
+            )
+            self.buffer, self._count_dev = fn(self.buffer, inc, count)
+        self._owns = True
+        self.count = needed
+
+    def extend(self, increments: Iterable[Any]) -> None:
+        for inc in increments:
+            self.append(inc)
+
+    # --------------------------------------------------------------- reading
+
+    def materialize(self) -> Array:
+        """Masked valid slice ``buffer[:count]`` (never the raw buffer)."""
+        return self.buffer[: self.count]
+
+    def rows(self, start: int, stop: int) -> Array:
+        return self.buffer[start:stop]
+
+    def snapshot(self) -> "CatBuffer":
+        """Cheap O(1) copy sharing the device buffer; the next append on
+        either side copies first (copy-on-write)."""
+        self._owns = False
+        out = CatBuffer(self.buffer, self.count, owns=False)
+        out._count_dev = self._count_dev  # device scalars are immutable
+        return out
+
+    def astype(self, dtype: Any) -> "CatBuffer":
+        return CatBuffer(self.buffer.astype(dtype), self.count)
+
+    def to_device(self, device: Any) -> "CatBuffer":
+        return CatBuffer(jax.device_put(self.buffer, device), self.count)
+
+    # ------------------------------------------------------------- protocols
+
+    def __len__(self) -> int:
+        return self.count
+
+    def __iter__(self) -> Iterator[Array]:
+        for i in range(self.count):
+            yield self.buffer[i]
+
+    def __eq__(self, other: Any) -> Any:
+        if other is self:
+            return True
+        if isinstance(other, CatBuffer):
+            if self.count != other.count or self.trailing != other.trailing:
+                return False
+            if self.count == 0:
+                return True
+            return bool(jnp.all(self.materialize() == other.materialize()))
+        if isinstance(other, (list, tuple)):
+            if len(other) == 0:
+                return self.count == 0
+            try:
+                cat = jnp.concatenate([_row_form(e) for e in other], axis=0)
+            except Exception:
+                return NotImplemented
+            if cat.shape != (self.count,) + self.trailing:
+                return False
+            return bool(jnp.all(self.materialize() == cat))
+        return NotImplemented
+
+    __hash__ = object.__hash__
+
+    def __repr__(self) -> str:
+        return (
+            f"CatBuffer(count={self.count}, capacity={self.capacity}, "
+            f"trailing={self.trailing}, dtype={self.dtype})"
+        )
+
+    # ------------------------------------------------- pickle / deepcopy
+
+    def __getstate__(self) -> Tuple[Any, int]:
+        return np.asarray(self.materialize()), self.count
+
+    def __setstate__(self, state: Tuple[Any, int]) -> None:
+        valid, count = state
+        cap = _capacity_for(max(count, 1))
+        arr = np.zeros((cap,) + valid.shape[1:], valid.dtype)
+        arr[:count] = valid
+        self.buffer = jnp.asarray(arr)
+        self.count = int(count)
+        self._count_dev = None
+        self._owns = True
+
+    def __deepcopy__(self, memo: dict) -> "CatBuffer":
+        # device arrays are immutable; an owned alias is a faithful deep copy
+        new = CatBuffer(self.buffer, self.count, owns=True)
+        new._count_dev = self._count_dev
+        self._owns = False
+        new._owns = False
+        memo[id(self)] = new
+        return new
+
+
+def cat_rows(value: Any, template: Optional[Array] = None) -> Array:
+    """Concatenated valid rows of a cat state in any layout.
+
+    Accepts a ``CatBuffer`` (masked slice), a list/tuple of increments, or an
+    already-concatenated array. An empty list yields a 0-row array shaped
+    like ``template`` (or ``(0,)`` float32 without one).
+    """
+    if isinstance(value, CatBuffer):
+        return value.materialize()
+    if isinstance(value, (list, tuple)):
+        if not value:
+            if template is not None:
+                return jnp.zeros((0,) + template.shape[1:], template.dtype)
+            return jnp.zeros((0,), jnp.float32)
+        return jnp.concatenate([_row_form(e) for e in value], axis=0)
+    arr = jnp.asarray(value)
+    return arr[None] if arr.ndim == 0 else arr

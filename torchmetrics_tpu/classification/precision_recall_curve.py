@@ -25,7 +25,7 @@ from ..functional.classification.precision_recall_curve import (
     _multilabel_precision_recall_curve_update,
 )
 from ..metric import Metric
-from ..utils.data import dim_zero_cat
+from ..utils.data import dim_zero_cat, padded_cat
 from ..utils.enums import ClassificationTask
 from .base import _ClassificationTaskWrapper
 
@@ -77,8 +77,10 @@ class BinaryPrecisionRecallCurve(Metric):
             self.confmat = self.confmat + _binary_precision_recall_curve_update(p, t, self.thresholds, mask)
 
     def _exact_state(self) -> Tuple[Array, Array]:
-        preds = dim_zero_cat(self.preds)
-        target = dim_zero_cat(self.target)
+        # padded layout: the state is a (buffer, count) pair; padded_cat
+        # slices off the invalid tail before the exact-length kernel sees it
+        preds, _ = padded_cat(self.preds)
+        target, _ = padded_cat(self.target)
         if self.ignore_index is not None:
             # astype(bool): sync transports may return the mask as 0/1 ints,
             # and integer `preds[keep]` would gather rows instead of masking
@@ -140,8 +142,8 @@ class MulticlassPrecisionRecallCurve(Metric):
             )
 
     def _exact_state(self) -> Tuple[Array, Array]:
-        preds = dim_zero_cat(self.preds)
-        target = dim_zero_cat(self.target)
+        preds, _ = padded_cat(self.preds)
+        target, _ = padded_cat(self.target)
         if self.ignore_index is not None:
             keep = dim_zero_cat(self.valid).astype(bool)
             preds, target = preds[keep], target[keep]
@@ -193,7 +195,7 @@ class MultilabelPrecisionRecallCurve(Metric):
             )
 
     def _exact_state(self) -> Tuple[Array, Array]:
-        return dim_zero_cat(self.preds), dim_zero_cat(self.target)
+        return padded_cat(self.preds)[0], padded_cat(self.target)[0]
 
     def compute(self):
         if self.thresholds is None:

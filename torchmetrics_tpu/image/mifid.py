@@ -48,7 +48,7 @@ class MemorizationInformedFrechetInceptionDistance(Metric):
         >>> mifid.update(real, real=True)
         >>> mifid.update(fake, real=False)
         >>> round(float(mifid.compute()), 4)
-        2069.8726
+        2072.2327
     """
 
     higher_is_better = False

@@ -34,6 +34,7 @@ from __future__ import annotations
 import copy
 import enum
 import functools
+import hashlib
 import inspect
 import itertools
 from contextlib import contextmanager
@@ -43,6 +44,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .buffers import CatBuffer, CatLayoutError
 from .parallel.reduction import ELEMENTWISE_REDUCTIONS, Reduction, resolve_reduction
 from .parallel.strategies import (
     SyncPolicy,
@@ -129,6 +131,10 @@ _INSTANCE_KEY_COUNTER = itertools.count()
 
 _MAX_KEY_ARRAY_BYTES = 4096
 
+# bytes fed through hashing in Metric.__hash__ — the incremental-digest
+# regression test asserts re-hashing an unchanged metric feeds zero bytes
+_HASH_STATS = {"bytes_hashed": 0}
+
 # attributes that never change the traced program (pure host-side bookkeeping)
 _RUNTIME_ATTRS = frozenset(
     {
@@ -145,6 +151,10 @@ _RUNTIME_ATTRS = frozenset(
         "_sync_backend",
         "_sync_policy",
         "_sync_residuals",
+        "_list_layout",
+        "_cat_meta",
+        "_layout_fallback",
+        "_hash_digests",
         "_jit_bound",
         "_exec_key_cache",
         "_exec_nonce",
@@ -294,6 +304,12 @@ class Metric:
             reduce-scatter decomposition, opt-in quantized collectives);
             ``None`` uses the process default — exact, full precision.
         jit: trace update/forward with ``jax.jit`` (per input-shape cache).
+        list_layout: storage for ``cat`` list states — ``"padded"`` (default)
+            accumulates increments in a power-of-two :class:`CatBuffer` via
+            in-place donated ``dynamic_update_slice`` writes (O(1) amortized,
+            O(log n) executables); ``"list"`` keeps the legacy
+            one-array-per-update Python list (the equivalence oracle,
+            bitwise-identical results).
 
     Example (defining a custom metric):
         >>> import jax.numpy as jnp
@@ -359,16 +375,23 @@ class Metric:
         sync_backend: Optional[SyncBackend] = None,
         sync_policy: Optional[SyncPolicy] = None,
         jit: bool = True,
+        list_layout: str = "padded",
         **kwargs: Any,
     ) -> None:
         if kwargs:
             raise ValueError(f"Unexpected keyword arguments: {sorted(kwargs)}")
+        if list_layout not in ("padded", "list"):
+            raise ValueError(f"list_layout must be 'padded' or 'list', got {list_layout!r}")
         # bypass __setattr__ guards during bootstrap
         object.__setattr__(self, "_defaults", {})
         object.__setattr__(self, "_state", {})
         self._reductions: Dict[str, Union[Reduction, Callable]] = {}
         self._persistent: Dict[str, bool] = {}
         self._list_states: set = set()
+        self._list_layout = list_layout
+        self._cat_meta: Dict[str, tuple] = {}  # name -> (np.dtype | None, trailing | None)
+        self._layout_fallback: set = set()  # cat states degraded to the list layout
+        self._hash_digests: Dict[str, list] = {}  # name -> [state obj, covered, hasher]
 
         self.compute_on_cpu = compute_on_cpu
         self.dist_sync_on_step = dist_sync_on_step
@@ -407,11 +430,16 @@ class Metric:
         default: Union[Array, list, float, int],
         dist_reduce_fx: Union[str, Callable, None] = None,
         persistent: bool = False,
+        dtype: Any = None,
     ) -> None:
         """Register a state leaf. Parity: reference ``metric.py:195-272``.
 
         ``default`` must be an array (fixed-shape state) or an empty list
         (``cat`` list state whose increments concatenate along dim 0).
+        ``dtype`` declares a list state's element dtype up front, so an
+        empty state concatenates to a 0-length array of that dtype (e.g.
+        integer retrieval indexes) instead of the metric-wide float default;
+        it is also learned automatically from the first appended increment.
         """
         if not name.isidentifier():
             raise ValueError(f"state name must be a valid identifier, got {name!r}")
@@ -419,8 +447,12 @@ class Metric:
             if default:
                 raise ValueError("list state default must be an empty list")
             self._list_states.add(name)
+            if dtype is not None:
+                self._cat_meta[name] = (np.dtype(dtype), None)
             value: Any = []
         else:
+            if dtype is not None:
+                raise ValueError("dtype declaration is only supported for list states")
             value = jnp.asarray(default)
         red = resolve_reduction(dist_reduce_fx)
         self._defaults[name] = [] if name in self._list_states else value
@@ -490,6 +522,7 @@ class Metric:
         self._computed = None
         self._cache = None
         self._is_synced = False
+        self._hash_digests.clear()
         for name, default in self._defaults.items():
             if name in self._list_states:
                 self._state[name] = []
@@ -636,7 +669,10 @@ class Metric:
         new_tensors, appends = self._pure_update(tensors, args, kwargs)
         out = dict(new_tensors)
         for k in self._list_states:
-            out[k] = tuple(state.get(k, ())) + appends[k]
+            prev = state.get(k, ())
+            if isinstance(prev, CatBuffer):
+                prev = (prev.materialize(),) if len(prev) else ()
+            out[k] = tuple(prev) + appends[k]
         return out
 
     def update_state_batched(
@@ -701,7 +737,13 @@ class Metric:
     def compute_state(self, state: StateDict) -> Any:
         """Pure compute over an explicit state pytree."""
         tensors = {k: v for k, v in state.items() if k not in self._list_states}
-        lists = {k: tuple(state.get(k, ())) for k in self._list_states}
+        lists = {}
+        for k in self._list_states:
+            v = state.get(k, ())
+            if isinstance(v, CatBuffer):
+                lists[k] = (v.materialize(),) if len(v) else ()
+            else:
+                lists[k] = tuple(v)
         return _squeeze_if_scalar(self._pure_compute(tensors, lists))
 
     def reduce_state(
@@ -725,7 +767,11 @@ class Metric:
             if name in self._list_states:
                 merged_list: list = []
                 for v in vals:
-                    merged_list.extend(list(v))
+                    if isinstance(v, CatBuffer):
+                        if len(v):
+                            merged_list.append(v.materialize())
+                    else:
+                        merged_list.extend(list(v))
                 out[name] = tuple(merged_list)
                 continue
             if red == Reduction.CAT:
@@ -755,17 +801,102 @@ class Metric:
         return {k: v for k, v in self._state.items() if k not in self._list_states}
 
     def _snapshot_state(self) -> StateDict:
-        return {k: (list(v) if k in self._list_states else v) for k, v in self._state.items()}
+        out: StateDict = {}
+        for k, v in self._state.items():
+            if isinstance(v, CatBuffer):
+                out[k] = v.snapshot()  # O(1) copy-on-write alias
+            else:
+                out[k] = list(v) if k in self._list_states else v
+        return out
 
     def _restore_defaults(self) -> None:
         for name, default in self._defaults.items():
             self._state[name] = [] if name in self._list_states else default
 
+    # -- cat-state layout (padded CatBuffer vs legacy list) --------------
+    def _uses_padded(self, name: str) -> bool:
+        return (
+            self._list_layout == "padded"
+            and not self.compute_on_cpu
+            and name not in self._layout_fallback
+            and self._reductions.get(name) == Reduction.CAT
+        )
+
+    def _record_cat_meta(self, name: str, inc: Any) -> None:
+        arr = inc if isinstance(inc, (jax.Array, np.ndarray)) else jnp.asarray(inc)
+        self._cat_meta[name] = (np.dtype(arr.dtype), arr.shape[1:] if arr.ndim else ())
+
+    def _degrade_cat_state(self, name: str) -> list:
+        """Fall back to the list layout for one state (ragged increments)."""
+        self._layout_fallback.add(name)
+        value = self._state[name]
+        if isinstance(value, CatBuffer):
+            self._state[name] = [value.materialize()] if len(value) else []
+        return self._state[name]
+
+    def _append_cat_increment(self, name: str, inc: Any) -> None:
+        self._record_cat_meta(name, inc)
+        target = self._state[name]
+        if self._uses_padded(name):
+            try:
+                if isinstance(target, CatBuffer):
+                    target.append(inc)
+                    return
+                if isinstance(target, list):
+                    # lazy: the empty state stays a plain [] until the first
+                    # append; loaded legacy increments fold in on the fly
+                    buf = CatBuffer.from_increments(target) if target else CatBuffer.allocate(inc)
+                    if target:
+                        buf.append(inc)
+                    self._state[name] = buf
+                    return
+            except CatLayoutError:
+                target = self._degrade_cat_state(name)
+        target.append(np.asarray(inc) if self.compute_on_cpu else inc)
+
     def _extend_list_states(self, appends: Dict[str, tuple]) -> None:
         for k, vs in appends.items():
-            target = self._state[k]
             for v in vs:
-                target.append(np.asarray(v) if self.compute_on_cpu else v)
+                self._append_cat_increment(k, v)
+
+    def _adopt_padded_lists(self) -> None:
+        """Fold increments an eager (non-jit) update body appended onto a
+        plain list into the padded buffer. Under the padded layout any
+        non-empty plain list consists entirely of raw increments (earlier
+        appends already live in a CatBuffer), so whole-list conversion is
+        exact; ragged increments degrade the state to the list layout."""
+        for k in self._list_states:
+            v = self._state[k]
+            if isinstance(v, list) and v and self._uses_padded(k):
+                self._record_cat_meta(k, v[-1])
+                try:
+                    self._state[k] = CatBuffer.from_increments(v)
+                except CatLayoutError:
+                    self._layout_fallback.add(k)
+
+    def _extend_list_states_stacked(self, appends: Dict[str, tuple], valid: int) -> None:
+        """Extend list states from scanned ``(K, ...)`` append stacks.
+
+        The streaming flush scan stacks each per-step increment along a
+        leading steps axis; rows at or past ``valid`` are padding garbage.
+        Under the padded layout the whole window lands in the CatBuffer as
+        ONE fused device write (step-major row order, bitwise-identical to
+        per-step appends); the list layout keeps per-step increments.
+        """
+        for k, arrs in appends.items():
+            if not arrs or valid == 0:
+                continue
+            if self._uses_padded(k):
+                trailings = {a.shape[2:] if a.ndim >= 2 else () for a in arrs}
+                if len(trailings) == 1:
+                    trailing = next(iter(trailings))
+                    cols = [a[:valid, None] if a.ndim == 1 else a[:valid] for a in arrs]
+                    flat = cols[0] if len(cols) == 1 else jnp.concatenate(cols, axis=1)
+                    self._append_cat_increment(k, flat.reshape((-1,) + trailing))
+                    continue
+            for i in range(valid):
+                for a in arrs:
+                    self._append_cat_increment(k, a[i])
 
     def _to_array(self, value: Any) -> Any:
         if isinstance(value, (np.ndarray, list, float, int, bool)) and not isinstance(value, (str,)):
@@ -965,6 +1096,25 @@ class Metric:
             elif name not in self._list_states and isinstance(red, Reduction) and red in ELEMENTWISE_REDUCTIONS:
                 arr = jnp.asarray(self._state[name])
                 buckets.setdefault((red, str(arr.dtype)), []).append(name)
+            elif (
+                red == Reduction.CAT
+                and name in self._list_states
+                and self._uses_padded(name)
+                and hasattr(backend, "sync_cat_padded")
+            ):
+                # padded gather contract: ship the power-of-two buffer plus
+                # the valid count; the backend masks each shard's invalid
+                # tail. The branch is layout-config-driven (not value-driven)
+                # so every rank issues the same collective sequence even when
+                # some ranks saw no updates.
+                if addressed:
+                    backend.set_current(name)
+                value = self._state[name]
+                if isinstance(value, CatBuffer):
+                    synced[name] = backend.sync_cat_padded(value.buffer, value.count)
+                else:
+                    probe = self._precat(name)
+                    synced[name] = backend.sync_cat_padded(probe, probe.shape[0])
             else:
                 if addressed:
                     backend.set_current(name)
@@ -1010,8 +1160,19 @@ class Metric:
     def _precat(self, name: str) -> Array:
         value = self._state[name]
         if name in self._list_states:
-            return dim_zero_cat(value) if value else jnp.zeros((0,), dtype=self._dtype)
+            if isinstance(value, CatBuffer):
+                return value.materialize()
+            return dim_zero_cat(value) if value else self._empty_cat(name)
         return jnp.asarray(value)
+
+    def _empty_cat(self, name: str) -> Array:
+        """0-length concat of an empty cat state in its declared/learned
+        element dtype — NOT the metric-wide float ``_dtype`` (which silently
+        floated integer states like retrieval indexes after reset+compute)."""
+        meta = self._cat_meta.get(name)
+        dtype = meta[0] if meta is not None and meta[0] is not None else self._dtype
+        trailing = meta[1] if meta is not None and meta[1] is not None else ()
+        return jnp.zeros((0,) + tuple(trailing), dtype=dtype)
 
     def unsync(self, should_unsync: bool = True) -> None:
         """Restore cached local states. Parity: reference ``metric.py:534-553``."""
@@ -1062,7 +1223,9 @@ class Metric:
     def to_device(self, device) -> "Metric":
         self._flush_pending()
         for k, v in self._state.items():
-            if k in self._list_states:
+            if isinstance(v, CatBuffer):
+                self._state[k] = v.to_device(device)
+            elif k in self._list_states:
                 self._state[k] = [jax.device_put(e, device) for e in v]
             else:
                 self._state[k] = jax.device_put(v, device)
@@ -1076,12 +1239,18 @@ class Metric:
         self._flush_pending()
         self._dtype = dtype
         for k, v in self._state.items():
-            if k in self._list_states:
+            if isinstance(v, CatBuffer):
+                if jnp.issubdtype(v.dtype, jnp.floating):
+                    self._state[k] = v.astype(dtype)
+            elif k in self._list_states:
                 self._state[k] = [
                     e.astype(dtype) if jnp.issubdtype(e.dtype, jnp.floating) else e for e in v
                 ]
             elif isinstance(v, jax.Array) and jnp.issubdtype(v.dtype, jnp.floating):
                 self._state[k] = v.astype(dtype)
+        for k, meta in list(self._cat_meta.items()):
+            if meta[0] is not None and np.issubdtype(meta[0], np.floating):
+                self._cat_meta[k] = (np.dtype(dtype), meta[1])
         self._invalidate_executable_key()
         return self
 
@@ -1097,7 +1266,14 @@ class Metric:
             if not keep:
                 continue
             v = self._state[name]
-            out[name] = [np.asarray(e) for e in v] if name in self._list_states else np.asarray(v)
+            if isinstance(v, CatBuffer):
+                # increment boundaries are already gone in the buffer; one
+                # concat-equal entry round-trips through load_state_dict
+                out[name] = [np.asarray(v.materialize())] if len(v) else []
+            elif name in self._list_states:
+                out[name] = [np.asarray(e) for e in v]
+            else:
+                out[name] = np.asarray(v)
         return out
 
     def load_state_dict(self, state_dict: Dict[str, Any], strict: bool = True) -> None:
@@ -1110,6 +1286,8 @@ class Metric:
                 self._state[name] = [jnp.asarray(e) for e in v]
             else:
                 self._state[name] = jnp.asarray(v)
+        # restored increments fold back into the padded layout
+        self._adopt_padded_lists()
 
     def clone(self) -> "Metric":
         return copy.deepcopy(self)
@@ -1127,6 +1305,8 @@ class Metric:
         state.pop("_jit_bound", None)
         state.pop("_exec_key_cache", None)
         state.pop("_exec_nonce", None)
+        # hashlib digests are unpicklable; the cache rebuilds on demand
+        state.pop("_hash_digests", None)
         state["_sync_backend"] = None if not isinstance(state.get("_sync_backend"), NoSync) else state["_sync_backend"]
         return state
 
@@ -1135,16 +1315,53 @@ class Metric:
         object.__setattr__(self, "_defaults", state.pop("_defaults"))
         for k, v in state.items():
             object.__setattr__(self, k, v)
+        # attrs absent from pre-padded-layout pickles (and the popped digests)
+        for attr, factory in (
+            ("_hash_digests", dict),
+            ("_cat_meta", dict),
+            ("_layout_fallback", set),
+            ("_list_layout", lambda: "padded"),
+        ):
+            if attr not in self.__dict__:
+                object.__setattr__(self, attr, factory())
+
+    def _cat_state_digest(self, name: str, value: Any) -> bytes:
+        """Incremental digest of a cat state's content.
+
+        The hasher is keyed by state-object identity and the covered element
+        count: appends only ever extend a list/CatBuffer in place, so
+        re-hashing feeds just the new suffix; reset/sync/unsync replace the
+        state object, which invalidates the cache automatically.
+        """
+        rec = self._hash_digests.get(name)
+        n = len(value)
+        if rec is None or rec[0] is not value or rec[1] > n:
+            rec = [value, 0, hashlib.blake2b(digest_size=16)]
+            self._hash_digests[name] = rec
+        if rec[1] < n:
+            if isinstance(value, CatBuffer):
+                chunk = np.asarray(value.rows(rec[1], n)).tobytes()
+                rec[2].update(chunk)
+                _HASH_STATS["bytes_hashed"] += len(chunk)
+            else:
+                for e in list(value)[rec[1] : n]:
+                    b = np.asarray(e).tobytes()
+                    rec[2].update(b)
+                    _HASH_STATS["bytes_hashed"] += len(b)
+            rec[1] = n
+        return rec[2].digest()
 
     def __hash__(self) -> int:
         self._flush_pending()
         vals = []
         for k in sorted(self._defaults):
             v = self._state[k]
-            if k in self._list_states:
-                vals.extend(np.asarray(e).tobytes() for e in v)
+            if k in self._list_states and isinstance(v, (list, tuple, CatBuffer)):
+                vals.append(self._cat_state_digest(k, v))
             else:
-                vals.append(np.asarray(v).tobytes())
+                b = np.asarray(v).tobytes()
+                _HASH_STATS["bytes_hashed"] += len(b)
+                vals.append(b)
         return hash((type(self).__name__, tuple(vals)))
 
     def __repr__(self) -> str:
@@ -1312,6 +1529,8 @@ def _wrap_update(update_fn: Callable) -> Callable:
             if self.compute_on_cpu:
                 for k in self._list_states:
                     self._state[k] = [np.asarray(e) for e in self._state[k]]
+            else:
+                self._adopt_padded_lists()
 
     wrapped._tm_wrapped = True
     return wrapped

@@ -57,11 +57,32 @@ __all__ = [
     "quantized_allreduce",
     "quantize_chunks",
     "dequantize_chunks",
+    "pad_cat_rows",
     "record_collective",
     "begin_sync",
     "wire_stats",
     "reset_wire_stats",
 ]
+
+
+def pad_cat_rows(value: "Array", target_rows: int, trailing: Tuple[int, ...], dtype) -> "Array":
+    """Adopt a cat shard to the group row layout and zero-pad to ``target_rows``.
+
+    Shared by the eager padded-buffer gather (``HostSync.sync_cat_padded``,
+    ``FakeSync.sync_cat_padded``): a never-updated rank's ``(0,)`` float32
+    placeholder takes on the group's trailing shape and dtype, and every
+    shard ships with the same uniform row count so one dense gather moves
+    the whole group.
+    """
+    trailing = tuple(int(d) for d in trailing)
+    if value.shape[0] == 0 and (value.shape[1:] != trailing or value.dtype != dtype):
+        value = jnp.zeros((0,) + trailing, dtype)
+    else:
+        value = value.astype(dtype)
+    pad = target_rows - value.shape[0]
+    if pad <= 0:
+        return value
+    return jnp.concatenate([value, jnp.zeros((pad,) + trailing, dtype)], axis=0)
 
 
 # ---------------------------------------------------------------------------

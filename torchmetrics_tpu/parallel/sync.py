@@ -38,6 +38,7 @@ from .strategies import (  # noqa: F401  (re-exported: stable import surface)
     default_policy,
     gather_bucket,
     invariant_all_gather,
+    pad_cat_rows,
     quantized_allreduce,
     record_collective,
     reduce_scatter_sum,
@@ -154,12 +155,22 @@ def reduce_tensor_in_graph(
 class _GatherLeaf:
     """One cat/NONE/custom leaf queued into a per-dtype gather bucket."""
 
-    __slots__ = ("red", "shape", "is_bool", "wire")
+    __slots__ = ("red", "shape", "is_bool", "wire", "valid")
 
-    def __init__(self, red, value: Array):
-        v = jnp.asarray(value)
-        if red == Reduction.CAT:
-            v = jnp.atleast_1d(v)
+    def __init__(self, red, value):
+        from ..buffers import CatBuffer
+
+        self.valid = None
+        if isinstance(value, CatBuffer):
+            # padded gather contract: ship the power-of-two buffer; the
+            # epilogue masks each shard's invalid tail rows. The count is a
+            # host int (SPMD-uniform layout ⇒ uniform across shards).
+            self.valid = value.count
+            v = value.buffer
+        else:
+            v = jnp.asarray(value)
+            if red == Reduction.CAT:
+                v = jnp.atleast_1d(v)
         self.red = red
         self.shape = v.shape
         self.is_bool = v.dtype == jnp.bool_
@@ -173,6 +184,11 @@ class _GatherLeaf:
         if self.is_bool:
             r = r.astype(jnp.bool_)
         if self.red == Reduction.CAT:
+            if self.valid is not None:
+                # compact: mask each shard's invalid padded tail (static
+                # slice — the valid count is a host int, no retrace per value)
+                r = r[:, : self.valid]
+                return r.reshape((n * self.valid,) + self.shape[1:])
             return r.reshape((n * self.shape[0],) + self.shape[1:])
         if self.red == Reduction.NONE:
             return r  # (world, ...) — parity with reference gather-no-reduce
@@ -500,6 +516,55 @@ class HostSync(SyncBackend):
             [gathered[r, : int(lens[r])] for r in range(len(lens))], axis=0
         )
 
+    def sync_cat_padded(self, buffer: Array, count: int) -> Array:
+        """Gather padded cat buffers plus per-rank valid counts.
+
+        The padded-layout variant of :meth:`_gather_uneven_cat`: each rank
+        ships its power-of-two buffer (padded to the group's max capacity —
+        no masked-slice copy on the send side) and its valid row count in the
+        metadata; the receive side slices each rank back to ``count`` rows,
+        masking the invalid tails. Ranks that never updated participate with
+        a ``(0,)`` float32 placeholder and 0 valid rows.
+        """
+        import numpy as np
+
+        trailing = buffer.shape[1:]
+        if len(trailing) > self._CAT_MAX_TRAILING:
+            raise ValueError(
+                f"cat state has {len(trailing)} trailing dims; HostSync supports up to "
+                f"{self._CAT_MAX_TRAILING}"
+            )
+        record_collective(
+            "eager_gather", buffer.size * buffer.dtype.itemsize, self.world_size()
+        )
+        meta = np.full(2 + self._CAT_MAX_TRAILING + self._CAT_NAME_WORDS, -1, dtype=np.int32)
+        meta[0] = count
+        meta[1] = buffer.shape[0]
+        meta[2 : 2 + len(trailing)] = trailing
+        meta[2 + self._CAT_MAX_TRAILING :] = self._encode_dtype(buffer.dtype)
+        metas = np.asarray(self._gather(jnp.asarray(meta))).reshape(-1, meta.size)
+        counts = metas[:, 0]
+        caps = metas[:, 1]
+        if counts.size == 0 or counts.max() == 0:  # every rank is empty
+            return buffer[:0]
+        donor = metas[int(np.argmax(counts > 0))]
+        group_trailing = tuple(
+            int(d) for d in donor[2 : 2 + self._CAT_MAX_TRAILING] if d >= 0
+        )
+        group_dtype = self._decode_dtype(donor[2 + self._CAT_MAX_TRAILING :])
+        nonempty = metas[counts > 0]
+        if not (nonempty[:, 2:] == donor[2:]).all():
+            raise ValueError(
+                "cat state shards disagree on trailing shape or dtype across ranks: "
+                f"{[tuple(m) for m in nonempty]}"
+            )
+        cmax = int(caps.max())
+        buffer = pad_cat_rows(buffer, cmax, group_trailing, group_dtype)
+        gathered = self._gather(buffer)  # (world, cmax, ...)
+        return jnp.concatenate(
+            [gathered[r, : int(counts[r])] for r in range(len(counts))], axis=0
+        )
+
     def all_gather_object(self, obj: Any) -> list:
         """Gather an arbitrary picklable object from every process.
 
@@ -573,10 +638,21 @@ class FakeSync(SyncBackend):
             self.world_size(),
         )
         if self._is_range(name):
+            from ..buffers import CatBuffer
+
             key, start, stop = name
             peers = []
             for s in self._group:
-                rows = list(s[key])[start:stop]
+                peer = s[key]
+                if isinstance(peer, CatBuffer):
+                    # padded layout: the range addresses buffer ROWS, not
+                    # list increments (see streaming._ov_issue)
+                    rows_arr = peer.rows(start, stop)
+                    peers.append(
+                        rows_arr if rows_arr.shape[0] else jnp.asarray(value)[:0]
+                    )
+                    continue
+                rows = list(peer)[start:stop]
                 peers.append(
                     jnp.concatenate([jnp.atleast_1d(jnp.asarray(r)) for r in rows], axis=0)
                     if rows
@@ -589,7 +665,23 @@ class FakeSync(SyncBackend):
                 for s in self._group
             ]
         else:
-            peers = [jnp.asarray(s[name]) for s in self._group]
+            from ..buffers import CatBuffer
+
+            def _leaf(v):
+                if isinstance(v, CatBuffer):
+                    return v.materialize()
+                if reduction == Reduction.CAT and isinstance(v, (list, tuple)):
+                    # live list-layout state: concat the increments (ranks
+                    # normally pre-concat, but raw state dicts work too)
+                    rows = [jnp.atleast_1d(jnp.asarray(r)) for r in v]
+                    return (
+                        jnp.concatenate(rows, axis=0)
+                        if rows
+                        else jnp.asarray(value)[:0]
+                    )
+                return jnp.asarray(v)
+
+            peers = [_leaf(s[name]) for s in self._group]
         if reduction == Reduction.CAT:
             # ranks may hold different sample counts (the reference's
             # pad-to-max gather, utilities/distributed.py:124-147) —
@@ -609,6 +701,39 @@ class FakeSync(SyncBackend):
         if callable(reduction):
             return reduction(gathered)
         raise ValueError(f"Unknown reduction {reduction}")
+
+    def sync_cat_padded(self, buffer: Array, count: int) -> Array:
+        """Padded-layout cat gather: concat each emulated rank's valid rows.
+
+        Mirrors :meth:`HostSync.sync_cat_padded` — the wire carries the full
+        power-of-two buffer and a valid count; here each peer's state is read
+        from the registered group and masked to its valid prefix directly.
+        """
+        from ..buffers import CatBuffer
+
+        record_collective(
+            "eager_gather", buffer.size * buffer.dtype.itemsize, self.world_size()
+        )
+        name = self._current_name
+        peers = []
+        for s in self._group:
+            peer = s[name]
+            if isinstance(peer, CatBuffer):
+                peers.append(peer.materialize())
+            elif isinstance(peer, (list, tuple)):
+                rows = [jnp.atleast_1d(jnp.asarray(r)) for r in peer]
+                peers.append(
+                    jnp.concatenate(rows, axis=0)
+                    if rows
+                    else jnp.zeros((0,) + buffer.shape[1:], buffer.dtype)
+                )
+            else:
+                arr = jnp.asarray(peer)
+                peers.append(arr[None] if arr.ndim == 0 else arr)
+        nonempty = [p for p in peers if p.shape[0]]
+        if not nonempty:
+            return buffer[:0]
+        return jnp.concatenate(nonempty, axis=0)
 
     def all_gather_object(self, obj: Any) -> list:
         # the registered group states already hold every emulated rank's

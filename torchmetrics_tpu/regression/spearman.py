@@ -10,7 +10,7 @@ import jax.numpy as jnp
 from ..functional.regression.kendall import kendall_rank_corrcoef
 from ..functional.regression.spearman import _spearman_corrcoef_compute
 from ..metric import Metric
-from ..utils.data import dim_zero_cat
+from ..utils.data import padded_cat
 
 Array = jax.Array
 
@@ -43,7 +43,8 @@ class SpearmanCorrCoef(Metric):
         self.target.append(target.astype(jnp.float32))
 
     def compute(self) -> Array:
-        return _spearman_corrcoef_compute(dim_zero_cat(self.preds), dim_zero_cat(self.target))
+        # padded layout: mask each (buffer, count) state to its valid prefix
+        return _spearman_corrcoef_compute(padded_cat(self.preds)[0], padded_cat(self.target)[0])
 
 
 class KendallRankCorrCoef(Metric):
@@ -85,5 +86,5 @@ class KendallRankCorrCoef(Metric):
 
     def compute(self):
         return kendall_rank_corrcoef(
-            dim_zero_cat(self.preds), dim_zero_cat(self.target), self.variant, self.t_test, self.alternative
+            padded_cat(self.preds)[0], padded_cat(self.target)[0], self.variant, self.t_test, self.alternative
         )

@@ -18,7 +18,7 @@ import numpy as np
 
 from ..metric import Metric
 from ..utils.checks import is_tracing
-from ..utils.data import dim_zero_cat
+from ..utils.data import dim_zero_cat, padded_cat
 
 Array = jax.Array
 
@@ -118,8 +118,11 @@ class RetrievalMetric(Metric, ABC):
         self.aggregation = aggregation
         self._compute_jittable = False
 
-        self.add_state("indexes", [], dist_reduce_fx="cat")
-        self.add_state("preds", [], dist_reduce_fx="cat")
+        # declared dtypes: an empty state after reset must come back with the
+        # increments' dtype, not the metric's float default — integer indexes
+        # drive _pad_by_query's bincount
+        self.add_state("indexes", [], dist_reduce_fx="cat", dtype=np.int32)
+        self.add_state("preds", [], dist_reduce_fx="cat", dtype=np.float32)
         self.add_state("target", [], dist_reduce_fx="cat")
         if ignore_index is not None:  # mask channel only when rows can be ignored
             self.add_state("ignore", [], dist_reduce_fx="cat")
@@ -163,9 +166,10 @@ class RetrievalMetric(Metric, ABC):
         return jnp.sum(target.astype(jnp.float32) * mask, axis=-1) == 0
 
     def compute(self) -> Array:
-        indexes = np.asarray(dim_zero_cat(self.indexes))
-        preds = np.asarray(dim_zero_cat(self.preds))
-        target = np.asarray(dim_zero_cat(self.target))
+        # padded layout: slice each (buffer, count) state to its valid prefix
+        indexes = np.asarray(padded_cat(self.indexes)[0])
+        preds = np.asarray(padded_cat(self.preds)[0])
+        target = np.asarray(padded_cat(self.target)[0])
         ignore = (
             np.asarray(dim_zero_cat(self.ignore)).astype(bool)
             if self.ignore_index is not None

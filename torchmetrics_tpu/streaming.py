@@ -49,6 +49,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .buffers import CatBuffer
 from .metric import Metric, StateDict, _filter_kwargs, _global_jit, _jit_safe_inputs
 from .parallel.reduction import Reduction
 from .parallel.strategies import begin_sync
@@ -274,12 +275,10 @@ class BufferedMetric:
             for k, v in new_tensors.items():
                 state[k] = v
             # appends leaves are (K, B, ...) scan stacks; rows >= valid are
-            # padding garbage — extend host lists with the valid rows only,
-            # preserving per-step append order (lazy device slices, no sync)
-            for i in range(valid):
-                m._extend_list_states(
-                    {k: tuple(a[i] for a in arrs) for k, arrs in appends.items()}
-                )
+            # padding garbage — the valid rows land in the cat state in ONE
+            # fused device write per state (padded layout) or as per-step
+            # increments (list layout), preserving step-major append order
+            m._extend_list_states_stacked(appends, valid)
             if pre_counts is not None:
                 backend = m.sync_backend
                 if backend.is_available() and not m._is_synced:
@@ -313,12 +312,18 @@ class BufferedMetric:
             if stop < start:  # state shrank (reset/load) — resync from zero
                 start = 0
                 gathered.pop(name, None)
-            rows = list(m.__dict__["_state"][name])[start:stop]
-            if rows:
-                local = jnp.concatenate([jnp.atleast_1d(jnp.asarray(r)) for r in rows])
+            value = m.__dict__["_state"][name]
+            if isinstance(value, CatBuffer):
+                # the padded layout indexes rows, not increments: the buffer
+                # slice IS the increment range (counts are row counts there)
+                local = value.rows(start, stop)
             else:
-                probe = m._precat(name)
-                local = probe[:0]
+                rows = list(value)[start:stop]
+                if rows:
+                    local = jnp.concatenate([jnp.atleast_1d(jnp.asarray(r)) for r in rows])
+                else:
+                    probe = m._precat(name)
+                    local = probe[:0]
             if addressed:
                 backend.set_current((name, start, stop))
             piece = backend.sync_tensor(local, Reduction.CAT)
@@ -571,10 +576,7 @@ class BufferedMetricCollection:
                 st = rep.__dict__["_state"]  # shared dict: group members see it
                 for k, v in new_states[name].items():
                     st[k] = v
-                for i in range(valid):
-                    rep._extend_list_states(
-                        {k: tuple(a[i] for a in arrs) for k, arrs in appends[name].items()}
-                    )
+                rep._extend_list_states_stacked(appends[name], valid)
         finally:
             self.__dict__["_flushing"] = False
 

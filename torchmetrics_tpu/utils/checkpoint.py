@@ -17,11 +17,24 @@ from typing import Any, Dict
 import jax.numpy as jnp
 import numpy as np
 
+from ..buffers import CatBuffer
 from .imports import _module_available
 
 __all__ = ["save_metric_state", "restore_metric_state"]
 
 _ORBAX = _module_available("orbax.checkpoint")
+
+
+def _serializable(node: Any) -> Any:
+    """Padded ``(buffer, count)`` cat states are not checkpoint leaves:
+    save the materialized valid rows as a one-entry list, which
+    ``load_state_dict`` re-adopts into the padded layout on restore
+    (same representation ``Metric.state_dict`` uses)."""
+    if isinstance(node, CatBuffer):
+        return [np.asarray(node.materialize())] if len(node) else []
+    if isinstance(node, dict):
+        return {k: _serializable(v) for k, v in node.items()}
+    return node
 
 
 def _members(obj: Any) -> Dict[str, Any]:
@@ -34,7 +47,7 @@ def _members(obj: Any) -> Dict[str, Any]:
 
 def _state_tree(obj: Any) -> Dict[str, Any]:
     if hasattr(obj, "metric_state"):  # Metric
-        return dict(obj.metric_state)
+        return _serializable(dict(obj.metric_state))
     if hasattr(obj, "items"):  # MetricCollection / plain dict of metrics
         return {k: _state_tree(v) for k, v in _members(obj).items()}
     return obj  # already a pytree

@@ -6,16 +6,20 @@ TPU-first differences: ``_bincount`` is implemented as a one-hot matmul-friendly
 segment sum with a *static* ``minlength`` (XLA requires static shapes) and the
 CUDA-determinism fallbacks disappear (TPU is deterministic by default).
 """
-from typing import List, Optional, Sequence, Union
+from typing import List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 
+from ..buffers import CatBuffer
+
 Array = jax.Array
 
 
-def dim_zero_cat(x: Union[Array, List[Array], tuple]) -> Array:
-    """Concatenate a (possibly list-valued) state along dim 0."""
+def dim_zero_cat(x: Union[Array, List[Array], tuple, CatBuffer]) -> Array:
+    """Concatenate a (possibly list-valued or padded-buffer) state along dim 0."""
+    if isinstance(x, CatBuffer):
+        return x.materialize()
     if isinstance(x, (jnp.ndarray, jax.Array)) and not isinstance(x, (list, tuple)):
         return x
     if isinstance(x, (list, tuple)):
@@ -26,7 +30,19 @@ def dim_zero_cat(x: Union[Array, List[Array], tuple]) -> Array:
     return jnp.asarray(x)
 
 
-def cat_state_or_empty(x: Union[Array, List[Array], tuple], dtype=jnp.float32) -> Array:
+def padded_cat(x: Union[Array, List[Array], tuple, CatBuffer]) -> Tuple[Array, int]:
+    """Cat state as a ``(values, count)`` pair in any layout.
+
+    For the padded layout this is the masked valid slice ``buffer[:count]``
+    of the power-of-two ``CatBuffer`` (advanced consumers that want to jit
+    over the raw capacity-shaped buffer can read ``x.buffer``/``x.count``
+    directly); list states and already-synced arrays concatenate as before.
+    """
+    values = dim_zero_cat(x)
+    return values, values.shape[0]
+
+
+def cat_state_or_empty(x: Union[Array, List[Array], tuple, CatBuffer], dtype=jnp.float32) -> Array:
     """``dim_zero_cat`` for list states that may already be synced.
 
     A sync backend replaces a list state with the pre-concatenated gathered
@@ -34,6 +50,8 @@ def cat_state_or_empty(x: Union[Array, List[Array], tuple], dtype=jnp.float32) -
     list's truthiness must handle both forms. Empty lists yield an empty
     array instead of raising.
     """
+    if isinstance(x, CatBuffer):
+        return x.materialize()
     if not isinstance(x, (list, tuple)):
         return jnp.asarray(x)
     return dim_zero_cat(x) if len(x) else jnp.zeros((0,), dtype=dtype)
