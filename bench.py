@@ -475,6 +475,78 @@ def bench_smoke() -> dict:
         and (cat["speedup"] or 0.0) >= 10.0
     )
 
+    # fault-injection gate (ISSUE 6): a seeded ChaosSync schedule injects one
+    # transient gather timeout, then a dropped rank, then its rejoin. The
+    # elastic layer must (a) recover the timeout within the retry budget with
+    # a bitwise-identical result, zero leaked poison and zero retraces under
+    # strict_mode; (b) degrade the drop round to a partial result whose
+    # coverage fraction matches the injected membership; (c) report 100%
+    # coverage again on the rejoin round.
+    from torchmetrics_tpu.classification import BinaryAccuracy
+    from torchmetrics_tpu.debug import strict_mode as _strict
+    from torchmetrics_tpu.parallel import (
+        ChaosSchedule,
+        ElasticSync,
+        FakeSync,
+        chaos_group,
+        elastic_stats,
+    )
+
+    fworld = 2
+    fpreds = jax.random.uniform(jax.random.PRNGKey(7), (fworld, 64))
+    ftarget = jax.random.randint(jax.random.PRNGKey(8), (fworld, 64), 0, 2)
+
+    def _fault_ranks():
+        ms = [BinaryAccuracy(validate_args=False) for _ in range(fworld)]
+        for r, m in enumerate(ms):
+            m.update(fpreds[r], ftarget[r])
+        return ms, [m.metric_state for m in ms]
+
+    ref_ms, ref_group = _fault_ranks()
+    ref_ms[0]._sync_backend = FakeSync(ref_group, 0)
+    fault_free = float(ref_ms[0].compute())
+
+    ch_ms, ch_group = _fault_ranks()
+    sched = ChaosSchedule({0: [("timeout", 1)], 1: [("drop", 1)], 2: [("rejoin", 1)]})
+    ch_backs = chaos_group(ch_group, sched)
+    fpolicy = SyncPolicy(retry_attempts=2, backoff_base_s=0.01)
+    for r, m in enumerate(ch_ms):
+        m._sync_backend = ElasticSync(ch_backs[r], policy=fpolicy)
+    es_before = elastic_stats()
+    ctrl = ch_backs[0].controller
+
+    ctrl.advance()  # round 0: one transient timeout, retried
+    with _strict(transfer_guard=None, max_retraces=0) as fstats:
+        r_timeout = float(ch_ms[0].compute())
+    cov0 = ch_ms[0].coverage
+    ctrl.advance()  # round 1: rank 1 permanently absent this epoch
+    ch_ms[0]._computed = None  # drop the compute cache so the round re-syncs
+    r_drop = float(ch_ms[0].compute())
+    cov1 = ch_ms[0].coverage
+    ctrl.advance()  # round 2: rank 1 back, full coverage restored
+    ch_ms[0]._computed = None
+    r_rejoin = float(ch_ms[0].compute())
+    cov2 = ch_ms[0].coverage
+
+    es_after = elastic_stats()
+    fault_retries = es_after["retries"] - es_before["retries"]
+    fault_recoveries = es_after["recoveries"] - es_before["recoveries"]
+    leaked_poison = any(b.poisoned for b in ch_backs) or any(
+        m._sync_backend.poisoned for m in ch_ms
+    )
+    fault_ok = (
+        r_timeout == fault_free  # bitwise: recovered round == fault-free run
+        and cov0 is not None and cov0.fraction == 1.0
+        and fault_retries >= 1 and fault_recoveries >= 1
+        and fstats.retraces == 0
+        and fstats.degraded_syncs == 0
+        and not leaked_poison
+        and cov1 is not None and cov1.ranks_present == fworld - 1
+        and cov1.ranks_expected == fworld
+        and r_rejoin == fault_free
+        and cov2 is not None and cov2.fraction == 1.0
+    )
+
     # static gate: the corpus must lint clean against the committed baseline
     repo_dir = os.path.dirname(os.path.abspath(__file__))
     try:
@@ -499,6 +571,7 @@ def bench_smoke() -> dict:
             and buffered_matches_eager
             and wire_ok
             and cat_ok
+            and fault_ok
             and tpulint_ok
         ),
         "dispatches_per_update": dispatches,
@@ -521,6 +594,16 @@ def bench_smoke() -> dict:
         "buffered_matches_eager": buffered_matches_eager,
         "cat_append_ok": cat_ok,
         "cat_append": cat,
+        "fault_injection_ok": fault_ok,
+        "fault_injection": {
+            "timeout_round_bitwise": r_timeout == fault_free,
+            "retries": fault_retries,
+            "recoveries": fault_recoveries,
+            "strict_retraces": fstats.retraces,
+            "leaked_poison": leaked_poison,
+            "drop_coverage": cov1.as_dict() if cov1 is not None else None,
+            "rejoin_coverage": cov2.as_dict() if cov2 is not None else None,
+        },
     }
 
 
@@ -814,26 +897,47 @@ def bench_auroc_exact() -> dict:
         jit_times.append(time.perf_counter() - t0)
     jit_s = sorted(jit_times)[len(jit_times) // 2]
 
-    # eager baseline: one warmup + ONE timed rep. The eager dynamic-shape
-    # path is the expensive half of this config (70 s/rep at N=1e6 — the
-    # r5 timeout); one warmed rep at N=2.5e5 keeps the child well inside
-    # the budget at the cost of a noisier — but still honest,
-    # steady-state — denominator.
-    # warmup synced via float(): block_until_ready on this 0-d result would
-    # return early (the pathology above) and leak ~70 s of in-flight eager
-    # work into the single timed rep below
+    # r5/r6 split: the eager dynamic-shape baseline was the expensive half
+    # of this config (70 s/rep at N=1e6 — the r5 TimeoutExpired, the only
+    # uncaptured value that round). It now lives in its own child config
+    # (``auroc_exact_eager``) so a slow eager path can only time out ITS
+    # child — the headline jit number here always lands in the report.
+    return {"value": round(1.0 / jit_s, 2), "unit": "computes/s (exact AUROC, N=2.5e5)",
+            "vs_baseline": None,
+            "note": "eager dynamic-shape denominator split into the auroc_exact_eager "
+                    "config (r5 timeout isolation); ratio = this value / that value",
+            "roofline": _roofline(jax.jit(EJ.binary_auroc_exact), (preds, target), 1.0 / jit_s)}
+
+
+def bench_auroc_exact_eager() -> dict:
+    """Eager dynamic-shape exact-AUROC baseline, split out of ``auroc_exact``
+    so its cost (the r5 420 s TimeoutExpired) cannot take the jit headline
+    number down with it. One warmup + one timed rep at the same N."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.functional.classification.auroc import _binary_auroc_compute
+
+    n = 250_000
+    rng = np.random.RandomState(0)
+    preds = jnp.asarray(rng.rand(n).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 2, n), jnp.int32)
+    # warmup synced via float(): block_until_ready on a 0-d result returns
+    # early on the remote layer and would leak in-flight eager work into
+    # the timed rep (see bench_auroc_exact)
     float(jnp.asarray(_binary_auroc_compute((preds, target), None, None)).reshape(()))
     p_e = jnp.asarray((rng.rand(n) + _SALT_BASE).astype(np.float32))
     jax.block_until_ready(p_e)
     t0 = time.perf_counter()
     float(jnp.asarray(_binary_auroc_compute((p_e, target), None, None)).reshape(()))
     eager_s = time.perf_counter() - t0
-
-    return {"value": round(1.0 / jit_s, 2), "unit": "computes/s (exact AUROC, N=2.5e5)",
-            "vs_baseline": round(eager_s / jit_s, 3),
-            "note": "vs_baseline = eager dynamic-shape exact compute on the same device "
-                    "(one warmed fresh-host-data rep, result pulled to host)",
-            "roofline": _roofline(jax.jit(EJ.binary_auroc_exact), (preds, target), 1.0 / jit_s)}
+    return {"value": round(1.0 / eager_s, 3),
+            "unit": "computes/s (eager dynamic-shape exact AUROC, N=2.5e5)",
+            "vs_baseline": None,
+            "note": "denominator config for auroc_exact: jit speedup = "
+                    "auroc_exact.value / this value"}
 
 
 # ---------------------------------------------------------- step overhead
@@ -1193,6 +1297,7 @@ def bench_cat_append() -> dict:
 _CONFIGS = {
     "config1": "bench_config1",
     "auroc_exact": "bench_auroc_exact",
+    "auroc_exact_eager": "bench_auroc_exact_eager",
     "map_epoch": "bench_config3",
     "step_overhead": "bench_step_overhead",
     "collection_fused": "bench_config2",

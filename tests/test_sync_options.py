@@ -247,28 +247,86 @@ def test_hostsync_timeout_raises_instead_of_hanging(monkeypatch):
     monkeypatch.setattr(multihost_utils, "process_allgather", stalled_gather)
     hs = HostSync(timeout_s=0.5)
     t0 = time.monotonic()
-    from torchmetrics_tpu.parallel import sync as sync_mod
     from torchmetrics_tpu.parallel.reduction import Reduction
 
-    sync_mod.clear_poison()
-    try:
-        with pytest.raises(TimeoutError, match="stalled or dead"):
-            hs.sync_tensor(jnp.asarray([1.0]), Reduction.SUM)
-        assert time.monotonic() - t0 < 5.0
-        # the timed-out collective may still be in flight: EVERY further
-        # gather in this process (any HostSync instance) must refuse to run
-        # rather than pair with it and silently desequence (ADVICE r4)
-        with pytest.raises(RuntimeError, match="poisoned"):
-            hs.all_gather_object({"a": 1})
-        with pytest.raises(RuntimeError, match="poisoned"):
-            HostSync().sync_tensor(jnp.asarray([1.0]), Reduction.SUM)
-        # clear_poison() re-arms (caller's contract: only after jax.distributed
-        # re-init) — the next gather runs again and times out afresh
+    with pytest.raises(TimeoutError, match="stalled or dead"):
+        hs.sync_tensor(jnp.asarray([1.0]), Reduction.SUM)
+    assert time.monotonic() - t0 < 5.0
+    # the timed-out collective may still be in flight: every further gather
+    # on THIS instance must refuse to run rather than pair with it and
+    # silently desequence (ADVICE r4). Poison is instance-scoped: a fresh
+    # HostSync (new watchdog, its own collective sequence) starts unpoisoned
+    # and times out afresh against the still-stalled peer.
+    assert hs.poisoned
+    with pytest.raises(RuntimeError, match="poisoned"):
+        hs.all_gather_object({"a": 1})
+    fresh = HostSync(timeout_s=0.5)
+    assert not fresh.poisoned
+    with pytest.raises(TimeoutError, match="stalled or dead"):
+        fresh.sync_tensor(jnp.asarray([1.0]), Reduction.SUM)
+    # instance clear_poison() re-arms (caller's contract: only after
+    # jax.distributed re-init) — the next gather runs and times out afresh
+    hs.clear_poison()
+    assert not hs.poisoned
+    with pytest.raises(TimeoutError, match="stalled or dead"):
+        hs.sync_tensor(jnp.asarray([1.0]), Reduction.SUM)
+
+
+def test_hostsync_recovery_barrier_autoclears_poison(monkeypatch):
+    """A successful post-recovery barrier re-arms a poisoned instance without
+    any manual clear_poison() call; a failed barrier leaves it poisoned."""
+    import time
+
+    from jax.experimental import multihost_utils
+
+    from torchmetrics_tpu.parallel.reduction import Reduction
+    from torchmetrics_tpu.parallel.sync import HostSync
+
+    def stalled_gather(value, *a, **k):
+        time.sleep(30)
+        return value
+
+    monkeypatch.setattr(multihost_utils, "process_allgather", stalled_gather)
+    hs = HostSync(timeout_s=0.3)
+    with pytest.raises(TimeoutError):
+        hs.sync_tensor(jnp.asarray([1.0]), Reduction.SUM)
+    assert hs.poisoned
+    # peer still stalled: the barrier itself times out, poison survives
+    with pytest.raises(TimeoutError):
+        hs.recovery_barrier(timeout_s=0.3)
+    assert hs.poisoned
+    # peer recovers: the barrier succeeds and auto-clears the flag
+    monkeypatch.setattr(multihost_utils, "process_allgather", lambda v, *a, **k: v)
+    hs.recovery_barrier()
+    assert not hs.poisoned
+    np.testing.assert_array_equal(
+        np.asarray(hs.sync_tensor(jnp.asarray([1.0]), Reduction.SUM)), [1.0]
+    )
+
+
+def test_module_clear_poison_deprecated_alias(monkeypatch):
+    """Module-level clear_poison() still works for existing callers but warns
+    and clears every live poisoned instance."""
+    import time
+
+    from jax.experimental import multihost_utils
+
+    from torchmetrics_tpu.parallel import sync as sync_mod
+    from torchmetrics_tpu.parallel.reduction import Reduction
+    from torchmetrics_tpu.parallel.sync import HostSync
+
+    def stalled_gather(value, *a, **k):
+        time.sleep(30)
+        return value
+
+    monkeypatch.setattr(multihost_utils, "process_allgather", stalled_gather)
+    hs = HostSync(timeout_s=0.3)
+    with pytest.raises(TimeoutError):
+        hs.sync_tensor(jnp.asarray([1.0]), Reduction.SUM)
+    assert hs.poisoned
+    with pytest.warns(DeprecationWarning, match="recovery_barrier"):
         sync_mod.clear_poison()
-        with pytest.raises(TimeoutError, match="stalled or dead"):
-            hs.sync_tensor(jnp.asarray([1.0]), Reduction.SUM)
-    finally:
-        sync_mod.clear_poison()
+    assert not hs.poisoned
 
 
 def test_failed_sync_leaves_local_state_intact(monkeypatch):
@@ -285,26 +343,20 @@ def test_failed_sync_leaves_local_state_intact(monkeypatch):
         return value
 
     monkeypatch.setattr(multihost_utils, "process_allgather", stalled_gather)
-    from torchmetrics_tpu.parallel import sync as sync_mod
-
-    sync_mod.clear_poison()
-    try:
-        hs = HostSync(timeout_s=0.3)
-        monkeypatch.setattr(hs, "is_available", lambda: True)
-        m = CatMetric(sync_backend=hs)
-        m.update(jnp.asarray([1.0, 2.0]))
-        with pytest.raises(TimeoutError):
-            m.sync()
-        assert not m._is_synced
-        assert m._cache is None
-        # local state is untouched and still usable (dim_zero_cat masks the
-        # padded buffer to its valid prefix)
-        np.testing.assert_array_equal(np.asarray(dim_zero_cat(m.metric_state["value"])), [1.0, 2.0])
-        m.update(jnp.asarray([3.0]))
-        m._sync_backend = None  # back to NoSync
-        np.testing.assert_array_equal(np.asarray(m.compute()), [1.0, 2.0, 3.0])
-    finally:
-        sync_mod.clear_poison()
+    hs = HostSync(timeout_s=0.3)
+    monkeypatch.setattr(hs, "is_available", lambda: True)
+    m = CatMetric(sync_backend=hs)
+    m.update(jnp.asarray([1.0, 2.0]))
+    with pytest.raises(TimeoutError):
+        m.sync()
+    assert not m._is_synced
+    assert m._cache is None
+    # local state is untouched and still usable (dim_zero_cat masks the
+    # padded buffer to its valid prefix)
+    np.testing.assert_array_equal(np.asarray(dim_zero_cat(m.metric_state["value"])), [1.0, 2.0])
+    m.update(jnp.asarray([3.0]))
+    m._sync_backend = None  # back to NoSync
+    np.testing.assert_array_equal(np.asarray(m.compute()), [1.0, 2.0, 3.0])
 
 
 def test_hostsync_timeout_validation():

@@ -556,3 +556,71 @@ def test_tpu008_masked_buffer_read_passes(tmp_path):
             return out
     """, root_kinds=("update", "kernel", "sync"))
     assert "TPU008" not in _rules(res)
+
+
+# ---------------------------------------------------------------------------
+# TPU009 — blocking host collective without a timeout/retry policy
+# ---------------------------------------------------------------------------
+
+
+def test_tpu009_bare_process_allgather_flagged(tmp_path):
+    res = _lint_fixture(tmp_path, sync_src="""
+        from jax.experimental import multihost_utils
+
+        def eager_gather(value):
+            return multihost_utils.process_allgather(value)
+    """, root_kinds=("update", "kernel", "sync"))
+    assert "TPU009" in _rules(res)
+
+
+def test_tpu009_sync_global_devices_flagged(tmp_path):
+    res = _lint_fixture(tmp_path, sync_src="""
+        from jax.experimental import multihost_utils
+
+        def epoch_barrier(tag):
+            multihost_utils.sync_global_devices(tag)
+    """, root_kinds=("update", "kernel", "sync"))
+    assert "TPU009" in _rules(res)
+
+
+def test_tpu009_timeout_guarded_gather_passes(tmp_path):
+    res = _lint_fixture(tmp_path, sync_src="""
+        from jax.experimental import multihost_utils
+
+        def eager_gather(self, value):
+            result = []
+
+            def _run():
+                result.append(multihost_utils.process_allgather(value))
+
+            _run_with_watchdog(_run, self.timeout_s)
+            return result[0]
+    """, root_kinds=("update", "kernel", "sync"))
+    assert "TPU009" not in _rules(res)
+
+
+def test_tpu009_retry_policy_gather_passes(tmp_path):
+    res = _lint_fixture(tmp_path, sync_src="""
+        from jax.experimental import multihost_utils
+
+        def eager_gather(value, policy):
+            for attempt in range(policy.retry_attempts + 1):
+                try:
+                    return multihost_utils.process_allgather(value)
+                except TimeoutError:
+                    continue
+            return value
+    """, root_kinds=("update", "kernel", "sync"))
+    assert "TPU009" not in _rules(res)
+
+
+def test_tpu009_jit_reachable_path_not_double_flagged(tmp_path):
+    # a traced path is TPU001/TPU007 territory; TPU009 must only fire on the
+    # jit-unreachable remainder
+    res = _lint_fixture(tmp_path, sync_src="""
+        from jax.experimental import multihost_utils
+
+        def reduce_state_in_graph(state, reductions, axis_name):
+            return multihost_utils.process_allgather(state)
+    """, root_kinds=("update", "kernel", "sync"))
+    assert "TPU009" not in _rules(res)

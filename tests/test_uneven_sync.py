@@ -104,3 +104,33 @@ def test_clustering_uneven_ranks():
     a = rng.randint(0, 3, 19)
     b = rng.randint(0, 3, 19)
     _merge_equals_full(tm.MutualInfoScore, [(a[:13], b[:13]), (a[13:], b[13:])])
+
+
+def test_rank_leaves_then_rejoins():
+    """A rank preempted mid-epoch checkpoints its partial state, misses
+    batches, then rejoins by merging the checkpoint back in; replaying only
+    its missed batches must restore the full-data result (the elastic
+    merge-on-rejoin contract, ``parallel.elastic.merge_checkpoint``)."""
+    from torchmetrics_tpu.parallel.elastic import checkpoint_metric, merge_checkpoint, rejoin_metric
+
+    rng = np.random.RandomState(8)
+    data = rng.rand(4, 5).astype(np.float32)
+
+    full = tm.CatMetric()
+    for batch in data:
+        full.update(jnp.asarray(batch))
+    expected = np.sort(np.asarray(full.compute()))
+
+    # rank 1 sees batches 0-1, is preempted (checkpoint), misses batch 2
+    r0, r1 = tm.CatMetric(), tm.CatMetric()
+    r0.update(jnp.asarray(data[0]))
+    r1.update(jnp.asarray(data[1]))
+    blob = checkpoint_metric(r1)
+    r0.update(jnp.asarray(data[2]))  # epoch continues on the survivor
+
+    # rejoin on fresh hardware: rehydrate, replay the missed batch, then
+    # merge the rejoined rank's state into the survivor's next sync
+    r1b = rejoin_metric(blob)
+    r1b.update(jnp.asarray(data[3]))
+    merge_checkpoint(r0, checkpoint_metric(r1b))
+    np.testing.assert_allclose(np.sort(np.asarray(r0.compute())), expected)

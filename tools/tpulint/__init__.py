@@ -6,7 +6,9 @@ under ``parallel/`` (``reduce_*_in_graph`` + the strategy kernels), then
 enforces the dispatch contract the fused single-dispatch and ``lax.scan``
 streaming paths rely on: no host syncs, no data-dependent shapes, no Python
 control flow on tracers, sane state registration, no use-after-donation, no
-float64, no per-leaf collectives looped over state dicts.
+float64, no per-leaf collectives looped over state dicts, and — on the
+jit-unreachable eager remainder — no blocking host collective without a
+timeout/retry policy (TPU009).
 
 Programmatic entry point::
 
@@ -33,6 +35,7 @@ from .rules import (
     Violation,
     check_state_contract,
     check_traced_rules,
+    check_unguarded_host_collective,
     check_use_after_donation,
 )
 from .waivers import apply_waivers, collect_waivers
@@ -85,6 +88,11 @@ def run_lint(
             violations.extend(check_state_contract(cinfo, corpus))
     for fn in sorted(corpus.functions.values(), key=lambda f: f.qualname):
         violations.extend(check_use_after_donation(fn))
+        # TPU009 covers the jit-UNREACHABLE remainder: eager sync paths where
+        # a blocking host collective is legal but must carry a timeout/retry
+        # policy (traced paths are TPU001's jurisdiction)
+        if fn.qualname not in reachability.reachable:
+            violations.extend(check_unguarded_host_collective(fn))
 
     waivers_by_path = {}
     for mod in corpus.modules.values():

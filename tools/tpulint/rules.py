@@ -17,6 +17,7 @@ directly; TPU005 scans all functions (donation misuse is an eager-layer bug).
 | TPU006 | TPU dtype hygiene: no implicit/explicit float64                   |
 | TPU007 | no per-leaf collective inside a Python loop over state dicts      |
 | TPU008 | no list-state concat in a traced path (use the padded layout)     |
+| TPU009 | no blocking host collective without a timeout/retry policy        |
 """
 from __future__ import annotations
 
@@ -34,7 +35,7 @@ from .callgraph import (
 )
 from .corpus import ClassInfo, Corpus, FunctionInfo
 
-ALL_RULES = ("TPU000", "TPU001", "TPU002", "TPU003", "TPU004", "TPU005", "TPU006", "TPU007", "TPU008")
+ALL_RULES = ("TPU000", "TPU001", "TPU002", "TPU003", "TPU004", "TPU005", "TPU006", "TPU007", "TPU008", "TPU009")
 
 RULE_TITLES = {
     "TPU000": "malformed waiver",
@@ -46,6 +47,7 @@ RULE_TITLES = {
     "TPU006": "TPU dtype hygiene (float64)",
     "TPU007": "per-leaf collective in a loop over states",
     "TPU008": "list-state concat in a traced path",
+    "TPU009": "blocking host collective without timeout/retry policy",
 }
 
 
@@ -489,6 +491,66 @@ def check_use_after_donation(fn: FunctionInfo) -> List[Violation]:
                 "TPU005", fn.path, node.lineno, node.col_offset,
                 f"`{node.id}` was donated to a jitted call on line {donated[node.id]} and is "
                 "read afterwards — the buffer is deleted on backends that honor donation",
+                fn.qualname,
+            ))
+    return out
+
+
+_BLOCKING_HOST_COLLECTIVES = {"process_allgather", "sync_global_devices", "broadcast_one_to_all"}
+_TIMEOUT_POLICY_MARKERS = ("timeout", "retry", "retries", "deadline", "watchdog")
+
+
+def _mentions_timeout_policy(fn_node: ast.AST) -> bool:
+    """Heuristic guard detector: the function binds, reads, or receives any
+    name/attribute/kwarg containing a timeout-or-retry marker (e.g. reads
+    ``self.timeout_s``, takes a ``timeout_s`` parameter, joins a watchdog
+    thread with a deadline)."""
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        elif isinstance(node, ast.arg):
+            name = node.arg
+        elif isinstance(node, ast.keyword) and node.arg:
+            name = node.arg
+        else:
+            continue
+        low = name.lower()
+        if any(marker in low for marker in _TIMEOUT_POLICY_MARKERS):
+            return True
+    return False
+
+
+def check_unguarded_host_collective(fn: FunctionInfo) -> List[Violation]:
+    """TPU009 over one jit-UNREACHABLE function.
+
+    A blocking multihost collective (``multihost_utils.process_allgather`` /
+    ``sync_global_devices`` / ``broadcast_one_to_all``) issued on an eager
+    sync path with no timeout/retry policy in scope deadlocks every rank the
+    moment one peer is preempted — the exact failure mode the elastic sync
+    layer exists to absorb. Traced paths are TPU001's jurisdiction (a host
+    collective can't appear under jit at all); this rule covers the
+    jit-unreachable remainder, where the call is legal but must run under a
+    watchdog (``HostSync.timeout_s``) or an elastic retry policy
+    (``SyncPolicy.retry_attempts``).
+    """
+    out: List[Violation] = []
+    if _mentions_timeout_policy(fn.node):
+        return out
+    imports = fn.module.imports
+    for node in ast.walk(fn.node):
+        if not isinstance(node, ast.Call) or not isinstance(node.func, (ast.Attribute, ast.Name)):
+            continue
+        dotted = _alias_targets(imports, node.func)
+        leaf = dotted.split(".")[-1]
+        if leaf in _BLOCKING_HOST_COLLECTIVES and "multihost_utils" in dotted:
+            out.append(Violation(
+                "TPU009", fn.path, node.lineno, node.col_offset,
+                f"blocking host collective `{leaf}` issued without a timeout/retry "
+                "policy: one preempted peer stalls this call forever and deadlocks "
+                "every rank — run it under a watchdog (HostSync.timeout_s) or an "
+                "elastic retry policy (SyncPolicy.retry_attempts)",
                 fn.qualname,
             ))
     return out

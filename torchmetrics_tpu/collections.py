@@ -391,6 +391,21 @@ class MetricCollection:
     def compute(self) -> Dict[str, Any]:
         return self._compute_and_reduce("compute")
 
+    @property
+    def coverage(self):
+        """Worst-case elastic-sync coverage across members: the member
+        coverage record (``parallel.elastic.Coverage``) with the lowest
+        fraction, or ``None`` when no member has an elastic backend. A
+        collection's computed dict is only as complete as its least-covered
+        member, so the minimum is the honest annotation for the whole
+        result."""
+        worst = None
+        for m in self._metrics.values():
+            cov = getattr(m, "coverage", None)
+            if cov is not None and (worst is None or cov.fraction < worst.fraction):
+                worst = cov
+        return worst
+
     def _compute_and_reduce(self, method_name: str) -> Dict[str, Any]:
         """Parity: reference ``collections.py:314-359``."""
         result = {}

@@ -28,6 +28,7 @@ from typing import Any, Iterator, Optional
 import jax
 
 from . import metric as _metric
+from .parallel import elastic as _elastic
 from .parallel import strategies as _strategies
 
 
@@ -52,6 +53,9 @@ class StrictStats:
     bytes_reduced: int = 0
     bytes_gathered: int = 0
     collectives_issued: int = 0
+    degraded_syncs: int = 0
+    sync_retries: int = 0
+    coverage_fraction: Optional[float] = None
 
 
 def _looks_like_transfer_guard_error(exc: BaseException) -> bool:
@@ -65,6 +69,7 @@ def strict_mode(
     transfer_guard: Optional[str] = "disallow",
     max_retraces: int = 0,
     max_new_executables: Optional[int] = None,
+    max_degraded_syncs: int = 0,
 ) -> Iterator[StrictStats]:
     """Context that raises :class:`StrictModeViolation` on contract breaks.
 
@@ -80,6 +85,12 @@ def strict_mode(
         max_new_executables: budget for first-time compiles inside the
             context, or ``None`` for unlimited. Set to 0 to assert fully-warm
             steady state.
+        max_degraded_syncs: how many degraded elastic sync rounds (coverage
+            below 100% — a peer dropped out or a retry budget was exhausted,
+            see ``parallel.elastic``) to tolerate. Default 0: existing tests
+            stay strict — any partial compute raises. Raise it for
+            preemption-tolerant eval loops that accept annotated partial
+            results.
     """
     stats = StrictStats()
 
@@ -102,9 +113,25 @@ def strict_mode(
                 "strict_mode, or raise max_new_executables."
             )
 
+    def _observe_degrade(coverage: Any) -> None:
+        stats.degraded_syncs += 1
+        stats.coverage_fraction = coverage.fraction
+        if stats.degraded_syncs > max_degraded_syncs:
+            raise StrictModeViolation(
+                f"degraded sync under strict_mode: coverage "
+                f"{coverage.fraction:.3f} ({coverage.ranks_present}/"
+                f"{coverage.ranks_expected} ranks, {coverage.samples_present}/"
+                f"{coverage.samples_expected} samples); {stats.degraded_syncs} "
+                f"degraded round(s) > budget {max_degraded_syncs}. A peer "
+                "dropped out or a retry budget was exhausted — raise "
+                "max_degraded_syncs to accept annotated partial results."
+            )
+
     _metric._COMPILE_OBSERVERS.append(_observe)
+    _elastic._DEGRADE_OBSERVERS.append(_observe_degrade)
     guard = jax.transfer_guard(transfer_guard) if transfer_guard is not None else contextlib.nullcontext()
     wire_before = _strategies.wire_stats()
+    elastic_before = _elastic.elastic_stats()
     try:
         with guard:
             yield stats
@@ -118,11 +145,15 @@ def strict_mode(
         raise
     finally:
         _metric._COMPILE_OBSERVERS.remove(_observe)
+        _elastic._DEGRADE_OBSERVERS.remove(_observe_degrade)
         wire_after = _strategies.wire_stats()
         stats.bytes_reduced = wire_after["bytes_reduced"] - wire_before["bytes_reduced"]
         stats.bytes_gathered = wire_after["bytes_gathered"] - wire_before["bytes_gathered"]
         stats.collectives_issued = (
             wire_after["collectives_issued"] - wire_before["collectives_issued"]
+        )
+        stats.sync_retries = (
+            _elastic.elastic_stats()["retries"] - elastic_before["retries"]
         )
 
 

@@ -56,6 +56,7 @@ from .parallel.strategies import (
     reset_wire_stats,
     wire_stats,
 )
+from .parallel.elastic import elastic_stats, reset_elastic_stats
 from .parallel.sync import NoSync, SyncBackend, default_sync_backend, reduce_state_in_graph
 from .utils.data import dim_zero_cat
 from .utils.exceptions import TorchMetricsUserError
@@ -257,14 +258,18 @@ def clear_executable_cache() -> None:
     _CACHE_STATS["retraces"] = 0
     _DISPATCH_COUNT[0] = 0
     reset_wire_stats()
+    reset_elastic_stats()
 
 
 def executable_cache_stats() -> Dict[str, int]:
     """Cache size, hit/miss counts, compile/retrace counts, dispatches, and
     wire-level sync counters (modelled bytes reduced/gathered + collectives
     issued; in-graph collectives count once per trace, eager once per call —
-    see ``parallel.strategies.record_collective``)."""
+    see ``parallel.strategies.record_collective``), and elastic-sync health
+    (retry/timeout/degraded counts plus the last round's coverage record —
+    see ``parallel.elastic``)."""
     wire = wire_stats()
+    es = elastic_stats()
     return {
         "size": len(_EXECUTABLE_CACHE),
         "hits": _CACHE_STATS["hits"],
@@ -276,6 +281,10 @@ def executable_cache_stats() -> Dict[str, int]:
         "bytes_gathered": wire["bytes_gathered"],
         "collectives_issued": wire["collectives_issued"],
         "syncs": wire["syncs"],
+        "sync_retries": es["retries"],
+        "sync_timeouts": es["timeouts"],
+        "degraded_syncs": es["degraded_syncs"],
+        "coverage": es["last_coverage"],
     }
 
 
@@ -1025,7 +1034,18 @@ class Metric:
         # double-counted by the recovery path
         try:
             begin_sync()
+            # elastic membership round: the contribution probe settles who is
+            # present BEFORE any state bytes move, every gather below is
+            # retried/degraded per SyncPolicy, and end_round() records the
+            # coverage fraction (raising CoverageError below min_coverage)
+            elastic = hasattr(backend, "begin_round")
+            if elastic:
+                backend.begin_round(
+                    contrib=int(self._update_count), policy=self._sync_policy
+                )
             synced = self._gather_synced(backend)
+            if elastic:
+                backend.end_round()
         except Exception:
             self._cache = None
             raise
@@ -1212,6 +1232,15 @@ class Metric:
     @property
     def update_count(self) -> int:
         return self._update_count
+
+    @property
+    def coverage(self):
+        """Coverage record of this metric's last elastic sync round
+        (``parallel.elastic.Coverage``), or ``None`` when the backend is not
+        elastic or no round has settled. A fraction below 1.0 marks the
+        current computed value as a partial result over the surviving
+        membership."""
+        return getattr(self._sync_backend, "last_coverage", None)
 
     @property
     def device(self):

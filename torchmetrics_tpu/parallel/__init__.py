@@ -1,6 +1,21 @@
 """Distributed / parallelism layer: reduction tags, sync backends, sequence/
 context parallelism (ring attention, expert all-to-all), and a reference
 dp x pp x tp (+ep) train-step template."""
+from .elastic import (
+    ChaosController,
+    ChaosSchedule,
+    ChaosSync,
+    Coverage,
+    CoverageError,
+    ElasticSync,
+    GatherTimeout,
+    chaos_group,
+    checkpoint_metric,
+    elastic_stats,
+    merge_checkpoint,
+    rejoin_metric,
+    reset_elastic_stats,
+)
 from .reduction import Reduction, resolve_reduction
 from .ring import expert_all_to_all, ring_attention
 from .train_demo import demo_param_shardings, init_demo_params, make_demo_train_step
@@ -34,4 +49,17 @@ __all__ = [
     "use_policy",
     "wire_stats",
     "reset_wire_stats",
+    "ElasticSync",
+    "ChaosSync",
+    "ChaosController",
+    "ChaosSchedule",
+    "Coverage",
+    "CoverageError",
+    "GatherTimeout",
+    "chaos_group",
+    "checkpoint_metric",
+    "rejoin_metric",
+    "merge_checkpoint",
+    "elastic_stats",
+    "reset_elastic_stats",
 ]

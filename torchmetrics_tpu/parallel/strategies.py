@@ -202,6 +202,20 @@ class SyncPolicy:
             most this many elements (bounds the zeros-buffer scratch to
             ``world·chunk`` and lets XLA pipeline chunked gathers); ``None``
             gathers each bucket whole.
+        retry_attempts: how many times an :class:`~torchmetrics_tpu.parallel.
+            elastic.ElasticSync` round retries a timed-out eager gather
+            (bounded exponential backoff, see ``parallel/elastic.py``) before
+            degrading to a partial result. 0 (default) fails over to the
+            local shard on the first timeout.
+        backoff_base_s: base of the exponential backoff between elastic
+            retries: attempt ``k`` sleeps ``backoff_base_s * 2**k`` seconds
+            (capped at 30 s).
+        min_coverage: coverage floor for a degraded elastic sync. When the
+            settled membership covers less than this fraction of the expected
+            ranks AND of the expected samples, the sync raises
+            :class:`~torchmetrics_tpu.parallel.elastic.CoverageError` instead
+            of returning a partial result. 0.0 (default) accepts any
+            coverage; 1.0 forbids degraded results entirely.
     """
 
     exact: bool = False
@@ -211,6 +225,9 @@ class SyncPolicy:
     quantize_chunk: int = 256
     reduce_scatter_threshold: int = 1 << 16
     gather_chunk_elems: Optional[int] = None
+    retry_attempts: int = 0
+    backoff_base_s: float = 0.5
+    min_coverage: float = 0.0
 
     def __post_init__(self) -> None:
         if self.gather not in _GATHER_MODES:
@@ -223,6 +240,12 @@ class SyncPolicy:
             raise ValueError("`reduce_scatter_threshold` must be >= 1")
         if self.gather_chunk_elems is not None and self.gather_chunk_elems < 1:
             raise ValueError("`gather_chunk_elems` must be None or >= 1")
+        if self.retry_attempts < 0:
+            raise ValueError(f"`retry_attempts` must be >= 0, got {self.retry_attempts}")
+        if self.backoff_base_s <= 0:
+            raise ValueError(f"`backoff_base_s` must be > 0, got {self.backoff_base_s}")
+        if not 0.0 <= self.min_coverage <= 1.0:
+            raise ValueError(f"`min_coverage` must be in [0, 1], got {self.min_coverage}")
 
     # -- resolution ------------------------------------------------------
     def use_all_gather(self) -> bool:

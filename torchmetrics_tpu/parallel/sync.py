@@ -24,6 +24,7 @@ The backend is injectable per-metric via the ``sync_backend`` ctor kwarg,
 preserving the reference's ``dist_sync_fn``/``distributed_available_fn``
 injection points (``metric.py:127-133``).
 """
+import weakref
 from typing import Any, Callable, Dict, Mapping, Optional, Union
 
 import jax
@@ -50,22 +51,40 @@ from .strategies import (  # noqa: F401  (re-exported: stable import surface)
 Array = jax.Array
 StateDict = Dict[str, Any]
 
-# Process-global poison flag: set when a HostSync gather times out (the
-# leaked worker's collective may still complete later and pair with any new
-# collective from this process). Cleared only by clear_poison(), to be
-# called after jax.distributed has been torn down and re-initialized.
-_POISONED = False
+# Every HostSync instance currently poisoned by a gather timeout (the leaked
+# worker's collective may still complete later and pair with any new
+# collective issued through the SAME backend instance). Weak so short-lived
+# test backends don't accumulate. Poison is scoped per instance: a fresh
+# HostSync (e.g. built by a recovery path after a jax.distributed re-init)
+# starts clean; the poisoned instance re-arms itself via
+# :meth:`HostSync.recovery_barrier` or :meth:`HostSync.clear_poison`.
+_POISONED_BACKENDS: "weakref.WeakSet" = weakref.WeakSet()
 
 
 def clear_poison() -> None:
-    """Re-arm :class:`HostSync` after a gather timeout.
+    """Deprecated module-level re-arm: clears the poison flag on EVERY live
+    :class:`HostSync` instance.
 
-    Call ONLY after tearing down and re-initializing ``jax.distributed`` —
-    clearing the flag while the timed-out collective is still in flight
-    re-exposes the silent-desequencing hazard the poison exists to prevent.
+    Deprecated in favor of the per-instance protocol — call
+    ``backend.recovery_barrier()`` (auto-clears on success) or
+    ``backend.clear_poison()`` after tearing down and re-initializing
+    ``jax.distributed``. Clearing while the timed-out collective is still in
+    flight re-exposes the silent-desequencing hazard the poison exists to
+    prevent.
     """
-    global _POISONED
-    _POISONED = False
+    import warnings
+
+    warnings.warn(
+        "torchmetrics_tpu.parallel.sync.clear_poison() is deprecated: poison "
+        "is scoped per HostSync instance — use backend.recovery_barrier() "
+        "(auto-clears after a successful post-recovery barrier) or "
+        "backend.clear_poison().",
+        DeprecationWarning,
+        stacklevel=2,
+    )
+    for backend in list(_POISONED_BACKENDS):
+        backend._poisoned = False
+    _POISONED_BACKENDS.clear()
 
 
 # ---------------------------------------------------------------------------
@@ -360,6 +379,7 @@ class HostSync(SyncBackend):
         if timeout_s is not None and timeout_s <= 0:
             raise ValueError(f"`timeout_s` must be positive or None, got {timeout_s}")
         self.timeout_s = timeout_s
+        self._poisoned = False
 
     def is_available(self) -> bool:
         return jax.process_count() > 1
@@ -367,32 +387,50 @@ class HostSync(SyncBackend):
     def world_size(self) -> int:
         return jax.process_count()
 
-    def _gather(self, value):
+    @property
+    def poisoned(self) -> bool:
+        """True when an earlier gather on THIS instance timed out and its
+        leaked worker collective may still be in flight."""
+        return self._poisoned
+
+    def clear_poison(self) -> None:
+        """Re-arm this instance after a gather timeout.
+
+        Call ONLY after tearing down and re-initializing ``jax.distributed``
+        (or after :meth:`recovery_barrier` semantics are otherwise satisfied)
+        — clearing while the timed-out collective is still in flight
+        re-exposes the silent-desequencing hazard the poison prevents.
+        """
+        self._poisoned = False
+        _POISONED_BACKENDS.discard(self)
+
+    def _gather(self, value, _bypass_poison: bool = False):
         """``process_allgather`` with an optional watchdog timeout.
 
-        The gather blocks inside the runtime, so it cannot be interrupted;
-        with ``timeout_s`` set it runs on a worker thread and the caller
-        raises once the deadline passes. The worker is leaked and its
-        collective may still complete later, so a timeout POISONS this
-        process's backend: every further HostSync gather raises until
-        :func:`clear_poison` is called after ``jax.distributed`` has been
+        The gather blocks inside the runtime, so it cannot be interrupted:
+        it always runs on a daemon worker thread and the caller joins with
+        the deadline (``timeout_s=None`` joins forever, preserving blocking
+        semantics). On expiry the worker is leaked and its collective may
+        still complete later, so a timeout POISONS this backend instance:
+        every further gather through it raises until
+        :meth:`recovery_barrier` succeeds (auto-clear) or
+        :meth:`clear_poison` is called after ``jax.distributed`` has been
         torn down and re-initialized — otherwise a new collective could
         pair with the stale in-flight one and silently desequence all
-        following collectives (wrong merged states, no error).
+        following collectives (wrong merged states, no error). Other
+        HostSync instances are unaffected (poison is per instance).
         """
         from jax.experimental import multihost_utils
 
-        global _POISONED
-        if _POISONED:
+        if self._poisoned and not _bypass_poison:
             raise RuntimeError(
-                "HostSync is poisoned by an earlier gather timeout: the timed-out "
-                "collective may still be in flight, and issuing another would race "
-                "it and silently corrupt every later collective. Tear down and "
-                "re-initialize jax.distributed, then call "
-                "torchmetrics_tpu.parallel.sync.clear_poison()."
+                "This HostSync instance is poisoned by an earlier gather timeout: "
+                "the timed-out collective may still be in flight, and issuing "
+                "another would race it and silently corrupt every later "
+                "collective. Run backend.recovery_barrier() (auto-clears on "
+                "success) or tear down and re-initialize jax.distributed, then "
+                "call backend.clear_poison()."
             )
-        if self.timeout_s is None:
-            return multihost_utils.process_allgather(value)
         import threading
 
         result: list = []
@@ -408,18 +446,42 @@ class HostSync(SyncBackend):
         t.start()
         t.join(self.timeout_s)
         if t.is_alive():
-            _POISONED = True
+            self._poisoned = True
+            _POISONED_BACKENDS.add(self)
             raise TimeoutError(
                 f"HostSync gather did not complete within {self.timeout_s}s — a peer "
                 f"process is likely stalled or dead (world_size={self.world_size()}). "
-                "Local metric state is intact: checkpoint it, then tear down and "
+                "Local metric state is intact: checkpoint it, then either retry via "
+                "recovery_barrier() once the membership settles, or tear down and "
                 "re-initialize jax.distributed before syncing again (the timed-out "
-                "collective may still be in flight, so further HostSync gathers in "
-                "this process raise until clear_poison() is called)."
+                "collective may still be in flight, so further gathers through this "
+                "instance raise until the poison is cleared)."
             )
         if err:
             raise err[0]
         return result[0]
+
+    def recovery_barrier(self, timeout_s: Optional[float] = None) -> None:
+        """Post-recovery barrier: one tiny gather that, when it completes,
+        proves this process and its surviving peers are sequenced on the same
+        collective stream again — and AUTO-CLEARS this instance's poison.
+
+        The barrier bypasses the poison check (it IS the recovery probe) but
+        keeps the watchdog: a barrier that also times out leaves the instance
+        poisoned and re-raises, so the caller can back off and try again
+        (see ``parallel/elastic.py``) or give up and re-init
+        ``jax.distributed``.
+        """
+        prev = self.timeout_s
+        if timeout_s is not None:
+            if timeout_s <= 0:
+                raise ValueError(f"`timeout_s` must be positive or None, got {timeout_s}")
+            self.timeout_s = timeout_s
+        try:
+            self._gather(jnp.zeros((), jnp.int32), _bypass_poison=True)
+        finally:
+            self.timeout_s = prev
+        self.clear_poison()
 
     def sync_tensor(self, value: Array, reduction) -> Array:
         nbytes = value.size * value.dtype.itemsize

@@ -51,6 +51,7 @@ from jax import lax
 
 from .buffers import CatBuffer
 from .metric import Metric, StateDict, _filter_kwargs, _global_jit, _jit_safe_inputs
+from .parallel.elastic import note_overlap_deferred
 from .parallel.reduction import Reduction
 from .parallel.strategies import begin_sync
 from .utils.exceptions import TorchMetricsUserError
@@ -282,7 +283,17 @@ class BufferedMetric:
             if pre_counts is not None:
                 backend = m.sync_backend
                 if backend.is_available() and not m._is_synced:
-                    self._ov_issue(backend, pre_counts)
+                    # an overlapped gather is an optimization, not a
+                    # correctness point: if a peer stalls here, defer the
+                    # rows to the compute-time barrier instead of failing
+                    # the flush. _ov_issue only advances the synced index
+                    # per state AFTER that state's gather succeeds, so slot
+                    # rotation stays intact and _ov_barrier re-gathers
+                    # exactly the rows this attempt did not cover.
+                    try:
+                        self._ov_issue(backend, pre_counts)
+                    except TimeoutError:
+                        note_overlap_deferred()
         finally:
             self.__dict__["_flushing"] = False
 
@@ -348,12 +359,21 @@ class BufferedMetric:
         m._cache = m._snapshot_state()
         try:
             begin_sync()
+            # same elastic round lifecycle as Metric.sync: settle membership
+            # before the tail gathers, record coverage for the whole window
+            elastic = hasattr(backend, "begin_round")
+            if elastic:
+                backend.begin_round(
+                    contrib=int(m._update_count), policy=m._sync_policy
+                )
             self._ov_issue(
                 backend, {name: len(m.__dict__["_state"][name]) for name in cat_names}
             )
             synced = m._gather_synced(backend, skip=frozenset(cat_names))
             for name in cat_names:
                 synced[name] = list(self.__dict__["_ov_gathered"].get(name, []))
+            if elastic:
+                backend.end_round()
         except Exception:
             m._cache = None
             raise
