@@ -547,6 +547,68 @@ def bench_smoke() -> dict:
         and cov2 is not None and cov2.fraction == 1.0
     )
 
+    # online-evaluation gate (ISSUE 7): a windowed + decayed + sketch stack
+    # must hold O(1) state while the stream grows — sketch bytes IDENTICAL
+    # after 5x more data — keep the t-digest estimate inside its documented
+    # rank-error bound vs the exact cat-state twin, and run steady state with
+    # zero retraces / new executables / host transfers under strict_mode.
+    import numpy as np
+
+    from torchmetrics_tpu import ApproxQuantile, DecayedMean, WindowedMean
+
+    def _state_nbytes(m) -> int:
+        total = 0
+        for name in m._defaults:
+            v = getattr(m, name)
+            if isinstance(v, list):
+                total += sum(int(x.size) * x.dtype.itemsize for x in v)
+            elif hasattr(v, "buffer"):  # padded cat state
+                total += int(v.buffer.size) * v.buffer.dtype.itemsize
+            else:
+                total += int(v.size) * v.dtype.itemsize
+        return total
+
+    onp = np.random.RandomState(5)
+    chunks = [jnp.asarray(onp.rand(256).astype(np.float32)) for _ in range(24)]
+    approx_q = ApproxQuantile(q=0.5, compression=64)
+    exact_q = ApproxQuantile(q=0.5, compression=64, exact=True)
+    owin = WindowedMean(horizon=8, slots=4).buffered(window=4)
+    odec = DecayedMean(halflife=8.0).buffered(window=4)
+    for c in chunks[:5]:  # warm every update path, incl. one scanned flush
+        approx_q.update(c)
+        owin.update(c)
+        odec.update(c)
+    sketch_bytes_small = _state_nbytes(approx_q)
+    online_retrace_before = M.executable_cache_stats()["retraces"]
+    online_strict_ok = True
+    try:
+        with strict_mode(max_new_executables=0):
+            for c in chunks[5:]:
+                approx_q.update(c)
+                owin.update(c)
+                odec.update(c)
+    except StrictModeViolation:
+        online_strict_ok = False
+    online_retraces = M.executable_cache_stats()["retraces"] - online_retrace_before
+    sketch_bytes_large = _state_nbytes(approx_q)
+    exact_bytes_small = None
+    for i, c in enumerate(chunks):  # exact twin grows; kept outside strict
+        exact_q.update(c)
+        if i == 4:
+            exact_bytes_small = _state_nbytes(exact_q)
+    exact_bytes_large = _state_nbytes(exact_q)
+    online_p50 = float(approx_q.compute())
+    online_p50_exact = float(exact_q.compute())
+    all_np = np.concatenate([np.asarray(c) for c in chunks])
+    online_rank_err = abs(float(np.mean(all_np <= online_p50)) - 0.5)
+    online_error_ok = online_rank_err <= approx_q.error_bound()
+    online_ok = (
+        online_strict_ok
+        and online_retraces == 0
+        and sketch_bytes_large == sketch_bytes_small
+        and online_error_ok
+    )
+
     # static gate: the corpus must lint clean against the committed baseline
     repo_dir = os.path.dirname(os.path.abspath(__file__))
     try:
@@ -572,6 +634,7 @@ def bench_smoke() -> dict:
             and wire_ok
             and cat_ok
             and fault_ok
+            and online_ok
             and tpulint_ok
         ),
         "dispatches_per_update": dispatches,
@@ -594,6 +657,19 @@ def bench_smoke() -> dict:
         "buffered_matches_eager": buffered_matches_eager,
         "cat_append_ok": cat_ok,
         "cat_append": cat,
+        "online_ok": online_ok,
+        "online": {
+            "strict_ok": online_strict_ok,
+            "steady_retraces": online_retraces,
+            "sketch_state_bytes": {"n1280": sketch_bytes_small, "n6144": sketch_bytes_large},
+            "exact_state_bytes": {"n1280": exact_bytes_small, "n6144": exact_bytes_large},
+            "p50_approx": round(online_p50, 6),
+            "p50_exact": round(online_p50_exact, 6),
+            "rank_error": round(online_rank_err, 5),
+            "rank_error_bound": round(approx_q.error_bound(), 5),
+            "windowed_mean": round(float(owin.compute()), 6),
+            "decayed_mean": round(float(odec.compute()), 6),
+        },
         "fault_injection_ok": fault_ok,
         "fault_injection": {
             "timeout_round_bitwise": r_timeout == fault_free,
@@ -1291,6 +1367,145 @@ def bench_cat_append() -> dict:
     }
 
 
+def bench_online_stream() -> dict:
+    """Online evaluation stream: events/s through a buffered windowed +
+    decayed + sketch metric stack (the serving-traffic shape of
+    examples/serve_demo.py), plus bytes-of-state scaling, approx vs exact,
+    at n ∈ {1e4, 1e6, 1e8} observed events. The exact twin's 1e8 point is
+    extrapolated from the padded-cat growth schedule (appending 1e8 rows
+    would allocate 400MB+ for a number the schedule already determines);
+    the sketch side needs NO extrapolation — the state is the same
+    fixed-shape array at any n, asserted at 1e4 vs 1e6."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    import torchmetrics_tpu.metric as M
+    from torchmetrics_tpu import (
+        ApproxAUROC,
+        ApproxFrequency,
+        ApproxQuantile,
+        DecayedMean,
+        WindowedMean,
+    )
+    from torchmetrics_tpu.buffers import _capacity_for
+    from torchmetrics_tpu.debug import StrictModeViolation, strict_mode
+
+    batch, window = 4096, 16
+    warm_steps, total_steps = 17, 261  # > 1e6 events, as examples/serve_demo.py
+    rng = np.random.RandomState(11)
+    n_feed = 64  # pre-generated batches cycled through the timed loop
+    feeds = []
+    for _ in range(n_feed):
+        label = (rng.rand(batch) < 0.3).astype(np.float32)
+        score = np.clip(label * 0.35 + rng.rand(batch) * 0.65, 0.0, 1.0).astype(np.float32)
+        latency = rng.lognormal(3.0, 0.5, size=batch).astype(np.float32)
+        items = (rng.zipf(1.5, size=batch) % 50_000).astype(np.int32)
+        feeds.append(
+            (jnp.asarray(score), jnp.asarray(label), jnp.asarray(latency), jnp.asarray(items))
+        )
+
+    latency_q = ApproxQuantile(q=(0.5, 0.99), compression=128).buffered(window=window)
+    auroc = ApproxAUROC(capacity=4096).buffered(window=window)
+    ctr = WindowedMean(horizon=64, slots=8).buffered(window=window)
+    ema = DecayedMean(halflife=32.0).buffered(window=window)
+    hot = ApproxFrequency(track=(0, 1, 2, 3), width=2048).buffered(window=window)
+
+    def step(score, label, latency, items):
+        latency_q.update(latency)
+        auroc.update(score, label)
+        ctr.update(label)
+        ema.update(latency)
+        hot.update(items)
+
+    for i in range(warm_steps):
+        step(*feeds[i % n_feed])
+
+    retrace_before = M.executable_cache_stats()["retraces"]
+    strict_ok = True
+    t0 = time.perf_counter()
+    try:
+        with strict_mode(max_new_executables=0):
+            for i in range(warm_steps, total_steps):
+                step(*feeds[i % n_feed])
+    except StrictModeViolation:
+        strict_ok = False
+    jax.block_until_ready(latency_q.metric.digest)
+    stream_s = time.perf_counter() - t0
+    steady_retraces = M.executable_cache_stats()["retraces"] - retrace_before
+    measured = (total_steps - warm_steps) * batch
+    events_per_s = measured / stream_s if stream_s > 0 else 0.0
+
+    # state-size scaling: one approx/exact quantile pair fed the SAME stream
+    def _state_nbytes(m) -> int:
+        total = 0
+        for name in m._defaults:
+            v = getattr(m, name)
+            if isinstance(v, list):
+                total += sum(int(x.size) * x.dtype.itemsize for x in v)
+            elif hasattr(v, "buffer"):  # padded cat state
+                total += int(v.buffer.size) * v.buffer.dtype.itemsize
+            else:
+                total += int(v.size) * v.dtype.itemsize
+        return total
+
+    approx = ApproxQuantile(q=0.5, compression=128)
+    exact = ApproxQuantile(q=0.5, compression=128, exact=True)
+    head_np = rng.rand(10_000).astype(np.float32)
+    chunk_np = rng.rand(45_000).astype(np.float32)
+    approx.update(jnp.asarray(head_np))
+    exact.update(jnp.asarray(head_np))
+    approx_1e4, exact_1e4 = _state_nbytes(approx), _state_nbytes(exact)
+    chunk = jnp.asarray(chunk_np)
+    for _ in range(22):  # 10_000 + 22 * 45_000 = 1e6 observations
+        approx.update(chunk)
+        exact.update(chunk)
+    approx_1e6, exact_1e6 = _state_nbytes(approx), _state_nbytes(exact)
+    exact_1e8 = _capacity_for(100_000_000) * 4  # float32 padded-cat schedule
+    o1_state = approx_1e6 == approx_1e4
+
+    p50_approx = float(approx.compute())
+    p50_exact = float(exact.compute())
+    all_np = np.concatenate([head_np] + [chunk_np] * 22)
+    rank_error = abs(float(np.mean(all_np <= p50_approx)) - 0.5)
+
+    return {
+        "value": round(events_per_s, 1),
+        "unit": f"events/s (5-metric online stack, batch={batch}, buffered window={window})",
+        "vs_baseline": round(exact_1e6 / approx_1e6, 1),
+        "note": (
+            "vs_baseline = exact cat-state bytes / sketch state bytes at n=1e6; "
+            "the measured window runs under strict_mode(max_new_executables=0)"
+        ),
+        "events_measured": measured,
+        "stream_s": round(stream_s, 3),
+        "strict_ok": strict_ok,
+        "steady_retraces": steady_retraces,
+        "o1_state": o1_state,
+        "state_bytes": {
+            "approx_n1e4": approx_1e4,
+            "approx_n1e6": approx_1e6,
+            "exact_n1e4": exact_1e4,
+            "exact_n1e6": exact_1e6,
+            "exact_n1e8_extrapolated": exact_1e8,
+        },
+        "p50": {
+            "approx": round(p50_approx, 5),
+            "exact": round(p50_exact, 5),
+            "rank_error": round(rank_error, 5),
+            "rank_error_bound": round(approx.error_bound(), 5),
+        },
+        "computed": {
+            "latency_p50_p99": [round(float(x), 2) for x in latency_q.compute()],
+            "auroc": round(float(auroc.compute()), 4),
+            "windowed_ctr": round(float(ctr.compute()), 4),
+            "ema_latency": round(float(ema.compute()), 2),
+            "hot_item_counts": [int(x) for x in hot.compute()],
+        },
+    }
+
+
 # order = execution order for the extras: the slow configs (auroc's eager
 # baseline, mAP's two baselines, the train-step epochs) run first so the
 # shrinking per-child timeout near the budget end hits only the fast ones
@@ -1305,6 +1520,7 @@ _CONFIGS = {
     "bertscore_kernel": "bench_config5",
     "bootstrap_vmap": "bench_bootstrap",
     "cat_append": "bench_cat_append",
+    "online_stream": "bench_online_stream",
 }
 
 

@@ -1,0 +1,103 @@
+"""Online evaluation service: windowed / decayed / sketch metrics over a
+serving stream.
+
+Simulates a model server emitting (score, label, latency, item_id) events and
+keeps live quality + traffic metrics with O(1) state:
+
+- ``ApproxQuantile`` (t-digest) — p50/p99 latency,
+- ``ApproxAUROC`` (reservoir) — ranking quality,
+- ``WindowedMean`` — click-through rate over the last window of updates,
+- ``DecayedMean`` — exponentially-weighted latency (EMA with a half-life),
+- ``ApproxFrequency`` (count-min) — hot-item request counts.
+
+After warm-up the whole stream runs inside ``strict_mode()``: one million+
+events, ZERO retraces and ZERO implicit host transfers — every update
+(including window-ring rotation and sketch compression) is pure in-graph
+arithmetic on fixed-shape state, staged through ``buffered()``'s scanned
+flush. State size is independent of stream length.
+
+    JAX_PLATFORMS=cpu python examples/serve_demo.py
+"""
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))  # in-repo run
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu import (
+    ApproxAUROC,
+    ApproxFrequency,
+    ApproxQuantile,
+    DecayedMean,
+    WindowedMean,
+)
+from torchmetrics_tpu.debug import strict_mode
+from torchmetrics_tpu.metric import executable_cache_stats
+
+
+def synth_events(rng, batch):
+    """One batch of synthetic serving traffic."""
+    label = (rng.rand(batch) < 0.3).astype(np.float32)
+    score = np.clip(label * 0.35 + rng.rand(batch) * 0.65, 0.0, 1.0).astype(np.float32)
+    latency = rng.lognormal(mean=3.0, sigma=0.5, size=batch).astype(np.float32)  # ~20ms median
+    items = rng.zipf(1.5, size=batch).astype(np.int32) % 50_000
+    return (
+        jnp.asarray(score),
+        jnp.asarray(label),
+        jnp.asarray(latency),
+        jnp.asarray(items),
+    )
+
+
+def main() -> None:
+    batch = 4096
+    steps = 260  # > 1e6 events total
+    rng = np.random.RandomState(0)
+
+    latency_q = ApproxQuantile(q=(0.5, 0.99), compression=128).buffered(window=16)
+    auroc = ApproxAUROC(capacity=4096).buffered(window=16)
+    ctr = WindowedMean(horizon=64, slots=8).buffered(window=16)
+    ema_latency = DecayedMean(halflife=32.0).buffered(window=16)
+    hot_items = ApproxFrequency(track=(0, 1, 2, 3), width=2048).buffered(window=16)
+
+    def step(score, label, latency, items):
+        latency_q.update(latency)
+        auroc.update(score, label)
+        ctr.update(label)
+        ema_latency.update(latency)
+        hot_items.update(items)
+
+    # warm-up: first flush traces+compiles each metric's scanned update once
+    for _ in range(17):
+        step(*synth_events(rng, batch))
+
+    events = 17 * batch
+    with strict_mode(max_new_executables=0) as stats:
+        for _ in range(steps - 17):
+            s, l, t, i = synth_events(rng, batch)  # host-side synthesis...
+            step(s, l, t, i)  # ...but the update path stays on device
+            events += batch
+    print(f"streamed {events:,} events: retraces={stats.retraces} "
+          f"new_executables={stats.new_executables}")
+
+    p50, p99 = (float(x) for x in latency_q.compute())
+    print(f"latency p50={p50:.1f}ms p99={p99:.1f}ms "
+          f"(rank error <= {latency_q.metric.error_bound():.3f})")
+    print(f"AUROC (reservoir {auroc.metric.capacity}): {float(auroc.compute()):.3f}")
+    print(f"CTR over last {ctr.metric.horizon} updates: {float(ctr.compute()):.3f}")
+    print(f"EMA latency (halflife {ema_latency.metric.halflife:.0f} updates): "
+          f"{float(ema_latency.compute()):.1f}ms")
+    print(f"hot item counts (count-min, overestimate-only): "
+          f"{hot_items.compute().tolist()}")
+
+    digest_bytes = latency_q.metric.digest.size * latency_q.metric.digest.dtype.itemsize
+    print(f"t-digest state: {digest_bytes} bytes — independent of the "
+          f"{events:,}-event stream length")
+    print(f"online dispatch counters: {executable_cache_stats()['online']}")
+
+
+if __name__ == "__main__":
+    main()

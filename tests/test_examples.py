@@ -22,6 +22,7 @@ EXAMPLES = [
     ("long_context_ring.py", 300),
     ("fid_ssim.py", 600),
     ("bootstrap_ci.py", 300),
+    ("serve_demo.py", 300),
 ]
 
 

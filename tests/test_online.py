@@ -1,0 +1,264 @@
+"""Tests for the online-evaluation wrappers (ISSUE 7).
+
+WindowedMetric / DecayedMetric semantics (rotation, decay closed forms),
+the rewritten RunningMean/RunningSum ring (exact reference semantics AND
+buffered(window=K) equivalence across flush boundaries), sync of windowed
+states, and the online dispatch counters.
+"""
+import pickle
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu import (
+    ApproxQuantile,
+    CatMetric,
+    DecayedMean,
+    DecayedSum,
+    MaxMetric,
+    MeanMetric,
+    RunningMean,
+    RunningSum,
+    SumMetric,
+    WindowedMax,
+    WindowedMean,
+    WindowedSum,
+)
+from torchmetrics_tpu.metric import executable_cache_stats
+from torchmetrics_tpu.online import (
+    DecayedMetric,
+    WindowedMetric,
+    online_stats,
+    reset_online_stats,
+)
+from torchmetrics_tpu.parallel.sync import FakeSync
+
+
+def _window_slices(stream, horizon, slots):
+    """The updates a warm slot ring covers: the last full/partial slot groups."""
+    slot_len = horizon // slots
+    groups = [stream[i:i + slot_len] for i in range(0, len(stream), slot_len)]
+    kept = groups[-slots:]
+    return [v for g in kept for v in g]
+
+
+# ----------------------------------------------------------------- windowed
+@pytest.mark.parametrize("horizon,slots,n", [(4, 4, 5), (4, 2, 6), (8, 4, 13), (6, 3, 4)])
+def test_windowed_sum_matches_slot_model(horizon, slots, n):
+    stream = [float(i + 1) for i in range(n)]
+    m = SumMetric().windowed(horizon=horizon, slots=slots)
+    for v in stream:
+        m.update(jnp.asarray(v))
+    assert float(m.compute()) == sum(_window_slices(stream, horizon, slots))
+
+
+def test_windowed_mean_weights_slots_by_element_counts():
+    # variable batch sizes: the window mean must weight by ELEMENT counts of
+    # the covered updates, not average the slot means
+    batches = [[1.0, 1.0, 1.0], [5.0], [2.0, 4.0], [10.0]]
+    m = MeanMetric().windowed(horizon=4, slots=2)
+    for b in batches:
+        m.update(jnp.asarray(b))
+    covered = [v for b in batches[-4:] for v in b]  # ring still warm: all kept
+    assert float(m.compute()) == pytest.approx(np.mean(covered))
+    m.update(jnp.asarray([100.0]))  # rotates: first slot (batches 0-1) drops
+    covered = [v for b in batches[2:] for v in b] + [100.0]
+    assert float(m.compute()) == pytest.approx(np.mean(covered))
+
+
+def test_windowed_max_forgets_old_peak():
+    m = MaxMetric().windowed(horizon=2, slots=2)
+    m.update(jnp.asarray(99.0))
+    m.update(jnp.asarray(1.0))
+    assert float(m.compute()) == 99.0
+    m.update(jnp.asarray(2.0))  # 99.0's slot rotates out
+    assert float(m.compute()) == 2.0
+
+
+def test_windowed_sketch_quantile_tracks_recent_distribution():
+    rng = np.random.RandomState(5)
+    m = ApproxQuantile(q=0.5, compression=64).windowed(horizon=8, slots=4)
+    for _ in range(8):  # old regime: values around 100
+        m.update(jnp.asarray(100.0 + rng.rand(200).astype(np.float32)))
+    for _ in range(8):  # new regime: values around 0 — fills the whole ring
+        m.update(jnp.asarray(rng.rand(200).astype(np.float32)))
+    assert float(m.compute()) < 2.0  # an epoch metric would still sit near ~50
+
+
+def test_windowed_facades_and_reset():
+    m = WindowedSum(horizon=4, slots=4)
+    assert isinstance(m, WindowedMetric)
+    for v in [1.0, 2.0, 3.0]:
+        m.update(jnp.asarray(v))
+    assert float(m.compute()) == 6.0
+    m.reset()
+    assert float(m.compute()) == 0.0
+    m.update(jnp.asarray(7.0))
+    assert float(m.compute()) == 7.0
+
+
+def test_windowed_validation_errors():
+    with pytest.raises(ValueError, match="multiple of slots"):
+        SumMetric().windowed(horizon=5, slots=2)
+    with pytest.raises(ValueError, match="slots"):
+        SumMetric().windowed(horizon=4, slots=1)
+    with pytest.raises(ValueError, match="cannot window CatMetric"):
+        CatMetric().windowed(horizon=4, slots=2)  # list states / eager update
+    used = SumMetric()
+    used.update(jnp.asarray(1.0))
+    with pytest.raises(ValueError, match="accumulated state"):
+        used.windowed(horizon=4, slots=2)
+
+
+# ------------------------------------------------------------------ decayed
+def test_decayed_sum_matches_closed_form():
+    h = 4.0
+    d = 0.5 ** (1.0 / h)
+    m = SumMetric().decayed(halflife=h)
+    n = 10
+    for _ in range(n):
+        m.update(jnp.asarray(1.0))
+    expected = sum(d ** k for k in range(n))
+    assert float(m.compute()) == pytest.approx(expected, rel=1e-5)
+    # an observation `halflife` updates old carries exactly half weight
+    m2 = SumMetric().decayed(halflife=4.0)
+    m2.update(jnp.asarray(1.0))
+    for _ in range(4):
+        m2.update(jnp.asarray(0.0))
+    assert float(m2.compute()) == pytest.approx(0.5, rel=1e-5)
+
+
+def test_decayed_mean_is_ema_of_batch_means():
+    d = 0.5 ** (1.0 / 3.0)
+    m = DecayedMean(halflife=3.0)
+    vals, wsum, wtot = [2.0, 4.0, 8.0], 0.0, 0.0
+    for v in vals:
+        m.update(jnp.asarray(v))
+        wsum = wsum * d + v
+        wtot = wtot * d + 1.0
+    assert float(m.compute()) == pytest.approx(wsum / wtot, rel=1e-5)
+
+
+def test_decayed_sketch_quantile_tracks_recent_distribution():
+    rng = np.random.RandomState(9)
+    m = ApproxQuantile(q=0.5, compression=64).decayed(halflife=4.0)
+    for _ in range(10):
+        m.update(jnp.asarray(100.0 + rng.rand(200).astype(np.float32)))
+    for _ in range(30):  # ~7.5 half-lives: old centroids carry ~0.5% weight
+        m.update(jnp.asarray(rng.rand(200).astype(np.float32)))
+    assert float(m.compute()) < 2.0
+
+
+def test_decayed_validation_errors():
+    with pytest.raises(ValueError, match="windowed"):
+        MaxMetric().decayed(halflife=4.0)
+    with pytest.raises(ValueError, match="halflife"):
+        SumMetric().decayed(halflife=0.0)
+    assert isinstance(DecayedSum(halflife=4.0), DecayedMetric)
+
+
+# ------------------------------------------- running ring: reference parity
+def _naive_running(updates, window):
+    """Reference semantics: mean/sum over ELEMENTS of the last `window` updates."""
+    kept = [np.asarray(u, dtype=np.float64) for u in updates[-window:]]
+    flat = np.concatenate([k.reshape(-1) for k in kept]) if kept else np.zeros((0,))
+    finite = flat[~np.isnan(flat)]
+    total = float(np.sum(finite))
+    mean = total / len(finite) if len(finite) else 0.0
+    return total, mean
+
+
+def test_running_mean_sum_match_reference_semantics():
+    rng = np.random.RandomState(13)
+    updates = [rng.rand(rng.randint(1, 6)).astype(np.float32) for _ in range(11)]
+    rm, rs = RunningMean(window=4), RunningSum(window=4)
+    for u in updates:
+        rm.update(jnp.asarray(u))
+        rs.update(jnp.asarray(u))
+    total, mean = _naive_running(updates, 4)
+    assert float(rs.compute()) == pytest.approx(total, rel=1e-5)
+    assert float(rm.compute()) == pytest.approx(mean, rel=1e-5)
+
+
+def test_running_mean_ignores_nans_with_ignore_strategy():
+    updates = [[1.0, np.nan], [np.nan, np.nan], [3.0]]
+    m = RunningMean(window=2, nan_strategy="ignore")
+    for u in updates:
+        m.update(jnp.asarray(np.asarray(u, dtype=np.float32)))
+    _, mean = _naive_running(updates, 2)
+    assert float(m.compute()) == pytest.approx(mean)
+
+
+@pytest.mark.parametrize("cls", [RunningMean, RunningSum])
+def test_running_ring_buffered_matches_eager_across_flush_boundaries(cls):
+    """The rewritten ring is jittable, so it stages under buffered(window=K);
+    staged flushes (including the short valid-masked final flush) must agree
+    with the eager twin at EVERY prefix length, i.e. across ring-crop and
+    flush boundaries simultaneously."""
+    rng = np.random.RandomState(17)
+    updates = [rng.rand(3).astype(np.float32) for _ in range(10)]
+    for n in (1, 4, 5, 7, 10):  # straddles flush boundary (K=3) and ring (4)
+        eager = cls(window=4)
+        buff = cls(window=4).buffered(window=3)
+        for u in updates[:n]:
+            eager.update(jnp.asarray(u))
+            buff.update(jnp.asarray(u))
+        assert float(buff.compute()) == float(eager.compute())
+
+
+def test_windowed_buffered_matches_eager():
+    rng = np.random.RandomState(19)
+    updates = [rng.rand(4).astype(np.float32) for _ in range(11)]
+    eager = WindowedMean(horizon=4, slots=2)
+    buff = WindowedMean(horizon=4, slots=2).buffered(window=3)
+    for u in updates:
+        eager.update(jnp.asarray(u))
+        buff.update(jnp.asarray(u))
+    assert float(buff.compute()) == float(eager.compute())
+
+
+# --------------------------------------------------------------------- sync
+def test_windowed_metric_syncs_slotwise_across_ranks():
+    ranks = [WindowedSum(horizon=4, slots=2) for _ in range(2)]
+    for r, m in enumerate(ranks):
+        for v in (1.0, 2.0, 3.0):  # rank r contributes (r+1)·6 over its window
+            m.update(jnp.asarray(v * (r + 1)))
+    group = [m.metric_state for m in ranks]
+    for r, m in enumerate(ranks):
+        m.sync(sync_backend=FakeSync(group, r))
+    for m in ranks:
+        assert float(m.compute()) == 18.0  # 6 + 12: both ranks' windows
+        np.testing.assert_array_equal(np.asarray(m._win_count), [4, 2])  # summed
+
+
+def test_windowed_sketch_metric_syncs_and_pickles():
+    rng = np.random.RandomState(23)
+    ranks = [ApproxQuantile(q=0.5, compression=64).windowed(horizon=4, slots=2) for _ in range(2)]
+    for r, m in enumerate(ranks):
+        for _ in range(3):
+            m.update(jnp.asarray(rng.rand(100).astype(np.float32) + r))
+    group = [m.metric_state for m in ranks]
+    for r, m in enumerate(ranks):
+        m.sync(sync_backend=FakeSync(group, r))
+    vals = [float(m.compute()) for m in ranks]
+    assert vals[0] == vals[1]  # slot-wise sketch merge is replica-identical
+    assert 0.0 < vals[0] < 2.0  # pooled median of U(0,1) ∪ U(1,2)
+    clone = pickle.loads(pickle.dumps(ranks[0]))  # _SlotwiseMerge round-trips
+    assert float(clone.compute()) == vals[0]
+
+
+# ----------------------------------------------------------------- counters
+def test_online_counters_track_updates_and_rotations():
+    reset_online_stats()
+    w = SumMetric().windowed(horizon=4, slots=2)
+    d = SumMetric().decayed(halflife=2.0)
+    for v in range(6):
+        w.update(jnp.asarray(float(v)))
+        d.update(jnp.asarray(float(v)))
+    stats = online_stats()
+    assert stats["windowed_metrics"] == 1 and stats["decayed_metrics"] == 1
+    assert stats["windowed_updates"] == 6 and stats["decayed_updates"] == 6
+    assert stats["window_rotations"] == 2  # rotations at updates 3 and 5
+    assert executable_cache_stats()["online"] == stats

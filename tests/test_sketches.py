@@ -1,0 +1,370 @@
+"""Property tests for the mergeable sketch states (ISSUE 7).
+
+Covers the documented error bounds against exact cat-state twins, the O(1)
+state-size invariant, and the merge contract — associativity / permutation
+invariance locally, under every ``SyncPolicy`` route, and through the
+ElasticSync checkpoint → merge-on-rejoin path.
+"""
+import pickle
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu import (
+    ApproxAUROC,
+    ApproxCalibrationError,
+    ApproxFrequency,
+    ApproxQuantile,
+)
+from torchmetrics_tpu.parallel.elastic import checkpoint_metric, merge_checkpoint
+from torchmetrics_tpu.parallel.reduction import (
+    SKETCH_REDUCTIONS,
+    Reduction,
+    SketchReduction,
+    resolve_reduction,
+)
+from torchmetrics_tpu.parallel.strategies import SyncPolicy
+from torchmetrics_tpu.parallel.sync import FakeSync, reduce_state_in_graph
+from torchmetrics_tpu.sketches import (
+    countmin_init,
+    countmin_merge,
+    countmin_query,
+    countmin_update,
+    reservoir_init,
+    reservoir_merge,
+    reservoir_rows,
+    reservoir_update,
+    tdigest_init,
+    tdigest_merge,
+    tdigest_quantile,
+    tdigest_update,
+)
+
+
+def _state_nbytes(m) -> int:
+    total = 0
+    for name in m._defaults:
+        v = getattr(m, name)
+        if isinstance(v, list):
+            total += sum(int(x.size) * x.dtype.itemsize for x in v)
+        elif hasattr(v, "buffer"):
+            total += int(v.buffer.size) * v.buffer.dtype.itemsize
+        else:
+            total += int(v.size) * v.dtype.itemsize
+    return total
+
+
+# --------------------------------------------------------------- registration
+def test_sketch_tags_resolve_to_registered_reductions():
+    td = resolve_reduction("tdigest")
+    rs = resolve_reduction("reservoir")
+    cm = resolve_reduction("countmin")
+    assert isinstance(td, SketchReduction) and td.mergeable and td.supports_decay
+    assert isinstance(rs, SketchReduction) and rs.mergeable and rs.supports_decay
+    assert cm is Reduction.SUM  # count-min merges elementwise: plain SUM alias
+    assert td is SKETCH_REDUCTIONS["tdigest"]  # singletons, not per-call copies
+    assert pickle.loads(pickle.dumps(td)) is td
+
+
+def test_unknown_sketch_tag_raises():
+    with pytest.raises(ValueError, match="sketch tag"):
+        resolve_reduction("hyperloglog")
+
+
+# ------------------------------------------------- t-digest vs the exact twin
+@pytest.mark.parametrize("q", [0.1, 0.5, 0.9])
+def test_tdigest_rank_error_within_documented_bound(q):
+    rng = np.random.RandomState(3)
+    data = rng.lognormal(0.0, 1.0, size=50_000).astype(np.float32)
+    approx = ApproxQuantile(q=q, compression=128)
+    exact = ApproxQuantile(q=q, compression=128, exact=True)
+    for chunk in np.split(data, 10):
+        approx.update(jnp.asarray(chunk))
+        exact.update(jnp.asarray(chunk))
+    est = float(approx.compute())
+    # the twin is the oracle: rank the estimate inside the exact sample
+    rank = float(np.mean(data <= est))
+    assert abs(rank - q) <= approx.error_bound()
+    # and the exact twin itself is the true quantile (same estimator)
+    assert float(exact.compute()) == pytest.approx(float(np.quantile(data, q)), rel=1e-5)
+
+
+def test_tdigest_state_bytes_constant_from_1e4_to_1e6():
+    rng = np.random.RandomState(7)
+    m = ApproxQuantile(q=0.5, compression=128)
+    m.update(jnp.asarray(rng.rand(10_000).astype(np.float32)))
+    bytes_1e4 = _state_nbytes(m)
+    chunk = jnp.asarray(rng.rand(45_000).astype(np.float32))
+    for _ in range(22):  # 10_000 + 22 * 45_000 = 1_000_000 observations
+        m.update(chunk)
+    assert _state_nbytes(m) == bytes_1e4
+    assert bytes_1e4 == (m.compression + 1) * 2 * 4  # (C+1, 2) float32, exactly
+
+
+def test_tdigest_merge_permutation_invariant_bitwise():
+    rng = np.random.RandomState(11)
+    digests = []
+    for r in range(4):
+        d = tdigest_init(64)
+        d = tdigest_update(d, jnp.asarray(rng.randn(2_000).astype(np.float32) + r))
+        digests.append(d)
+    stack = jnp.stack(digests)
+    merged = tdigest_merge(stack)
+    for perm in ([3, 1, 0, 2], [1, 0, 3, 2], [2, 3, 1, 0]):
+        np.testing.assert_array_equal(
+            np.asarray(tdigest_merge(stack[jnp.asarray(perm)])), np.asarray(merged)
+        )
+
+
+def test_tdigest_two_step_merge_agrees_within_envelope():
+    rng = np.random.RandomState(13)
+    data = rng.randn(3, 4_000).astype(np.float32)
+    parts = [tdigest_update(tdigest_init(128), jnp.asarray(d)) for d in data]
+    one_shot = tdigest_merge(jnp.stack(parts))
+    two_step = tdigest_merge(jnp.stack([tdigest_merge(jnp.stack(parts[:2])), parts[2]]))
+    bound = ApproxQuantile(compression=128).error_bound()
+    flat = data.reshape(-1)
+    for q in (0.25, 0.5, 0.75):
+        for est in (one_shot, two_step):
+            rank = float(np.mean(flat <= float(tdigest_quantile(est, q))))
+            assert abs(rank - q) <= bound
+
+
+# ----------------------------------------------------- count-min: bounds
+def test_countmin_overestimate_only_and_epsilon_bound():
+    rng = np.random.RandomState(17)
+    items = (rng.zipf(1.3, size=20_000) % 10_000).astype(np.int32)
+    depth, width = 4, 2048
+    table = countmin_init(depth, width)
+    for chunk in np.split(items, 10):
+        table = countmin_update(table, jnp.asarray(chunk), seed=0)
+    ids, true_counts = np.unique(items, return_counts=True)
+    est = np.asarray(countmin_query(table, jnp.asarray(ids), seed=0))
+    assert np.all(est >= true_counts)  # collisions can only ADD
+    # ε = e/width excess over the total count, w.p. 1 - e^-depth; with a
+    # fixed seed the failure set is deterministic — gate every id
+    eps_n = np.e / width * items.size
+    assert np.all(est - true_counts <= eps_n)
+
+
+def test_countmin_merge_is_exact_addition():
+    rng = np.random.RandomState(19)
+    tables = []
+    all_items = []
+    for r in range(3):
+        items = (rng.zipf(1.5, size=5_000) % 1_000).astype(np.int32)
+        all_items.append(items)
+        tables.append(countmin_update(countmin_init(4, 1024), jnp.asarray(items), seed=0))
+    merged = countmin_merge(jnp.stack(tables))
+    direct = countmin_update(
+        countmin_init(4, 1024), jnp.asarray(np.concatenate(all_items)), seed=0
+    )
+    np.testing.assert_array_equal(np.asarray(merged), np.asarray(direct))
+
+
+# ----------------------------------------------------- reservoir: sampling
+def test_reservoir_holds_everything_below_capacity():
+    vals = jnp.arange(100, dtype=jnp.float32)
+    sk = reservoir_update(reservoir_init(256), vals, seed=0)
+    rows, valid = reservoir_rows(sk)
+    assert int(jnp.sum(valid)) == 100
+    got = np.sort(np.asarray(rows[:, 0])[np.asarray(valid)])
+    np.testing.assert_array_equal(got, np.arange(100, dtype=np.float32))
+
+
+def test_reservoir_sample_mean_unbiased_over_seeds():
+    rng = np.random.RandomState(23)
+    data = rng.rand(4_096).astype(np.float32)  # true mean 0.5003...
+    cap, n_seeds = 256, 24
+    means = []
+    for seed in range(n_seeds):
+        sk = reservoir_init(cap)
+        for chunk in np.split(data, 8):
+            sk = reservoir_update(sk, jnp.asarray(chunk), seed=seed)
+        rows, valid = reservoir_rows(sk)
+        means.append(float(jnp.sum(jnp.where(valid, rows[:, 0], 0.0)) / jnp.sum(valid)))
+    # mean of per-seed sample means concentrates at the population mean with
+    # s.e. ≈ σ/sqrt(cap·seeds); gate 4 standard errors
+    se = float(np.std(data)) / np.sqrt(cap * n_seeds)
+    assert abs(np.mean(means) - float(np.mean(data))) <= 4 * se
+
+
+def test_reservoir_merge_permutation_invariant_bitwise():
+    rng = np.random.RandomState(29)
+    parts = []
+    for r in range(4):
+        sk = reservoir_init(64)
+        sk = reservoir_update(sk, jnp.asarray(rng.rand(300).astype(np.float32)), seed=r)
+        parts.append(sk)
+    stack = jnp.stack(parts)
+    merged = reservoir_merge(stack)
+    for perm in ([2, 0, 3, 1], [3, 2, 1, 0]):
+        np.testing.assert_array_equal(
+            np.asarray(reservoir_merge(stack[jnp.asarray(perm)])), np.asarray(merged)
+        )
+    # associative: ((a+b)+(c+d)) == (a+b+c+d) bitwise — top-K over a union
+    ab = reservoir_merge(stack[:2])
+    cd = reservoir_merge(stack[2:])
+    np.testing.assert_array_equal(
+        np.asarray(reservoir_merge(jnp.stack([ab, cd]))), np.asarray(merged)
+    )
+
+
+def test_reservoir_auroc_within_sampling_error_of_exact_twin():
+    rng = np.random.RandomState(31)
+    n = 20_000
+    target = (rng.rand(n) < 0.4).astype(np.float32)
+    preds = np.clip(0.3 * target + 0.7 * rng.rand(n), 0, 1).astype(np.float32)
+    approx = ApproxAUROC(capacity=2048)
+    exact = ApproxAUROC(capacity=2048, exact=True)
+    for p, t in zip(np.split(preds, 10), np.split(target, 10)):
+        approx.update(jnp.asarray(p), jnp.asarray(t))
+        exact.update(jnp.asarray(p), jnp.asarray(t))
+    assert abs(float(approx.compute()) - float(exact.compute())) <= approx.error_bound()
+
+
+def test_reservoir_ece_within_sampling_error_of_exact_twin():
+    rng = np.random.RandomState(37)
+    n = 20_000
+    preds = rng.rand(n).astype(np.float32)
+    target = (rng.rand(n) < preds).astype(np.float32)  # perfectly calibrated
+    approx = ApproxCalibrationError(capacity=2048, n_bins=10)
+    exact = ApproxCalibrationError(capacity=2048, n_bins=10, exact=True)
+    for p, t in zip(np.split(preds, 10), np.split(target, 10)):
+        approx.update(jnp.asarray(p), jnp.asarray(t))
+        exact.update(jnp.asarray(p), jnp.asarray(t))
+    assert abs(float(approx.compute()) - float(exact.compute())) <= approx.error_bound()
+
+
+# ------------------------------------------------- sync: every policy route
+_POLICIES = {
+    "default": None,
+    "exact": SyncPolicy(exact=True),
+    "all_gather": SyncPolicy(gather="all_gather"),
+    "psum": SyncPolicy(gather="psum"),
+    "quantized": SyncPolicy(gather="all_gather", quantize_bits=8, quantize_threshold=1),
+    "reduce_scatter": SyncPolicy(reduce_scatter_threshold=1),
+}
+
+
+def _sketch_ranks(policy, world=2):
+    rng = np.random.RandomState(41)
+    ms = []
+    for _ in range(world):
+        kw = {} if policy is None else {"sync_policy": policy}
+        ms.append(
+            (
+                ApproxQuantile(q=0.5, compression=64, **kw),
+                ApproxAUROC(capacity=128, **kw),
+                ApproxFrequency(track=(1, 2, 3), width=256, **kw),
+            )
+        )
+    for q, a, f in ms:
+        vals = rng.rand(500).astype(np.float32)
+        labels = (rng.rand(500) < 0.5).astype(np.float32)
+        items = (rng.zipf(1.5, size=500) % 100).astype(np.int32)
+        q.update(jnp.asarray(vals))
+        a.update(jnp.asarray(vals), jnp.asarray(labels))
+        f.update(jnp.asarray(items))
+    return ms
+
+
+@pytest.mark.parametrize("name", sorted(_POLICIES))
+def test_sketch_states_sync_bitwise_on_every_policy_route(name):
+    """After an eager sync, every rank holds the SAME merged sketch — the
+    n-way merge rides the callable-reduction path of whichever route the
+    policy selects (sketch leaves are never quantized or scattered)."""
+    policy = _POLICIES[name]
+    ms = _sketch_ranks(policy)
+    for col in range(3):
+        ranks = [ms[r][col] for r in range(len(ms))]
+        expected = ranks[0].merge_states([m._tensor_state() for m in ranks])
+        group = [m.metric_state for m in ranks]
+        for r, m in enumerate(ranks):
+            m.sync(sync_backend=FakeSync(group, r))
+        states = [m.metric_state for m in ranks]
+        for key in states[0]:
+            ref = np.asarray(states[0][key])
+            np.testing.assert_array_equal(np.asarray(states[1][key]), ref)
+            np.testing.assert_array_equal(np.asarray(expected[key]), ref)
+
+
+def test_sketch_leaf_reduces_in_graph_via_vmap_collective():
+    """The in-graph route: a tdigest leaf in a vmapped ``reduce_state_in_graph``
+    merges to the same digest on every replica, identical to a host-side
+    ``tdigest_merge`` of the per-replica stack."""
+    rng = np.random.RandomState(43)
+    parts = [
+        tdigest_update(tdigest_init(64), jnp.asarray(rng.randn(400).astype(np.float32)))
+        for _ in range(4)
+    ]
+    stack = jnp.stack(parts)
+    red = resolve_reduction("tdigest")
+    out = jax.vmap(
+        lambda s: reduce_state_in_graph(s, {"digest": red}, "dp"), axis_name="dp"
+    )({"digest": stack})["digest"]
+    expected = np.asarray(tdigest_merge(stack))
+    for r in range(4):
+        np.testing.assert_array_equal(np.asarray(out[r]), expected)
+
+
+# -------------------------------------- elastic: checkpoint → merge-on-rejoin
+def test_sketch_checkpoint_merge_on_rejoin_matches_direct_merge():
+    rng = np.random.RandomState(47)
+    a = ApproxQuantile(q=0.5, compression=64)
+    b = ApproxQuantile(q=0.5, compression=64)
+    da, db = rng.randn(2, 1_000).astype(np.float32)
+    a.update(jnp.asarray(da))
+    b.update(jnp.asarray(db))
+    expected = a.merge_states([a._tensor_state(), b._tensor_state()])
+    blob = checkpoint_metric(b)  # the preempted rank hands off its state...
+    merge_checkpoint(a, blob)  # ...and folds back into the surviving peer
+    np.testing.assert_array_equal(np.asarray(a.digest), np.asarray(expected["digest"]))
+    # the rejoined estimate stays inside the documented envelope on the union
+    both = np.concatenate([da, db])
+    rank = float(np.mean(both <= float(a.compute())))
+    assert abs(rank - 0.5) <= a.error_bound()
+
+
+def test_sketch_metric_survives_elastic_drop_and_rejoin():
+    """ChaosSync drop → degraded partial result with honest coverage;
+    rejoin → full-coverage result bitwise equal to the fault-free run."""
+    from torchmetrics_tpu.parallel import ChaosSchedule, ElasticSync, chaos_group
+
+    rng = np.random.RandomState(53)
+    data = rng.rand(2, 800).astype(np.float32)
+
+    def _ranks():
+        ms = [ApproxQuantile(q=0.5, compression=64) for _ in range(2)]
+        for r, m in enumerate(ms):
+            m.update(jnp.asarray(data[r]))
+        return ms
+
+    ref = _ranks()
+    ref[0]._sync_backend = FakeSync([m.metric_state for m in ref], 0)
+    fault_free = float(ref[0].compute())
+
+    ms = _ranks()
+    sched = ChaosSchedule({0: [("drop", 1)], 1: [("rejoin", 1)]})
+    backs = chaos_group([m.metric_state for m in ms], sched)
+    for r, m in enumerate(ms):
+        m._sync_backend = ElasticSync(backs[r], policy=SyncPolicy(retry_attempts=1))
+    ctrl = backs[0].controller
+
+    ctrl.advance()  # round 0: rank 1 absent — degraded, coverage 1/2
+    degraded = float(ms[0].compute())
+    cov = ms[0].coverage
+    assert cov is not None and cov.ranks_present == 1 and cov.ranks_expected == 2
+    # rank 0 alone: its own data's median, within the sketch envelope
+    rank0 = float(np.mean(data[0] <= degraded))
+    assert abs(rank0 - 0.5) <= ms[0].error_bound()
+
+    ctrl.advance()  # round 1: rank 1 rejoins — full coverage, bitwise result
+    ms[0]._computed = None
+    rejoined = float(ms[0].compute())
+    cov = ms[0].coverage
+    assert cov is not None and cov.fraction == 1.0
+    assert rejoined == fault_free

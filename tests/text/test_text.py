@@ -360,3 +360,35 @@ def test_infolm_end_to_end_with_user_model():
     m2 = InfoLM(user_tokenizer=tok, user_forward_fn=fwd, idf=False)
     m2.update(["the cat sat"], ["the cat sat"])
     np.testing.assert_allclose(float(m2.compute()), 0.0, atol=1e-5)
+
+
+def test_ter_tokenizer_memo_is_a_true_lru(monkeypatch):
+    """Regression: the tokenizer memo is a capped LRU, not a fill-once dict —
+    hits refresh recency, overflow evicts the LEAST-recently-used entry, and
+    eviction never changes tokenization results."""
+    import torchmetrics_tpu.functional.text.ter as ter_mod
+
+    monkeypatch.setattr(ter_mod, "_MEMO_CAP", 4)
+    tok = ter_mod._TercomTokenizer()
+    sents = [f"Sentence number {i} ." for i in range(6)]
+    outs = [tok(s) for s in sents[:4]]  # fill to cap
+    assert len(tok._memo) == 4
+    assert tok(sents[0]) == outs[0]  # hit: refreshes sents[0]'s recency
+    tok(sents[4])  # overflow: evicts sents[1] (now the LRU), NOT sents[0]
+    assert len(tok._memo) == 4
+    assert sents[0] in tok._memo and sents[1] not in tok._memo
+    tok(sents[5])  # evicts sents[2]
+    assert sents[2] not in tok._memo
+    # evicted entries recompute to the same tokenization
+    assert tok(sents[1]) == outs[1]
+    assert len(tok._memo) == 4  # never exceeds the cap
+
+
+def test_ter_tokenizer_bounded_on_low_repetition_stream():
+    """A long stream of distinct sentences stays bounded at _MEMO_CAP."""
+    from torchmetrics_tpu.functional.text.ter import _MEMO_CAP, _TercomTokenizer
+
+    tok = _TercomTokenizer()
+    for i in range(_MEMO_CAP + 257):
+        tok(f"unique sentence {i}")
+    assert len(tok._memo) == _MEMO_CAP

@@ -74,7 +74,7 @@ def run_lint(
     paths: Sequence[str],
     root: str = ".",
     baseline_path: Optional[str] = DEFAULT_BASELINE,
-    root_kinds: Tuple[str, ...] = ("update", "kernel", "sync"),
+    root_kinds: Tuple[str, ...] = ("update", "kernel", "sync", "sketch"),
 ) -> LintResult:
     corpus = Corpus.build(list(paths), root=root)
     roots = find_roots(corpus, kinds=root_kinds)

@@ -25,15 +25,15 @@ def main(argv=None) -> int:
                     help="report every violation, ignoring the baseline")
     ap.add_argument("--update-baseline", action="store_true",
                     help="rewrite the baseline from this scan and exit 0")
-    ap.add_argument("--roots", default="update,kernel,sync",
-                    help="comma-separated root kinds: update,kernel,sync,compute")
+    ap.add_argument("--roots", default="update,kernel,sync,sketch",
+                    help="comma-separated root kinds: update,kernel,sync,sketch,compute")
     ap.add_argument("--json", action="store_true", help="emit one JSON object instead of text")
     ap.add_argument("--show-waived", action="store_true", help="also list waived/baselined hits")
     args = ap.parse_args(argv)
 
     paths = args.paths or ["torchmetrics_tpu"]
     root_kinds = tuple(k.strip() for k in args.roots.split(",") if k.strip())
-    if not set(root_kinds) <= {"update", "kernel", "sync", "compute"}:
+    if not set(root_kinds) <= {"update", "kernel", "sync", "sketch", "compute"}:
         ap.error(f"unknown root kind in --roots={args.roots}")
 
     result = run_lint(

@@ -33,6 +33,11 @@ SYNC_ROOT_RE = re.compile(
     r"reduce_scatter_sum|quantized_allreduce|quantize_chunks|dequantize_chunks)$"
 )
 
+# sketch state kernels under sketches/ (reservoir_update, tdigest_merge,
+# countmin_update, ...) — registered as state reductions, so they trace
+# inside metric updates AND inside the in-graph sync epilogue: jit roots
+SKETCH_ROOT_RE = re.compile(r"^\w+_(update|merge|compress)$")
+
 # attribute reads that return host metadata, not device data
 _META_ATTRS = {"shape", "ndim", "size", "dtype", "at", "T"}
 _META_VALUE_ATTRS = {"shape", "ndim", "size", "dtype"}
@@ -345,6 +350,10 @@ def find_roots(corpus: Corpus, kinds: Tuple[str, ...] = ("update", "kernel")) ->
     if "sync" in kinds:
         for qn, fn in corpus.functions.items():
             if fn.cls is None and ".parallel." in fn.module.name and SYNC_ROOT_RE.match(fn.name):
+                roots[qn] = fn
+    if "sketch" in kinds:
+        for qn, fn in corpus.functions.items():
+            if fn.cls is None and ".sketches." in fn.module.name and SKETCH_ROOT_RE.match(fn.name):
                 roots[qn] = fn
     return roots
 

@@ -228,8 +228,14 @@ class MeanMetric(BaseAggregator):
 class RunningMean(BaseAggregator):
     """Mean over a sliding window of the last ``window`` updates.
 
-    Parity: reference ``aggregation.py:616``. Window cropping is host-side
-    list management, so this metric runs its update eagerly.
+    Parity: reference ``aggregation.py:616`` — but where the reference crops
+    a host-side list (``pop(0)`` per update, state growing with batch size),
+    this keeps a fixed-shape ring of per-update ``[sum, count]`` pairs plus a
+    device-resident cursor. The update is pure index arithmetic, so it jits,
+    stages under ``buffered(window=K)``'s scanned flush, and holds O(window)
+    state regardless of batch sizes. The computed value — mean over all
+    elements of the last ``window`` updates, nan-ignored elements excluded —
+    is unchanged.
 
     Example:
         >>> import jax.numpy as jnp
@@ -241,29 +247,32 @@ class RunningMean(BaseAggregator):
         2.5
     """
 
-    jittable = False
+    full_state_update = True  # update reads the cursor/ring it advances
 
     def __init__(self, window: int = 5, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
-        # window cropping pops whole per-update increments: len()/pop(0)
-        # count increments, not rows, so this state needs the list layout
-        # (the padded CatBuffer only supports appends + masked reads)
-        kwargs.setdefault("list_layout", "list")
-        super().__init__("cat", [], nan_strategy, **kwargs)
+        # ring rows are per-update [element sum, element count]; stale rows
+        # are overwritten in cursor order, so the ring always holds exactly
+        # the last min(updates, window) increments
+        super().__init__(
+            "sum", jnp.zeros((max(int(window), 1), 2), dtype=jnp.float32), nan_strategy, **kwargs
+        )
         if not (isinstance(window, int) and window > 0):
             raise ValueError(f"Arg `window` should be a positive integer but got {window}")
         self.window = window
+        self.add_state("cursor", default=jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="max")
 
     def update(self, value: Array) -> None:
         value = jnp.atleast_1d(self._impute(jnp.asarray(value, dtype=jnp.float32)))
-        if self.nan_strategy in ("ignore", "warn"):
-            value = value[~jnp.isnan(value)]
-        self.value.append(value)
-        while len(self.value) > self.window:
-            self.value.pop(0)
+        mask = self._nan_mask(value)
+        row = jnp.stack(
+            [jnp.sum(value, where=mask), jnp.sum(mask).astype(jnp.float32)]
+        )
+        self.value = self.value.at[self.cursor % self.window].set(row)
+        self.cursor = self.cursor + 1
 
     def compute(self) -> Array:
-        vals = cat_state_or_empty(self.value)
-        return jnp.mean(vals) if vals.size else jnp.asarray(0.0, dtype=jnp.float32)
+        total, count = jnp.sum(self.value, axis=0)
+        return jnp.where(count > 0, total / jnp.maximum(count, 1.0), 0.0)
 
 
 class RunningSum(RunningMean):
@@ -280,5 +289,120 @@ class RunningSum(RunningMean):
     """
 
     def compute(self) -> Array:
-        vals = cat_state_or_empty(self.value)
-        return jnp.sum(vals) if vals.size else jnp.asarray(0.0, dtype=jnp.float32)
+        return jnp.sum(self.value[:, 0])
+
+
+class WindowedSum(Metric):
+    """Sum over (approximately) the last ``horizon`` updates, slot-granular.
+
+    Thin facade over ``SumMetric().windowed(...)`` — see
+    :class:`~torchmetrics_tpu.online.WindowedMetric`. Unlike
+    :class:`RunningSum` (exact per-update ring, O(window) state) this rotates
+    ``slots`` sub-epoch states, so ``horizon`` can be large (e.g. one hour of
+    serving traffic) at O(slots) state.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import WindowedSum
+        >>> metric = WindowedSum(horizon=4, slots=4)
+        >>> for v in [1.0, 2.0, 3.0, 4.0, 5.0]:
+        ...     metric.update(jnp.asarray(v))
+        >>> float(metric.compute())
+        14.0
+    """
+
+    _base_cls: Any = SumMetric
+
+    def __new__(cls, horizon: int = 64, slots: int = 8, **kwargs: Any) -> Any:
+        from .online import WindowedMetric
+
+        return WindowedMetric(cls._base_cls(**kwargs), horizon=horizon, slots=slots)
+
+
+class WindowedMean(WindowedSum):
+    """Weighted mean over (approximately) the last ``horizon`` updates.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import WindowedMean
+        >>> metric = WindowedMean(horizon=2, slots=2)
+        >>> for v in [0.0, 4.0, 6.0]:
+        ...     metric.update(jnp.asarray(v))
+        >>> float(metric.compute())
+        5.0
+    """
+
+    _base_cls = MeanMetric
+
+
+class WindowedMax(WindowedSum):
+    """Maximum over (approximately) the last ``horizon`` updates — a max that
+    can *recover* when the spike ages out of the window.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import WindowedMax
+        >>> metric = WindowedMax(horizon=2, slots=2)
+        >>> for v in [9.0, 1.0, 2.0, 1.0]:
+        ...     metric.update(jnp.asarray(v))
+        >>> float(metric.compute())
+        2.0
+    """
+
+    _base_cls = MaxMetric
+
+
+class WindowedMin(WindowedSum):
+    """Minimum over (approximately) the last ``horizon`` updates.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import WindowedMin
+        >>> metric = WindowedMin(horizon=2, slots=2)
+        >>> for v in [-9.0, 1.0, 2.0, 3.0]:
+        ...     metric.update(jnp.asarray(v))
+        >>> float(metric.compute())
+        2.0
+    """
+
+    _base_cls = MinMetric
+
+
+class DecayedSum(Metric):
+    """Exponentially-decayed sum: an update made ``halflife`` updates ago
+    contributes half its value. Facade over ``SumMetric().decayed(...)`` —
+    see :class:`~torchmetrics_tpu.online.DecayedMetric`.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import DecayedSum
+        >>> metric = DecayedSum(halflife=1.0)
+        >>> for v in [8.0, 0.0, 0.0, 0.0]:
+        ...     metric.update(jnp.asarray(v))
+        >>> float(metric.compute())
+        1.0
+    """
+
+    _base_cls: Any = SumMetric
+
+    def __new__(cls, halflife: float = 64.0, **kwargs: Any) -> Any:
+        from .online import DecayedMetric
+
+        return DecayedMetric(cls._base_cls(**kwargs), halflife=halflife)
+
+
+class DecayedMean(DecayedSum):
+    """Exponentially-weighted mean (EMA with a half-life): both the weighted
+    value sum and the weight sum decay, so the ratio tracks recent data.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import DecayedMean
+        >>> metric = DecayedMean(halflife=2.0)
+        >>> for v in [0.0, 0.0, 1.0, 1.0]:
+        ...     metric.update(jnp.asarray(v))
+        >>> float(metric.compute()) > 0.5
+        True
+    """
+
+    _base_cls = MeanMetric

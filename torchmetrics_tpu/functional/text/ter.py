@@ -22,6 +22,7 @@ The tercom pipeline per sentence pair, mirrored here exactly:
 """
 import math
 import re
+from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -35,6 +36,7 @@ _MAX_SHIFT_DIST = 50
 _MAX_SHIFT_CANDIDATES = 1000
 _BEAM_WIDTH = 25
 _MAX_CACHED_ROWS = 10_000
+_MEMO_CAP = 4096  # LRU entries per tokenizer (repeated references dominate MT eval)
 _INF = 10**16
 
 # edit ops: 'n' keep, 's' substitute, 'i' insert, 'd' delete
@@ -57,15 +59,20 @@ class _TercomTokenizer:
         self.no_punctuation = no_punctuation
         self.lowercase = lowercase
         self.asian_support = asian_support
-        self._memo: Dict[str, str] = {}
+        self._memo: "OrderedDict[str, str]" = OrderedDict()
 
     def __call__(self, sentence: str) -> str:
+        # true LRU: hits refresh recency, overflow evicts the oldest entry —
+        # a long low-repetition stream stays bounded at _MEMO_CAP instead of
+        # freezing a stale first-epoch snapshot (the old fill-once dict)
         hit = self._memo.get(sentence)
         if hit is not None:
+            self._memo.move_to_end(sentence)
             return hit
         out = self._tokenize(sentence)
-        if len(self._memo) < 2**16:  # repeated references dominate MT eval
-            self._memo[sentence] = out
+        self._memo[sentence] = out
+        if len(self._memo) > _MEMO_CAP:
+            self._memo.popitem(last=False)
         return out
 
     def _tokenize(self, sentence: str) -> str:

@@ -37,6 +37,7 @@ import functools
 import hashlib
 import inspect
 import itertools
+import sys
 from contextlib import contextmanager
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -267,9 +268,18 @@ def executable_cache_stats() -> Dict[str, int]:
     issued; in-graph collectives count once per trace, eager once per call —
     see ``parallel.strategies.record_collective``), and elastic-sync health
     (retry/timeout/degraded counts plus the last round's coverage record —
-    see ``parallel.elastic``)."""
+    see ``parallel.elastic``; the per-metric view of the same record is the
+    :attr:`Metric.coverage` property). The ``online`` entry carries the
+    online-evaluation dispatch counters (windowed/decayed metrics created,
+    eager update dispatches, estimated window rotations — see
+    ``online.online_stats``); it is ``{}`` until ``torchmetrics_tpu.online``
+    is first used."""
     wire = wire_stats()
     es = elastic_stats()
+    online: Dict[str, int] = {}
+    mod = sys.modules.get("torchmetrics_tpu.online")
+    if mod is not None:
+        online = mod.online_stats()
     return {
         "size": len(_EXECUTABLE_CACHE),
         "hits": _CACHE_STATS["hits"],
@@ -285,6 +295,7 @@ def executable_cache_stats() -> Dict[str, int]:
         "sync_timeouts": es["timeouts"],
         "degraded_syncs": es["degraded_syncs"],
         "coverage": es["last_coverage"],
+        "online": online,
     }
 
 
@@ -524,6 +535,24 @@ class Metric:
 
         return BufferedMetric(self, window, overlap_sync=overlap_sync)
 
+    def windowed(self, horizon: int, slots: int = 8) -> "Any":
+        """Return a :class:`~torchmetrics_tpu.online.WindowedMetric` tracking
+        this metric over a sliding window of (approximately) the last
+        ``horizon`` updates, as a ring of ``slots`` sub-epoch state slots
+        rotated entirely in-graph (see ``docs/online_evaluation.md``)."""
+        from .online import WindowedMetric
+
+        return WindowedMetric(self, horizon=horizon, slots=slots)
+
+    def decayed(self, halflife: float) -> "Any":
+        """Return a :class:`~torchmetrics_tpu.online.DecayedMetric` tracking
+        this metric with per-update exponential decay: an observation made
+        ``halflife`` updates ago contributes half its original weight (see
+        ``docs/online_evaluation.md``)."""
+        from .online import DecayedMetric
+
+        return DecayedMetric(self, halflife=halflife)
+
     def reset(self) -> None:
         """Restore default states. Parity: reference ``metric.py:673-688``."""
         self._flush_pending()
@@ -659,6 +688,10 @@ class Metric:
                 merged[name] = jnp.maximum(glob, batch)
             elif red == Reduction.MIN:
                 merged[name] = jnp.minimum(glob, batch)
+            elif callable(red) and getattr(red, "mergeable", False):
+                # sketch reductions (reservoir/t-digest): the tag IS the
+                # n-way merge over a leading stack axis
+                merged[name] = red(jnp.stack([glob, batch]))
             else:  # NONE / custom: forward fast path keeps the batch value;
                 # metrics whose update reads global state set full_state_update=True
                 merged[name] = batch
@@ -703,10 +736,12 @@ class Metric:
         prior MEAN value is ignored, matching a fresh state.
         """
         for red in self._reductions.values():
-            if red == Reduction.NONE or callable(red):
+            if red == Reduction.NONE or (
+                callable(red) and not isinstance(red, Reduction) and not getattr(red, "mergeable", False)
+            ):
                 raise TorchMetricsUserError(
                     f"{type(self).__name__} has a custom/None reduction state; "
-                    "update_state_batched requires associative (sum/mean/max/min/cat) reductions."
+                    "update_state_batched requires associative (sum/mean/max/min/cat/sketch) reductions."
                 )
 
         def one_step(step_args, step_kwargs):
@@ -741,6 +776,8 @@ class Metric:
                 out[name] = jnp.maximum(state[name], jnp.max(v, axis=0))
             elif red == Reduction.MIN:
                 out[name] = jnp.minimum(state[name], jnp.min(v, axis=0))
+            elif callable(red):  # mergeable sketch: n-way merge with prior state
+                out[name] = red(jnp.concatenate([state[name][None], v], axis=0))
         return out
 
     def compute_state(self, state: StateDict) -> Any:

@@ -1,0 +1,310 @@
+"""Online evaluation: sliding-window and exponentially-decayed metrics.
+
+Epoch metrics accumulate forever; a serving stream needs *recency*. This
+module adds two generic wrappers over any fixed-shape, jittable metric:
+
+- :class:`WindowedMetric` (``Metric.windowed(horizon=...)``) — a ring of
+  ``slots`` sub-epoch state slots, each covering ``horizon // slots``
+  updates. Every update folds the batch into the current slot with the base
+  metric's own merge semantics; when a slot fills, the ring advances and the
+  oldest slot is cleared to the base defaults. Rotation is pure in-graph
+  arithmetic on a device-resident cursor (no host transfers, no retraces —
+  one executable serves the whole stream), so a ``buffered(window=K)`` flush
+  stages rotation inside its ``lax.scan`` body automatically. ``compute()``
+  merges the live slots — masked by per-slot update counts exactly like
+  ``CatBuffer``'s valid-count masking — and runs the base compute, so the
+  result covers (approximately) the last ``horizon`` updates with slot
+  granularity: between ``horizon − horizon//slots + 1`` and ``horizon``
+  updates once the ring is warm.
+
+- :class:`DecayedMetric` (``Metric.decayed(halflife=...)``) — exponential
+  decay folded into the update body: each update first scales the state by
+  ``d = 0.5 ** (1/halflife)``, then merges the batch, so an observation made
+  ``halflife`` updates ago carries half weight. Supported state leaves: SUM
+  reductions (floats scale; integer counters scale-and-floor) and sketch
+  reductions with a decay hook (reservoir keys divide by ``d``, t-digest
+  centroid weights scale). MAX/MIN/MEAN leaves have no meaningful decay —
+  use ``windowed()`` for those.
+
+Both wrappers are ordinary metrics: their slot/decayed states carry
+elementwise or mergeable-sketch reduction tags, so eager ``sync()``, the
+in-graph bucketed collectives, every SyncPolicy route, checkpointing and
+ElasticSync merge-on-rejoin work unchanged. Concrete aggregator variants
+(``WindowedSum``/``WindowedMean``/``WindowedMax``/``WindowedMin``,
+``DecayedSum``/``DecayedMean``) live in :mod:`torchmetrics_tpu.aggregation`.
+
+See ``docs/online_evaluation.md`` for semantics and accuracy knobs.
+"""
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from .metric import Metric
+from .parallel.reduction import Reduction
+
+Array = jax.Array
+
+__all__ = [
+    "WindowedMetric",
+    "DecayedMetric",
+    "online_stats",
+    "reset_online_stats",
+]
+
+# eager-dispatch counters surfaced via executable_cache_stats()["online"]:
+# instances created, eager update dispatches (buffered flushes stage updates
+# without re-entering the eager path, so staged steps are not re-counted),
+# and window rotations estimated from per-metric update counts.
+_ONLINE_STATS: Dict[str, int] = {
+    "windowed_metrics": 0,
+    "decayed_metrics": 0,
+    "windowed_updates": 0,
+    "decayed_updates": 0,
+    "window_rotations": 0,
+}
+
+
+def online_stats() -> Dict[str, int]:
+    """Snapshot of the online-evaluation dispatch counters."""
+    return dict(_ONLINE_STATS)
+
+
+def reset_online_stats() -> None:
+    for k in _ONLINE_STATS:
+        _ONLINE_STATS[k] = 0
+
+
+class _SlotwiseMerge:
+    """Per-slot n-way merge for a ``(slots, ...)`` stacked sketch leaf.
+
+    Wraps a sketch reduction so a gathered ``(n, slots, ...)`` stack merges
+    slot-by-slot (``vmap`` over the slot axis) — the sync layers see just
+    another mergeable callable and route it through the bucketed gather."""
+
+    mergeable = True
+
+    def __init__(self, inner: Any) -> None:
+        self.inner = inner
+
+    def __call__(self, stack: Array) -> Array:
+        return jax.vmap(self.inner, in_axes=1, out_axes=0)(stack)
+
+    def __repr__(self) -> str:
+        return f"_SlotwiseMerge({self.inner!r})"
+
+    def __str__(self) -> str:
+        return f"slotwise:{self.inner}"
+
+    def __reduce__(self):
+        return (_SlotwiseMerge, (self.inner,))
+
+
+_WINDOWABLE = (Reduction.SUM, Reduction.MEAN, Reduction.MAX, Reduction.MIN)
+
+
+def _check_online_base(base: Metric, verb: str) -> None:
+    if not isinstance(base, Metric):
+        raise TypeError(f"can only {verb} a Metric, got {type(base).__name__}")
+    if not type(base).jittable or not base._use_jit:
+        raise ValueError(
+            f"cannot {verb} {type(base).__name__}: online wrappers rotate/decay state "
+            "in-graph, so the base update body must be jittable."
+        )
+    if base._list_states:
+        raise ValueError(
+            f"cannot {verb} {type(base).__name__}: cat/list states grow without bound; "
+            "use a sketch-backed state (reservoir/tdigest/countmin) for unbounded streams."
+        )
+    if base.update_count:
+        raise ValueError(
+            f"cannot {verb} {type(base).__name__} with accumulated state; wrap a fresh "
+            "metric (or reset() it first) — the wrapper starts from the state defaults."
+        )
+
+
+class WindowedMetric(Metric):
+    """Sliding-window view of a base metric over its last ``horizon`` updates.
+
+    Built via ``base.windowed(horizon=..., slots=...)``. State is a ring of
+    ``slots`` copies of every base state leaf plus a device-resident cursor
+    and per-slot valid counts; see the module docstring for semantics.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import SumMetric
+        >>> m = SumMetric().windowed(horizon=4, slots=4)
+        >>> for v in [1.0, 2.0, 3.0, 4.0, 5.0]:
+        ...     m.update(jnp.asarray(v))
+        >>> float(m.compute())  # slot holding 1.0 was rotated out
+        14.0
+    """
+
+    full_state_update = True  # update reads the cursor/counts it advances
+    higher_is_better = None
+    is_differentiable = False
+
+    def __init__(self, base: Metric, horizon: int, slots: int = 8, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        _check_online_base(base, "window")
+        if not (isinstance(slots, int) and slots >= 2):
+            raise ValueError(f"slots must be an int >= 2, got {slots}")
+        if not (isinstance(horizon, int) and horizon >= slots and horizon % slots == 0):
+            raise ValueError(
+                f"horizon must be a positive multiple of slots={slots}, got {horizon}"
+            )
+        for red in base._reductions.values():
+            if not (red in _WINDOWABLE or getattr(red, "mergeable", False)):
+                raise ValueError(
+                    f"cannot window a {red!r} state; windowed() needs mergeable "
+                    "(sum/mean/max/min/sketch) reductions."
+                )
+        self.base = base
+        self.horizon = horizon
+        self.slots = slots
+        self.slot_len = horizon // slots
+        reserved = {"base", "horizon", "slots", "slot_len", "_win_cursor", "_win_count"}
+        for name, default in base._defaults.items():
+            if name in reserved:
+                raise ValueError(f"state name {name!r} collides with WindowedMetric internals")
+            red = base._reductions[name]
+            slot_red = _SlotwiseMerge(red) if getattr(red, "mergeable", False) else red
+            stacked = jnp.array(jnp.broadcast_to(default, (slots,) + jnp.shape(default)))
+            self.add_state(name, default=stacked, dist_reduce_fx=slot_red)
+        self.add_state("_win_cursor", default=jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="max")
+        self.add_state(
+            "_win_count", default=jnp.zeros((slots,), dtype=jnp.int32), dist_reduce_fx="sum"
+        )
+        _ONLINE_STATS["windowed_metrics"] += 1
+
+    def _eager_validate(self, *args: Any, **kwargs: Any) -> None:
+        self.base._eager_validate(*args, **kwargs)
+        _ONLINE_STATS["windowed_updates"] += 1
+        if self._update_count > 1 and (self._update_count - 1) % self.slot_len == 0:
+            _ONLINE_STATS["window_rotations"] += 1
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        base = self.base
+        cursor = self._win_cursor
+        counts = self._win_count
+        # rotate when the current slot is full: advance and clear the slot
+        # being entered (the oldest) back to the base defaults — the in-graph
+        # analogue of CatBuffer's valid-count masking, with `rotate` a traced
+        # scalar so ONE executable serves the whole stream
+        rotate = counts[cursor] >= jnp.int32(self.slot_len)
+        new_cursor = jnp.where(rotate, (cursor + 1) % self.slots, cursor)
+        slot_state: Dict[str, Array] = {}
+        staged: Dict[str, Array] = {}
+        for name, default in base._defaults.items():
+            stacked = getattr(self, name)
+            cleared = stacked.at[new_cursor].set(default)
+            stacked = jnp.where(rotate, cleared, stacked)
+            staged[name] = stacked
+            slot_state[name] = stacked[new_cursor]
+        counts = jnp.where(rotate, counts.at[new_cursor].set(0), counts)
+        n_prev = counts[new_cursor]
+        batch, _ = base._pure_update(dict(base._defaults), tuple(args), dict(kwargs))
+        merged = base._merge_tensor_states(slot_state, batch, n_prev)
+        for name in base._defaults:
+            setattr(self, name, staged[name].at[new_cursor].set(merged[name]))
+        self._win_count = counts.at[new_cursor].add(1)
+        self._win_cursor = new_cursor
+
+    def compute(self) -> Any:
+        base = self.base
+        counts = self._win_count
+        merged: Dict[str, Array] = {}
+        for name, red in base._reductions.items():
+            stacked = getattr(self, name)
+            if red == Reduction.SUM:
+                merged[name] = jnp.sum(stacked, axis=0)
+            elif red == Reduction.MEAN:
+                # weight each slot's mean by its update count (empty slots
+                # carry weight 0 — the valid-count mask)
+                w = counts.astype(jnp.float32).reshape((-1,) + (1,) * (stacked.ndim - 1))
+                total = jnp.sum(counts).astype(jnp.float32)
+                mean = jnp.sum(stacked * w, axis=0) / jnp.maximum(total, 1.0)
+                merged[name] = jnp.where(total > 0, mean, base._defaults[name])
+            elif red == Reduction.MAX:
+                merged[name] = jnp.max(stacked, axis=0)
+            elif red == Reduction.MIN:
+                merged[name] = jnp.min(stacked, axis=0)
+            else:  # mergeable sketch: n-way merge over the slot axis (empty
+                # slots are the sketch defaults — merge identities)
+                merged[name] = red(stacked)
+        return base._pure_compute(merged, {})
+
+
+class DecayedMetric(Metric):
+    """Exponentially-decayed view of a base metric.
+
+    Built via ``base.decayed(halflife=...)``; see the module docstring.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import MeanMetric
+        >>> m = MeanMetric().decayed(halflife=2.0)
+        >>> for v in [0.0, 0.0, 1.0, 1.0]:
+        ...     m.update(jnp.asarray(v))
+        >>> float(m.compute()) > 0.5  # recent 1.0s outweigh the old 0.0s
+        True
+    """
+
+    full_state_update = True  # update decays the state it reads
+    higher_is_better = None
+    is_differentiable = False
+
+    def __init__(self, base: Metric, halflife: float, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        _check_online_base(base, "decay")
+        if not halflife > 0:
+            raise ValueError(f"halflife must be positive, got {halflife}")
+        for name, red in base._reductions.items():
+            decayable = red == Reduction.SUM or (
+                getattr(red, "mergeable", False) and getattr(red, "supports_decay", False)
+            )
+            if not decayable:
+                raise ValueError(
+                    f"cannot decay state {name!r} with reduction {red!r}: exponential "
+                    "decay is defined for SUM and decay-capable sketch states; wrap "
+                    "max/min/mean-style metrics with windowed() instead."
+                )
+        self.base = base
+        self.halflife = float(halflife)
+        self.decay_factor = float(0.5 ** (1.0 / self.halflife))
+        reserved = {"base", "halflife", "decay_factor"}
+        for name, default in base._defaults.items():
+            if name in reserved:
+                raise ValueError(f"state name {name!r} collides with DecayedMetric internals")
+            self.add_state(name, default=jnp.array(default, copy=True), dist_reduce_fx=base._reductions[name])
+        _ONLINE_STATS["decayed_metrics"] += 1
+
+    def _eager_validate(self, *args: Any, **kwargs: Any) -> None:
+        self.base._eager_validate(*args, **kwargs)
+        _ONLINE_STATS["decayed_updates"] += 1
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        base = self.base
+        d = jnp.float32(self.decay_factor)
+        decayed: Dict[str, Array] = {}
+        for name, red in base._reductions.items():
+            x = getattr(self, name)
+            if isinstance(red, Reduction):  # SUM (validated in __init__)
+                if jnp.issubdtype(x.dtype, jnp.integer):
+                    # integer counters decay by scale-and-floor: still an
+                    # overestimate-only transform for count-min tables
+                    x = jnp.floor(x.astype(jnp.float32) * d).astype(x.dtype)
+                else:
+                    x = x * d
+            else:
+                x = red.decay(x, d)
+            decayed[name] = x
+        batch, _ = base._pure_update(dict(base._defaults), tuple(args), dict(kwargs))
+        merged = base._merge_tensor_states(decayed, batch, jnp.int32(1))
+        for name in base._defaults:
+            setattr(self, name, merged[name])
+
+    def compute(self) -> Any:
+        return self.base._pure_compute(
+            {name: getattr(self, name) for name in self.base._defaults}, {}
+        )

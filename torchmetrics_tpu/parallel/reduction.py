@@ -32,6 +32,85 @@ ELEMENTWISE_REDUCTIONS = frozenset({Reduction.SUM, Reduction.MEAN, Reduction.MAX
 ReduceFx = Union[str, Reduction, Callable, None]
 
 
+class SketchReduction:
+    """A named, *mergeable* reduction for fixed-shape sketch states.
+
+    Instances are callables that merge an ``(n, ...)`` stack of per-replica
+    sketch arrays into one sketch of the same shape, so they flow through
+    every layer that already handles custom callable reductions — the
+    in-graph bucketed gather (``reduce_state_in_graph``), the eager sync
+    backends, ``Metric.merge_states`` and therefore ElasticSync's
+    merge-on-rejoin — with no new code in those layers. Unlike anonymous
+    callables they additionally declare ``mergeable = True`` (the merge is
+    n-way associative/permutation-invariant), so the batched-update and
+    forward fast paths accept them, and they pickle by registry name so
+    checkpointed metrics rehydrate to the same singleton.
+
+    ``decay`` (optional) folds a per-update exponential decay factor into
+    the sketch state; sketches without a decay hook reject
+    ``Metric.decayed()``.
+    """
+
+    mergeable = True
+
+    def __init__(self, kind: str, merge: Callable, decay: Optional[Callable] = None) -> None:
+        self.kind = kind
+        self._merge = merge
+        self._decay = decay
+
+    def __call__(self, stack):
+        return self._merge(stack)
+
+    def decay(self, state, factor):
+        if self._decay is None:
+            raise ValueError(f"sketch reduction {self.kind!r} does not support exponential decay")
+        return self._decay(state, factor)
+
+    @property
+    def supports_decay(self) -> bool:
+        return self._decay is not None
+
+    def __repr__(self) -> str:
+        return f"SketchReduction({self.kind!r})"
+
+    def __str__(self) -> str:
+        # stable across processes/instances: participates in the executable
+        # cache key (metric.py freezes reductions via str())
+        return f"sketch:{self.kind}"
+
+    def __reduce__(self):
+        return (_lookup_sketch_reduction, (self.kind,))
+
+
+#: registry of sketch reduction tags: name -> SketchReduction (or a plain
+#: Reduction alias when the sketch's merge IS an existing elementwise
+#: reduction — count-min merges by elementwise addition, so it rides the
+#: psum/reduce-scatter buckets as a SUM leaf, bitwise-exact on every route).
+SKETCH_REDUCTIONS: dict = {}
+
+
+def register_sketch_reduction(kind: str, merge, decay=None) -> "SketchReduction":
+    red = SketchReduction(kind, merge, decay=decay)
+    SKETCH_REDUCTIONS[kind] = red
+    return red
+
+
+def register_sketch_alias(kind: str, red: Reduction) -> Reduction:
+    SKETCH_REDUCTIONS[kind] = red
+    return red
+
+
+def _lookup_sketch_reduction(kind: str):
+    _ensure_sketches_loaded()
+    return SKETCH_REDUCTIONS[kind]
+
+
+def _ensure_sketches_loaded() -> None:
+    """Import the sketches package so its reductions self-register."""
+    if not SKETCH_REDUCTIONS:
+        import torchmetrics_tpu.sketches  # noqa: F401  (registration side effect)
+
+
 def resolve_reduction(fx: ReduceFx) -> Union[Reduction, Callable]:
     """Map user-facing ``dist_reduce_fx`` values to a Reduction tag."""
     if fx is None:
@@ -42,8 +121,12 @@ def resolve_reduction(fx: ReduceFx) -> Union[Reduction, Callable]:
         try:
             return Reduction(fx)
         except ValueError:
+            _ensure_sketches_loaded()
+            if fx in SKETCH_REDUCTIONS:
+                return SKETCH_REDUCTIONS[fx]
             raise ValueError(
-                f"`dist_reduce_fx` must be one of {[r.value for r in Reduction]} or a callable, got {fx!r}"
+                f"`dist_reduce_fx` must be one of {[r.value for r in Reduction]}, "
+                f"a sketch tag ({sorted(SKETCH_REDUCTIONS)}) or a callable, got {fx!r}"
             ) from None
     if callable(fx):
         return fx

@@ -1,0 +1,70 @@
+"""Fixed-shape, jit-clean, mergeable sketch states.
+
+Three sketches back the online-evaluation layer (``torchmetrics_tpu.online``)
+and register themselves as first-class state reductions beside SUM/MEAN/CAT:
+
+- ``"reservoir"`` — weighted reservoir sample (:mod:`.reservoir`),
+- ``"tdigest"`` — t-digest quantile sketch (:mod:`.tdigest`),
+- ``"countmin"`` — count-min frequency table (:mod:`.countmin`); its merge
+  is elementwise addition, so it registers as a plain ``Reduction.SUM``
+  alias and rides the psum/reduce-scatter buckets bitwise-exactly.
+
+``Metric.add_state(..., dist_reduce_fx="tdigest")`` is all a metric needs:
+the registered reduction is a mergeable callable, so the fused collection
+dispatch, the bucketed SyncPolicy gather routes, checkpointing and
+ElasticSync's merge-on-rejoin handle sketch leaves through the code paths
+that already served custom callable reductions.
+"""
+from ..parallel.reduction import Reduction, register_sketch_alias, register_sketch_reduction
+from .countmin import countmin_init, countmin_merge, countmin_query, countmin_update
+from .reservoir import (
+    reservoir_decay,
+    reservoir_init,
+    reservoir_merge,
+    reservoir_rows,
+    reservoir_update,
+)
+from .tdigest import (
+    tdigest_compress,
+    tdigest_decay,
+    tdigest_init,
+    tdigest_merge,
+    tdigest_quantile,
+    tdigest_update,
+)
+
+RESERVOIR = register_sketch_reduction("reservoir", reservoir_merge, decay=reservoir_decay)
+TDIGEST = register_sketch_reduction("tdigest", tdigest_merge, decay=tdigest_decay)
+COUNTMIN = register_sketch_alias("countmin", Reduction.SUM)
+
+from .metrics import (  # noqa: E402  (metrics need the reductions registered first)
+    ApproxAUROC,
+    ApproxCalibrationError,
+    ApproxFrequency,
+    ApproxQuantile,
+)
+
+__all__ = [
+    "RESERVOIR",
+    "TDIGEST",
+    "COUNTMIN",
+    "ApproxAUROC",
+    "ApproxCalibrationError",
+    "ApproxFrequency",
+    "ApproxQuantile",
+    "countmin_init",
+    "countmin_merge",
+    "countmin_query",
+    "countmin_update",
+    "reservoir_decay",
+    "reservoir_init",
+    "reservoir_merge",
+    "reservoir_rows",
+    "reservoir_update",
+    "tdigest_compress",
+    "tdigest_decay",
+    "tdigest_init",
+    "tdigest_merge",
+    "tdigest_quantile",
+    "tdigest_update",
+]

@@ -1,0 +1,277 @@
+"""Sketch-backed approximate metrics with exact cat-state twins.
+
+Each metric takes ``exact=False`` by default and keeps O(1) sketch state; the
+``exact=True`` twin accumulates the full observation stream in a padded cat
+state (the PR 5 layout) and computes the SAME statistic over it, so the twin
+is the ε-oracle for the approximation: the only difference between the two
+modes is sketch error, never estimator choice. With fewer observations than
+the sketch capacity the reservoir-backed metrics hold every observation and
+the twin match is exact up to float summation order.
+
+Error bounds (documented here, asserted in tests and ``bench.py --smoke``):
+
+- :class:`ApproxQuantile` — rank error ``≤ max(8·q(1−q)/δ, 4/δ)`` with
+  ``δ = 2(compression−2)`` (t-digest k1 interior bound, conservative).
+- :class:`ApproxAUROC` / :class:`ApproxCalibrationError` — Monte-Carlo
+  sampling error ``O(1/sqrt(capacity))`` of the uniform reservoir sample;
+  tests gate ``3/sqrt(capacity)``.
+- :class:`ApproxFrequency` — overestimate-only; excess ``≤ e·N/width`` with
+  probability ``1 − e^{-depth}``.
+"""
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..metric import Metric
+from ..utils.data import padded_cat
+from .countmin import countmin_init, countmin_query, countmin_update
+from .reservoir import reservoir_init, reservoir_rows, reservoir_update
+from .tdigest import tdigest_init, tdigest_quantile, tdigest_update
+
+Array = jax.Array
+
+__all__ = ["ApproxQuantile", "ApproxAUROC", "ApproxCalibrationError", "ApproxFrequency"]
+
+
+def _masked_auroc(scores: Array, labels: Array, valid: Array) -> Array:
+    """Mann-Whitney AUROC over a masked sample; ties count half.
+
+    O(K log K): negatives sort with ``+inf`` sentinels for masked rows, so
+    ``searchsorted`` rank counts below any finite score are uncontaminated.
+    """
+    pos = valid & (labels > 0.5)
+    neg = valid & ~(labels > 0.5)
+    neg_sorted = jnp.sort(jnp.where(neg, scores, jnp.inf))
+    s = jnp.where(pos, scores, -jnp.inf)
+    less = jnp.searchsorted(neg_sorted, s, side="left")
+    leq = jnp.searchsorted(neg_sorted, s, side="right")
+    u = jnp.sum(jnp.where(pos, less + 0.5 * (leq - less), 0.0))
+    n_pos = jnp.sum(pos)
+    n_neg = jnp.sum(neg)
+    return jnp.where((n_pos > 0) & (n_neg > 0), u / jnp.maximum(n_pos * n_neg, 1), jnp.nan)
+
+
+def _masked_ece(conf: Array, correct: Array, valid: Array, n_bins: int) -> Array:
+    """Expected calibration error (L1, equal-width bins) over a masked sample."""
+    bins = jnp.clip((conf * n_bins).astype(jnp.int32), 0, n_bins - 1)
+    w = valid.astype(jnp.float32)
+    n_b = jax.ops.segment_sum(w, bins, num_segments=n_bins)
+    conf_b = jax.ops.segment_sum(conf * w, bins, num_segments=n_bins)
+    acc_b = jax.ops.segment_sum(correct * w, bins, num_segments=n_bins)
+    n = jnp.sum(w)
+    gap = jnp.abs(acc_b - conf_b) / jnp.maximum(n_b, 1.0)  # |acc−conf| per bin
+    return jnp.where(n > 0, jnp.sum(gap * n_b) / jnp.maximum(n, 1.0), jnp.nan)
+
+
+class ApproxQuantile(Metric):
+    """Streaming quantile(s) from a t-digest (O(compression) state).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import ApproxQuantile
+        >>> m = ApproxQuantile(q=0.5, compression=64)
+        >>> m.update(jnp.arange(101, dtype=jnp.float32))
+        >>> bool(abs(float(m.compute()) - 50.0) <= 3.0)
+        True
+    """
+
+    full_state_update = False
+    higher_is_better = None
+    is_differentiable = False
+
+    def __init__(self, q: Any = 0.5, compression: int = 128, exact: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.q = tuple(jnp.atleast_1d(jnp.asarray(q, dtype=jnp.float32)).tolist())
+        if any(not (0.0 <= qi <= 1.0) for qi in self.q):
+            raise ValueError(f"quantiles must be in [0, 1], got {self.q}")
+        self.compression = compression
+        self.exact = exact
+        if exact:
+            self.add_state("values", default=[], dist_reduce_fx="cat")
+        else:
+            self.add_state("digest", default=tdigest_init(compression), dist_reduce_fx="tdigest")
+
+    def update(self, values: Array, weights: Optional[Array] = None) -> None:
+        values = jnp.asarray(values, dtype=jnp.float32).reshape(-1)
+        if self.exact:
+            self.values.append(values)
+        else:
+            self.digest = tdigest_update(self.digest, values, weights)
+
+    def compute(self) -> Array:
+        qs = jnp.asarray(self.q, dtype=jnp.float32)
+        if self.exact:
+            vals = padded_cat(self.values)[0]
+            out = jnp.quantile(vals, qs)
+        else:
+            out = tdigest_quantile(self.digest, qs)
+        return out[0] if len(self.q) == 1 else out
+
+    def error_bound(self) -> float:
+        """Documented worst-interior rank-error envelope of the estimate."""
+        delta = 2.0 * (self.compression - 2)
+        return max(8.0 * 0.25 / delta, 4.0 / delta)
+
+
+class ApproxAUROC(Metric):
+    """Binary AUROC over a weighted reservoir sample of (score, label) pairs.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import ApproxAUROC
+        >>> m = ApproxAUROC(capacity=256)
+        >>> m.update(jnp.asarray([0.9, 0.8, 0.3, 0.2]), jnp.asarray([1, 1, 0, 0]))
+        >>> float(m.compute())
+        1.0
+    """
+
+    full_state_update = False
+    higher_is_better = True
+    is_differentiable = False
+
+    def __init__(self, capacity: int = 2048, seed: int = 0, exact: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.capacity = capacity
+        self.seed = seed
+        self.exact = exact
+        if exact:
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
+        else:
+            self.add_state(
+                "sample", default=reservoir_init(capacity, values=2), dist_reduce_fx="reservoir"
+            )
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds = jnp.asarray(preds, dtype=jnp.float32).reshape(-1)
+        target = jnp.asarray(target, dtype=jnp.float32).reshape(-1)
+        if self.exact:
+            self.preds.append(preds)
+            self.target.append(target)
+        else:
+            rows = jnp.stack([preds, target], axis=1)
+            self.sample = reservoir_update(self.sample, rows, seed=self.seed)
+
+    def compute(self) -> Array:
+        if self.exact:
+            preds = padded_cat(self.preds)[0]
+            target = padded_cat(self.target)[0]
+            return _masked_auroc(preds, target, jnp.ones(preds.shape, dtype=bool))
+        rows, valid = reservoir_rows(self.sample)
+        return _masked_auroc(rows[:, 0], rows[:, 1], valid)
+
+    def error_bound(self) -> float:
+        return 3.0 / float(self.capacity) ** 0.5
+
+
+class ApproxCalibrationError(Metric):
+    """Binary ECE (L1, equal-width bins) over a reservoir sample of
+    (confidence, correctness) pairs.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import ApproxCalibrationError
+        >>> m = ApproxCalibrationError(capacity=256, n_bins=10)
+        >>> m.update(jnp.asarray([0.9, 0.9, 0.1, 0.1]), jnp.asarray([1, 1, 0, 0]))
+        >>> round(float(m.compute()), 4)
+        0.1
+    """
+
+    full_state_update = False
+    higher_is_better = False
+    is_differentiable = False
+
+    def __init__(
+        self,
+        capacity: int = 2048,
+        n_bins: int = 15,
+        seed: int = 0,
+        exact: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.capacity = capacity
+        self.n_bins = n_bins
+        self.seed = seed
+        self.exact = exact
+        if exact:
+            self.add_state("confidences", default=[], dist_reduce_fx="cat")
+            self.add_state("correctness", default=[], dist_reduce_fx="cat")
+        else:
+            self.add_state(
+                "sample", default=reservoir_init(capacity, values=2), dist_reduce_fx="reservoir"
+            )
+
+    def update(self, preds: Array, target: Array) -> None:
+        """``preds``: probabilities of the positive class; ``target``: {0,1}."""
+        preds = jnp.asarray(preds, dtype=jnp.float32).reshape(-1)
+        target = jnp.asarray(target, dtype=jnp.float32).reshape(-1)
+        conf = jnp.where(preds >= 0.5, preds, 1.0 - preds)
+        correct = jnp.where(preds >= 0.5, target, 1.0 - target)
+        if self.exact:
+            self.confidences.append(conf)
+            self.correctness.append(correct)
+        else:
+            rows = jnp.stack([conf, correct], axis=1)
+            self.sample = reservoir_update(self.sample, rows, seed=self.seed)
+
+    def compute(self) -> Array:
+        if self.exact:
+            conf = padded_cat(self.confidences)[0]
+            correct = padded_cat(self.correctness)[0]
+            return _masked_ece(conf, correct, jnp.ones(conf.shape, dtype=bool), self.n_bins)
+        rows, valid = reservoir_rows(self.sample)
+        return _masked_ece(rows[:, 0], rows[:, 1], valid, self.n_bins)
+
+    def error_bound(self) -> float:
+        return 3.0 / float(self.capacity) ** 0.5
+
+
+class ApproxFrequency(Metric):
+    """Count-min frequencies of integer item ids for a tracked id set.
+
+    State is an ``(depth, width)`` int32 table whose merge is elementwise
+    addition — it syncs as a plain SUM leaf (bitwise on every route).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import ApproxFrequency
+        >>> m = ApproxFrequency(track=(7, 9), width=64)
+        >>> m.update(jnp.asarray([7, 7, 9, 3]))
+        >>> m.compute().tolist()
+        [2, 1]
+    """
+
+    full_state_update = False
+    higher_is_better = None
+    is_differentiable = False
+
+    def __init__(
+        self,
+        track: Sequence[int],
+        depth: int = 4,
+        width: int = 1024,
+        seed: int = 0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.track = tuple(int(t) for t in track)
+        if not self.track:
+            raise ValueError("`track` must name at least one item id")
+        self.depth = depth
+        self.width = width
+        self.seed = seed
+        self.add_state("table", default=countmin_init(depth, width), dist_reduce_fx="countmin")
+
+    def update(self, items: Array, counts: Optional[Array] = None) -> None:
+        self.table = countmin_update(self.table, items, counts, seed=self.seed)
+
+    def compute(self) -> Array:
+        return countmin_query(self.table, jnp.asarray(self.track, dtype=jnp.int32), seed=self.seed)
+
+    def error_bound_fraction(self) -> float:
+        """Overestimate excess as a fraction of total count (w.p. 1−e^-depth)."""
+        import math
+
+        return math.e / float(self.width)

@@ -1,0 +1,141 @@
+"""Weighted reservoir sample: fixed-shape, jit-clean, mergeable.
+
+A-Res weighted reservoir sampling (Efraimidis & Spirakis 2006): each item
+draws ``u ~ U(0,1)`` and keeps key ``log(u)/w``; the reservoir is the top-K
+items by key. The whole sketch is ONE float32 array of shape
+``(capacity + 1, 1 + values)``:
+
+- row 0 is the header ``[n_seen, total_weight, 0...]``,
+- rows 1..K are ``[logkey, v_0, ..., v_{V-1}]``; empty slots carry
+  ``logkey = -inf`` (the identity under top-K), payload 0.
+
+Key properties that make it a first-class state reduction:
+
+- **fixed shape** — state bytes at 1e8 events equal state bytes at 1e2;
+- **mergeable** — ``merge(stack)`` takes the top-K over the union of rows, so
+  the n-way merge is associative AND permutation-invariant (distinct keys +
+  deterministic lexsort ⇒ bitwise order-invariant), exactly the contract the
+  bucketed sync routes and ElasticSync's merge-on-rejoin assume;
+- **deterministic** — randomness comes from a stateless integer hash of
+  (seed, item payload bits, batch lane, items-seen counter), not from traced
+  PRNG state, so replays are bitwise-reproducible and replicas hashing
+  different data draw independent keys;
+- **decayable** — scaling all weights by ``d`` maps ``log(u)/w`` to
+  ``log(u)/(dw) = logkey/d``, so exponential decay is one elementwise op on
+  the key column (old items sink toward ``-inf``).
+
+Sampling error for a statistic estimated from the sample is the usual
+O(1/sqrt(K)) Monte-Carlo bound; with n ≤ K the reservoir holds *every* item.
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+__all__ = [
+    "reservoir_init",
+    "reservoir_update",
+    "reservoir_merge",
+    "reservoir_decay",
+    "reservoir_rows",
+]
+
+
+def reservoir_init(capacity: int, values: int = 1) -> Array:
+    """Empty reservoir: header zeros, body keys at ``-inf``."""
+    if capacity < 1 or values < 1:
+        raise ValueError(f"capacity and values must be >= 1, got {capacity}, {values}")
+    body = jnp.concatenate(
+        [
+            jnp.full((capacity, 1), -jnp.inf, dtype=jnp.float32),
+            jnp.zeros((capacity, values), dtype=jnp.float32),
+        ],
+        axis=1,
+    )
+    header = jnp.zeros((1, 1 + values), dtype=jnp.float32)
+    return jnp.concatenate([header, body], axis=0)
+
+
+def _mix_u32(x: Array) -> Array:
+    """splitmix32-style avalanche over uint32 lanes (wraps mod 2**32)."""
+    x = (x ^ (x >> 16)) * jnp.uint32(0x7FEB352D)
+    x = (x ^ (x >> 15)) * jnp.uint32(0x846CA68B)
+    return x ^ (x >> 16)
+
+
+def _item_uniforms(values: Array, seed: int, n_seen: Array) -> Array:
+    """Stateless per-item uniforms in (0, 1) from payload bits + position."""
+    bits = jax.lax.bitcast_convert_type(values, jnp.uint32)  # (B, V)
+    h = jnp.uint32(seed) * jnp.uint32(0x9E3779B9)
+    h = h + n_seen.astype(jnp.uint32) * jnp.uint32(0x85EBCA6B)
+    acc = jnp.full((values.shape[0],), h, dtype=jnp.uint32)
+    for c in range(values.shape[1]):
+        acc = _mix_u32(acc ^ (bits[:, c] + jnp.uint32(0xC2B2AE35) * jnp.uint32(c + 1)))
+    acc = _mix_u32(acc ^ jnp.arange(values.shape[0], dtype=jnp.uint32))
+    # 24 high bits -> uniform in (0, 1), strictly positive so log() is finite
+    return (acc >> jnp.uint32(8)).astype(jnp.float32) * jnp.float32(2**-24) + jnp.float32(2**-26)
+
+
+def _top_k_rows(rows: Array, capacity: int) -> Array:
+    """Canonical top-``capacity`` rows by key (col 0), sorted descending.
+
+    Deterministic on the row *multiset*: lexsort keyed by (-key, payload...)
+    is stable and total on distinct keys, so any permutation of the input
+    rows produces a bitwise-identical reservoir body.
+    """
+    keys = [rows[:, c] for c in range(rows.shape[1] - 1, 0, -1)] + [-rows[:, 0]]
+    order = jnp.lexsort(tuple(keys))
+    return rows[order[:capacity]]
+
+
+def reservoir_update(
+    sketch: Array, values: Array, weights: Optional[Array] = None, *, seed: int = 0
+) -> Array:
+    """Fold a batch into the reservoir. ``values``: (B,) or (B, V) float32;
+    ``weights``: (B,) non-negative (0 drops the item — use it for masking)."""
+    values = jnp.asarray(values, dtype=jnp.float32)
+    if values.ndim == 1:
+        values = values[:, None]
+    v_cols = sketch.shape[1] - 1
+    if values.shape[1] != v_cols:
+        raise ValueError(f"expected {v_cols} value column(s), got {values.shape[1]}")
+    if weights is None:
+        weights = jnp.ones((values.shape[0],), dtype=jnp.float32)
+    weights = jnp.asarray(weights, dtype=jnp.float32)
+    header, body = sketch[:1], sketch[1:]
+    u = _item_uniforms(values, seed, header[0, 0])
+    logkey = jnp.where(weights > 0, jnp.log(u) / jnp.maximum(weights, 1e-38), -jnp.inf)
+    cand = jnp.concatenate([logkey[:, None], values], axis=1)
+    new_body = _top_k_rows(jnp.concatenate([body, cand], axis=0), body.shape[0])
+    new_header = header.at[0, 0].add(jnp.float32(values.shape[0]))
+    new_header = new_header.at[0, 1].add(jnp.sum(jnp.where(weights > 0, weights, 0.0)))
+    return jnp.concatenate([new_header, new_body], axis=0)
+
+
+def reservoir_merge(stack: Array) -> Array:
+    """Merge an ``(n, K+1, 1+V)`` stack of reservoirs into one.
+
+    Top-K over the union of body rows; headers add (integral ``n_seen``
+    counts sum exactly in float32 below 2**24 per replica)."""
+    stack = jnp.asarray(stack, dtype=jnp.float32)
+    n, rows, cols = stack.shape
+    header = jnp.sum(stack[:, 0, :], axis=0, keepdims=True)
+    body = _top_k_rows(stack[:, 1:, :].reshape(n * (rows - 1), cols), rows - 1)
+    return jnp.concatenate([header, body], axis=0)
+
+
+def reservoir_decay(sketch: Array, factor) -> Array:
+    """Exponential decay: weights scale by ``factor`` ⇒ keys divide by it."""
+    header, body = sketch[:1], sketch[1:]
+    f = jnp.asarray(factor, dtype=jnp.float32)
+    header = header.at[0, 1].multiply(f)
+    body = body.at[:, 0].divide(f)  # logkey < 0: /f<1 sinks old items
+    return jnp.concatenate([header, body], axis=0)
+
+
+def reservoir_rows(sketch: Array) -> Tuple[Array, Array]:
+    """(payload rows (K, V), validity mask (K,)) of the current sample."""
+    body = sketch[1:]
+    return body[:, 1:], jnp.isfinite(body[:, 0])

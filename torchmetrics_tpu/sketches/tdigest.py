@@ -1,0 +1,150 @@
+"""t-digest quantile sketch: fixed-shape, jit-clean, mergeable.
+
+The merging t-digest (Dunning & Ertl, 2019) with the ``k1`` scale function
+``k(q) = δ/(2π)·asin(2q−1)``. The whole sketch is ONE float32 array of shape
+``(compression + 1, 2)``:
+
+- row 0 is the header ``[min, max]`` (init ``[+inf, -inf]``),
+- rows 1..C are centroid ``[mean, weight]`` pairs; empty slots carry
+  ``weight = 0, mean = +inf`` (they sort last and contribute nothing).
+
+The compression pass is fully static-shape: sort centroids by mean
+(``lexsort``), accumulate quantile boundaries, assign output slots with one
+``lax.scan``, and ``segment_sum`` means/weights into the C fixed slots. With
+``δ = 2(C−2)`` the k1 bound (≤ δ/2 + 2 output centroids) guarantees the
+greedy pass never overflows C slots, so the clamp is never hit in steady
+state.
+
+Error bound (documented, asserted in tests and ``bench.py --smoke``): the
+rank error of an interpolated quantile is O(q(1−q)/δ) in the interior; we
+gate the conservative envelope ``|rank(est(q)) − q| ≤ max(8·q(1−q)/δ, 4/δ)``.
+
+Merging sorts the union of centroids before compressing, so the n-way merge
+is permutation-invariant (bitwise: lexsort is deterministic on the centroid
+multiset and segment_sum accumulates in slot order). Two-step merges
+``merge(merge(a,b),c)`` re-compress and agree with ``merge(a,b,c)`` within
+the same rank-error envelope, which is what the retry/degrade and
+merge-on-rejoin paths rely on.
+"""
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+__all__ = [
+    "tdigest_init",
+    "tdigest_update",
+    "tdigest_merge",
+    "tdigest_decay",
+    "tdigest_compress",
+    "tdigest_quantile",
+    "tdigest_delta",
+]
+
+
+def tdigest_delta(compression: int) -> float:
+    """k1 scale δ for a C-slot digest (≤ δ/2 + 2 centroids fit exactly)."""
+    return float(2 * (compression - 2))
+
+
+def tdigest_init(compression: int = 128) -> Array:
+    if compression < 8:
+        raise ValueError(f"compression must be >= 8, got {compression}")
+    header = jnp.asarray([[jnp.inf, -jnp.inf]], dtype=jnp.float32)
+    body = jnp.tile(jnp.asarray([[jnp.inf, 0.0]], dtype=jnp.float32), (compression, 1))
+    return jnp.concatenate([header, body], axis=0)
+
+
+def _k_scale(q: Array, delta: float) -> Array:
+    return jnp.float32(delta / (2.0 * math.pi)) * jnp.arcsin(2.0 * jnp.clip(q, 0.0, 1.0) - 1.0)
+
+
+def tdigest_compress(centroids: Array, compression: int) -> Array:
+    """Compress an ``(M, 2)`` centroid multiset into ``(C, 2)`` slots."""
+    delta = tdigest_delta(compression)
+    order = jnp.lexsort((centroids[:, 1], centroids[:, 0]))
+    c = centroids[order]
+    mean, w = c[:, 0], c[:, 1]
+    total = jnp.sum(w)
+    safe_total = jnp.maximum(total, 1e-38)
+    cum = jnp.cumsum(w)
+    q_left = (cum - w) / safe_total
+    q_right = cum / safe_total
+    valid = w > 0
+
+    def body(carry, x):
+        slot, k_start = carry
+        ql, qr, is_valid = x
+        open_new = is_valid & (_k_scale(qr, delta) - k_start > 1.0) & (ql > 0)
+        slot = jnp.where(open_new, slot + 1, slot)
+        k_start = jnp.where(open_new, _k_scale(ql, delta), k_start)
+        return (slot, k_start), slot
+
+    (_, _), slots = jax.lax.scan(
+        body, (jnp.int32(0), _k_scale(jnp.float32(0.0), delta)), (q_left, q_right, valid)
+    )
+    slots = jnp.clip(slots, 0, compression - 1)
+    w_masked = jnp.where(valid, w, 0.0)
+    sum_w = jax.ops.segment_sum(w_masked, slots, num_segments=compression)
+    sum_mw = jax.ops.segment_sum(
+        jnp.where(valid, mean, 0.0) * w_masked, slots, num_segments=compression
+    )
+    new_mean = jnp.where(sum_w > 0, sum_mw / jnp.maximum(sum_w, 1e-38), jnp.inf)
+    return jnp.stack([new_mean, sum_w], axis=1)
+
+
+def tdigest_update(sketch: Array, values: Array, weights: Optional[Array] = None) -> Array:
+    """Fold a batch of scalar observations into the digest."""
+    values = jnp.asarray(values, dtype=jnp.float32).reshape(-1)
+    if weights is None:
+        weights = jnp.ones_like(values)
+    weights = jnp.asarray(weights, dtype=jnp.float32).reshape(-1)
+    compression = sketch.shape[0] - 1
+    header, body = sketch[:1], sketch[1:]
+    ok = weights > 0
+    pts = jnp.stack([jnp.where(ok, values, jnp.inf), jnp.where(ok, weights, 0.0)], axis=1)
+    new_body = tdigest_compress(jnp.concatenate([body, pts], axis=0), compression)
+    lo = jnp.min(jnp.where(ok, values, jnp.inf))
+    hi = jnp.max(jnp.where(ok, values, -jnp.inf))
+    new_header = jnp.stack(
+        [jnp.minimum(header[0, 0], lo), jnp.maximum(header[0, 1], hi)]
+    )[None, :]
+    return jnp.concatenate([new_header, new_body], axis=0)
+
+
+def tdigest_merge(stack: Array) -> Array:
+    """Merge an ``(n, C+1, 2)`` stack of digests into one."""
+    stack = jnp.asarray(stack, dtype=jnp.float32)
+    n, rows, _ = stack.shape
+    compression = rows - 1
+    header = jnp.stack(
+        [jnp.min(stack[:, 0, 0]), jnp.max(stack[:, 0, 1])]
+    )[None, :]
+    body = tdigest_compress(stack[:, 1:, :].reshape(n * compression, 2), compression)
+    return jnp.concatenate([header, body], axis=0)
+
+
+def tdigest_decay(sketch: Array, factor) -> Array:
+    """Exponential decay: centroid weights scale by ``factor``; the min/max
+    header is a lifetime envelope and intentionally does not decay."""
+    f = jnp.asarray(factor, dtype=jnp.float32)
+    return sketch.at[1:, 1].multiply(f)
+
+
+def tdigest_quantile(sketch: Array, q) -> Array:
+    """Interpolated quantile estimate(s); NaN on an empty digest."""
+    q = jnp.asarray(q, dtype=jnp.float32)
+    header, body = sketch[0], sketch[1:]
+    mean, w = body[:, 0], body[:, 1]
+    valid = w > 0
+    total = jnp.sum(w)
+    cum_mid = jnp.cumsum(w) - 0.5 * w  # centroid midpoints in rank space
+    xs = jnp.concatenate([jnp.zeros((1,)), cum_mid, total[None]])
+    ys = jnp.concatenate(
+        [header[0][None], jnp.where(valid, mean, header[1]), header[1][None]]
+    )
+    est = jnp.interp(q * total, xs, ys)
+    return jnp.where(total > 0, est, jnp.nan)

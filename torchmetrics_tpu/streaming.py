@@ -47,6 +47,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from .buffers import CatBuffer
@@ -269,9 +270,17 @@ class BufferedMetric:
             )
             steps, valid = ring.take()
             fn = self._flush_fn()
-            new_tensors, appends = fn(
-                m._donation_safe_tensor_state(), jnp.asarray(valid, jnp.int32), steps
-            )
+            # the valid count is a host int: ship it with an EXPLICIT
+            # device_put (cached per count — steady state always flushes a
+            # full window, so this is one constant) rather than an implicit
+            # jnp.asarray transfer, which strict_mode()'s transfer guard
+            # rightly rejects in the serving loop
+            valid_cache = self.__dict__.setdefault("_valid_consts", {})
+            valid_dev = valid_cache.get(valid)
+            if valid_dev is None:
+                valid_dev = jax.device_put(np.int32(valid))
+                valid_cache[valid] = valid_dev
+            new_tensors, appends = fn(m._donation_safe_tensor_state(), valid_dev, steps)
             state = m.__dict__["_state"]
             for k, v in new_tensors.items():
                 state[k] = v

@@ -157,19 +157,20 @@ class TranslationEditRate(_HostTextMetric):
     """Parity: reference ``text/ter.py:TranslationEditRate``.
 
     .. note::
-        Tokenization is memoized: the metric's ``_TercomTokenizer`` caches
-        each distinct input sentence's tokenized form in a per-instance dict
-        capped at ``2**16`` entries (``functional/text/ter.py``; entries
-        past the cap are computed but not cached). The memo persists across
-        ``update()`` and ``reset()`` calls for the lifetime of the metric
-        object — worst-case host memory is therefore bounded by 65 536
-        cached sentences, not by epoch length (at a typical ~200 bytes per
-        tokenized sentence that is ~13 MB per metric instance; long-document
-        inputs scale it linearly with sentence length) — and is NOT part of
-        the metric state: it is excluded from ``state_dict()`` and
-        distributed sync (it only serves to skip re-tokenizing repeated
-        references). Drop the metric object (or construct a fresh one per
-        evaluation corpus) to release the memo.
+        Tokenization is memoized: the metric's ``_TercomTokenizer`` keeps a
+        per-instance **LRU** of tokenized sentences, capped at
+        ``_MEMO_CAP = 4096`` entries (``functional/text/ter.py``): cache
+        hits refresh an entry's recency and overflow evicts the
+        least-recently-used entry, so repeated references stay cached while
+        a long low-repetition stream cannot grow the memo past the cap. The
+        memo persists across ``update()`` and ``reset()`` calls for the
+        lifetime of the metric object — worst-case host memory is therefore
+        bounded by 4096 cached sentences, not by epoch length (at a typical
+        ~200 bytes per tokenized sentence that is well under 1 MB per metric
+        instance; long-document inputs scale it linearly with sentence
+        length) — and is NOT part of the metric state: it is excluded from
+        ``state_dict()`` and distributed sync (it only serves to skip
+        re-tokenizing repeated references).
 
     Example:
         >>> import jax.numpy as jnp
