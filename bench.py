@@ -311,6 +311,61 @@ def bench_config2() -> dict:
             "roofline": _roofline(epoch, (preds, target, jnp.float32(0)), ours / steps)}
 
 
+def _telemetry_smoke() -> dict:
+    """Telemetry gate: tracing is off by default and effectively free when
+    off; when armed it yields Perfetto-loadable spans for the metric
+    lifecycle plus a Prometheus scrape over the migrated counter islands.
+    """
+    import timeit
+
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu import MeanMetric
+    from torchmetrics_tpu.observability import export as _export
+    from torchmetrics_tpu.observability import spans as _spans
+
+    default_disabled = not _spans.ENABLED
+
+    # disabled cost: the hot path pays one module-attr test per phase — price
+    # a pessimistic four of them against one real warm jitted update dispatch
+    m = MeanMetric()
+    x = jnp.ones((64,))
+    m.update(x)
+    n = 300
+    t0 = time.perf_counter()
+    for _ in range(n):
+        m.update(x)
+    update_s = (time.perf_counter() - t0) / n
+    guard_s = timeit.timeit(lambda: _spans.ENABLED, number=20000) / 20000
+    disabled_overhead_pct = 100.0 * (4 * guard_s) / update_s if update_s > 0 else 0.0
+
+    with _spans.tracing():
+        m2 = MeanMetric()
+        m2.update(x)
+        m2.update(x)
+        float(m2.compute())
+        armed = list(_spans.collected_spans())
+    names = {s.name for s in armed}
+    doc = _export.to_perfetto(armed)
+    scrape = _export.to_prometheus()
+    ok = (
+        default_disabled
+        and disabled_overhead_pct < 1.0
+        and {"metric.update", "metric.compute"} <= names
+        and any(e.get("ph") == "X" for e in doc["traceEvents"])
+        and "tmtpu_cache_hits" in scrape
+        and "tmtpu_wire_bytes_reduced" in scrape
+    )
+    return {
+        "ok": ok,
+        "tracing_disabled_by_default": default_disabled,
+        "disabled_overhead_pct": round(disabled_overhead_pct, 4),
+        "armed_span_names": sorted(names),
+        "perfetto_events": len(doc["traceEvents"]),
+        "prometheus_lines": len(scrape.splitlines()),
+    }
+
+
 def bench_smoke() -> dict:
     """CPU-safe sanity pass: tiny shapes, one rep, no backend probe.
 
@@ -620,6 +675,21 @@ def bench_smoke() -> dict:
         tpulint_new = -1
     tpulint_ok = tpulint_new == 0
 
+    # bench-trajectory gate (tools/benchwatch): the committed BENCH_r*.json
+    # series is a contract — the latest round of every config with enough
+    # history must sit inside an IQR-aware band around its trajectory median
+    try:
+        from tools import benchwatch
+
+        trajectory = benchwatch.check(repo_dir)
+        bench_trajectory_ok = bool(trajectory["ok"])
+    except Exception as exc:  # a broken gate must fail loudly, not skip
+        trajectory = {"error": repr(exc)}
+        bench_trajectory_ok = False
+
+    telemetry = _telemetry_smoke()
+    telemetry_ok = bool(telemetry["ok"])
+
     return {
         "mode": "smoke",
         "ok": (
@@ -636,6 +706,8 @@ def bench_smoke() -> dict:
             and fault_ok
             and online_ok
             and tpulint_ok
+            and bench_trajectory_ok
+            and telemetry_ok
         ),
         "dispatches_per_update": dispatches,
         "clone_new_compilations": clone_misses,
@@ -670,6 +742,14 @@ def bench_smoke() -> dict:
             "windowed_mean": round(float(owin.compute()), 6),
             "decayed_mean": round(float(odec.compute()), 6),
         },
+        "bench_trajectory_ok": bench_trajectory_ok,
+        "bench_trajectory": {
+            name: v.get("status", "?") for name, v in trajectory.get("configs", {}).items()
+        }
+        if isinstance(trajectory, dict)
+        else trajectory,
+        "telemetry_ok": telemetry_ok,
+        "telemetry": telemetry,
         "fault_injection_ok": fault_ok,
         "fault_injection": {
             "timeout_round_bitwise": r_timeout == fault_free,
@@ -1685,6 +1765,13 @@ def main() -> None:
         # CPU-safe, probe-free: must work in CI / tier-1 without a TPU tunnel
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         print(json.dumps(bench_smoke()))
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "--baseline":
+        # re-anchor the benchwatch trajectory gate to the latest committed
+        # round (after an INTENTIONAL perf change); no backend probe needed
+        from tools import benchwatch
+
+        print(json.dumps(benchwatch.write_baseline(os.path.dirname(os.path.abspath(__file__)))))
         return
     _ensure_working_backend()
     if len(sys.argv) > 1 and sys.argv[1] == "--map-child":

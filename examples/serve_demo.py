@@ -16,10 +16,16 @@ events, ZERO retraces and ZERO implicit host transfers — every update
 arithmetic on fixed-shape state, staged through ``buffered()``'s scanned
 flush. State size is independent of stream length.
 
-    JAX_PLATFORMS=cpu python examples/serve_demo.py
+A short post-measurement slice of the stream then runs with span tracing
+armed and ships the two artifacts an operator would scrape: a
+Perfetto-loadable trace (``serve_trace.perfetto.json``) and a Prometheus
+text exposition over the live counter registry (``serve_metrics.prom``).
+
+    JAX_PLATFORMS=cpu python examples/serve_demo.py [out_dir]
 """
 import os as _os
 import sys as _sys
+import tempfile
 
 _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))  # in-repo run
 
@@ -34,6 +40,7 @@ from torchmetrics_tpu import (
     DecayedMean,
     WindowedMean,
 )
+from torchmetrics_tpu import observability as obs
 from torchmetrics_tpu.debug import strict_mode
 from torchmetrics_tpu.metric import executable_cache_stats
 
@@ -97,6 +104,24 @@ def main() -> None:
     print(f"t-digest state: {digest_bytes} bytes — independent of the "
           f"{events:,}-event stream length")
     print(f"online dispatch counters: {executable_cache_stats()['online']}")
+
+    # telemetry demo: arm tracing for a short slice (outside the strict
+    # measurement above — tracing costs time) and export what an operator
+    # would scrape
+    out_dir = _sys.argv[1] if len(_sys.argv) > 1 else tempfile.mkdtemp(prefix="serve_demo_")
+    with obs.tracing():
+        for _ in range(4):
+            step(*synth_events(rng, batch))
+        float(ema_latency.compute())  # forces a traced flush + compute span
+        spans = list(obs.collected_spans())
+    trace_path = _os.path.join(out_dir, "serve_trace.perfetto.json")
+    obs.write_perfetto(trace_path, spans)
+    prom_path = _os.path.join(out_dir, "serve_metrics.prom")
+    with open(prom_path, "w") as fh:
+        fh.write(obs.to_prometheus())
+    phases = sorted({s.name for s in spans})
+    print(f"telemetry: {len(spans)} spans over phases {phases} -> {trace_path}")
+    print(f"telemetry: prometheus scrape -> {prom_path}")
 
 
 if __name__ == "__main__":

@@ -43,3 +43,15 @@ def test_bench_smoke_passes():
     assert result["buffered_staged_dispatches"] == 2, result
     assert result["buffered_pending_before_compute"] == 2, result
     assert result["buffered_matches_eager"] is True, result
+    # trajectory gate (tools/benchwatch): the committed BENCH_r*.json series
+    # must pass its own regression check — headline has enough history to be
+    # actively gated, never skipped
+    assert result["bench_trajectory_ok"] is True, result
+    assert result["bench_trajectory"].get("headline") == "pass", result
+    # telemetry gate: tracing is off by default, the disabled guard costs
+    # <1% of a warm update dispatch, and armed tracing yields Perfetto
+    # events + a Prometheus scrape over the migrated counter islands
+    assert result["telemetry_ok"] is True, result
+    assert result["telemetry"]["tracing_disabled_by_default"] is True, result
+    assert result["telemetry"]["disabled_overhead_pct"] < 1.0, result
+    assert result["telemetry"]["perfetto_events"] > 0, result

@@ -1,0 +1,120 @@
+"""Bench-trajectory regression gate (``tools/benchwatch``).
+
+Covers round extraction across every committed BENCH_r*.json shape (parsed
+dict, recoverable truncated tail, timed-out round), the direction-aware
+IQR tolerance gate, the min-observation skip, baseline re-anchoring, and —
+as an integration check — that the repo's own committed trajectory passes.
+"""
+import json
+import os
+
+from tools import benchwatch
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _round(tmp_path, n, parsed=None, tail="", rc=0):
+    doc = {"n": n, "cmd": "bench", "rc": rc, "tail": tail, "parsed": parsed}
+    (tmp_path / f"BENCH_r{n:02d}.json").write_text(json.dumps(doc))
+
+
+def _payload(headline, **extras):
+    return {
+        "value": headline,
+        "extra": {k: {"value": v} for k, v in extras.items()},
+    }
+
+
+def test_load_rounds_parsed_and_fragment_and_dead(tmp_path):
+    _round(tmp_path, 1, parsed=_payload(100.0, cfg=10.0))
+    _round(tmp_path, 2, rc=124, tail="")  # timed-out round: skipped
+    # front-truncated payload: only fragments + the methodology runs list
+    _round(tmp_path, 3, tail='53, "cfg": {"value": 12.5, "unit": "x"}, '
+           '"methodology": {"headline_runs": [90.0, 110.0, 105.0]}}')
+    rounds = benchwatch.load_rounds(str(tmp_path))
+    assert [r["n"] for r in rounds] == [1, 3]
+    assert rounds[0]["source"] == "parsed"
+    assert rounds[0]["values"] == {"headline": 100.0, "cfg": 10.0}
+    assert rounds[1]["source"] == "tail-fragment"
+    assert rounds[1]["values"]["cfg"] == 12.5
+    # headline refit as the median of the recovered runs
+    assert rounds[1]["values"]["headline"] == 105.0
+
+
+def test_step_overhead_pct_extracted_lower_better(tmp_path):
+    parsed = {"value": 50.0, "extra": {"step_overhead": {"pct": 2.5}}}
+    _round(tmp_path, 1, parsed=parsed)
+    (r,) = benchwatch.load_rounds(str(tmp_path))
+    assert r["values"]["step_overhead_pct"] == 2.5
+
+
+def test_gate_passes_within_tolerance(tmp_path):
+    for n, v in enumerate([100.0, 110.0, 95.0], start=1):
+        _round(tmp_path, n, parsed=_payload(v))
+    res = benchwatch.check(str(tmp_path), baseline_path=str(tmp_path / "anchor.json"))
+    assert res["ok"] is True
+    assert res["configs"]["headline"]["status"] == "pass"
+
+
+def test_gate_fails_on_headline_regression(tmp_path):
+    for n, v in enumerate([100.0, 102.0, 98.0, 40.0], start=1):
+        _round(tmp_path, n, parsed=_payload(v))
+    res = benchwatch.check(str(tmp_path), baseline_path=str(tmp_path / "anchor.json"))
+    assert res["ok"] is False
+    verdict = res["configs"]["headline"]
+    assert verdict["status"] == "fail"
+    assert verdict["direction"] == "higher_better"
+    assert verdict["latest"] == 40.0
+
+
+def test_gate_direction_aware_for_overhead(tmp_path):
+    # overhead pct going UP is the regression
+    for n, pct in enumerate([1.0, 1.1, 0.9, 5.0], start=1):
+        _round(tmp_path, n, parsed={"value": 100.0,
+                                    "extra": {"step_overhead": {"pct": pct}}})
+    res = benchwatch.check(str(tmp_path), baseline_path=str(tmp_path / "anchor.json"))
+    assert res["configs"]["step_overhead_pct"]["status"] == "fail"
+    assert res["configs"]["headline"]["status"] == "pass"
+
+
+def test_noisy_series_widens_tolerance_via_iqr(tmp_path):
+    # prior spread is huge: a 35% dip must ride inside the IQR-aware band
+    # (a fixed 25% floor alone would reject it)
+    for n, v in enumerate([60.0, 140.0, 100.0, 65.0], start=1):
+        _round(tmp_path, n, parsed=_payload(v))
+    res = benchwatch.check(str(tmp_path), baseline_path=str(tmp_path / "anchor.json"))
+    verdict = res["configs"]["headline"]
+    assert verdict["tolerance"] > 0.25
+    assert verdict["status"] == "pass"
+
+
+def test_thin_history_skipped_not_gated(tmp_path):
+    # one prior round is not a median — report skipped, never fail
+    for n, v in enumerate([100.0, 10.0], start=1):
+        _round(tmp_path, n, parsed=_payload(v))
+    res = benchwatch.check(str(tmp_path), baseline_path=str(tmp_path / "anchor.json"))
+    assert res["ok"] is True
+    assert res["configs"]["headline"]["status"] == "skipped"
+
+
+def test_baseline_reanchors_reference(tmp_path):
+    for n, v in enumerate([100.0, 102.0, 98.0, 40.0], start=1):
+        _round(tmp_path, n, parsed=_payload(v))
+    anchor = str(tmp_path / "anchor.json")
+    assert benchwatch.check(str(tmp_path), baseline_path=anchor)["ok"] is False
+    doc = benchwatch.write_baseline(str(tmp_path), anchor)
+    assert doc["values"]["headline"] == 40.0
+    # after the intentional re-anchor the same trajectory passes
+    res = benchwatch.check(str(tmp_path), baseline_path=anchor)
+    assert res["ok"] is True
+    assert res["configs"]["headline"]["anchored"] is True
+
+
+def test_committed_trajectory_passes():
+    # the repo's own BENCH_r*.json history is the contract bench.py --smoke
+    # enforces; it must hold, and the headline must be actively gated
+    res = benchwatch.check(REPO)
+    assert res["ok"] is True, res
+    assert res["rounds_seen"] >= 3
+    assert res["configs"]["headline"]["status"] == "pass"
+    assert res["configs"]["headline"]["observations"] >= 3

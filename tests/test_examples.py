@@ -4,6 +4,7 @@ Parity: the reference ships runnable ``examples/`` exercised in docs/CI;
 here each script must exit 0 on the simulated-device configuration its
 header documents.
 """
+import json
 import os
 import subprocess
 import sys
@@ -34,7 +35,17 @@ def test_example_runs(name, timeout, tmp_path):
     env.pop("PALLAS_AXON_POOL_IPS", None)  # keep the run off the TPU tunnel
     env["MPLBACKEND"] = "Agg"
     args = [sys.executable, os.path.join(REPO, "examples", name)]
-    if name == "plotting.py":
+    if name in ("plotting.py", "serve_demo.py"):
         args.append(str(tmp_path))
     proc = subprocess.run(args, env=env, cwd=REPO, capture_output=True, text=True, timeout=timeout)
     assert proc.returncode == 0, f"{name} failed:\n{proc.stdout[-1500:]}\n{proc.stderr[-1500:]}"
+    if name == "serve_demo.py":
+        # the telemetry artifacts must be non-empty and well-formed: a
+        # Perfetto-loadable trace and a Prometheus scrape over the registry
+        trace = tmp_path / "serve_trace.perfetto.json"
+        prom = tmp_path / "serve_metrics.prom"
+        assert trace.exists() and trace.stat().st_size > 0
+        doc = json.loads(trace.read_text())
+        assert doc["traceEvents"], "empty Perfetto trace"
+        scrape = prom.read_text()
+        assert "tmtpu_cache_dispatches" in scrape and "tmtpu_online" in scrape

@@ -1,0 +1,407 @@
+"""Unified telemetry subsystem (``torchmetrics_tpu.observability``).
+
+Covers the registry (typed instruments + the CounterGroup facade the
+migrated counter islands mutate through), span tracing (disabled-by-default
+null path, nesting, the full metric lifecycle, elastic chaos rounds), the
+exporters (Perfetto trace_event JSON, Prometheus text format, JSONL event
+log), the backward-compat contract of ``executable_cache_stats()``, the
+all-island ``reset_cache_stats()`` regression, and the ``strict_mode()``
+span report.
+"""
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import torchmetrics_tpu as tm
+import torchmetrics_tpu.metric as M
+from torchmetrics_tpu.classification import BinaryAccuracy
+from torchmetrics_tpu.debug import StrictModeViolation, strict_mode
+from torchmetrics_tpu.observability import (
+    Counter,
+    Gauge,
+    Histogram,
+    JsonlEventLog,
+    Registry,
+    to_perfetto,
+    to_prometheus,
+    write_perfetto,
+)
+from torchmetrics_tpu.observability import spans as spans_mod
+from torchmetrics_tpu.online import _ONLINE_STATS
+from torchmetrics_tpu.parallel import (
+    ChaosSchedule,
+    ElasticSync,
+    SyncPolicy,
+    chaos_group,
+)
+from torchmetrics_tpu.parallel.elastic import _ELASTIC
+from torchmetrics_tpu.parallel.strategies import _WIRE, record_collective
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracing():
+    spans_mod.disable_tracing()
+    spans_mod.clear_spans()
+    yield
+    spans_mod.disable_tracing()
+    spans_mod.clear_spans()
+
+
+# ------------------------------------------------------------------ registry
+def test_counter_inc_and_labels():
+    reg = Registry()
+    c = reg.counter("req.total", "requests")
+    c.inc()
+    c.inc(2)
+    c.inc(5, route="sync")
+    assert c.get() == 3
+    assert c.get(route="sync") == 5
+    assert c.value == 8
+    c.reset()
+    assert c.value == 0
+
+
+def test_gauge_last_written_wins():
+    reg = Registry()
+    g = reg.gauge("coverage")
+    g.set(0.5)
+    g.set(0.75)
+    assert g.value == 0.75
+
+
+def test_histogram_buckets_and_snapshot():
+    reg = Registry()
+    h = reg.histogram("dur", buckets=(0.001, 0.01, 0.1))
+    for v in (0.0005, 0.005, 0.005, 0.05):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == 4
+    assert snap["sum"] == pytest.approx(0.0605)
+    ((labels, counts, total_sum, total),) = h.collect()
+    assert labels == ()
+    assert counts == [1, 2, 1]
+    assert total == 4
+
+
+def test_registry_get_or_create_idempotent_and_kind_clash():
+    reg = Registry()
+    assert reg.counter("x") is reg.counter("x")
+    with pytest.raises(ValueError):
+        reg.gauge("x")
+
+
+def test_counter_group_keeps_dict_idiom():
+    reg = Registry()
+    grp = reg.group("island", {"hits": 0, "misses": 0})
+    grp["hits"] += 3  # the historical hot-path mutation idiom
+    grp["misses"] = 2
+    assert dict(grp) == {"hits": 3, "misses": 2}
+    assert isinstance(grp["hits"], int)
+    assert reg.get("island.hits").value == 3  # registry-visible
+    grp["novel"] = 7  # unknown keys register on first write
+    assert reg.get("island.novel").value == 7
+    grp.reset()
+    assert dict(grp) == {"hits": 0, "misses": 0, "novel": 0}
+    with pytest.raises(TypeError):
+        del grp["hits"]
+
+
+def test_registry_prefix_reset_and_as_dict():
+    reg = Registry()
+    reg.counter("a.x").inc(4)
+    reg.counter("b.y").inc(9)
+    assert reg.as_dict("a") == {"a.x": 4}
+    reg.reset("a")
+    assert reg.get("a.x").value == 0
+    assert reg.get("b.y").value == 9
+
+
+# -------------------------------------------------------------------- spans
+def test_tracing_disabled_by_default_returns_null_span():
+    assert spans_mod.ENABLED is False
+    sp = spans_mod.trace_span("anything", k=1)
+    assert sp is spans_mod._NULL_SPAN
+    with sp:
+        pass
+    spans_mod.instant("nothing")
+    assert spans_mod.collected_spans() == []
+
+
+def test_span_nesting_and_attrs():
+    with spans_mod.tracing():
+        with spans_mod.trace_span("outer", a=1) as outer:
+            with spans_mod.trace_span("inner") as inner:
+                inner.set_attr(b=2)
+        spans = spans_mod.collected_spans()
+    by_name = {s.name: s for s in spans}
+    assert by_name["inner"].parent_id == by_name["outer"].span_id
+    assert by_name["outer"].attrs == {"a": 1}
+    assert by_name["inner"].attrs == {"b": 2}
+    assert by_name["outer"].duration_s >= by_name["inner"].duration_s
+
+
+def test_span_records_error_attr():
+    with spans_mod.tracing():
+        with pytest.raises(RuntimeError):
+            with spans_mod.trace_span("boom"):
+                raise RuntimeError("x")
+        (sp,) = spans_mod.collected_spans()
+    assert sp.attrs["error"] == "RuntimeError"
+
+
+def test_traced_decorator_and_phase_totals():
+    @spans_mod.traced("my.phase")
+    def f(x):
+        return x + 1
+
+    assert f(1) == 2  # disabled: plain call
+    with spans_mod.tracing():
+        f(1)
+        f(2)
+        totals = spans_mod.phase_totals()
+    assert totals["my.phase"]["count"] == 2
+    assert totals["my.phase"]["total_s"] >= totals["my.phase"]["max_s"]
+
+
+def test_tracing_context_restores_state_and_drain():
+    with spans_mod.tracing():
+        with spans_mod.trace_span("a"):
+            pass
+    assert spans_mod.ENABLED is False
+    assert len(spans_mod.drain_spans()) == 1
+    assert spans_mod.collected_spans() == []
+
+
+# -------------------------------------------------- metric lifecycle spans
+def test_metric_lifecycle_spans():
+    m = tm.MeanMetric()
+    x = jnp.ones((8,))
+    m.update(x)  # warm outside tracing
+    with spans_mod.tracing():
+        m.update(x)
+        float(m.compute())
+        names = [s.name for s in spans_mod.collected_spans()]
+    assert "metric.update" in names
+    assert "metric.compute" in names
+    upd = next(s for s in spans_mod.drain_spans() if s.name == "metric.update")
+    assert upd.attrs.get("metric") == "MeanMetric"
+
+
+def test_collective_instants_carry_wire_model():
+    with spans_mod.tracing():
+        record_collective("psum", 1024, 4, dtype=jnp.float32)
+        (sp,) = spans_mod.collected_spans()
+    assert sp.name == "collective"
+    assert sp.attrs["kind"] == "psum"
+    assert sp.attrs["bytes"] == 1024
+    assert sp.attrs["world"] == 4
+    assert sp.attrs["wire_bytes"] == 2 * 3 * 1024 // 4  # ring 2(n-1)S/n
+    assert "float32" in sp.attrs["dtype"]
+
+
+# ------------------------------------------------------ elastic chaos spans
+FAST = SyncPolicy(retry_attempts=2, backoff_base_s=0.001)
+
+
+def _ranked_accuracy(world, seed=0, batches=2, n=32):
+    rng = np.random.RandomState(seed)
+    ms = [BinaryAccuracy(validate_args=False) for _ in range(world)]
+    for m in ms:
+        for _ in range(batches):
+            p = jnp.asarray(rng.rand(n).astype(np.float32))
+            t = jnp.asarray(rng.randint(0, 2, n))
+            m.update(p, t)
+    return ms, [m.metric_state for m in ms]
+
+
+def test_chaos_degrade_round_visible_as_nested_spans():
+    # the ISSUE acceptance criterion: a seeded timeout -> retry -> degrade
+    # round shows up as an elastic.round span with coverage attrs and
+    # probe/attempt/backoff children plus a degrade instant
+    world = 2
+    ms, group = _ranked_accuracy(world)
+    backs = chaos_group(group, ChaosSchedule({0: [("timeout", 10)]}))
+    ms[0]._sync_backend = ElasticSync(backs[0], policy=FAST)
+    backs[0].advance_round()
+    with spans_mod.tracing():
+        float(ms[0].compute())
+        spans = spans_mod.collected_spans()
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s.name, []).append(s)
+    (round_sp,) = by_name["elastic.round"]
+    assert round_sp.attrs["degraded"] is True
+    assert round_sp.attrs["coverage"] == 0.5
+    assert round_sp.attrs["ranks_present"] == 1
+    assert round_sp.attrs["ranks_expected"] == world
+    # children nest under the round span
+    (probe,) = by_name["elastic.probe"]
+    assert probe.parent_id == round_sp.span_id
+    # attempts nest under the round directly, or under the probe (the probe
+    # gather is itself retry-guarded) — the probe in turn nests in the round
+    attempts = by_name["elastic.attempt"]
+    assert attempts and all(
+        a.parent_id in (round_sp.span_id, probe.span_id) for a in attempts
+    )
+    assert any(a.attrs.get("timeout") for a in attempts)
+    assert by_name["elastic.backoff"]
+    assert by_name["elastic.degrade"]  # budget-exhaustion instant
+    # the round itself nests under the metric.sync phase
+    (sync_sp,) = by_name["metric.sync"]
+    assert round_sp.parent_id == sync_sp.span_id
+
+
+# ---------------------------------------------------------------- exporters
+def test_perfetto_export_structure():
+    with spans_mod.tracing():
+        with spans_mod.trace_span("phase.a", k="v"):
+            pass
+        spans_mod.instant("tick", n=1)
+        spans = spans_mod.collected_spans()
+    doc = to_perfetto(spans)
+    events = doc["traceEvents"]
+    assert any(e["ph"] == "M" and e["name"] == "process_name" for e in events)
+    (x,) = [e for e in events if e["ph"] == "X"]
+    assert x["name"] == "phase.a" and x["dur"] >= 0 and x["args"]["k"] == "v"
+    (i,) = [e for e in events if e["ph"] == "i"]
+    assert i["name"] == "tick" and i["args"]["n"] == 1
+
+
+def test_write_perfetto_roundtrips(tmp_path):
+    with spans_mod.tracing():
+        with spans_mod.trace_span("p"):
+            pass
+        path = tmp_path / "trace.json"
+        write_perfetto(str(path), spans_mod.collected_spans())
+    doc = json.loads(path.read_text())
+    assert any(e.get("name") == "p" for e in doc["traceEvents"])
+
+
+def test_prometheus_text_format():
+    reg = Registry()
+    reg.counter("req.total", "total requests").inc(3, route="sync")
+    reg.gauge("cov").set(0.5)
+    h = reg.histogram("lat", buckets=(0.01, 0.1))
+    h.observe(0.005)
+    h.observe(0.05)
+    text = to_prometheus(reg, prefix="t")
+    assert "# TYPE t_req_total counter" in text
+    assert 't_req_total{route="sync"} 3' in text
+    assert "t_cov 0.5" in text
+    # cumulative buckets + +Inf + _sum/_count
+    assert 't_lat_bucket{le="0.01"} 1' in text
+    assert 't_lat_bucket{le="0.1"} 2' in text
+    assert 't_lat_bucket{le="+Inf"} 2' in text
+    assert "t_lat_count 2" in text
+
+
+def test_jsonl_event_log_skips_partial_trailing_line(tmp_path):
+    path = tmp_path / "events.jsonl"
+    with JsonlEventLog(str(path)) as log:
+        log.write({"kind": "a", "n": 1})
+        log.write({"kind": "b", "n": 2})
+    # simulate a preemption mid-write: a torn trailing record
+    with open(path, "a") as fh:
+        fh.write('{"kind": "c", "n":')
+    records = JsonlEventLog.read(str(path))
+    assert [r["kind"] for r in records] == ["a", "b"]
+
+
+# --------------------------------------------- compat + reset regression
+EXPECTED_CACHE_STATS_KEYS = {
+    "size", "hits", "misses", "compiles", "retraces", "dispatches",
+    "bytes_reduced", "bytes_gathered", "collectives_issued", "syncs",
+    "sync_retries", "sync_timeouts", "degraded_syncs", "coverage", "online",
+}
+EXPECTED_ONLINE_KEYS = {
+    "windowed_metrics", "decayed_metrics", "windowed_updates",
+    "decayed_updates", "window_rotations",
+}
+
+
+def test_executable_cache_stats_backward_compat_keys():
+    # every pre-registry key must survive the registry-backed rewrite, with
+    # plain-int values (json-serializable, comparable with == as before)
+    stats = M.executable_cache_stats()
+    assert set(stats) == EXPECTED_CACHE_STATS_KEYS
+    assert set(stats["online"]) == EXPECTED_ONLINE_KEYS
+    for key, value in stats.items():
+        if key == "coverage":
+            assert value is None or isinstance(value, dict)
+        elif key == "online":
+            assert all(isinstance(v, int) for v in value.values())
+        else:
+            assert isinstance(value, int), (key, type(value))
+    json.dumps(stats)  # stays serializable
+
+
+def test_executable_cache_stats_tracks_real_traffic():
+    M.reset_cache_stats()
+    m = tm.SumMetric()
+    m.update(jnp.ones((4,)))
+    m.update(jnp.ones((4,)))
+    stats = M.executable_cache_stats()
+    assert stats["dispatches"] >= 2
+    assert stats["compiles"] >= 1
+
+
+def test_reset_cache_stats_zeroes_every_island():
+    # regression: the historical reset only touched the cache island and
+    # left wire/elastic/online counters running
+    M._CACHE_STATS["hits"] += 1
+    record_collective("psum", 512, 2)
+    _ELASTIC["retries"] += 3
+    _ONLINE_STATS["windowed_updates"] += 5
+    stats = M.executable_cache_stats()
+    assert stats["bytes_reduced"] > 0
+    assert stats["sync_retries"] == 3
+    assert stats["online"]["windowed_updates"] == 5
+    M.reset_cache_stats()
+    stats = M.executable_cache_stats()
+    assert stats["hits"] == 0
+    assert stats["bytes_reduced"] == 0 and stats["collectives_issued"] == 0
+    assert stats["sync_retries"] == 0
+    assert stats["online"]["windowed_updates"] == 0
+    assert dict(_WIRE) == {k: 0 for k in _WIRE}
+    assert all(v == 0 for v in dict(_ELASTIC).values())
+
+
+# --------------------------------------------------- strict_mode span report
+def test_strict_mode_fills_span_report_fields():
+    m = tm.MeanMetric()
+    x = jnp.ones((8,))
+    m.update(x)  # warm
+    with spans_mod.tracing():
+        with strict_mode(transfer_guard=None) as stats:
+            m.update(x)
+    assert "metric.update" in stats.span_phase_totals
+    assert stats.span_phase_totals["metric.update"]["count"] == 1
+    assert 1 <= len(stats.slowest_spans) <= 3
+    name, dur = stats.slowest_spans[0]
+    assert isinstance(name, str) and dur >= 0
+
+
+def test_strict_mode_violation_names_span_phases():
+    m = tm.MeanMetric()
+    x = jnp.ones((8,))
+    m.update(x)  # warm
+    with spans_mod.tracing():
+        with pytest.raises(StrictModeViolation) as ei:
+            with strict_mode(transfer_guard=None, max_new_executables=0):
+                m.update(x)  # warm: completes, leaves a span
+                tm.MaxMetric().update(x)  # fresh compile: violation
+    assert "span phases" in str(ei.value)
+    assert "metric.update" in str(ei.value)
+
+
+def test_strict_mode_report_empty_when_tracing_off():
+    m = tm.MeanMetric()
+    x = jnp.ones((8,))
+    m.update(x)
+    with strict_mode(transfer_guard=None) as stats:
+        m.update(x)
+    assert stats.span_phase_totals == {}
+    assert stats.slowest_spans == []

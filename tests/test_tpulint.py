@@ -624,3 +624,60 @@ def test_tpu009_jit_reachable_path_not_double_flagged(tmp_path):
             return multihost_utils.process_allgather(state)
     """, root_kinds=("update", "kernel", "sync"))
     assert "TPU009" not in _rules(res)
+
+
+# --------------------------------------------------------------------- TPU010
+def test_tpu010_mutated_counter_dict_flagged(tmp_path):
+    res = _lint_fixture(tmp_path, metrics_src="""
+        _CACHE_STATS = {"hits": 0, "misses": 0}
+
+        def record_hit():
+            _CACHE_STATS["hits"] += 1
+    """)
+    assert "TPU010" in _rules(res)
+
+
+def test_tpu010_subscript_write_flagged(tmp_path):
+    res = _lint_fixture(tmp_path, metrics_src="""
+        _WIRE = {"bytes_reduced": 0}
+
+        def reset():
+            _WIRE["bytes_reduced"] = 0
+    """)
+    assert "TPU010" in _rules(res)
+
+
+def test_tpu010_registry_group_passes(tmp_path):
+    # the migrated idiom: a registry-backed group is a Call node, not a dict
+    # literal — the historical `d[k] += n` mutation sites stay as they are
+    res = _lint_fixture(tmp_path, metrics_src="""
+        from torchmetrics_tpu.observability.registry import REGISTRY
+
+        _CACHE_STATS = REGISTRY.group("cache", {"hits": 0, "misses": 0})
+
+        def record_hit():
+            _CACHE_STATS["hits"] += 1
+    """)
+    assert "TPU010" not in _rules(res)
+
+
+def test_tpu010_unmutated_lookup_table_passes(tmp_path):
+    # an int-valued dict that is only ever READ is a lookup table, not a
+    # counter island
+    res = _lint_fixture(tmp_path, metrics_src="""
+        _NUM_CLASSES = {"binary": 2, "multiclass": 10}
+
+        def lookup(kind):
+            return _NUM_CLASSES[kind]
+    """)
+    assert "TPU010" not in _rules(res)
+
+
+def test_tpu010_non_int_dict_passes(tmp_path):
+    res = _lint_fixture(tmp_path, metrics_src="""
+        _CALIBRATION = {"nb": 2.19, "wb": 3.02}
+
+        def recalibrate():
+            _CALIBRATION["nb"] = 2.2
+    """)
+    assert "TPU010" not in _rules(res)

@@ -8,7 +8,9 @@ streaming paths rely on: no host syncs, no data-dependent shapes, no Python
 control flow on tracers, sane state registration, no use-after-donation, no
 float64, no per-leaf collectives looped over state dicts, and — on the
 jit-unreachable eager remainder — no blocking host collective without a
-timeout/retry policy (TPU009).
+timeout/retry policy (TPU009). Module-scoped TPU010 keeps process telemetry
+honest: counter state must live on ``observability.registry``, not in ad-hoc
+module-level dicts that escape reset/export/strict-mode budgets.
 
 Programmatic entry point::
 
@@ -33,6 +35,7 @@ from .rules import (
     ALL_RULES,
     RULE_TITLES,
     Violation,
+    check_counter_island,
     check_state_contract,
     check_traced_rules,
     check_unguarded_host_collective,
@@ -93,6 +96,10 @@ def run_lint(
         # policy (traced paths are TPU001's jurisdiction)
         if fn.qualname not in reachability.reachable:
             violations.extend(check_unguarded_host_collective(fn))
+    # TPU010 is module-scoped: ad-hoc counter islands live at module level,
+    # outside any function body
+    for mod in sorted(corpus.modules.values(), key=lambda m: m.path):
+        violations.extend(check_counter_island(mod))
 
     waivers_by_path = {}
     for mod in corpus.modules.values():

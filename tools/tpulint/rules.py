@@ -18,6 +18,7 @@ directly; TPU005 scans all functions (donation misuse is an eager-layer bug).
 | TPU007 | no per-leaf collective inside a Python loop over state dicts      |
 | TPU008 | no list-state concat in a traced path (use the padded layout)     |
 | TPU009 | no blocking host collective without a timeout/retry policy        |
+| TPU010 | no ad-hoc module-level counter dicts (use observability.registry) |
 """
 from __future__ import annotations
 
@@ -33,9 +34,9 @@ from .callgraph import (
     compute_taint,
     host_only_lines,
 )
-from .corpus import ClassInfo, Corpus, FunctionInfo
+from .corpus import ClassInfo, Corpus, FunctionInfo, ModuleInfo
 
-ALL_RULES = ("TPU000", "TPU001", "TPU002", "TPU003", "TPU004", "TPU005", "TPU006", "TPU007", "TPU008", "TPU009")
+ALL_RULES = ("TPU000", "TPU001", "TPU002", "TPU003", "TPU004", "TPU005", "TPU006", "TPU007", "TPU008", "TPU009", "TPU010")
 
 RULE_TITLES = {
     "TPU000": "malformed waiver",
@@ -48,6 +49,7 @@ RULE_TITLES = {
     "TPU007": "per-leaf collective in a loop over states",
     "TPU008": "list-state concat in a traced path",
     "TPU009": "blocking host collective without timeout/retry policy",
+    "TPU010": "ad-hoc module-level counter dict (use observability.registry)",
 }
 
 
@@ -577,3 +579,61 @@ def _is_donating_jit(expr: ast.expr) -> bool:
 
 def _is_empty_tuple(node: ast.expr) -> bool:
     return isinstance(node, ast.Tuple) and not node.elts
+
+
+# ------------------------------------------------------------------ TPU010
+def check_counter_island(mod: ModuleInfo) -> List[Violation]:
+    """TPU010 over one module: ad-hoc module-level counter dicts.
+
+    A module-level dict literal whose values are all plain ints and whose
+    entries are subscript-mutated somewhere in the same module is an ad-hoc
+    counter island: invisible to ``reset_cache_stats()``, to the Prometheus
+    exporter, and to ``strict_mode()`` budgets. Counters belong on
+    ``observability.registry`` (``REGISTRY.counter(...)`` or
+    ``REGISTRY.group(...)`` — the latter keeps the historical ``d[k] += n``
+    mutation idiom working). Registry-backed groups are ``Call`` nodes, not
+    dict literals, so migrated islands don't fire.
+    """
+    candidates: Dict[str, ast.Assign] = {}
+    for stmt in mod.tree.body:
+        if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+            continue
+        target = stmt.targets[0]
+        value = stmt.value
+        if not isinstance(target, ast.Name) or not isinstance(value, ast.Dict):
+            continue
+        if not value.values:
+            continue
+        if all(
+            isinstance(v, ast.Constant) and type(v.value) is int
+            for v in value.values
+        ):
+            candidates[target.id] = stmt
+
+    if not candidates:
+        return []
+
+    mutated: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        sub: Optional[ast.expr] = None
+        if isinstance(node, ast.AugAssign) and isinstance(node.target, ast.Subscript):
+            sub = node.target.value
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    sub = t.value
+        if isinstance(sub, ast.Name) and sub.id in candidates:
+            mutated.add(sub.id)
+
+    out: List[Violation] = []
+    for name in sorted(mutated):
+        stmt = candidates[name]
+        out.append(Violation(
+            "TPU010", mod.path, stmt.lineno, stmt.col_offset,
+            f"module-level counter dict `{name}` is an ad-hoc telemetry island: "
+            "it escapes reset_cache_stats(), the Prometheus exporter, and "
+            "strict_mode() budgets — register it via "
+            "observability.registry (REGISTRY.group keeps the `d[k] += n` idiom)",
+            f"{mod.name}:{name}",
+        ))
+    return out

@@ -42,6 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .metric import Metric, _filter_kwargs, _global_jit, _jit_safe_inputs
+from .observability import spans as _spans
 from .parallel.reduction import Reduction
 from .parallel.strategies import SyncPolicy
 from .parallel.sync import reduce_state_in_graph
@@ -327,6 +328,20 @@ class MetricCollection:
         return _global_jit(key, fused_update, donate_state=True)
 
     def _run_fused_update(self, fused, fused_fn, args: tuple, kwargs: Dict[str, Any]) -> None:
+        _sp = (
+            _spans.start_span("collection.fused_update", members=len(fused))
+            if _spans.ENABLED
+            else None
+        )
+        try:
+            self._run_fused_update_inner(fused, fused_fn, args, kwargs)
+        finally:
+            if _sp is not None:
+                _sp.end()
+
+    def _run_fused_update_inner(
+        self, fused, fused_fn, args: tuple, kwargs: Dict[str, Any]
+    ) -> None:
         for _name, rep in fused:
             if rep._is_synced:
                 raise TorchMetricsUserError(

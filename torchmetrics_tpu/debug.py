@@ -22,12 +22,13 @@ Used by ``tests/test_strict_mode.py`` and ``bench.py --smoke``.
 from __future__ import annotations
 
 import contextlib
-from dataclasses import dataclass
-from typing import Any, Iterator, Optional
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 import jax
 
 from . import metric as _metric
+from .observability import spans as _spans
 from .parallel import elastic as _elastic
 from .parallel import strategies as _strategies
 
@@ -56,6 +57,12 @@ class StrictStats:
     degraded_syncs: int = 0
     sync_retries: int = 0
     coverage_fraction: Optional[float] = None
+    # filled at exit when span tracing is armed (observability.enable_tracing):
+    # per-phase {name: {count, total_s, max_s}} over spans completed inside the
+    # context, and the top-3 slowest (name, duration_s) — so a blown budget
+    # names the phase that blew it
+    span_phase_totals: Dict[str, Dict[str, float]] = field(default_factory=dict)
+    slowest_spans: List[Tuple[str, float]] = field(default_factory=list)
 
 
 def _looks_like_transfer_guard_error(exc: BaseException) -> bool:
@@ -93,6 +100,23 @@ def strict_mode(
             results.
     """
     stats = StrictStats()
+    spans_before = len(_spans.collected_spans()) if _spans.ENABLED else 0
+
+    def _span_report() -> str:
+        """One-line per-phase summary naming where the time went (tracing on)."""
+        if not _spans.ENABLED:
+            return ""
+        inside = _spans.collected_spans()[spans_before:]
+        if not inside:
+            return ""
+        totals = _spans.phase_totals(inside)
+        parts = [
+            f"{name}: {agg['count']}x {agg['total_s'] * 1e3:.2f}ms"
+            for name, agg in sorted(
+                totals.items(), key=lambda kv: kv[1]["total_s"], reverse=True
+            )
+        ]
+        return " [span phases — " + ", ".join(parts) + "]"
 
     def _observe(key: Any, new_compiles: int, retraces: int) -> None:
         stats.compiles += new_compiles
@@ -104,6 +128,7 @@ def strict_mode(
                 f"{stats.retraces} retrace(s) > budget {max_retraces}. Input "
                 "shapes/dtypes are churning against a warm executable — pad or "
                 "bucket inputs, or raise max_retraces if this churn is intended."
+                + _span_report()
             )
         if max_new_executables is not None and stats.new_executables > max_new_executables:
             raise StrictModeViolation(
@@ -111,6 +136,7 @@ def strict_mode(
                 f"{stats.new_executables} new executable(s) > budget "
                 f"{max_new_executables}. Warm the metric up before entering "
                 "strict_mode, or raise max_new_executables."
+                + _span_report()
             )
 
     def _observe_degrade(coverage: Any) -> None:
@@ -125,6 +151,7 @@ def strict_mode(
                 f"degraded round(s) > budget {max_degraded_syncs}. A peer "
                 "dropped out or a retry budget was exhausted — raise "
                 "max_degraded_syncs to accept annotated partial results."
+                + _span_report()
             )
 
     _metric._COMPILE_OBSERVERS.append(_observe)
@@ -155,6 +182,12 @@ def strict_mode(
         stats.sync_retries = (
             _elastic.elastic_stats()["retries"] - elastic_before["retries"]
         )
+        if _spans.ENABLED:
+            inside = _spans.collected_spans()[spans_before:]
+            stats.span_phase_totals = _spans.phase_totals(inside)
+            stats.slowest_spans = [
+                (s.name, s.duration_s) for s in _spans.slowest_spans(3, inside)
+            ]
 
 
 __all__ = ["StrictModeViolation", "StrictStats", "strict_mode"]

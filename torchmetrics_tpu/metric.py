@@ -46,6 +46,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .buffers import CatBuffer, CatLayoutError
+from .observability import spans as _spans
+from .observability.registry import REGISTRY as _REGISTRY
 from .parallel.reduction import ELEMENTWISE_REDUCTIONS, Reduction, resolve_reduction
 from .parallel.strategies import (
     SyncPolicy,
@@ -124,8 +126,14 @@ def _jit_safe_inputs(*trees: Any) -> bool:
 # ---------------------------------------------------------------------------
 
 _EXECUTABLE_CACHE: Dict[Any, Callable] = {}
-_CACHE_STATS = {"hits": 0, "misses": 0, "compiles": 0, "retraces": 0}
-_DISPATCH_COUNT = [0]
+# registry-backed (see observability/registry.py): same mutation idiom as the
+# historical plain dicts, but scrapeable via to_prometheus()
+_CACHE_STATS = _REGISTRY.group(
+    "cache",
+    {"hits": 0, "misses": 0, "compiles": 0, "retraces": 0},
+    help="process-global executable cache",
+)
+_DISPATCH_COUNT = _REGISTRY.counter("cache.dispatches", "jitted dispatches")
 # observers called as cb(key, new_compiles, retraces) whenever a dispatch
 # triggers XLA compilation; used by debug.strict_mode() to fail fast
 _COMPILE_OBSERVERS: List[Callable[[Any, int, int], None]] = []
@@ -135,7 +143,7 @@ _MAX_KEY_ARRAY_BYTES = 4096
 
 # bytes fed through hashing in Metric.__hash__ — the incremental-digest
 # regression test asserts re-hashing an unchanged metric feeds zero bytes
-_HASH_STATS = {"bytes_hashed": 0}
+_HASH_STATS = _REGISTRY.group("hash", {"bytes_hashed": 0}, help="Metric.__hash__ traffic")
 
 # attributes that never change the traced program (pure host-side bookkeeping)
 _RUNTIME_ATTRS = frozenset(
@@ -227,7 +235,7 @@ def _global_jit(key: Any, fn: Callable, donate_state: bool = False) -> Callable:
         seen_compiles = [0]
 
         def entry(*args: Any, **kwargs: Any) -> Any:
-            _DISPATCH_COUNT[0] += 1
+            _DISPATCH_COUNT.inc()
             before = _jit_compile_count(jitted)
             out = jitted(*args, **kwargs)
             new = _jit_compile_count(jitted) - before
@@ -250,16 +258,28 @@ def _global_jit(key: Any, fn: Callable, donate_state: bool = False) -> Callable:
     return entry
 
 
+def reset_cache_stats() -> None:
+    """Zero EVERY telemetry island: cache, wire, elastic, and online.
+
+    The historical reset skipped the online counters (they live in a
+    lazily-imported module), silently skewing any before/after
+    measurement that mixed streaming and batch metrics; resetting here
+    goes through all four islands so deltas line up.
+    """
+    _CACHE_STATS.reset()
+    _DISPATCH_COUNT.reset()
+    _HASH_STATS.reset()
+    reset_wire_stats()
+    reset_elastic_stats()
+    mod = sys.modules.get("torchmetrics_tpu.online")
+    if mod is not None:
+        mod.reset_online_stats()
+
+
 def clear_executable_cache() -> None:
     """Drop all cached executables and reset counters (tests/benchmarks)."""
     _EXECUTABLE_CACHE.clear()
-    _CACHE_STATS["hits"] = 0
-    _CACHE_STATS["misses"] = 0
-    _CACHE_STATS["compiles"] = 0
-    _CACHE_STATS["retraces"] = 0
-    _DISPATCH_COUNT[0] = 0
-    reset_wire_stats()
-    reset_elastic_stats()
+    reset_cache_stats()
 
 
 def executable_cache_stats() -> Dict[str, int]:
@@ -273,7 +293,11 @@ def executable_cache_stats() -> Dict[str, int]:
     online-evaluation dispatch counters (windowed/decayed metrics created,
     eager update dispatches, estimated window rotations — see
     ``online.online_stats``); it is ``{}`` until ``torchmetrics_tpu.online``
-    is first used."""
+    is first used.
+
+    This is a backward-compatibility view: the counters themselves live in
+    the :mod:`~torchmetrics_tpu.observability.registry` and can be scraped
+    directly via :func:`~torchmetrics_tpu.observability.to_prometheus`."""
     wire = wire_stats()
     es = elastic_stats()
     online: Dict[str, int] = {}
@@ -286,7 +310,7 @@ def executable_cache_stats() -> Dict[str, int]:
         "misses": _CACHE_STATS["misses"],
         "compiles": _CACHE_STATS["compiles"],
         "retraces": _CACHE_STATS["retraces"],
-        "dispatches": _DISPATCH_COUNT[0],
+        "dispatches": int(_DISPATCH_COUNT.value),
         "bytes_reduced": wire["bytes_reduced"],
         "bytes_gathered": wire["bytes_gathered"],
         "collectives_issued": wire["collectives_issued"],
@@ -585,9 +609,18 @@ class Metric:
             raise TorchMetricsUserError(
                 "The Metric has been synced and `forward` assumes local state; call `unsync()` first."
             )
-        if self.full_state_update or self.full_state_update is None or self.dist_sync_on_step:
-            return self._forward_full_state_update(*args, **kwargs)
-        return self._forward_reduce_state_update(*args, **kwargs)
+        _sp = (
+            _spans.start_span("metric.forward", metric=type(self).__name__)
+            if _spans.ENABLED
+            else None
+        )
+        try:
+            if self.full_state_update or self.full_state_update is None or self.dist_sync_on_step:
+                return self._forward_full_state_update(*args, **kwargs)
+            return self._forward_reduce_state_update(*args, **kwargs)
+        finally:
+            if _sp is not None:
+                _sp.end()
 
     def __call__(self, *args: Any, **kwargs: Any) -> Any:
         return self.forward(*args, **kwargs)
@@ -1069,6 +1102,13 @@ class Metric:
         # (e.g. HostSync TimeoutError on a stalled peer) must leave local
         # state intact — a half-synced state dict would be checkpointed or
         # double-counted by the recovery path
+        _sp = (
+            _spans.start_span(
+                "metric.sync", metric=type(self).__name__, world=backend.world_size()
+            )
+            if _spans.ENABLED
+            else None
+        )
         try:
             begin_sync()
             # elastic membership round: the contribution probe settles who is
@@ -1086,6 +1126,9 @@ class Metric:
         except Exception:
             self._cache = None
             raise
+        finally:
+            if _sp is not None:
+                _sp.end()
         self._state.update(synced)
         self._is_synced = True
 
@@ -1111,6 +1154,7 @@ class Metric:
             "eager_gather",
             q.size * q.dtype.itemsize + scales.size * scales.dtype.itemsize,
             backend.world_size(),
+            dtype=q.dtype,
         )
         gq = backend.sync_tensor(q, Reduction.NONE)  # (world, Q)
         gs = backend.sync_tensor(scales, Reduction.NONE)  # (world, C)
@@ -1581,22 +1625,33 @@ def _wrap_update(update_fn: Callable) -> Callable:
             raise TorchMetricsUserError(
                 "The Metric is currently synced; call `unsync()` before `update`."
             )
-        args = tuple(self._to_array(a) for a in args)
-        kwargs = {k: self._to_array(v) for k, v in kwargs.items()}
-        self._eager_validate(*args, **kwargs)
-        if self._use_jit and _jit_safe_inputs(args, kwargs):
-            upd = self._get_jitted("update", self._pure_update, donate_state=True)
-            new_tensors, appends = upd(self._donation_safe_tensor_state(), args, kwargs)
-            for k, v in new_tensors.items():
-                self._state[k] = v
-            self._extend_list_states(appends)
-        else:
-            update_fn(self, *args, **kwargs)
-            if self.compute_on_cpu:
-                for k in self._list_states:
-                    self._state[k] = [np.asarray(e) for e in self._state[k]]
+        _sp = (
+            _spans.start_span("metric.update", metric=type(self).__name__)
+            if _spans.ENABLED
+            else None
+        )
+        try:
+            args = tuple(self._to_array(a) for a in args)
+            kwargs = {k: self._to_array(v) for k, v in kwargs.items()}
+            self._eager_validate(*args, **kwargs)
+            if self._use_jit and _jit_safe_inputs(args, kwargs):
+                upd = self._get_jitted("update", self._pure_update, donate_state=True)
+                new_tensors, appends = upd(self._donation_safe_tensor_state(), args, kwargs)
+                for k, v in new_tensors.items():
+                    self._state[k] = v
+                self._extend_list_states(appends)
+                if _sp is not None:
+                    _sp.set_attr(jit=True).fence(new_tensors)
             else:
-                self._adopt_padded_lists()
+                update_fn(self, *args, **kwargs)
+                if self.compute_on_cpu:
+                    for k in self._list_states:
+                        self._state[k] = [np.asarray(e) for e in self._state[k]]
+                else:
+                    self._adopt_padded_lists()
+        finally:
+            if _sp is not None:
+                _sp.end()
 
     wrapped._tm_wrapped = True
     return wrapped
@@ -1614,8 +1669,17 @@ def _wrap_compute(compute_fn: Callable) -> Callable:
             )
         if self.compute_with_cache and self._computed is not None:
             return self._computed
-        with self.sync_context(should_sync=self._to_sync):
-            value = _squeeze_if_scalar(compute_fn(self, *args, **kwargs))
+        _sp = (
+            _spans.start_span("metric.compute", metric=type(self).__name__)
+            if _spans.ENABLED
+            else None
+        )
+        try:
+            with self.sync_context(should_sync=self._to_sync):
+                value = _squeeze_if_scalar(compute_fn(self, *args, **kwargs))
+        finally:
+            if _sp is not None:
+                _sp.end()
         if self.compute_with_cache:
             self._computed = value
         return value

@@ -1,0 +1,60 @@
+"""Unified telemetry: span tracing, typed counters, exporters.
+
+See ``docs/observability.md`` for the span taxonomy, exporter formats
+and sampling knobs. Everything here is host-side and zero-overhead when
+tracing is disabled (the default).
+"""
+from .registry import (
+    REGISTRY,
+    Counter,
+    CounterGroup,
+    Gauge,
+    Histogram,
+    Registry,
+    get_registry,
+)
+from .spans import (
+    ENABLED,
+    Span,
+    clear_spans,
+    collected_spans,
+    disable_tracing,
+    drain_spans,
+    enable_tracing,
+    instant,
+    phase_totals,
+    slowest_spans,
+    start_span,
+    trace_span,
+    traced,
+    tracing,
+)
+from .export import JsonlEventLog, to_perfetto, to_prometheus, write_perfetto
+
+__all__ = [
+    "REGISTRY",
+    "Counter",
+    "CounterGroup",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "get_registry",
+    "ENABLED",
+    "Span",
+    "clear_spans",
+    "collected_spans",
+    "disable_tracing",
+    "drain_spans",
+    "enable_tracing",
+    "instant",
+    "phase_totals",
+    "slowest_spans",
+    "start_span",
+    "trace_span",
+    "traced",
+    "tracing",
+    "JsonlEventLog",
+    "to_perfetto",
+    "to_prometheus",
+    "write_perfetto",
+]

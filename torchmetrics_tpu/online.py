@@ -41,6 +41,7 @@ import jax
 import jax.numpy as jnp
 
 from .metric import Metric
+from .observability.registry import REGISTRY as _REGISTRY
 from .parallel.reduction import Reduction
 
 Array = jax.Array
@@ -56,13 +57,17 @@ __all__ = [
 # instances created, eager update dispatches (buffered flushes stage updates
 # without re-entering the eager path, so staged steps are not re-counted),
 # and window rotations estimated from per-metric update counts.
-_ONLINE_STATS: Dict[str, int] = {
-    "windowed_metrics": 0,
-    "decayed_metrics": 0,
-    "windowed_updates": 0,
-    "decayed_updates": 0,
-    "window_rotations": 0,
-}
+_ONLINE_STATS = _REGISTRY.group(
+    "online",
+    {
+        "windowed_metrics": 0,
+        "decayed_metrics": 0,
+        "windowed_updates": 0,
+        "decayed_updates": 0,
+        "window_rotations": 0,
+    },
+    help="online-evaluation dispatch counters",
+)
 
 
 def online_stats() -> Dict[str, int]:

@@ -53,6 +53,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple, Un
 import jax
 import jax.numpy as jnp
 
+from ..observability import spans as _spans
+from ..observability.registry import REGISTRY as _REGISTRY
 from .reduction import Reduction
 from .strategies import SyncPolicy, default_policy
 from .sync import SyncBackend
@@ -119,17 +121,23 @@ class Coverage:
 # process-global elastic counters (surfaced via executable_cache_stats())
 # ---------------------------------------------------------------------------
 
-_ELASTIC = {
-    "rounds": 0,             # elastic sync rounds completed
-    "epochs": 0,             # membership changes observed
-    "retries": 0,            # gather attempts repeated after a timeout
-    "timeouts": 0,           # gather timeouts observed (incl. retried ones)
-    "recoveries": 0,         # gathers that succeeded on a retry attempt
-    "degraded_syncs": 0,     # rounds that settled below 100% coverage
-    "rejoins": 0,            # membership-grew epochs (a rank came back)
-    "duplicates_dropped": 0, # duplicated deliveries deduplicated by rank id
-    "overlap_deferred": 0,   # overlapped-flush gathers deferred to the barrier
-}
+# registry-backed (see observability/registry.py); dict-style mutation below
+# is unchanged, but the values are scrapeable via to_prometheus()
+_ELASTIC = _REGISTRY.group(
+    "elastic",
+    {
+        "rounds": 0,             # elastic sync rounds completed
+        "epochs": 0,             # membership changes observed
+        "retries": 0,            # gather attempts repeated after a timeout
+        "timeouts": 0,           # gather timeouts observed (incl. retried ones)
+        "recoveries": 0,         # gathers that succeeded on a retry attempt
+        "degraded_syncs": 0,     # rounds that settled below 100% coverage
+        "rejoins": 0,            # membership-grew epochs (a rank came back)
+        "duplicates_dropped": 0, # duplicated deliveries deduplicated by rank id
+        "overlap_deferred": 0,   # overlapped-flush gathers deferred to the barrier
+    },
+    help="elastic-sync health",
+)
 _LAST_COVERAGE: List[Optional[Coverage]] = [None]
 
 # observers called as cb(coverage) whenever a round settles degraded; used by
@@ -524,16 +532,26 @@ class ElasticSync(SyncBackend):
         when the budget is exhausted (the round is then annotated partial)."""
         policy = self._policy()
         attempts = policy.retry_attempts
+        traced_on = _spans.ENABLED
         for attempt in range(attempts + 1):
+            _asp = (
+                _spans.start_span("elastic.attempt", attempt=attempt)
+                if traced_on
+                else None
+            )
             try:
                 out = op()
                 if attempt:
                     _ELASTIC["recoveries"] += 1
+                    if _asp is not None:
+                        _asp.set_attr(recovered=True)
                 return out
             except TimeoutError as exc:
                 _ELASTIC["timeouts"] += 1
                 suspects = tuple(getattr(exc, "suspect_ranks", ()) or ())
                 self._suspects.update(int(s) for s in suspects)
+                if _asp is not None:
+                    _asp.set_attr(timeout=True, suspects=list(suspects))
                 if attempt >= attempts:
                     break
             except RuntimeError as exc:
@@ -541,9 +559,18 @@ class ElasticSync(SyncBackend):
                 # below re-arms it, so a retry is meaningful
                 if attempt >= attempts or "poison" not in str(exc).lower():
                     raise
+            finally:
+                if _asp is not None:
+                    _asp.end()
             _ELASTIC["retries"] += 1
-            time.sleep(min(policy.backoff_base_s * (2 ** attempt), _BACKOFF_CAP_S))
-            self._shrink_membership()
+            backoff_s = min(policy.backoff_base_s * (2 ** attempt), _BACKOFF_CAP_S)
+            if traced_on:
+                with _spans.trace_span("elastic.backoff", attempt=attempt, sleep_s=backoff_s):
+                    time.sleep(backoff_s)
+                    self._shrink_membership()
+            else:
+                time.sleep(backoff_s)
+                self._shrink_membership()
         # budget exhausted: partial result over whatever answered — here,
         # just this rank. end_round() reports the coverage fraction.
         self._round_degraded = True
@@ -551,6 +578,8 @@ class ElasticSync(SyncBackend):
             self._present -= self._suspects
         else:
             self._present = {self._rank()}
+        if traced_on:
+            _spans.instant("elastic.degrade", suspects=sorted(self._suspects))
         return local()
 
     def _shrink_membership(self) -> None:
@@ -586,7 +615,17 @@ class ElasticSync(SyncBackend):
         self._present = set(range(self._expected)) - set(
             getattr(getattr(self._inner, "controller", None), "down", ())
         )
-        self._probe(int(contrib))
+        if _spans.ENABLED:
+            # cross-call span: opened here, closed (with coverage attrs) by
+            # end_round — the retry/backoff/degrade children nest under it
+            self._round_span = _spans.start_span(
+                "elastic.round", epoch=self.epoch, contrib=int(contrib)
+            )
+            with _spans.trace_span("elastic.probe"):
+                self._probe(int(contrib))
+        else:
+            self._round_span = None
+            self._probe(int(contrib))
 
     def _probe(self, contrib: int) -> None:
         inner = self._inner
@@ -646,6 +685,17 @@ class ElasticSync(SyncBackend):
         self.last_coverage = cov
         degraded = self._round_degraded or not cov.full
         record_coverage(cov, degraded=degraded)
+        _rsp = self.__dict__.get("_round_span")
+        if _rsp is not None:
+            _rsp.set_attr(
+                degraded=degraded,
+                coverage=cov.fraction,
+                ranks_present=cov.ranks_present,
+                ranks_expected=cov.ranks_expected,
+                samples_present=cov.samples_present,
+                samples_expected=cov.samples_expected,
+            ).end()
+            self._round_span = None
         policy = self._policy()
         self._round_policy = None
         if cov.fraction < policy.min_coverage:

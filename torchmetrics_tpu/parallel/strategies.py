@@ -43,6 +43,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..observability import spans as _spans
+from ..observability.registry import REGISTRY as _REGISTRY
+
 Array = jax.Array
 
 __all__ = [
@@ -89,16 +92,24 @@ def pad_cat_rows(value: "Array", target_rows: int, trailing: Tuple[int, ...], dt
 # wire-level counters
 # ---------------------------------------------------------------------------
 
-_WIRE = {
-    "bytes_reduced": 0,     # elementwise all-reduce traffic (model, per device)
-    "bytes_gathered": 0,    # cat/NONE gather traffic (model, per device)
-    "collectives_issued": 0,
-    "syncs": 0,             # reduce_state_in_graph traces + eager Metric.sync calls
-}
-_LAST_SYNC = dict(_WIRE)
+# registry-backed (see observability/registry.py); dict-style mutation below
+# is unchanged, but the values are scrapeable via to_prometheus()
+_WIRE = _REGISTRY.group(
+    "wire",
+    {
+        "bytes_reduced": 0,     # elementwise all-reduce traffic (model, per device)
+        "bytes_gathered": 0,    # cat/NONE gather traffic (model, per device)
+        "collectives_issued": 0,
+        "syncs": 0,             # reduce_state_in_graph traces + eager Metric.sync calls
+    },
+    help="modelled ring-bandwidth wire traffic",
+)
+_LAST_SYNC = _REGISTRY.group(
+    "wire.last_sync", dict(_WIRE), help="per-collective breakdown of the latest sync"
+)
 
 
-def record_collective(kind: str, nbytes: int, world: int) -> None:
+def record_collective(kind: str, nbytes: int, world: int, dtype: Any = None) -> None:
     """Account one collective over ``nbytes`` of payload on a ``world`` ring.
 
     Ring-bandwidth model (bytes per device): ``psum``/``pmax``/``pmin`` move
@@ -130,6 +141,15 @@ def record_collective(kind: str, nbytes: int, world: int) -> None:
     _WIRE["collectives_issued"] += 1
     _LAST_SYNC[key] += moved
     _LAST_SYNC["collectives_issued"] += 1
+    if _spans.ENABLED:
+        _spans.instant(
+            "collective",
+            kind=kind,
+            bytes=int(nbytes),
+            wire_bytes=int(moved),
+            world=n,
+            dtype=str(dtype) if dtype is not None else None,
+        )
 
 
 def begin_sync() -> None:
@@ -339,14 +359,14 @@ def _zeros_psum_gather(v: Array, axis_name: str, n: int) -> Array:
     """(n, *v.shape) invariant gather via scatter-into-zeros + psum."""
     i = lax.axis_index(axis_name)
     buf = jnp.zeros((n,) + v.shape, v.dtype).at[i].set(v)
-    record_collective("zeros_psum_gather", v.size * v.dtype.itemsize, n)
+    record_collective("zeros_psum_gather", v.size * v.dtype.itemsize, n, dtype=v.dtype)
     return lax.psum(buf, axis_name)
 
 
 def _stack_gather(v: Array, axis_name: str, n: int, policy: SyncPolicy) -> Array:
     """(n, *v.shape) gather, policy-routed."""
     if policy.use_all_gather():
-        record_collective("all_gather", v.size * v.dtype.itemsize, n)
+        record_collective("all_gather", v.size * v.dtype.itemsize, n, dtype=v.dtype)
         return lax.all_gather(v, axis_name)
     return _zeros_psum_gather(v, axis_name, n)
 
@@ -419,11 +439,11 @@ def reduce_scatter_sum(
     n = axis_size(axis_name)
     size = flat.size
     padded, _ = _pad_to_multiple(flat, n)
-    record_collective("psum_scatter", padded.size * padded.dtype.itemsize, n)
+    record_collective("psum_scatter", padded.size * padded.dtype.itemsize, n, dtype=padded.dtype)
     shard = lax.psum_scatter(padded, axis_name, tiled=True)
     if mean:
         shard = shard / n if jnp.issubdtype(shard.dtype, jnp.floating) else shard // n
-    record_collective("all_gather", shard.size * shard.dtype.itemsize, n)
+    record_collective("all_gather", shard.size * shard.dtype.itemsize, n, dtype=shard.dtype)
     out = lax.all_gather(shard, axis_name, tiled=True)
     return out[:size]
 
@@ -494,7 +514,7 @@ def quantized_allreduce(
     # quantizes with identical scales (required for integer accumulation)
     blocks = padded.reshape(-1, chunk)
     local_absmax = jnp.max(jnp.abs(blocks), axis=1)
-    record_collective("pmax", local_absmax.size * local_absmax.dtype.itemsize, n)
+    record_collective("pmax", local_absmax.size * local_absmax.dtype.itemsize, n, dtype=local_absmax.dtype)
     absmax = lax.pmax(local_absmax, axis_name)
     scales = (absmax / qmax).astype(blocks.dtype)
     safe = jnp.where(scales > 0, scales, 1.0)
@@ -505,7 +525,7 @@ def quantized_allreduce(
     # (2) integer reduce-scatter: accumulator must hold n·qmax
     acc_dtype = jnp.int16 if bits == 8 and n <= 255 else jnp.int32
     acc_flat = q_in.astype(acc_dtype).reshape(-1)
-    record_collective("psum_scatter", acc_flat.size * acc_flat.dtype.itemsize, n)
+    record_collective("psum_scatter", acc_flat.size * acc_flat.dtype.itemsize, n, dtype=acc_flat.dtype)
     shard_acc = lax.psum_scatter(acc_flat, axis_name, tiled=True)
 
     # (3) dequantize the shard with its slice of the shared scales, then
@@ -518,9 +538,9 @@ def quantized_allreduce(
     if mean:
         shard = shard / n
     q_out, out_scales, _ = quantize_chunks(shard, bits, chunk)
-    record_collective("all_gather", q_out.size * q_out.dtype.itemsize, n)
+    record_collective("all_gather", q_out.size * q_out.dtype.itemsize, n, dtype=q_out.dtype)
     gathered_q = lax.all_gather(q_out, axis_name, tiled=True)
-    record_collective("all_gather", out_scales.size * out_scales.dtype.itemsize, n)
+    record_collective("all_gather", out_scales.size * out_scales.dtype.itemsize, n, dtype=out_scales.dtype)
     gathered_scales = lax.all_gather(out_scales, axis_name, tiled=True)
     result = dequantize_chunks(gathered_q, gathered_scales, flat.dtype)[:size]
     return result, new_residual

@@ -113,7 +113,8 @@ _PLAIN_KIND = {
 def _plain_reduce(value: Array, reduction: Reduction, axis_name: str) -> Array:
     """Full-precision elementwise collective (the dense strategy)."""
     record_collective(
-        _PLAIN_KIND[reduction], value.size * value.dtype.itemsize, axis_size(axis_name)
+        _PLAIN_KIND[reduction], value.size * value.dtype.itemsize, axis_size(axis_name),
+        dtype=value.dtype,
     )
     if reduction == Reduction.SUM:
         return lax.psum(value, axis_name)
@@ -486,7 +487,7 @@ class HostSync(SyncBackend):
     def sync_tensor(self, value: Array, reduction) -> Array:
         nbytes = value.size * value.dtype.itemsize
         kind = "eager_reduce" if reduction in ELEMENTWISE_REDUCTIONS else "eager_gather"
-        record_collective(kind, nbytes, self.world_size())
+        record_collective(kind, nbytes, self.world_size(), dtype=value.dtype)
         if reduction == Reduction.CAT:
             return self._gather_uneven_cat(jnp.atleast_1d(value))
         gathered = self._gather(value)  # (world, ...)
@@ -597,7 +598,8 @@ class HostSync(SyncBackend):
                 f"{self._CAT_MAX_TRAILING}"
             )
         record_collective(
-            "eager_gather", buffer.size * buffer.dtype.itemsize, self.world_size()
+            "eager_gather", buffer.size * buffer.dtype.itemsize, self.world_size(),
+            dtype=buffer.dtype,
         )
         meta = np.full(2 + self._CAT_MAX_TRAILING + self._CAT_NAME_WORDS, -1, dtype=np.int32)
         meta[0] = count
@@ -698,6 +700,7 @@ class FakeSync(SyncBackend):
             "eager_reduce" if reduction in ELEMENTWISE_REDUCTIONS else "eager_gather",
             value.size * value.dtype.itemsize,
             self.world_size(),
+            dtype=value.dtype,
         )
         if self._is_range(name):
             from ..buffers import CatBuffer
@@ -774,7 +777,8 @@ class FakeSync(SyncBackend):
         from ..buffers import CatBuffer
 
         record_collective(
-            "eager_gather", buffer.size * buffer.dtype.itemsize, self.world_size()
+            "eager_gather", buffer.size * buffer.dtype.itemsize, self.world_size(),
+            dtype=buffer.dtype,
         )
         name = self._current_name
         peers = []

@@ -52,6 +52,7 @@ from jax import lax
 
 from .buffers import CatBuffer
 from .metric import Metric, StateDict, _filter_kwargs, _global_jit, _jit_safe_inputs
+from .observability import spans as _spans
 from .parallel.elastic import note_overlap_deferred
 from .parallel.reduction import Reduction
 from .parallel.strategies import begin_sync
@@ -221,15 +222,24 @@ class BufferedMetric:
             return
         m._eager_validate(*args, **kwargs)
         ring: _Ring = self._ring
-        sig = _input_signature(args, kwargs)
-        if ring.count and ring.signature != sig:
-            self.flush()  # new shape/dtype signature: drain the old window
-        ring.signature = sig
-        ring.stage((args, kwargs))
-        m._computed = None
-        m._update_count += 1
-        if ring.full:
-            self.flush()
+        _sp = (
+            _spans.start_span("buffered.stage", metric=type(m).__name__)
+            if _spans.ENABLED
+            else None
+        )
+        try:
+            sig = _input_signature(args, kwargs)
+            if ring.count and ring.signature != sig:
+                self.flush()  # new shape/dtype signature: drain the old window
+            ring.signature = sig
+            ring.stage((args, kwargs))
+            m._computed = None
+            m._update_count += 1
+            if ring.full:
+                self.flush()
+        finally:
+            if _sp is not None:
+                _sp.end()
 
     # -- flush ----------------------------------------------------------
     def _flush_fn(self):
@@ -257,6 +267,11 @@ class BufferedMetric:
         if ring.count == 0 or self.__dict__["_flushing"]:
             return
         self.__dict__["_flushing"] = True
+        _sp = (
+            _spans.start_span("buffered.flush", staged=ring.count)
+            if _spans.ENABLED
+            else None
+        )
         try:
             m = self.__dict__["_metric"]
             # snapshot the cat-state row counts the PREVIOUS windows produced
@@ -280,7 +295,14 @@ class BufferedMetric:
             if valid_dev is None:
                 valid_dev = jax.device_put(np.int32(valid))
                 valid_cache[valid] = valid_dev
-            new_tensors, appends = fn(m._donation_safe_tensor_state(), valid_dev, steps)
+            if _sp is None:
+                new_tensors, appends = fn(m._donation_safe_tensor_state(), valid_dev, steps)
+            else:
+                with _spans.trace_span("buffered.scan", valid=int(valid)) as scan_sp:
+                    new_tensors, appends = fn(
+                        m._donation_safe_tensor_state(), valid_dev, steps
+                    )
+                    scan_sp.fence(new_tensors)
             state = m.__dict__["_state"]
             for k, v in new_tensors.items():
                 state[k] = v
@@ -300,11 +322,17 @@ class BufferedMetric:
                     # rotation stays intact and _ov_barrier re-gathers
                     # exactly the rows this attempt did not cover.
                     try:
-                        self._ov_issue(backend, pre_counts)
+                        if _sp is None:
+                            self._ov_issue(backend, pre_counts)
+                        else:
+                            with _spans.trace_span("buffered.overlap_issue"):
+                                self._ov_issue(backend, pre_counts)
                     except TimeoutError:
                         note_overlap_deferred()
         finally:
             self.__dict__["_flushing"] = False
+            if _sp is not None:
+                _sp.end()
 
     # -- sync/compute overlap -------------------------------------------
     def _ov_cat_names(self) -> List[str]:
@@ -366,6 +394,15 @@ class BufferedMetric:
             raise TorchMetricsUserError("The Metric has already been synced.")
         cat_names = self._ov_cat_names()
         m._cache = m._snapshot_state()
+        _sp = (
+            _spans.start_span(
+                "buffered.overlap_barrier",
+                metric=type(m).__name__,
+                world=backend.world_size(),
+            )
+            if _spans.ENABLED
+            else None
+        )
         try:
             begin_sync()
             # same elastic round lifecycle as Metric.sync: settle membership
@@ -386,6 +423,9 @@ class BufferedMetric:
         except Exception:
             m._cache = None
             raise
+        finally:
+            if _sp is not None:
+                _sp.end()
         m.__dict__["_state"].update(synced)
         m._is_synced = True
 
