@@ -54,23 +54,17 @@ STEPS = 1000
 _SALT_BASE = (time.time() % 997.0) * 1e-6
 _PROC_T0 = time.perf_counter()  # for charging a CPU-fallback re-exec's probe time to the budget
 
-# Chip peaks for the roofline model (TPU v5e, per chip): 197 TFLOP/s bf16
-# MXU, 819 GB/s HBM. cost_analysis() FLOPs are dtype-blind, so pct_peak_flops
-# for f32-heavy configs understates pressure (f32 runs below bf16 peak) —
-# the reported bound is still correct because both ratios shift together.
-_PEAK_FLOPS = {"TPU v5 lite": 1.97e14}
-_PEAK_BW = {"TPU v5 lite": 8.19e11}
-_DEFAULT_PEAKS = (1.97e14, 8.19e11)  # assume v5e when the kind is unknown (CPU fallback runs)
-
-
 def _roofline(lowerable, call_args, calls_per_second: float) -> dict:
     """Analytical %-of-peak from XLA's compiled cost model.
 
-    ``calls_per_second`` is the measured throughput of one compiled call;
-    FLOPs/bytes come from ``lower().compile().cost_analysis()`` so the model
-    reflects the program XLA actually built (post-fusion), not a hand count.
+    The model itself (chip peaks table + bound classification) lives in
+    ``torchmetrics_tpu.observability.ledger``; this wrapper only does the
+    ad-hoc AOT lower+compile for lowerables the bench times outside the
+    process-global executable cache. Smoke mode additionally reports the
+    per-kernel rooflines the armed ledger derived from ``cost_analysis()``
+    for every cached executable — see ``kernel_rooflines``.
     """
-    import jax
+    from torchmetrics_tpu.observability import ledger as _ledger
 
     try:
         ca = lowerable.lower(*call_args).compile().cost_analysis()
@@ -79,25 +73,7 @@ def _roofline(lowerable, call_args, calls_per_second: float) -> dict:
         byts = float(ca.get("bytes accessed", 0.0))
     except Exception as err:  # noqa: BLE001
         return {"error": f"cost_analysis unavailable: {type(err).__name__}"}
-    kind = jax.devices()[0].device_kind
-    peak_f = _PEAK_FLOPS.get(kind, _DEFAULT_PEAKS[0])
-    peak_b = _PEAK_BW.get(kind, _DEFAULT_PEAKS[1])
-    pf = flops * calls_per_second / peak_f
-    pb = byts * calls_per_second / peak_b
-    if max(pf, pb) < 0.02:
-        bound = "host/latency"  # dispatch+tunnel dominates; the chip is idle
-    elif pf >= pb:
-        bound = "compute"
-    else:
-        bound = "memory"
-    return {
-        "flops_per_call": flops,
-        "bytes_per_call": byts,
-        "pct_peak_flops": round(100 * pf, 2),
-        "pct_peak_bw": round(100 * pb, 2),
-        "bound": bound,
-        "device_kind": kind,
-    }
+    return _ledger.roofline_from_cost(flops, byts, calls_per_second)
 
 
 def _ensure_working_backend() -> None:
@@ -382,7 +358,13 @@ def bench_smoke() -> dict:
     import torchmetrics_tpu.metric as M
     from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassF1Score
     from torchmetrics_tpu.collections import MetricCollection
+    from torchmetrics_tpu.observability import ledger as _obsledger
     from torchmetrics_tpu.parallel.sync import FakeSync
+
+    # arm the device-truth ledger for the whole smoke run: every executable
+    # minted below must come out the other side with XLA cost/memory analysis
+    # attached (the ledger gate at the end asserts exactly that)
+    _obsledger.enable_ledger()
 
     n_cls, batch, steps = 4, 8, 3
     coll = MetricCollection(
@@ -687,8 +669,122 @@ def bench_smoke() -> dict:
         trajectory = {"error": repr(exc)}
         bench_trajectory_ok = False
 
+    # autotune gate (ISSUE 14): close the telemetry loop. Cold ProfileCache:
+    # the tuner observes a few windows, measures the hand-picked baseline
+    # grid — the trajectory's buffered-window sweep K in {1, 8, 32} crossed
+    # with the wire gate's two gather routes — and must lock a config that
+    # matches or beats every baseline on (modelled wire bytes, then measured
+    # step overhead). Warm cache (fresh tuner, same file): the identical
+    # decision with ZERO observation windows, and a replay of the locked
+    # config with zero retraces / zero new executables under strict_mode
+    # (the cold run's measurement phase doubles as the warm-up).
+    import tempfile
+
+    from torchmetrics_tpu.observability import Autotuner, ProfileCache, TunedConfig
+    from torchmetrics_tpu.parallel.reduction import Reduction as _Red
+
+    tune_feed = [(bpreds[i], btarget[i]) for i in range(b_steps)]
+    hand_picked = [
+        TunedConfig(gather=g, window=k)
+        for g in ("psum", "all_gather")
+        for k in (1, 8, 32)
+    ]
+    # CAT-heavy wire model state (same shape as the wire gate above): the
+    # gather route choice must matter on the wire for the decision to be a
+    # decision
+    tune_wire_state = {
+        "confmat": jnp.zeros((n_cls, n_cls), jnp.float32),
+        "seen": jnp.zeros((256,), jnp.float32),
+        "scores": jnp.zeros((512,), jnp.float32),
+    }
+    tune_wire_reds = {"confmat": _Red.SUM, "seen": _Red.CAT, "scores": _Red.CAT}
+    profile_path = os.path.join(
+        tempfile.mkdtemp(prefix="tmtpu_profile_"), "profile.json"
+    )
+    tuner = Autotuner(
+        ProfileCache(profile_path), observe_windows=2, steps_per_window=4
+    )
+    cold = tuner.tune(
+        _mk,
+        tune_feed,
+        world=4,
+        candidates=hand_picked,
+        wire_state=tune_wire_state,
+        wire_reductions=tune_wire_reds,
+    )
+    win_m = next(
+        m_ for m_ in cold.measurements if m_["config"] == cold.config.as_dict()
+    )
+    autotune_beats_baselines = all(
+        win_m["wire_bytes"] < b["wire_bytes"]
+        or (
+            win_m["wire_bytes"] == b["wire_bytes"]
+            and win_m["step_s"] <= b["step_s"]
+        )
+        for b in cold.measurements
+    )
+    warm_tuner = Autotuner(ProfileCache(profile_path))
+    warm = warm_tuner.tune(
+        _mk,
+        tune_feed,
+        world=4,
+        candidates=hand_picked,
+        wire_state=tune_wire_state,
+        wire_reductions=tune_wire_reds,
+    )
+    try:
+        with strict_mode(
+            transfer_guard=None, max_retraces=0, max_new_executables=0
+        ) as tstats:
+            replay = _mk()
+            rh = warm.config.wrap(replay)
+            for step in tune_feed:
+                rh.update(*step)
+            if hasattr(rh, "flush"):
+                rh.flush()
+        autotune_warm_strict_ok = True
+        autotune_warm_retraces = tstats.retraces
+    except StrictModeViolation:
+        autotune_warm_strict_ok = False
+        autotune_warm_retraces = -1
+    autotune_ok = (
+        cold.source == "observed"
+        and cold.windows_observed > 0
+        and autotune_beats_baselines
+        and warm.source == "cache"
+        and warm.windows_observed == 0
+        and warm.config == cold.config
+        and autotune_warm_strict_ok
+        and autotune_warm_retraces == 0
+    )
+
     telemetry = _telemetry_smoke()
     telemetry_ok = bool(telemetry["ok"])
+
+    # ledger gate (ISSUE 14): every executable minted while the ledger was
+    # armed (the whole smoke run) must carry XLA's cost analysis (flops,
+    # bytes), its compiled footprint, and the donation set — and the bench's
+    # per-kernel rooflines must derive from those recorded analyses, not
+    # hand-coded constants.
+    ledger_entries = _obsledger.executable_ledger()
+    stats_end = M.executable_cache_stats()
+    ledger_minted = stats_end["compiles"] - stats_end["retraces"]
+    ledger_complete = bool(ledger_entries) and all(
+        "flops" in e
+        and "bytes_accessed" in e
+        and "generated_code_bytes" in e
+        and "donated_args" in e
+        and not e.get("analysis_error")
+        for e in ledger_entries
+    )
+    smoke_cps = (1.0 / update_s) if update_s > 0 else 0.0
+    rooflines = _obsledger.kernel_rooflines(calls_per_second=smoke_cps)
+    ledger_ok = (
+        ledger_complete
+        and len(ledger_entries) == ledger_minted
+        and len(rooflines) == len(ledger_entries)
+    )
+    _obsledger.disable_ledger()
 
     return {
         "mode": "smoke",
@@ -708,6 +804,8 @@ def bench_smoke() -> dict:
             and tpulint_ok
             and bench_trajectory_ok
             and telemetry_ok
+            and autotune_ok
+            and ledger_ok
         ),
         "dispatches_per_update": dispatches,
         "clone_new_compilations": clone_misses,
@@ -748,8 +846,40 @@ def bench_smoke() -> dict:
         }
         if isinstance(trajectory, dict)
         else trajectory,
+        "bench_trajectory_skipped_rounds": trajectory.get("skipped_rounds", [])
+        if isinstance(trajectory, dict)
+        else [],
         "telemetry_ok": telemetry_ok,
         "telemetry": telemetry,
+        "autotune_ok": autotune_ok,
+        "autotune": {
+            "cold": {
+                "source": cold.source,
+                "windows_observed": cold.windows_observed,
+                "config": cold.config.as_dict(),
+                "beats_all_baselines": autotune_beats_baselines,
+                "winner_measurement": {
+                    "wire_bytes": win_m["wire_bytes"],
+                    "step_s": round(win_m["step_s"], 6),
+                },
+                "baselines_measured": len(cold.measurements),
+            },
+            "warm": {
+                "source": warm.source,
+                "windows_observed": warm.windows_observed,
+                "same_decision": warm.config == cold.config,
+                "strict_ok": autotune_warm_strict_ok,
+                "replay_retraces": autotune_warm_retraces,
+            },
+        },
+        "ledger_ok": ledger_ok,
+        "ledger": {
+            "entries": len(ledger_entries),
+            "minted_executables": ledger_minted,
+            "complete": ledger_complete,
+            "summary": stats_end["ledger"],
+        },
+        "rooflines": rooflines,
         "fault_injection_ok": fault_ok,
         "fault_injection": {
             "timeout_round_bitwise": r_timeout == fault_free,

@@ -55,3 +55,27 @@ def test_bench_smoke_passes():
     assert result["telemetry"]["tracing_disabled_by_default"] is True, result
     assert result["telemetry"]["disabled_overhead_pct"] < 1.0, result
     assert result["telemetry"]["perfetto_events"] > 0, result
+    # excluded rounds (the committed BENCH_PARTIAL.json, the rc=124 round)
+    # are reported with reasons, never silently parsed
+    skipped = {s["path"] for s in result["bench_trajectory_skipped_rounds"]}
+    assert "BENCH_PARTIAL.json" in skipped, result
+    # autotune gate: cold cache observes then locks a config matching or
+    # beating every hand-picked baseline; warm cache replays the identical
+    # decision with zero observation windows and zero new retraces
+    assert result["autotune_ok"] is True, result
+    assert result["autotune"]["cold"]["source"] == "observed", result
+    assert result["autotune"]["cold"]["windows_observed"] > 0, result
+    assert result["autotune"]["cold"]["beats_all_baselines"] is True, result
+    assert result["autotune"]["warm"]["source"] == "cache", result
+    assert result["autotune"]["warm"]["windows_observed"] == 0, result
+    assert result["autotune"]["warm"]["same_decision"] is True, result
+    assert result["autotune"]["warm"]["strict_ok"] is True, result
+    assert result["autotune"]["warm"]["replay_retraces"] == 0, result
+    # ledger gate: a complete device-truth entry (flops, bytes, compiled
+    # footprint, donation set) for every executable the smoke run minted,
+    # and a roofline row per entry derived from cost_analysis()
+    assert result["ledger_ok"] is True, result
+    assert result["ledger"]["complete"] is True, result
+    assert result["ledger"]["entries"] == result["ledger"]["minted_executables"], result
+    assert len(result["rooflines"]) == result["ledger"]["entries"], result
+    assert all(r["bytes_per_call"] > 0 for r in result["rooflines"]), result

@@ -118,3 +118,55 @@ def test_committed_trajectory_passes():
     assert res["rounds_seen"] >= 3
     assert res["configs"]["headline"]["status"] == "pass"
     assert res["configs"]["headline"]["observations"] >= 3
+
+
+# -------------------------------------------------- explicit round exclusion
+def test_scan_rounds_excludes_partial_fixture_with_reason(tmp_path):
+    # BENCH_PARTIAL.json is a raw bench payload committed without the
+    # n/rc/parsed envelope; it must be excluded by name, with a reason,
+    # not parsed as a round (its "value" field would poison the series)
+    _round(tmp_path, 1, parsed=_payload(100.0))
+    (tmp_path / "BENCH_PARTIAL.json").write_text(
+        json.dumps({"metric": "throughput", "value": 406.89, "extra": {}})
+    )
+    rounds, skipped = benchwatch.scan_rounds(str(tmp_path))
+    assert [r["n"] for r in rounds] == [1]
+    (sk,) = skipped
+    assert sk["path"] == "BENCH_PARTIAL.json"
+    assert "envelope" in sk["reason"]
+
+
+def test_scan_rounds_excludes_failed_rc_with_reason(tmp_path):
+    _round(tmp_path, 1, parsed=_payload(100.0))
+    # a timed-out round: rc=124 — excluded even though its tail might hold
+    # fragments (a dead run's numbers are not trajectory evidence)
+    _round(tmp_path, 2, rc=124, tail='{"value": 3.0}')
+    _round(tmp_path, 3, parsed=_payload(99.0))
+    rounds, skipped = benchwatch.scan_rounds(str(tmp_path))
+    assert [r["n"] for r in rounds] == [1, 3]
+    (sk,) = skipped
+    assert sk["path"] == "BENCH_r02.json"
+    assert "rc=124" in sk["reason"]
+
+
+def test_check_reports_skipped_rounds(tmp_path):
+    _round(tmp_path, 1, parsed=_payload(100.0))
+    _round(tmp_path, 2, rc=1, tail="")
+    (tmp_path / "BENCH_PARTIAL.json").write_text("{}")
+    (tmp_path / "BENCH_r03.json").write_text("{ not json")
+    res = benchwatch.check(str(tmp_path), baseline_path=str(tmp_path / "anchor.json"))
+    reasons = {s["path"]: s["reason"] for s in res["skipped_rounds"]}
+    assert set(reasons) == {"BENCH_PARTIAL.json", "BENCH_r02.json", "BENCH_r03.json"}
+    assert "rc=1" in reasons["BENCH_r02.json"]
+    assert "unreadable" in reasons["BENCH_r03.json"]
+    assert res["rounds_seen"] == 1
+
+
+def test_committed_partial_fixture_is_skipped_not_parsed():
+    # the repo really does commit a BENCH_PARTIAL.json; the live check must
+    # list it (and the rc=124 round) under skipped_rounds
+    res = benchwatch.check(REPO)
+    skipped_paths = {s["path"] for s in res["skipped_rounds"]}
+    assert "BENCH_PARTIAL.json" in skipped_paths
+    assert "BENCH_r04.json" in skipped_paths  # the committed timed-out round
+    assert all("reason" in s and s["reason"] for s in res["skipped_rounds"])

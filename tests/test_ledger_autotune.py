@@ -1,0 +1,299 @@
+"""Device-truth executable ledger + profile-cached autotuner (ISSUE 14).
+
+Ledger half: every executable the fused-dispatch smoke path mints carries
+XLA's own ``cost_analysis()`` / ``memory_analysis()`` numbers and donation
+accounting; retrace attribution names the metric class instead of dumping
+an opaque key tuple; ``reset_cache_stats()`` clears the ledger island; the
+roofline model derives from recorded cost analyses, not hand constants.
+
+Autotuner half: the pure pruning rules (EQuARX-style quantize veto on
+flapping coverage, payload-size thresholds for quantize/chunking, window
+budget under scan-dominated flushes), ProfileCache persistence and
+invalidation (corrupt file == cold, schema move == cold, key moves with
+topology/config), and the cold-observe → warm-replay loop with zero
+observation windows and zero new retraces on the warm path.
+"""
+import json
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import torchmetrics_tpu as tm
+import torchmetrics_tpu.metric as M
+from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassF1Score
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.debug import strict_mode
+from torchmetrics_tpu.observability import ledger as ledger_mod
+from torchmetrics_tpu.observability.autotune import (
+    Autotuner,
+    ProfileCache,
+    TunedConfig,
+    prune_candidates,
+)
+
+# N_CLS deliberately differs from test_fused_collection's 5: equal configs
+# would hit the process-global executable cache when the whole suite runs
+# in one process, and the minting assertions below need fresh compiles
+N_CLS = 6
+
+
+@pytest.fixture(autouse=True)
+def _clean_ledger():
+    ledger_mod.disable_ledger()
+    ledger_mod.reset_ledger()
+    yield
+    ledger_mod.disable_ledger()
+    ledger_mod.reset_ledger()
+
+
+def _data(steps=4, batch=18, seed=0):
+    preds = jax.nn.softmax(
+        jax.random.normal(jax.random.PRNGKey(seed), (steps, batch, N_CLS)), axis=-1
+    )
+    target = jax.random.randint(jax.random.PRNGKey(seed + 1), (steps, batch), 0, N_CLS)
+    return preds, target
+
+
+# ------------------------------------------------------------------- ledger
+def test_ledger_covers_every_fused_smoke_executable():
+    # the bench smoke's fused-dispatch path: a two-member collection whose
+    # warmup mints the per-member and fused-group executables; with the
+    # ledger armed, every one of those compiles must carry a full analysis
+    preds, target = _data()
+    stats0 = M.executable_cache_stats()
+    with ledger_mod.ledger_observing():
+        coll = MetricCollection(
+            {
+                "acc": MulticlassAccuracy(
+                    num_classes=N_CLS, average="micro", validate_args=False
+                ),
+                "f1": MulticlassF1Score(
+                    num_classes=N_CLS, average="macro", validate_args=False
+                ),
+            }
+        )
+        for i in range(3):
+            coll.update(preds[i], target[i])
+        coll.compute()
+    stats1 = M.executable_cache_stats()
+    minted = (stats1["compiles"] - stats1["retraces"]) - (
+        stats0["compiles"] - stats0["retraces"]
+    )
+    entries = [
+        e for e in ledger_mod.executable_ledger() if e["compiles"] > e["retraces"]
+    ]
+    assert minted >= 1
+    assert len(entries) >= minted  # an entry for every freshly minted executable
+    for e in ledger_mod.executable_ledger():
+        assert "analysis_error" not in e, e
+        # cost analysis: XLA's post-fusion numbers
+        assert e["flops"] >= 0.0 and e["bytes_accessed"] > 0.0, e
+        # memory analysis: compiled footprint + live buffers (tiny programs
+        # can legitimately report a zero code size on CPU)
+        assert e["generated_code_bytes"] >= 0, e
+        assert e["live_bytes"] >= 0, e
+        # donation accounting matches the dispatch's donate flag
+        assert e["donated_args"] == ([0] if e["donate_state"] else []), e
+    # the aggregate view is consistent with the entries
+    summary = M.executable_cache_stats()["ledger"]
+    assert summary["entries"] == len(ledger_mod.executable_ledger())
+    assert summary["flops_total"] == pytest.approx(
+        sum(e["flops"] for e in ledger_mod.executable_ledger())
+    )
+    json.dumps(ledger_mod.executable_ledger())  # JSON-safe for the payload
+
+
+def test_ledger_retrace_attribution_names_the_metric():
+    m = tm.MeanMetric()
+    with ledger_mod.ledger_observing():
+        m.update(jnp.ones((11,)))  # fresh shape: compile
+        m.update(jnp.ones((13,)))  # new shape, same key: retrace
+    entry = next(
+        e for e in ledger_mod.executable_ledger() if e["retraces"] >= 1
+    )
+    assert entry["metric"] == "MeanMetric"  # names the class, not a key dump
+    assert entry["op"] == "update"
+    assert "MeanMetric" in entry["key"]
+
+
+def test_ledger_disabled_by_default_and_reset_clears_island():
+    assert ledger_mod.ENABLED is False
+    m = tm.MeanMetric()
+    m.update(jnp.ones((17,)))  # fresh shape compiles, but the ledger is off
+    assert ledger_mod.executable_ledger() == []
+    with ledger_mod.ledger_observing():
+        tm.MeanMetric().update(jnp.ones((19,)))
+    assert M.executable_cache_stats()["ledger"]["entries"] >= 1
+    M.reset_cache_stats()
+    assert M.executable_cache_stats()["ledger"]["entries"] == 0
+    assert ledger_mod.executable_ledger() == []
+
+
+def test_rooflines_derive_from_recorded_cost_analysis():
+    with ledger_mod.ledger_observing():
+        tm.MeanMetric().update(jnp.ones((23,)))
+    rows = ledger_mod.kernel_rooflines(calls_per_second=1000.0)
+    assert rows
+    (entry,) = [e for e in ledger_mod.executable_ledger() if "flops" in e][:1]
+    row = next(r for r in rows if r["key"] == entry["key"])
+    # the row's inputs are the ledger's recorded numbers, not constants
+    assert row["flops_per_call"] == entry["flops"]
+    assert row["bytes_per_call"] == entry["bytes_accessed"]
+    assert row["bound"] in ("compute", "memory", "host/latency")
+    peak_f, peak_b = ledger_mod.device_peaks(row["device_kind"])
+    assert row["pct_peak_flops"] == pytest.approx(
+        100.0 * entry["flops"] * 1000.0 / peak_f, abs=0.01
+    )
+    assert row["pct_peak_bw"] == pytest.approx(
+        100.0 * entry["bytes_accessed"] * 1000.0 / peak_b, abs=0.01
+    )
+
+
+def test_describe_key_renders_op_metric_and_donation():
+    m = MulticlassAccuracy(num_classes=N_CLS, validate_args=False)
+    key = (("update", m._executable_cache_key()), True)
+    assert ledger_mod.describe_key(key) == "update[MulticlassAccuracy]+donate"
+    attr = ledger_mod.attribute_key(key)
+    assert attr["op"] == "update"
+    assert attr["metric"] == "MulticlassAccuracy"
+    assert attr["donated"] is True
+
+
+# ------------------------------------------------------- pruning (pure rules)
+def test_prune_measures_both_routes_and_requested_windows():
+    cands = prune_candidates({"scan_fraction": 0.0}, world=1, windows=(1, 8))
+    gathers = {c.gather for c in cands}
+    ks = {c.window for c in cands}
+    assert gathers == {"psum", "all_gather"}
+    assert ks == {1, 8}
+    assert all(c.quantize_bits is None for c in cands)  # lossy not allowed
+    assert all(not c.overlap_sync for c in cands)  # world=1: no overlap
+
+
+def test_prune_quantize_needs_payload_and_stable_coverage():
+    base = {"scan_fraction": 0.0, "collective_nbytes_ub": 65536}
+    ok = prune_candidates(
+        {**base, "coverage_min_fraction": 1.0}, world=4, allow_quantize=True
+    )
+    assert any(c.quantize_bits == 8 for c in ok)
+    # flapping membership vetoes compression (degraded-round error must not
+    # compound with quantization error)
+    flap = prune_candidates(
+        {**base, "coverage_min_fraction": 0.75}, world=4, allow_quantize=True
+    )
+    assert all(c.quantize_bits is None for c in flap)
+    # small payloads never amortize the scale overhead
+    small = prune_candidates(
+        {"scan_fraction": 0.0, "collective_nbytes_ub": 256, "coverage_min_fraction": 1.0},
+        world=4,
+        allow_quantize=True,
+    )
+    assert all(c.quantize_bits is None for c in small)
+
+
+def test_prune_chunking_keys_off_observed_payload():
+    big = prune_candidates({"scan_fraction": 0.0, "collective_nbytes_ub": 2 << 20})
+    assert all(c.gather_chunk_elems == 1 << 16 for c in big)
+    small = prune_candidates({"scan_fraction": 0.0, "collective_nbytes_ub": 4096})
+    assert all(c.gather_chunk_elems is None for c in small)
+
+
+def test_prune_window_budget_when_scan_dominates():
+    # flushes are real scan work: windows beyond the observed cadence drop
+    obs = {"scan_fraction": 0.9, "steps_per_window": 4}
+    cands = prune_candidates(obs, windows=(1, 8, 32))
+    assert {c.window for c in cands} == {1}
+    # dispatch-overhead-dominated flushes keep the full sweep
+    obs = {"scan_fraction": 0.1, "steps_per_window": 4}
+    cands = prune_candidates(obs, windows=(1, 8, 32))
+    assert {c.window for c in cands} == {1, 8, 32}
+
+
+def test_prune_overlap_only_with_peers_and_buffering():
+    cands = prune_candidates({"scan_fraction": 0.0}, world=4, windows=(1, 8))
+    assert any(c.overlap_sync for c in cands if c.window > 1)
+    assert all(not c.overlap_sync for c in cands if c.window == 1)
+
+
+# ------------------------------------------------------------- profile cache
+def test_profile_cache_roundtrip_and_atomic_save(tmp_path):
+    path = str(tmp_path / "profile.json")
+    cache = ProfileCache(path)
+    cfg = TunedConfig(gather="all_gather", window=8)
+    cache.put("k1", cfg, meta={"measurements": [{"wire_bytes": 1}]})
+    assert (tmp_path / "profile.json").exists()
+    warm = ProfileCache(path)
+    assert len(warm) == 1
+    entry = warm.get("k1")
+    assert TunedConfig.from_dict(entry["config"]) == cfg
+    assert entry["meta"]["measurements"] == [{"wire_bytes": 1}]
+
+
+def test_profile_cache_corrupt_and_schema_mismatch_mean_cold(tmp_path):
+    path = tmp_path / "profile.json"
+    path.write_text("{ not json")
+    assert len(ProfileCache(str(path))) == 0
+    path.write_text(json.dumps({"schema": 999, "entries": {"k": {}}}))
+    assert len(ProfileCache(str(path))) == 0  # schema moved: re-observe
+
+
+def test_profile_key_moves_with_topology_and_metric_config():
+    k = ProfileCache.profile_key((1, "cpu"), "metric-a")
+    assert k != ProfileCache.profile_key((2, "cpu"), "metric-a")  # world changed
+    assert k != ProfileCache.profile_key((1, "tpu"), "metric-a")  # device changed
+    assert k != ProfileCache.profile_key((1, "cpu"), "metric-b")  # config changed
+    assert k == ProfileCache.profile_key((1, "cpu"), "metric-a")  # stable digest
+
+
+# ----------------------------------------------------------- cold/warm tune
+def _mk():
+    return MulticlassAccuracy(num_classes=N_CLS, average="micro", validate_args=False)
+
+
+def test_cold_tune_observes_and_locks_wire_winner(tmp_path):
+    preds, target = _data(steps=4)
+    feed = [(preds[i], target[i]) for i in range(4)]
+    path = str(tmp_path / "profile.json")
+    tuner = Autotuner(ProfileCache(path), observe_windows=1, steps_per_window=2)
+    grid = [TunedConfig(gather=g, window=k) for g in ("psum", "all_gather") for k in (1, 2)]
+    res = tuner.tune(_mk, feed, world=4, candidates=grid)
+    assert res.source == "observed"
+    assert res.windows_observed == 1
+    assert len(res.measurements) == len(grid)
+    assert res.observation["windows"] == 1
+    # lexicographic winner: least modelled wire bytes, then step overhead
+    win = next(m for m in res.measurements if m["config"] == res.config.as_dict())
+    assert all(
+        win["wire_bytes"] < m["wire_bytes"]
+        or (win["wire_bytes"] == m["wire_bytes"] and win["step_s"] <= m["step_s"])
+        for m in res.measurements
+    )
+    assert "step_s_warm" in win  # winner re-measured on the warm path
+
+    # warm: a FRESH tuner over the persisted file replays the decision with
+    # zero observation windows and no new retraces under strict_mode
+    warm = Autotuner(ProfileCache(path), observe_windows=1, steps_per_window=2)
+    res2 = warm.tune(_mk, feed, world=4, candidates=grid)
+    assert res2.source == "cache"
+    assert res2.windows_observed == 0
+    assert res2.config == res.config
+    assert res2.measurements == res.measurements
+    with strict_mode(transfer_guard=None, max_retraces=0, max_new_executables=0):
+        handle = res2.config.wrap(_mk())
+        for step in feed:
+            handle.update(*step)
+        if hasattr(handle, "flush"):
+            handle.flush()
+
+
+def test_tune_world1_skips_wire_dimension(tmp_path):
+    preds, target = _data(steps=2)
+    feed = [(preds[i], target[i]) for i in range(2)]
+    tuner = Autotuner(observe_windows=1, steps_per_window=2)
+    res = tuner.tune(
+        _mk, feed, world=1, candidates=[TunedConfig(window=1), TunedConfig(window=2)]
+    )
+    assert res.source == "observed"
+    assert all(m["wire_bytes"] == 0 for m in res.measurements)

@@ -310,11 +310,137 @@ def test_jsonl_event_log_skips_partial_trailing_line(tmp_path):
     assert [r["kind"] for r in records] == ["a", "b"]
 
 
+def test_prometheus_escapes_label_values():
+    reg = Registry()
+    reg.counter("weird", "w").inc(1, path='C:\\tmp\\"x"\nnext')
+    text = to_prometheus(reg, prefix="t")
+    (sample,) = [l for l in text.splitlines() if l.startswith("t_weird{")]
+    # backslash, double-quote and newline escaped per the exposition format
+    assert sample == 't_weird{path="C:\\\\tmp\\\\\\"x\\"\\nnext"} 1'
+
+
+def test_prometheus_zero_observation_histogram_is_valid():
+    reg = Registry()
+    reg.histogram("lat", "never observed", buckets=(0.01, 0.1))
+    text = to_prometheus(reg, prefix="t")
+    # a registered-but-empty histogram still emits a complete series
+    assert 't_lat_bucket{le="0.01"} 0' in text
+    assert 't_lat_bucket{le="+Inf"} 0' in text
+    assert "t_lat_sum 0" in text
+    assert "t_lat_count 0" in text
+    # every sample line parses as <name>{...} <value>
+    for line in text.splitlines():
+        if not line.startswith("#"):
+            assert line.rsplit(" ", 1)[1] == "0"
+
+
+def test_jsonl_rotation_at_cap_boundary(tmp_path):
+    path = tmp_path / "serve.jsonl"
+    line_len = len(json.dumps({"i": 0, "pad": "x" * 16})) + 1
+    cap = int(3.5 * line_len)  # 4th record would cross the cap -> rotates
+    log = JsonlEventLog(str(path), max_bytes=cap)
+    for i in range(5):
+        log.write({"i": i, "pad": "x" * 16})
+    log.close()
+    assert (tmp_path / "serve.jsonl.1").exists()
+    # records are never split across the boundary: every line in both
+    # generations parses whole, and the logical order is preserved
+    assert path.stat().st_size <= cap
+    records = JsonlEventLog.read(str(path))
+    assert [r["i"] for r in records] == [0, 1, 2, 3, 4]
+    # current file alone holds only the post-rotation records
+    assert [r["i"] for r in JsonlEventLog.read(str(path), include_rotated=False)] == [3, 4]
+
+
+def test_jsonl_rotation_preserves_torn_line_recovery(tmp_path):
+    path = tmp_path / "serve.jsonl"
+    log = JsonlEventLog(str(path), max_bytes=60)
+    log.write({"i": 0})
+    log.close()
+    # preemption tears the trailing line of the active file...
+    with open(path, "a") as fh:
+        fh.write('{"i": 1, "torn')
+    # ...then a restarted writer's next record pushes past the cap and
+    # rotates; the torn line rides into the backup generation
+    log2 = JsonlEventLog(str(path), max_bytes=60)
+    log2.write({"i": 2, "pad": "y" * 40})
+    log2.close()
+    records = JsonlEventLog.read(str(path))
+    assert [r["i"] for r in records] == [0, 2]  # torn line skipped, not merged
+
+
+def test_histogram_reset_labels_is_scoped():
+    reg = Registry()
+    h = reg.histogram("shared", buckets=(1.0, 10.0))
+    h.observe(0.5, owner="a", phase="x")
+    h.observe(0.5, owner="a", phase="y")
+    h.observe(0.5, owner="b", phase="x")
+    h.reset_labels(owner="a")  # drops every label set containing owner=a
+    assert h.snapshot(owner="a", phase="x")["count"] == 0
+    assert h.snapshot(owner="a", phase="y")["count"] == 0
+    assert h.snapshot(owner="b", phase="x")["count"] == 1
+
+
+# ------------------------------------------------- StepTimer compat facade
+def test_steptimer_facade_keeps_summary_shape():
+    # regression for the PR-8-era timing island: StepTimer now stores into
+    # the registry histogram but its public surface must not move
+    from torchmetrics_tpu.observability.registry import REGISTRY
+    from torchmetrics_tpu.utils.profiler import StepTimer
+
+    t = StepTimer(block_until_ready=False)
+    with t.phase("update"):
+        pass
+    with t.phase("update"):
+        with t.phase("sync"):  # reentrant nesting still works
+            pass
+    s = t.summary()
+    assert set(s) == {"update", "sync"}
+    assert set(s["update"]) == {"total_s", "count", "mean_ms"}
+    assert s["update"]["count"] == 2 and s["sync"]["count"] == 1
+    assert s["update"]["mean_ms"] == pytest.approx(
+        1000.0 * s["update"]["total_s"] / 2
+    )
+    # the numbers live in the shared registry histogram, per-timer labelled
+    hist = REGISTRY.get("profiler.phase_s")
+    assert hist.snapshot(timer=t._id, phase="update")["count"] == 2
+    # instances are isolated: a second timer neither sees nor clears the first
+    t2 = StepTimer(block_until_ready=False)
+    with t2.phase("update"):
+        pass
+    assert t2.summary()["update"]["count"] == 1
+    t2.reset()
+    assert t2.summary() == {}
+    assert t.summary()["update"]["count"] == 2
+
+
+def test_steptimer_records_time_when_body_raises():
+    from torchmetrics_tpu.utils.profiler import StepTimer
+
+    t = StepTimer(block_until_ready=False)
+    with pytest.raises(RuntimeError):
+        with t.phase("boom"):
+            raise RuntimeError("x")
+    assert t.summary()["boom"]["count"] == 1
+
+
+def test_steptimer_emits_spans_when_tracing_armed():
+    from torchmetrics_tpu.utils.profiler import StepTimer
+
+    t = StepTimer(block_until_ready=False)
+    with spans_mod.tracing():
+        with t.phase("step"):
+            pass
+        names = [s.name for s in spans_mod.collected_spans()]
+    assert "profiler.step" in names
+
+
 # --------------------------------------------- compat + reset regression
 EXPECTED_CACHE_STATS_KEYS = {
     "size", "hits", "misses", "compiles", "retraces", "dispatches",
     "bytes_reduced", "bytes_gathered", "collectives_issued", "syncs",
     "sync_retries", "sync_timeouts", "degraded_syncs", "coverage", "online",
+    "ledger",
 }
 EXPECTED_ONLINE_KEYS = {
     "windowed_metrics", "decayed_metrics", "windowed_updates",
@@ -333,6 +459,9 @@ def test_executable_cache_stats_backward_compat_keys():
             assert value is None or isinstance(value, dict)
         elif key == "online":
             assert all(isinstance(v, int) for v in value.values())
+        elif key == "ledger":
+            assert isinstance(value, dict)
+            assert {"enabled", "entries", "flops_total"} <= set(value)
         else:
             assert isinstance(value, int), (key, type(value))
     json.dumps(stats)  # stays serializable
@@ -351,20 +480,29 @@ def test_executable_cache_stats_tracks_real_traffic():
 def test_reset_cache_stats_zeroes_every_island():
     # regression: the historical reset only touched the cache island and
     # left wire/elastic/online counters running
+    from torchmetrics_tpu.observability import ledger as ledger_mod
+
     M._CACHE_STATS["hits"] += 1
     record_collective("psum", 512, 2)
     _ELASTIC["retries"] += 3
     _ONLINE_STATS["windowed_updates"] += 5
+    with ledger_mod.ledger_observing():
+        # a shape no other test dispatches -> guaranteed fresh XLA compile,
+        # so the ledger records an entry regardless of test ordering
+        tm.MeanMetric().update(jnp.ones((7, 3, 2)))
     stats = M.executable_cache_stats()
     assert stats["bytes_reduced"] > 0
     assert stats["sync_retries"] == 3
     assert stats["online"]["windowed_updates"] == 5
+    assert stats["ledger"]["entries"] >= 1
     M.reset_cache_stats()
     stats = M.executable_cache_stats()
     assert stats["hits"] == 0
     assert stats["bytes_reduced"] == 0 and stats["collectives_issued"] == 0
     assert stats["sync_retries"] == 0
     assert stats["online"]["windowed_updates"] == 0
+    assert stats["ledger"]["entries"] == 0  # the ledger island resets too
+    assert ledger_mod.executable_ledger() == []
     assert dict(_WIRE) == {k: 0 for k in _WIRE}
     assert all(v == 0 for v in dict(_ELASTIC).values())
 

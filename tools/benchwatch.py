@@ -106,13 +106,36 @@ def _values_from_fragment(tail: str) -> Dict[str, float]:
     return out
 
 
-def load_rounds(repo_root: str) -> List[Dict[str, Any]]:
-    """Parse every BENCH_r*.json into {n, source, values}; skips dead rounds."""
+# a trajectory round is exactly BENCH_r<NN>.json; anything else under the
+# BENCH_* glob (BENCH_PARTIAL.json — a raw payload the driver committed
+# without the n/rc envelope) is not part of the series
+_ROUND_NAME = re.compile(r"^BENCH_r\d+\.json$")
+
+
+def scan_rounds(repo_root: str) -> Tuple[List[Dict[str, Any]], List[Dict[str, str]]]:
+    """Parse BENCH_* files into ``(rounds, skipped)``.
+
+    Every excluded file carries an explicit reason instead of vanishing:
+    non-round names (``BENCH_PARTIAL.json``), unreadable JSON, failed
+    rounds (``rc`` ≠ 0, e.g. a timeout's rc=124 — whatever their tail
+    holds is from a run that died, so it never enters the trajectory),
+    and envelopes with nothing recoverable.
+    """
     rounds: List[Dict[str, Any]] = []
-    for path in sorted(glob.glob(os.path.join(repo_root, "BENCH_r*.json"))):
+    skipped: List[Dict[str, str]] = []
+    for path in sorted(glob.glob(os.path.join(repo_root, "BENCH_*.json"))):
+        name = os.path.basename(path)
+        if not _ROUND_NAME.match(name):
+            skipped.append({"path": name, "reason": "not a BENCH_r<NN>.json round envelope"})
+            continue
         try:
             doc = json.load(open(path))
-        except (OSError, json.JSONDecodeError):
+        except (OSError, json.JSONDecodeError) as exc:
+            skipped.append({"path": name, "reason": f"unreadable: {type(exc).__name__}"})
+            continue
+        rc = doc.get("rc")
+        if rc not in (0, None):
+            skipped.append({"path": name, "reason": f"rc={rc} (round did not exit cleanly)"})
             continue
         n = doc.get("n")
         parsed = doc.get("parsed")
@@ -131,10 +154,16 @@ def load_rounds(repo_root: str) -> List[Dict[str, Any]]:
                 values = _values_from_fragment(tail)
                 source = "tail-fragment"
         if not values:
-            continue  # e.g. a timed-out round: rc=124, empty tail
-        rounds.append({"n": n, "path": os.path.basename(path), "source": source, "values": values})
+            skipped.append({"path": name, "reason": "no recoverable values (empty parsed/tail)"})
+            continue
+        rounds.append({"n": n, "path": name, "source": source, "values": values})
     rounds.sort(key=lambda r: (r["n"] is None, r["n"]))
-    return rounds
+    return rounds, skipped
+
+
+def load_rounds(repo_root: str) -> List[Dict[str, Any]]:
+    """Back-compat view of :func:`scan_rounds`: just the usable rounds."""
+    return scan_rounds(repo_root)[0]
 
 
 def _series(rounds: List[Dict[str, Any]]) -> Dict[str, List[Tuple[Any, float]]]:
@@ -161,10 +190,13 @@ def check(
 ) -> Dict[str, Any]:
     """Gate the latest round of every config against its trajectory.
 
-    Returns ``{"ok": bool, "configs": {name: verdict}, "rounds_seen": N}``.
-    A config's verdict is one of status ``pass`` / ``fail`` /
-    ``skipped`` (with a reason); ``ok`` is the AND over gated configs
-    (vacuously true when nothing has enough history yet).
+    Returns ``{"ok": bool, "configs": {name: verdict}, "rounds_seen": N,
+    "skipped_rounds": [{"path", "reason"}, ...]}``. A config's verdict is
+    one of status ``pass`` / ``fail`` / ``skipped`` (with a reason);
+    ``ok`` is the AND over gated configs (vacuously true when nothing
+    has enough history yet). ``skipped_rounds`` lists every BENCH_* file
+    excluded from the trajectory and why (partial payloads, rc≠0
+    rounds), so exclusions are auditable in the smoke payload.
     """
     baseline_path = baseline_path or _BASELINE_DEFAULT
     baseline: Dict[str, float] = {}
@@ -175,7 +207,7 @@ def check(
             }
         except (OSError, json.JSONDecodeError, AttributeError, TypeError, ValueError):
             baseline = {}
-    rounds = load_rounds(repo_root)
+    rounds, skipped_rounds = scan_rounds(repo_root)
     configs: Dict[str, Any] = {}
     ok = True
     for name, obs in sorted(_series(rounds).items()):
@@ -222,7 +254,12 @@ def check(
         }
         configs[name] = verdict
         ok = ok and passed
-    return {"ok": ok, "configs": configs, "rounds_seen": len(rounds)}
+    return {
+        "ok": ok,
+        "configs": configs,
+        "rounds_seen": len(rounds),
+        "skipped_rounds": skipped_rounds,
+    }
 
 
 def write_baseline(repo_root: str, baseline_path: Optional[str] = None) -> Dict[str, Any]:

@@ -28,6 +28,7 @@ from typing import Any, Dict, Iterator, List, Optional, Tuple
 import jax
 
 from . import metric as _metric
+from .observability import ledger as _ledger
 from .observability import spans as _spans
 from .parallel import elastic as _elastic
 from .parallel import strategies as _strategies
@@ -123,8 +124,11 @@ def strict_mode(
         stats.retraces += retraces
         stats.new_executables += new_compiles - retraces
         if stats.retraces > max_retraces:
+            # ledger attribution names the metric/op instead of an opaque
+            # key tuple; works unarmed (pure key introspection)
             raise StrictModeViolation(
-                f"unexpected retrace under strict_mode (executable key={key!r}): "
+                f"unexpected retrace under strict_mode in "
+                f"{_ledger.describe_key(key)} (executable key={key!r}): "
                 f"{stats.retraces} retrace(s) > budget {max_retraces}. Input "
                 "shapes/dtypes are churning against a warm executable — pad or "
                 "bucket inputs, or raise max_retraces if this churn is intended."
@@ -132,7 +136,8 @@ def strict_mode(
             )
         if max_new_executables is not None and stats.new_executables > max_new_executables:
             raise StrictModeViolation(
-                f"unexpected compile under strict_mode (executable key={key!r}): "
+                f"unexpected compile under strict_mode in "
+                f"{_ledger.describe_key(key)} (executable key={key!r}): "
                 f"{stats.new_executables} new executable(s) > budget "
                 f"{max_new_executables}. Warm the metric up before entering "
                 "strict_mode, or raise max_new_executables."

@@ -46,6 +46,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .buffers import CatBuffer, CatLayoutError
+from .observability import ledger as _ledger
 from .observability import spans as _spans
 from .observability.registry import REGISTRY as _REGISTRY
 from .parallel.reduction import ELEMENTWISE_REDUCTIONS, Reduction, resolve_reduction
@@ -236,6 +237,9 @@ def _global_jit(key: Any, fn: Callable, donate_state: bool = False) -> Callable:
 
         def entry(*args: Any, **kwargs: Any) -> Any:
             _DISPATCH_COUNT.inc()
+            # abstract shapes are snapshotted BEFORE dispatch: donation may
+            # consume argument buffers, and the ledger must never touch them
+            spec = _ledger.arg_specs(args, kwargs) if _ledger.ENABLED else None
             before = _jit_compile_count(jitted)
             out = jitted(*args, **kwargs)
             new = _jit_compile_count(jitted) - before
@@ -247,6 +251,8 @@ def _global_jit(key: Any, fn: Callable, donate_state: bool = False) -> Callable:
                 seen_compiles[0] += new
                 _CACHE_STATS["compiles"] += new
                 _CACHE_STATS["retraces"] += retraces
+                if _ledger.ENABLED:
+                    _ledger.record_compile(key, jitted, spec, donate_state, new, retraces)
                 for cb in list(_COMPILE_OBSERVERS):
                     cb(key, new, retraces)
             return out
@@ -259,7 +265,7 @@ def _global_jit(key: Any, fn: Callable, donate_state: bool = False) -> Callable:
 
 
 def reset_cache_stats() -> None:
-    """Zero EVERY telemetry island: cache, wire, elastic, and online.
+    """Zero EVERY telemetry island: cache, wire, elastic, ledger, and online.
 
     The historical reset skipped the online counters (they live in a
     lazily-imported module), silently skewing any before/after
@@ -271,6 +277,7 @@ def reset_cache_stats() -> None:
     _HASH_STATS.reset()
     reset_wire_stats()
     reset_elastic_stats()
+    _ledger.reset_ledger()
     mod = sys.modules.get("torchmetrics_tpu.online")
     if mod is not None:
         mod.reset_online_stats()
@@ -293,7 +300,10 @@ def executable_cache_stats() -> Dict[str, int]:
     online-evaluation dispatch counters (windowed/decayed metrics created,
     eager update dispatches, estimated window rotations — see
     ``online.online_stats``); it is ``{}`` until ``torchmetrics_tpu.online``
-    is first used.
+    is first used. The ``ledger`` entry summarizes the device-truth
+    executable ledger (XLA cost/memory analysis per executable — see
+    ``observability.ledger``); it reports zero entries unless the ledger
+    was armed via ``observability.enable_ledger()``.
 
     This is a backward-compatibility view: the counters themselves live in
     the :mod:`~torchmetrics_tpu.observability.registry` and can be scraped
@@ -320,6 +330,7 @@ def executable_cache_stats() -> Dict[str, int]:
         "degraded_syncs": es["degraded_syncs"],
         "coverage": es["last_coverage"],
         "online": online,
+        "ledger": _ledger.ledger_summary(),
     }
 
 

@@ -106,10 +106,24 @@ def _prom_name(name: str) -> str:
     return sanitized
 
 
+def _prom_escape(value: str) -> str:
+    """Escape a label value per the text exposition format.
+
+    Backslash first (so later escapes aren't doubled), then double-quote
+    and newline — the three characters the format reserves.
+    """
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 def _prom_labels(labels) -> str:
     if not labels:
         return ""
-    body = ",".join(f'{k}="{v}"' for k, v in labels)
+    body = ",".join(f'{k}="{_prom_escape(v)}"' for k, v in labels)
     return "{" + body + "}"
 
 
@@ -141,7 +155,10 @@ def to_prometheus(registry: Optional[Registry] = None, prefix: str = "tmtpu") ->
         elif isinstance(inst, Histogram):
             lines.append(f"# HELP {metric} {inst.help or inst.name}")
             lines.append(f"# TYPE {metric} histogram")
-            for labels, counts, total_sum, total in inst.collect():
+            # a registered-but-never-observed histogram still emits one
+            # valid unlabeled series (all-zero buckets, zero sum/count)
+            samples = inst.collect() or [((), [0] * len(inst.buckets), 0.0, 0)]
+            for labels, counts, total_sum, total in samples:
                 cumulative = 0
                 for le, n in zip(inst.buckets, counts):
                     cumulative += n
@@ -165,20 +182,46 @@ class JsonlEventLog:
     same log; every record is written as one line then flushed, so a
     kill mid-run can truncate at most the final line (readers skip a
     trailing partial line via :meth:`read`).
+
+    ``max_bytes`` arms size-capped rotation for long serve runs: when a
+    record would push the active file past the cap, the file is atomically
+    renamed to ``<path>.1`` (one backup generation, so disk stays bounded
+    at roughly twice the cap) and the record starts a fresh file. Records
+    are never split across the boundary, and rotation preserves the
+    torn-trailing-line guarantee — a partial line torn by a preemption
+    rides along into the rotated file, where :meth:`read` still skips it.
     """
 
-    def __init__(self, path: str) -> None:
+    def __init__(self, path: str, max_bytes: Optional[int] = None) -> None:
         self.path = path
+        self.max_bytes = max_bytes
         self._fh: Optional[IO[str]] = None
+
+    @property
+    def rotated_path(self) -> str:
+        return self.path + ".1"
 
     def _ensure_open(self) -> IO[str]:
         if self._fh is None or self._fh.closed:
             self._fh = open(self.path, "a")
         return self._fh
 
+    def _maybe_rotate(self, incoming_len: int) -> None:
+        if not self.max_bytes:
+            return
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return
+        if size and size + incoming_len > self.max_bytes:
+            self.close()
+            os.replace(self.path, self.rotated_path)
+
     def write(self, record: Dict[str, Any]) -> None:
+        line = json.dumps({k: _json_safe(v) for k, v in record.items()}) + "\n"
+        self._maybe_rotate(len(line))
         fh = self._ensure_open()
-        fh.write(json.dumps({k: _json_safe(v) for k, v in record.items()}) + "\n")
+        fh.write(line)
         fh.flush()
 
     def write_span(self, span: Span) -> None:
@@ -206,18 +249,27 @@ class JsonlEventLog:
         self.close()
 
     @staticmethod
-    def read(path: str) -> List[Dict[str, Any]]:
-        """Parse a JSONL log, tolerating a truncated final line."""
+    def read(path: str, include_rotated: bool = True) -> List[Dict[str, Any]]:
+        """Parse a JSONL log, tolerating a truncated final line.
+
+        With ``include_rotated`` (the default) a ``<path>.1`` backup left
+        by :attr:`max_bytes` rotation is read first, so the caller sees
+        the logical log in order; a line torn by a preemption — whether
+        it now sits at the end of the backup or of the active file — is
+        skipped, never merged across the boundary.
+        """
         records: List[Dict[str, Any]] = []
-        if not os.path.exists(path):
-            return records
-        with open(path) as fh:
-            for line in fh:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    records.append(json.loads(line))
-                except json.JSONDecodeError:
-                    continue  # partial trailing line from a preemption
+        paths = [path + ".1", path] if include_rotated else [path]
+        for p in paths:
+            if not os.path.exists(p):
+                continue
+            with open(p) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        records.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        continue  # partial trailing line from a preemption
         return records

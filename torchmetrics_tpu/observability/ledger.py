@@ -1,0 +1,425 @@
+"""Device-truth executable ledger: what XLA actually built, per executable.
+
+Every executable minted by the process-global cache
+(``metric._global_jit``) can be recorded here with the numbers XLA
+itself reports for the compiled program — ``cost_analysis()`` flops and
+bytes accessed (post-fusion, so a hand count of the source ops is
+irrelevant), ``memory_analysis()`` compiled-code and live-buffer
+footprints, and the donation accounting (which argument buffers were
+aliased into outputs). Entries are keyed by the executable-cache key,
+so retrace attribution can name the metric class and op that caused a
+recompile instead of dumping an opaque tuple.
+
+The ledger is **disabled by default** and armed explicitly
+(:func:`enable_ledger` / :func:`ledger_observing`): harvesting runs an
+AOT ``lower().compile()`` against the dispatch's abstract shapes, which
+doubles compile cost for the first dispatch of each executable. The AOT
+path never touches the jit dispatch cache, so arming the ledger does
+not perturb compile/retrace counters or ``strict_mode()`` budgets.
+
+Surfaces:
+
+* ``executable_cache_stats()["ledger"]`` — aggregate summary.
+* :func:`executable_ledger` — JSON-safe per-executable entries.
+* span instants (``ledger.compile``) when tracing is armed, so compile
+  events land in Perfetto/JSONL exports with flops/bytes attrs.
+* registry gauges (``ledger.*``) scraped by ``to_prometheus``.
+* :func:`roofline_from_cost` / :func:`kernel_rooflines` — the roofline
+  model over recorded cost analyses (the peaks tables live here, not in
+  ``bench.py``).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+from . import spans as _spans
+from .registry import REGISTRY as _REGISTRY
+
+__all__ = [
+    "ENABLED",
+    "enable_ledger",
+    "disable_ledger",
+    "ledger_observing",
+    "record_compile",
+    "executable_ledger",
+    "ledger_summary",
+    "reset_ledger",
+    "attribute_key",
+    "describe_key",
+    "arg_specs",
+    "device_peaks",
+    "roofline_from_cost",
+    "kernel_rooflines",
+]
+
+ENABLED = False
+"""Fast-path flag: the dispatch wrapper tests this before anything else."""
+
+_LEDGER: Dict[Any, Dict[str, Any]] = {}
+
+_LEDGER_STATS = _REGISTRY.group(
+    "ledger",
+    {"entries": 0, "analysis_errors": 0},
+    help="device-truth executable ledger",
+)
+_FLOPS_TOTAL = _REGISTRY.gauge("ledger.flops_total", "sum of per-executable XLA flops")
+_BYTES_TOTAL = _REGISTRY.gauge(
+    "ledger.bytes_accessed_total", "sum of per-executable XLA bytes accessed"
+)
+_CODE_TOTAL = _REGISTRY.gauge(
+    "ledger.compiled_code_bytes", "sum of generated-code sizes across executables"
+)
+
+# ---------------------------------------------------------------------------
+# roofline model — chip peaks, moved here from bench.py so the model is a
+# library concern and every surface (bench payload, notebooks, serving
+# dashboards) shares one table.
+#
+# TPU v5e, per chip: 197 TFLOP/s bf16 MXU, 819 GB/s HBM. cost_analysis()
+# FLOPs are dtype-blind, so pct_peak_flops for f32-heavy configs understates
+# pressure (f32 runs below bf16 peak) — the reported bound is still correct
+# because both ratios shift together.
+# ---------------------------------------------------------------------------
+_PEAK_FLOPS = {"TPU v5 lite": 1.97e14}
+_PEAK_BW = {"TPU v5 lite": 8.19e11}
+_DEFAULT_PEAKS = (1.97e14, 8.19e11)  # assume v5e when the kind is unknown (CPU runs)
+
+
+def device_peaks(device_kind: Optional[str] = None) -> Tuple[float, float]:
+    """(peak FLOP/s, peak bytes/s) for a device kind; v5e when unknown."""
+    if device_kind is None:
+        import jax
+
+        device_kind = jax.devices()[0].device_kind
+    return (
+        _PEAK_FLOPS.get(device_kind, _DEFAULT_PEAKS[0]),
+        _PEAK_BW.get(device_kind, _DEFAULT_PEAKS[1]),
+    )
+
+
+def roofline_from_cost(
+    flops: float,
+    bytes_accessed: float,
+    calls_per_second: float,
+    device_kind: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Analytical %-of-peak given XLA's compiled cost model.
+
+    ``calls_per_second`` is the measured throughput of one compiled call;
+    flops/bytes come from ``cost_analysis()`` so the model reflects the
+    program XLA actually built (post-fusion), not a hand count.
+    """
+    if device_kind is None:
+        import jax
+
+        device_kind = jax.devices()[0].device_kind
+    peak_f, peak_b = device_peaks(device_kind)
+    pf = flops * calls_per_second / peak_f
+    pb = bytes_accessed * calls_per_second / peak_b
+    if max(pf, pb) < 0.02:
+        bound = "host/latency"  # dispatch+tunnel dominates; the chip is idle
+    elif pf >= pb:
+        bound = "compute"
+    else:
+        bound = "memory"
+    return {
+        "flops_per_call": flops,
+        "bytes_per_call": bytes_accessed,
+        "pct_peak_flops": round(100 * pf, 2),
+        "pct_peak_bw": round(100 * pb, 2),
+        "bound": bound,
+        "device_kind": device_kind,
+    }
+
+
+# ---------------------------------------------------------------------------
+# key attribution
+# ---------------------------------------------------------------------------
+
+
+def _find_types(key: Any, out: List[type]) -> None:
+    if isinstance(key, type):
+        out.append(key)
+    elif isinstance(key, (tuple, list, frozenset)):
+        for item in key:
+            _find_types(item, out)
+
+
+def _find_op(key: Any) -> Optional[str]:
+    """First bare string in the key tree — the op name _global_jit callers
+    lead their keys with ("update", "forward_fast", "stream_flush", ...)."""
+    if isinstance(key, str):
+        return key
+    if isinstance(key, (tuple, list)):
+        for item in key:
+            op = _find_op(item)
+            if op is not None and op not in ("cfg", "instance"):
+                return op
+    return None
+
+
+def attribute_key(key: Any) -> Dict[str, Any]:
+    """Human attribution for an executable-cache key.
+
+    Returns ``{"op", "metric", "donated"}`` where ``metric`` is the metric
+    class name embedded in the key (keys built by ``_executable_cache_key``
+    carry ``type(self)``) and ``op`` the leading op string. Works on any
+    key shape ``_global_jit`` sees, including the direct callers in
+    ``streaming``/``collections``/``buffers``.
+    """
+    donated = None
+    inner = key
+    if isinstance(key, tuple) and len(key) == 2 and isinstance(key[1], bool):
+        inner, donated = key
+    types: List[type] = []
+    _find_types(inner, types)
+    # keys also freeze dtype/enum classes; attribution wants the Metric
+    # subclasses (lazy import — metric.py imports this module at load time)
+    try:
+        from ..metric import Metric as _Metric
+
+        metric_types = [t for t in types if issubclass(t, _Metric)]
+    except Exception:  # pragma: no cover - partial interpreter shutdown
+        metric_types = types
+    if not metric_types:
+        metric_types = [t for t in types if t.__module__.startswith("torchmetrics_tpu")]
+    metrics = [t.__name__ for t in metric_types]
+    return {
+        "op": _find_op(inner),
+        "metric": metrics[0] if metrics else None,
+        "metrics": metrics,
+        "donated": donated,
+    }
+
+
+def describe_key(key: Any) -> str:
+    """Short human-readable rendering: ``"update[BinaryAccuracy]"``."""
+    attr = attribute_key(key)
+    op = attr["op"] or "?"
+    metric = ",".join(attr["metrics"]) if attr["metrics"] else "?"
+    out = f"{op}[{metric}]"
+    if attr["donated"]:
+        out += "+donate"
+    return out
+
+
+# ---------------------------------------------------------------------------
+# harvest
+# ---------------------------------------------------------------------------
+
+
+def arg_specs(args: tuple, kwargs: dict) -> Tuple[tuple, dict]:
+    """Snapshot abstract shapes of a dispatch's arguments.
+
+    Taken *before* the dispatch runs so donated buffers (consumed by the
+    call) are never touched; array leaves become ``ShapeDtypeStruct``,
+    everything else passes through (python scalars retain weak typing).
+    """
+    import jax
+
+    def spec(leaf: Any) -> Any:
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype)
+        return leaf
+
+    spec_args, spec_kwargs = jax.tree_util.tree_map(spec, (args, kwargs))
+    return spec_args, spec_kwargs
+
+
+def _analyze(jitted: Callable, spec: Tuple[tuple, dict]) -> Dict[str, Any]:
+    """AOT lower+compile against the recorded shapes; pull XLA's numbers.
+
+    The AOT path compiles outside the jit dispatch cache (verified:
+    ``_cache_size()`` is unchanged), so retrace counting stays honest.
+    """
+    spec_args, spec_kwargs = spec
+    compiled = jitted.lower(*spec_args, **spec_kwargs).compile()
+    ca = compiled.cost_analysis()
+    ca = ca[0] if isinstance(ca, (list, tuple)) else (ca or {})
+    out: Dict[str, Any] = {
+        "flops": float(ca.get("flops", 0.0)),
+        "bytes_accessed": float(ca.get("bytes accessed", 0.0)),
+    }
+    ma = compiled.memory_analysis()
+    if ma is not None:
+        for field, attr in (
+            ("generated_code_bytes", "generated_code_size_in_bytes"),
+            ("argument_bytes", "argument_size_in_bytes"),
+            ("output_bytes", "output_size_in_bytes"),
+            ("alias_bytes", "alias_size_in_bytes"),
+            ("temp_bytes", "temp_size_in_bytes"),
+        ):
+            val = getattr(ma, attr, None)
+            if val is not None:
+                out[field] = int(val)
+    # live-buffer footprint while the executable runs: arguments + outputs +
+    # scratch, minus buffers shared via donation aliasing
+    if "argument_bytes" in out:
+        out["live_bytes"] = (
+            out.get("argument_bytes", 0)
+            + out.get("output_bytes", 0)
+            + out.get("temp_bytes", 0)
+            - out.get("alias_bytes", 0)
+        )
+    return out
+
+
+def record_compile(
+    key: Any,
+    jitted: Callable,
+    spec: Optional[Tuple[tuple, dict]],
+    donate_state: bool,
+    new_compiles: int,
+    retraces: int,
+) -> Optional[Dict[str, Any]]:
+    """Record (or update) the ledger entry for an executable-cache key.
+
+    Called from the dispatch wrapper whenever a dispatch triggered XLA
+    compilation and the ledger is armed. Reuses the key's existing entry
+    on retrace, bumping its compile/retrace counts and re-analyzing under
+    the new shapes (the latest specialization wins the cost columns).
+    """
+    if not ENABLED:
+        return None
+    entry = _LEDGER.get(key)
+    if entry is None:
+        attr = attribute_key(key)
+        entry = _LEDGER[key] = {
+            "key": describe_key(key),
+            "op": attr["op"],
+            "metric": attr["metric"],
+            "metrics": attr["metrics"],
+            "donate_state": bool(donate_state),
+            "donated_args": (0,) if donate_state else (),
+            "compiles": 0,
+            "retraces": 0,
+        }
+        _LEDGER_STATS["entries"] += 1
+    entry["compiles"] += new_compiles
+    entry["retraces"] += retraces
+    if spec is not None:
+        try:
+            analysis = _analyze(jitted, spec)
+        except Exception as err:  # noqa: BLE001 - backend without AOT analysis
+            entry["analysis_error"] = f"{type(err).__name__}: {err}"
+            _LEDGER_STATS["analysis_errors"] += 1
+        else:
+            entry.pop("analysis_error", None)
+            entry.update(analysis)
+            _refresh_gauges()
+    if _spans.ENABLED:
+        _spans.instant(
+            "ledger.compile",
+            key=entry["key"],
+            retrace=bool(retraces),
+            flops=entry.get("flops"),
+            bytes_accessed=entry.get("bytes_accessed"),
+            generated_code_bytes=entry.get("generated_code_bytes"),
+        )
+    return entry
+
+
+def _refresh_gauges() -> None:
+    _FLOPS_TOTAL.set(sum(e.get("flops", 0.0) for e in _LEDGER.values()))
+    _BYTES_TOTAL.set(sum(e.get("bytes_accessed", 0.0) for e in _LEDGER.values()))
+    _CODE_TOTAL.set(sum(e.get("generated_code_bytes", 0) for e in _LEDGER.values()))
+
+
+# ---------------------------------------------------------------------------
+# read side
+# ---------------------------------------------------------------------------
+
+
+def executable_ledger() -> List[Dict[str, Any]]:
+    """JSON-safe copies of every recorded entry (insertion order)."""
+    out = []
+    for entry in _LEDGER.values():
+        e = dict(entry)
+        e["donated_args"] = list(e["donated_args"])
+        out.append(e)
+    return out
+
+
+def ledger_entry(key: Any) -> Optional[Dict[str, Any]]:
+    """The live entry for a raw executable-cache key, if recorded."""
+    return _LEDGER.get(key)
+
+
+def ledger_summary() -> Dict[str, Any]:
+    """Aggregate view for ``executable_cache_stats()["ledger"]``."""
+    return {
+        "enabled": ENABLED,
+        "entries": len(_LEDGER),
+        "flops_total": sum(e.get("flops", 0.0) for e in _LEDGER.values()),
+        "bytes_accessed_total": sum(
+            e.get("bytes_accessed", 0.0) for e in _LEDGER.values()
+        ),
+        "compiled_code_bytes": sum(
+            e.get("generated_code_bytes", 0) for e in _LEDGER.values()
+        ),
+        "analysis_errors": _LEDGER_STATS["analysis_errors"],
+    }
+
+
+def kernel_rooflines(
+    calls_per_second: float = 0.0, device_kind: Optional[str] = None
+) -> List[Dict[str, Any]]:
+    """Per-executable roofline rows from the recorded cost analyses.
+
+    ``calls_per_second`` is the measured dispatch rate to model each
+    kernel at (the bench smoke uses its measured steady-state step rate);
+    pass 0.0 for shape-only rows (flops/bytes, no %-of-peak).
+    """
+    rows = []
+    for entry in _LEDGER.values():
+        if "flops" not in entry:
+            continue
+        row = {"key": entry["key"], "op": entry["op"], "metric": entry["metric"]}
+        row.update(
+            roofline_from_cost(
+                entry["flops"],
+                entry["bytes_accessed"],
+                calls_per_second,
+                device_kind,
+            )
+        )
+        rows.append(row)
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# lifecycle
+# ---------------------------------------------------------------------------
+
+
+def enable_ledger() -> None:
+    """Arm ledger harvest for subsequent compiles (doubles compile cost)."""
+    global ENABLED
+    ENABLED = True
+
+
+def disable_ledger() -> None:
+    global ENABLED
+    ENABLED = False
+
+
+@contextlib.contextmanager
+def ledger_observing() -> Iterator[None]:
+    """``with ledger_observing():`` — arm the ledger for a scoped region."""
+    global ENABLED
+    was = ENABLED
+    ENABLED = True
+    try:
+        yield
+    finally:
+        ENABLED = was
+
+
+def reset_ledger() -> None:
+    """Drop all entries and zero the ledger gauges (tests/benchmarks)."""
+    _LEDGER.clear()
+    _LEDGER_STATS.reset()
+    _FLOPS_TOTAL.reset()
+    _BYTES_TOTAL.reset()
+    _CODE_TOTAL.reset()

@@ -182,6 +182,19 @@ class Histogram(_Instrument):
             for key in sorted(self._counts)
         ]
 
+    def reset_labels(self, **labels: str) -> None:
+        """Drop every label set containing the given pairs as a subset.
+
+        Lets a facade that owns one label dimension (``timer=<id>``)
+        re-zero its own observations without clobbering other owners of
+        the shared instrument.
+        """
+        want = set(_freeze_labels(labels))
+        for key in [k for k in self._counts if want <= set(k)]:
+            del self._counts[key]
+            del self._sums[key]
+            del self._totals[key]
+
     def reset(self) -> None:
         self._counts.clear()
         self._sums.clear()

@@ -47,6 +47,7 @@ from __future__ import annotations
 
 import pickle
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple, Union
 
@@ -139,6 +140,11 @@ _ELASTIC = _REGISTRY.group(
     help="elastic-sync health",
 )
 _LAST_COVERAGE: List[Optional[Coverage]] = [None]
+# bounded ring of recent rounds' coverage (newest last) — the
+# observability.autotune observer reads membership churn from this history
+# (a flapping ring argues against aggressive routes), not just the last round
+_COVERAGE_HISTORY_MAX = 64
+_COVERAGE_HISTORY: deque = deque(maxlen=_COVERAGE_HISTORY_MAX)
 
 # observers called as cb(coverage) whenever a round settles degraded; used by
 # debug.strict_mode() to enforce its degraded-compute budget
@@ -153,15 +159,22 @@ def elastic_stats() -> Dict[str, Any]:
     return out
 
 
+def coverage_history() -> List[Coverage]:
+    """Recent settled rounds' coverage records, oldest first (bounded ring)."""
+    return list(_COVERAGE_HISTORY)
+
+
 def reset_elastic_stats() -> None:
     for k in _ELASTIC:
         _ELASTIC[k] = 0
     _LAST_COVERAGE[0] = None
+    _COVERAGE_HISTORY.clear()
 
 
 def record_coverage(coverage: Coverage, degraded: bool) -> None:
     """Record one settled round; notify strict-mode observers when degraded."""
     _LAST_COVERAGE[0] = coverage
+    _COVERAGE_HISTORY.append(coverage)
     _ELASTIC["rounds"] += 1
     if degraded:
         _ELASTIC["degraded_syncs"] += 1
@@ -740,6 +753,7 @@ __all__ = [
     "chaos_group",
     "ElasticSync",
     "elastic_stats",
+    "coverage_history",
     "reset_elastic_stats",
     "record_coverage",
     "note_overlap_deferred",

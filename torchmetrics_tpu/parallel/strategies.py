@@ -107,6 +107,14 @@ _WIRE = _REGISTRY.group(
 _LAST_SYNC = _REGISTRY.group(
     "wire.last_sync", dict(_WIRE), help="per-collective breakdown of the latest sync"
 )
+# per-collective payload size distribution, labelled by kind — the
+# observability.autotune observer reads this to size gather chunks and decide
+# whether quantization can pay for its scale overhead
+_COLLECTIVE_NBYTES = _REGISTRY.histogram(
+    "wire.collective_nbytes",
+    "payload bytes per collective, by kind",
+    buckets=(64, 256, 1024, 4096, 16384, 65536, 262144, 1 << 20, 1 << 22, 1 << 24),
+)
 
 
 def record_collective(kind: str, nbytes: int, world: int, dtype: Any = None) -> None:
@@ -141,6 +149,7 @@ def record_collective(kind: str, nbytes: int, world: int, dtype: Any = None) -> 
     _WIRE["collectives_issued"] += 1
     _LAST_SYNC[key] += moved
     _LAST_SYNC["collectives_issued"] += 1
+    _COLLECTIVE_NBYTES.observe(float(nbytes), kind=kind)
     if _spans.ENABLED:
         _spans.instant(
             "collective",
@@ -174,6 +183,7 @@ def reset_wire_stats() -> None:
         _WIRE[k] = 0
     for k in _LAST_SYNC:
         _LAST_SYNC[k] = 0
+    _COLLECTIVE_NBYTES.reset()
 
 
 # ---------------------------------------------------------------------------

@@ -43,6 +43,7 @@ dispatch-count math.
 """
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -53,12 +54,21 @@ from jax import lax
 from .buffers import CatBuffer
 from .metric import Metric, StateDict, _filter_kwargs, _global_jit, _jit_safe_inputs
 from .observability import spans as _spans
+from .observability.registry import REGISTRY as _REGISTRY
 from .parallel.elastic import note_overlap_deferred
 from .parallel.reduction import Reduction
 from .parallel.strategies import begin_sync
 from .utils.exceptions import TorchMetricsUserError
 
 __all__ = ["BufferedMetric", "BufferedMetricCollection"]
+
+# wall-clock dispatch latency of the scanned flush, labelled by window size —
+# one observation per flush (per-K-steps, not per-step, so always-on is
+# cheap). The autotune observer compares this against the staged-step cadence
+# when choosing the buffered window K.
+_FLUSH_LATENCY = _REGISTRY.histogram(
+    "streaming.flush_latency_s", "seconds per scanned flush dispatch"
+)
 
 
 def _input_signature(args: tuple, kwargs: dict) -> tuple:
@@ -272,6 +282,7 @@ class BufferedMetric:
             if _spans.ENABLED
             else None
         )
+        _t0 = time.perf_counter()
         try:
             m = self.__dict__["_metric"]
             # snapshot the cat-state row counts the PREVIOUS windows produced
@@ -331,6 +342,7 @@ class BufferedMetric:
                         note_overlap_deferred()
         finally:
             self.__dict__["_flushing"] = False
+            _FLUSH_LATENCY.observe(time.perf_counter() - _t0, window=str(self._window))
             if _sp is not None:
                 _sp.end()
 
@@ -633,6 +645,7 @@ class BufferedMetricCollection:
         if ring.count == 0 or self.__dict__["_flushing"]:
             return
         self.__dict__["_flushing"] = True
+        _t0 = time.perf_counter()
         try:
             coll = self.__dict__["_collection"]
             fused, _eager, _ = coll._fused_update_plan()
@@ -648,6 +661,7 @@ class BufferedMetricCollection:
                 rep._extend_list_states_stacked(appends[name], valid)
         finally:
             self.__dict__["_flushing"] = False
+            _FLUSH_LATENCY.observe(time.perf_counter() - _t0, window=str(self._window))
 
     # -- observation (flush-first delegation) ---------------------------
     def compute(self) -> Dict[str, Any]:

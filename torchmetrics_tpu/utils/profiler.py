@@ -8,15 +8,31 @@ ships a small built-in timer:
   block-until-ready semantics so device work is actually counted;
 - :func:`annotate` — wraps a phase in ``jax.profiler.TraceAnnotation`` so
   the phases show up in TPU profiler traces (xprof) too.
+
+Since PR 8 the observability layer owns all timing state; ``StepTimer``
+is now a thin facade over it rather than a fifth timing island. Phase
+durations land in the shared ``profiler.phase_s`` registry histogram
+(labelled ``timer=<id>, phase=<name>`` so instances stay isolated and
+exporters scrape them alongside everything else), and each phase opens a
+``profiler.<name>`` span when tracing is armed. ``summary()`` keeps its
+historical ``{name: {"total_s", "count", "mean_ms"}}`` shape.
 """
+import itertools
 import time
-from collections import defaultdict
 from contextlib import contextmanager
 from typing import Any, Dict
 
 import jax
 
+from ..observability import spans as _spans
+from ..observability.registry import REGISTRY as _REGISTRY
+
 __all__ = ["StepTimer", "annotate"]
+
+_PHASE_HIST = _REGISTRY.histogram(
+    "profiler.phase_s", "seconds per StepTimer phase, by timer and phase"
+)
+_timer_ids = itertools.count(1)
 
 
 @contextmanager
@@ -36,13 +52,17 @@ class StepTimer:
             with timer.phase("metric_update"):
                 state = metric.update_state(state, *batch)
         print(timer.summary())   # {"metric_update": {"total_s": ..., "count": ..., "mean_ms": ...}}
+
+    The accumulated state lives in the process-global registry (histogram
+    ``profiler.phase_s``), keyed by a per-instance ``timer`` label, so a
+    Prometheus scrape or registry snapshot sees the same numbers
+    ``summary()`` reports.
     """
 
     def __init__(self, block_until_ready: bool = True) -> None:
-        self._totals: Dict[str, float] = defaultdict(float)
-        self._counts: Dict[str, int] = defaultdict(int)
         self._block = block_until_ready
         self._live: Any = None
+        self._id = f"st{next(_timer_ids)}"
 
     @contextmanager
     def phase(self, name: str, result: Any = None):
@@ -52,6 +72,7 @@ class StepTimer:
         exception-safe (time is recorded even if the block raises)."""
         outer_live = self._live
         self._live = result
+        span = _spans.trace_span(f"profiler.{name}", timer=self._id)
         t0 = time.perf_counter()
         try:
             with jax.profiler.TraceAnnotation(name):
@@ -59,8 +80,9 @@ class StepTimer:
             if self._block and self._live is not None:
                 jax.block_until_ready(self._live)
         finally:
-            self._totals[name] += time.perf_counter() - t0
-            self._counts[name] += 1
+            elapsed = time.perf_counter() - t0
+            span.end()
+            _PHASE_HIST.observe(elapsed, timer=self._id, phase=name)
             self._live = outer_live
 
     @property
@@ -72,15 +94,17 @@ class StepTimer:
         self._live = value
 
     def summary(self) -> Dict[str, Dict[str, float]]:
-        return {
-            name: {
-                "total_s": self._totals[name],
-                "count": self._counts[name],
-                "mean_ms": 1000.0 * self._totals[name] / max(self._counts[name], 1),
+        out: Dict[str, Dict[str, float]] = {}
+        for labels, _counts, total_s, count in _PHASE_HIST.collect():
+            d = dict(labels)
+            if d.get("timer") != self._id:
+                continue
+            out[d.get("phase", "")] = {
+                "total_s": total_s,
+                "count": count,
+                "mean_ms": 1000.0 * total_s / max(count, 1),
             }
-            for name in self._totals
-        }
+        return out
 
     def reset(self) -> None:
-        self._totals.clear()
-        self._counts.clear()
+        _PHASE_HIST.reset_labels(timer=self._id)
