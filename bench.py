@@ -758,6 +758,27 @@ def bench_smoke() -> dict:
         and autotune_warm_retraces == 0
     )
 
+    # multi-tenant gate (ISSUE 16): N=256 homogeneous tenants stacked along
+    # a leading slot axis must run as ONE executable per update (≥ 20x the
+    # sequential per-tenant loop) and ONE collective per (Reduction, dtype)
+    # sync bucket; tenant add/remove rides the pre-compiled slot kernel so
+    # churn never retraces under strict_mode; and a rebuilt stack shares the
+    # ProfileCache key (slot count included) and replays warm with zero
+    # retraces, while a different slot count moves the key.
+    mt = _multi_tenant_case(n_tenants=256, batch=4, steps=20, loop_passes=2)
+    multi_tenant_ok = (
+        mt["dispatches_per_update"] == 1
+        and mt["speedup_vs_loop"] >= 20.0
+        and mt["sync_collectives"] == mt["expected_sync_buckets"]
+        and mt["churn_strict_ok"]
+        and mt["churn_retraces"] == 0
+        and mt["profile_key_stable"]
+        and mt["slot_count_moves_key"]
+        and mt["replay_strict_ok"]
+        and mt["replay_retraces"] == 0
+        and mt["ledger_key"] == "update[TenantStack[MulticlassAccuracy]×256]"
+    )
+
     telemetry = _telemetry_smoke()
     telemetry_ok = bool(telemetry["ok"])
 
@@ -806,6 +827,7 @@ def bench_smoke() -> dict:
             and telemetry_ok
             and autotune_ok
             and ledger_ok
+            and multi_tenant_ok
         ),
         "dispatches_per_update": dispatches,
         "clone_new_compilations": clone_misses,
@@ -872,6 +894,8 @@ def bench_smoke() -> dict:
                 "replay_retraces": autotune_warm_retraces,
             },
         },
+        "multi_tenant_ok": multi_tenant_ok,
+        "multi_tenant": mt,
         "ledger_ok": ledger_ok,
         "ledger": {
             "entries": len(ledger_entries),
@@ -1716,6 +1740,182 @@ def bench_online_stream() -> dict:
     }
 
 
+def _multi_tenant_case(
+    n_tenants: int, batch: int = 4, steps: int = 30, loop_passes: int = 3
+) -> dict:
+    """One stacked-vs-sequential comparison at ``n_tenants`` tenants.
+
+    Stacked: one ``TenantStack(MulticlassAccuracy)`` — the whole fleet's
+    update is ONE dispatch of one vmapped executable, and an eager 2-rank
+    sync is ONE collective per (Reduction, dtype) bucket over the stacked
+    state. Sequential: N individual instances updated in a Python loop (the
+    shape TPU011 flags) — N dispatches per logical step, even though all N
+    share one cached executable. The churn and rebuilt-replay legs run under
+    strict_mode, so zero-retrace is enforced, not observed.
+    """
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    import torchmetrics_tpu.metric as M
+    from torchmetrics_tpu import TenantStack
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+    from torchmetrics_tpu.debug import StrictModeViolation, strict_mode
+    from torchmetrics_tpu.observability.autotune import (
+        ProfileCache,
+        metric_set_key,
+        topology_key,
+    )
+    from torchmetrics_tpu.observability.ledger import describe_key
+    from torchmetrics_tpu.parallel.sync import FakeSync
+
+    n_cls = 4
+
+    def _template():
+        return MulticlassAccuracy(num_classes=n_cls, average="micro", validate_args=False)
+
+    def _mk_stack(n: int = n_tenants) -> TenantStack:
+        return TenantStack(_template(), tenants=list(range(n)), capacity=n)
+
+    stack = _mk_stack()
+    slots = stack.slots
+    rng = np.random.RandomState(7)
+    feed = [
+        (
+            jnp.asarray(rng.randint(0, n_cls, size=(slots, batch)).astype(np.int32)),
+            jnp.asarray(rng.randint(0, n_cls, size=(slots, batch)).astype(np.int32)),
+        )
+        for _ in range(4)
+    ]
+    stack.update(*feed[0])  # trace + compile
+    stack.update(*feed[1])
+    d_before = M.executable_cache_stats()["dispatches"]
+    stack.update(*feed[2])
+    dispatches_per_update = M.executable_cache_stats()["dispatches"] - d_before
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        stack.update(*feed[i % len(feed)])
+    jax.block_until_ready(stack.tenant_count)
+    stacked_step_s = (time.perf_counter() - t0) / steps
+
+    fleet = [_template() for _ in range(n_tenants)]
+    preds0, target0 = feed[0]
+    for i, m_ in enumerate(fleet):  # warm: all N share ONE cached executable
+        m_.update(preds0[i], target0[i])
+    t0 = time.perf_counter()
+    for p in range(loop_passes):
+        preds, target = feed[p % len(feed)]
+        for i, m_ in enumerate(fleet):
+            m_.update(preds[i], target[i])
+    probe = fleet[-1]
+    jax.block_until_ready(getattr(probe, next(iter(probe._defaults))))
+    loop_step_s = (time.perf_counter() - t0) / loop_passes
+    speedup = loop_step_s / stacked_step_s if stacked_step_s > 0 else 0.0
+
+    # one collective per (Reduction, dtype) bucket, regardless of N
+    ranks = [_mk_stack() for _ in range(2)]
+    for r, s in enumerate(ranks):
+        s.update(*feed[r])
+    group = [s.metric_state for s in ranks]
+    c_before = M.executable_cache_stats()["collectives_issued"]
+    ranks[0].sync(sync_backend=FakeSync(group, 0))
+    sync_collectives = M.executable_cache_stats()["collectives_issued"] - c_before
+    expected_sync_buckets = len(
+        {(str(stack._reductions[k]), str(getattr(stack, k).dtype)) for k in stack._defaults}
+    )
+
+    # tenant churn inside strict_mode: the slot kernel and the update
+    # executable must both be shape-stable across the roster change
+    victim = n_tenants - 1
+    stack.remove_tenant(victim)
+    stack.add_tenant(victim)  # warm both kernel directions at this capacity
+    r_before = M.executable_cache_stats()["retraces"]
+    churn_strict_ok = True
+    try:
+        with strict_mode(max_new_executables=0):
+            stack.remove_tenant(victim)
+            stack.update(*feed[3])
+            stack.add_tenant(victim)
+            stack.update(*feed[0])
+    except StrictModeViolation:
+        churn_strict_ok = False
+    churn_retraces = M.executable_cache_stats()["retraces"] - r_before
+
+    # ProfileCache identity: an identically-configured stack shares the
+    # profile key (and the executables behind it) — so a warm profile
+    # replays with zero retraces — while a different slot count moves the
+    # key (pow2 growth means a different executable)
+    topo = topology_key(world=1)
+    key_a = ProfileCache.profile_key(topo, metric_set_key(stack))
+    rebuilt = _mk_stack()
+    key_b = ProfileCache.profile_key(topo, metric_set_key(rebuilt))
+    half = _mk_stack(max(n_tenants // 2, 2))
+    key_half = ProfileCache.profile_key(topo, metric_set_key(half))
+    profile_key_stable = key_a == key_b
+    slot_count_moves_key = key_half != key_a
+    r_before = M.executable_cache_stats()["retraces"]
+    replay_strict_ok = True
+    try:
+        with strict_mode(max_new_executables=0):
+            rebuilt.update(*feed[0])
+            rebuilt.update(*feed[1])
+    except StrictModeViolation:
+        replay_strict_ok = False
+    replay_retraces = M.executable_cache_stats()["retraces"] - r_before
+
+    return {
+        "n_tenants": n_tenants,
+        "slots": slots,
+        "dispatches_per_update": dispatches_per_update,
+        "stacked_updates_per_s": round(n_tenants / stacked_step_s, 1)
+        if stacked_step_s > 0
+        else 0.0,
+        "loop_updates_per_s": round(n_tenants / loop_step_s, 1)
+        if loop_step_s > 0
+        else 0.0,
+        "stacked_step_s": round(stacked_step_s, 6),
+        "loop_step_s": round(loop_step_s, 6),
+        "speedup_vs_loop": round(speedup, 1),
+        "sync_collectives": sync_collectives,
+        "expected_sync_buckets": expected_sync_buckets,
+        "churn_strict_ok": churn_strict_ok,
+        "churn_retraces": churn_retraces,
+        "profile_key_stable": profile_key_stable,
+        "slot_count_moves_key": slot_count_moves_key,
+        "replay_strict_ok": replay_strict_ok,
+        "replay_retraces": replay_retraces,
+        "ledger_key": describe_key(("update", stack._executable_cache_key())),
+    }
+
+
+def bench_multi_tenant() -> dict:
+    """Multi-tenant fleets: N ∈ {16, 256, 4096} homogeneous tenants as ONE
+    ``TenantStack`` vs N individual metric instances updated in a Python
+    loop. Reports tenant-updates/s for both sides, dispatches per stacked
+    step (always 1), and collectives per 2-rank sync (one per
+    (Reduction, dtype) bucket, regardless of N). The tenant-churn and
+    rebuilt-stack replay legs run under strict_mode at every N."""
+    cases = {
+        "n16": _multi_tenant_case(16, steps=30, loop_passes=4),
+        "n256": _multi_tenant_case(256, steps=30, loop_passes=2),
+        "n4096": _multi_tenant_case(4096, steps=10, loop_passes=1),
+    }
+    mid = cases["n256"]
+    return {
+        "value": mid["stacked_updates_per_s"],
+        "unit": "tenant-updates/s (N=256 stacked MulticlassAccuracy)",
+        "vs_baseline": mid["speedup_vs_loop"],
+        "note": (
+            "vs_baseline = sequential per-tenant loop step time / stacked "
+            "step time at N=256; a stacked step is one dispatch and a sync "
+            "one collective per (Reduction, dtype) bucket at any N"
+        ),
+        "cases": cases,
+    }
+
+
 # order = execution order for the extras: the slow configs (auroc's eager
 # baseline, mAP's two baselines, the train-step epochs) run first so the
 # shrinking per-child timeout near the budget end hits only the fast ones
@@ -1731,6 +1931,7 @@ _CONFIGS = {
     "bootstrap_vmap": "bench_bootstrap",
     "cat_append": "bench_cat_append",
     "online_stream": "bench_online_stream",
+    "multi_tenant": "bench_multi_tenant",
 }
 
 

@@ -1,31 +1,36 @@
-"""Online evaluation service: windowed / decayed / sketch metrics over a
-serving stream.
+"""Multi-tenant online evaluation service: one vmapped stack per fleet.
 
-Simulates a model server emitting (score, label, latency, item_id) events and
-keeps live quality + traffic metrics with O(1) state:
+Simulates a model server handling N tenant cohorts (regions / surfaces) at
+once. An ingest thread synthesizes per-tenant (label, latency) traffic into
+a bounded queue; the consumer loop drains it into THREE TenantStacks —
 
-- ``ApproxQuantile`` (t-digest) — p50/p99 latency,
-- ``ApproxAUROC`` (reservoir) — ranking quality,
-- ``WindowedMean`` — click-through rate over the last window of updates,
-- ``DecayedMean`` — exponentially-weighted latency (EMA with a half-life),
-- ``ApproxFrequency`` (count-min) — hot-item request counts.
+- ``TenantStack(WindowedMean)``  — click-through rate over the last window,
+- ``TenantStack(DecayedMean)``   — exponentially-weighted latency (EMA),
+- ``TenantStack(ApproxQuantile)``— p50 latency via a t-digest sketch,
 
-After warm-up the whole stream runs inside ``strict_mode()``: one million+
-events, ZERO retraces and ZERO implicit host transfers — every update
-(including window-ring rotation and sketch compression) is pure in-graph
-arithmetic on fixed-shape state, staged through ``buffered()``'s scanned
-flush. State size is independent of stream length.
+so every step costs ONE dispatch per stack regardless of tenant count
+(the per-tenant Python loop this replaces is exactly what tpulint's TPU011
+flags). After warm-up the stream runs inside ``strict_mode()``: a million+
+events, ZERO retraces and ZERO implicit host transfers, staged through
+``buffered()``'s scanned flush. Mid-service tenant churn (add/remove) flips
+slots in the padded pow2 mask through one pre-compiled kernel — no retrace.
 
-A short post-measurement slice of the stream then runs with span tracing
-armed and ships the two artifacts an operator would scrape: a
-Perfetto-loadable trace (``serve_trace.perfetto.json``) and a Prometheus
-text exposition over the live counter registry (``serve_metrics.prom``).
+A 2-rank sync of the sketch stack then runs under an injected ChaosSync
+timeout: ElasticSync retries and recovers the full-coverage merged result —
+ONE collective per (Reduction, dtype) bucket, not per tenant.
+
+Ships the two artifacts an operator would scrape: a Perfetto-loadable trace
+(``serve_trace.perfetto.json``) and a Prometheus text exposition
+(``serve_metrics.prom``) whose ``tmtpu_serve_*`` gauges carry a
+``tenant="..."`` label per cohort.
 
     JAX_PLATFORMS=cpu python examples/serve_demo.py [out_dir]
 """
 import os as _os
+import queue
 import sys as _sys
 import tempfile
+import threading
 
 _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))  # in-repo run
 
@@ -34,75 +39,139 @@ import numpy as np
 import jax.numpy as jnp
 
 from torchmetrics_tpu import (
-    ApproxAUROC,
-    ApproxFrequency,
     ApproxQuantile,
     DecayedMean,
+    TenantStack,
     WindowedMean,
 )
 from torchmetrics_tpu import observability as obs
 from torchmetrics_tpu.debug import strict_mode
 from torchmetrics_tpu.metric import executable_cache_stats
+from torchmetrics_tpu.parallel import ChaosSchedule, ElasticSync, SyncPolicy, chaos_group
+
+TENANTS = ["us", "eu", "apac", "play", "web", "ios"]
+# per-cohort traffic character: base CTR and log-latency location
+BASE_CTR = np.asarray([0.30, 0.24, 0.36, 0.18, 0.27, 0.33], np.float32)
+LAT_MU = np.asarray([3.0, 3.2, 3.4, 2.9, 3.1, 3.0], np.float32)
 
 
-def synth_events(rng, batch):
-    """One batch of synthetic serving traffic."""
-    label = (rng.rand(batch) < 0.3).astype(np.float32)
-    score = np.clip(label * 0.35 + rng.rand(batch) * 0.65, 0.0, 1.0).astype(np.float32)
-    latency = rng.lognormal(mean=3.0, sigma=0.5, size=batch).astype(np.float32)  # ~20ms median
-    items = rng.zipf(1.5, size=batch).astype(np.int32) % 50_000
-    return (
-        jnp.asarray(score),
-        jnp.asarray(label),
-        jnp.asarray(latency),
-        jnp.asarray(items),
-    )
+def _pad(per_tenant: np.ndarray, slots: int) -> np.ndarray:
+    """Pad the tenant axis to the pow2 slot count (spare rows are ignored)."""
+    out = np.zeros((slots,) + per_tenant.shape[1:], per_tenant.dtype)
+    out[: per_tenant.shape[0]] = per_tenant
+    return out
+
+
+def synth_events(rng, slots: int, batch: int):
+    """One (slots, batch) step of synthetic per-tenant serving traffic."""
+    n = len(TENANTS)
+    label = (rng.rand(n, batch) < BASE_CTR[:, None]).astype(np.float32)
+    latency = rng.lognormal(mean=LAT_MU[:, None], sigma=0.5, size=(n, batch)).astype(np.float32)
+    return _pad(label, slots), _pad(latency, slots)
+
+
+def ingest(q: "queue.Queue", seed: int, slots: int, batch: int, steps: int) -> None:
+    """Producer thread: host-side synthesis feeding the bounded queue."""
+    rng = np.random.RandomState(seed)
+    for _ in range(steps):
+        q.put(synth_events(rng, slots, batch))
+    q.put(None)  # end-of-stream
 
 
 def main() -> None:
-    batch = 4096
-    steps = 260  # > 1e6 events total
-    rng = np.random.RandomState(0)
+    batch = 512
+    steps = 260  # x 8 slots x 512 events/slot > 1e6 events total
+    warm = 17
 
-    latency_q = ApproxQuantile(q=(0.5, 0.99), compression=128).buffered(window=16)
-    auroc = ApproxAUROC(capacity=4096).buffered(window=16)
-    ctr = WindowedMean(horizon=64, slots=8).buffered(window=16)
-    ema_latency = DecayedMean(halflife=32.0).buffered(window=16)
-    hot_items = ApproxFrequency(track=(0, 1, 2, 3), width=2048).buffered(window=16)
+    ctr = TenantStack(WindowedMean(horizon=64, slots=8), tenants=TENANTS).buffered(window=16)
+    ema = TenantStack(DecayedMean(halflife=32.0), tenants=TENANTS).buffered(window=16)
+    p50 = TenantStack(ApproxQuantile(q=0.5, compression=64), tenants=TENANTS).buffered(window=16)
+    slots = ctr.metric.slots
 
-    def step(score, label, latency, items):
-        latency_q.update(latency)
-        auroc.update(score, label)
-        ctr.update(label)
-        ema_latency.update(latency)
-        hot_items.update(items)
+    q: "queue.Queue" = queue.Queue(maxsize=8)
+    producer = threading.Thread(
+        target=ingest, args=(q, 0, slots, batch, steps), daemon=True
+    )
+    producer.start()
 
-    # warm-up: first flush traces+compiles each metric's scanned update once
-    for _ in range(17):
-        step(*synth_events(rng, batch))
+    def step(label: np.ndarray, latency: np.ndarray) -> None:
+        lat = jnp.asarray(latency)
+        ctr.update(jnp.asarray(label))
+        ema.update(lat)
+        p50.update(lat)
 
-    events = 17 * batch
+    # warm-up: first flush traces+compiles each stack's scanned update once
+    for _ in range(warm):
+        step(*q.get())
+
+    events = warm * slots * batch
     with strict_mode(max_new_executables=0) as stats:
-        for _ in range(steps - 17):
-            s, l, t, i = synth_events(rng, batch)  # host-side synthesis...
-            step(s, l, t, i)  # ...but the update path stays on device
-            events += batch
-    print(f"streamed {events:,} events: retraces={stats.retraces} "
-          f"new_executables={stats.new_executables}")
+        while (ev := q.get()) is not None:
+            step(*ev)  # one dispatch per stack for ALL tenants
+            events += slots * batch
+    producer.join()
+    print(f"streamed {events:,} events across {len(TENANTS)} tenants: "
+          f"retraces={stats.retraces} new_executables={stats.new_executables}")
 
-    p50, p99 = (float(x) for x in latency_q.compute())
-    print(f"latency p50={p50:.1f}ms p99={p99:.1f}ms "
-          f"(rank error <= {latency_q.metric.error_bound():.3f})")
-    print(f"AUROC (reservoir {auroc.metric.capacity}): {float(auroc.compute()):.3f}")
-    print(f"CTR over last {ctr.metric.horizon} updates: {float(ctr.compute()):.3f}")
-    print(f"EMA latency (halflife {ema_latency.metric.halflife:.0f} updates): "
-          f"{float(ema_latency.compute()):.1f}ms")
-    print(f"hot item counts (count-min, overestimate-only): "
-          f"{hot_items.compute().tolist()}")
+    # mid-service churn: flush staged work, then flip slots through the
+    # pre-compiled kernel — roster changes within a capacity never retrace
+    for w in (ctr, ema, p50):
+        w.compute()
+    churn_before = executable_cache_stats()["retraces"]
+    for w in (ctr, ema, p50):
+        w.metric.remove_tenant("web")  # surface decommissioned...
+        w.metric.add_tenant("br")  # ...new region onboarded, same slot
+    rng2 = np.random.RandomState(1)
+    roster = list(ctr.metric.tenant_ids)
+    for _ in range(16):  # traffic continues; 'br' starts accumulating
+        step(*synth_events(rng2, slots, batch))
+    for w in (ctr, ema, p50):
+        w.compute()
+    print(f"tenant churn (-web +br): roster={roster} "
+          f"retraces={executable_cache_stats()['retraces'] - churn_before}")
 
-    digest_bytes = latency_q.metric.digest.size * latency_q.metric.digest.dtype.itemsize
-    print(f"t-digest state: {digest_bytes} bytes — independent of the "
-          f"{events:,}-event stream length")
+    ctr_res = ctr.metric.results()
+    ema_res = ema.metric.results()
+    p50_res = p50.metric.results()
+    for t in roster:
+        print(f"  {t:>5}: ctr={float(ctr_res[t]):.3f} "
+              f"ema_latency={float(ema_res[t]):6.1f}ms "
+              f"p50={float(p50_res[t]):6.1f}ms")
+    err = p50.metric._view.members[0][2].error_bound()
+    print(f"p50 via stacked t-digest (rank error <= {err:.3f}); "
+          f"state bytes independent of stream length")
+
+    # elastic 2-rank sync of the sketch stack under an injected timeout:
+    # ONE collective per (Reduction, dtype) bucket — never per tenant
+    ranks = [TenantStack(ApproxQuantile(q=0.5, compression=64), tenants=TENANTS) for _ in range(2)]
+    rng3 = np.random.RandomState(2)
+    for r in range(2):
+        _, latency = synth_events(rng3, slots, batch)
+        ranks[r].update(jnp.asarray(latency))
+    backs = chaos_group(
+        [m.metric_state for m in ranks], ChaosSchedule({0: [("timeout", 1)]})
+    )
+    for r, m in enumerate(ranks):
+        m._sync_backend = ElasticSync(backs[r], policy=SyncPolicy(retry_attempts=1))
+    backs[0].controller.advance()
+    wire_before = executable_cache_stats()["collectives_issued"]
+    merged = ranks[0].results()  # sync happens here: timeout -> retry -> ok
+    cov = ranks[0].coverage
+    print(f"chaos sync: coverage={cov.fraction if cov else 1.0:.1f} "
+          f"collectives={executable_cache_stats()['collectives_issued'] - wire_before} "
+          f"merged p50[us]={float(merged['us']):.1f}ms")
+
+    # per-tenant-labelled gauges on the shared registry -> Prometheus scrape
+    reg = obs.get_registry()
+    g_ctr = reg.gauge("serve_ctr", "windowed click-through rate per tenant")
+    g_ema = reg.gauge("serve_latency_ema_ms", "EMA latency per tenant (ms)")
+    g_p50 = reg.gauge("serve_latency_p50_ms", "p50 latency per tenant (ms)")
+    g_slots = reg.gauge("serve_tenant_slots", "padded tenant slot capacity")
+    for t in roster:
+        g_ctr.set(float(ctr_res[t]), tenant=str(t))
+        g_ema.set(float(ema_res[t]), tenant=str(t))
+        g_p50.set(float(p50_res[t]), tenant=str(t))
+    g_slots.set(float(slots))
     print(f"online dispatch counters: {executable_cache_stats()['online']}")
 
     # telemetry demo: arm tracing for a short slice (outside the strict
@@ -111,8 +180,8 @@ def main() -> None:
     out_dir = _sys.argv[1] if len(_sys.argv) > 1 else tempfile.mkdtemp(prefix="serve_demo_")
     with obs.tracing():
         for _ in range(4):
-            step(*synth_events(rng, batch))
-        float(ema_latency.compute())  # forces a traced flush + compute span
+            step(*synth_events(rng2, slots, batch))
+        float(jnp.sum(ema.compute()))  # forces a traced flush + compute span
         spans = list(obs.collected_spans())
     trace_path = _os.path.join(out_dir, "serve_trace.perfetto.json")
     obs.write_perfetto(trace_path, spans)
@@ -121,7 +190,7 @@ def main() -> None:
         fh.write(obs.to_prometheus())
     phases = sorted({s.name for s in spans})
     print(f"telemetry: {len(spans)} spans over phases {phases} -> {trace_path}")
-    print(f"telemetry: prometheus scrape -> {prom_path}")
+    print(f"telemetry: prometheus scrape (per-tenant tmtpu_serve_* gauges) -> {prom_path}")
 
 
 if __name__ == "__main__":

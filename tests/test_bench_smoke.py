@@ -71,6 +71,21 @@ def test_bench_smoke_passes():
     assert result["autotune"]["warm"]["same_decision"] is True, result
     assert result["autotune"]["warm"]["strict_ok"] is True, result
     assert result["autotune"]["warm"]["replay_retraces"] == 0, result
+    # multi-tenant gate: 256 stacked tenants run as ONE dispatch per update
+    # (>= 20x the sequential per-tenant loop) and one collective per
+    # (Reduction, dtype) sync bucket; slot churn and a rebuilt-stack replay
+    # hold zero retraces under strict_mode, and the ProfileCache key tracks
+    # the slot count
+    assert result["multi_tenant_ok"] is True, result
+    mt = result["multi_tenant"]
+    assert mt["dispatches_per_update"] == 1, result
+    assert mt["speedup_vs_loop"] >= 20.0, result
+    assert mt["sync_collectives"] == mt["expected_sync_buckets"], result
+    assert mt["churn_strict_ok"] is True and mt["churn_retraces"] == 0, result
+    assert mt["profile_key_stable"] is True, result
+    assert mt["slot_count_moves_key"] is True, result
+    assert mt["replay_strict_ok"] is True and mt["replay_retraces"] == 0, result
+    assert mt["ledger_key"] == "update[TenantStack[MulticlassAccuracy]×256]", result
     # ledger gate: a complete device-truth entry (flops, bytes, compiled
     # footprint, donation set) for every executable the smoke run minted,
     # and a roofline row per entry derived from cost_analysis()

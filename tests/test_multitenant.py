@@ -1,0 +1,507 @@
+"""TenantStack + MetricState: vectorized multi-tenant collections.
+
+Locks the multi-tenant PR's contracts:
+
+- stacked-vs-sequential-loop bitwise parity for update/compute and for every
+  sync route (dense psum, forced all_gather, reduce-scatter decomposition,
+  quantized wire format);
+- ONE dispatch per stacked update and ONE collective per (Reduction, dtype)
+  sync bucket, regardless of N;
+- pow2 slot churn: add/remove within a capacity never retraces (enforced
+  under strict_mode), growth happens exactly at boundaries and preserves
+  live state, removed slots reset so syncs never carry ghost tenants;
+- checkpoint → rejoin (pickle round-trip) composes with a seeded ChaosSync;
+- the executable/ProfileCache identity includes the tenant-slot count, and
+  the ledger renders stacked executables as ``update[TenantStack[...]×N]``;
+- MetricState pytree semantics (metadata survives tree_map / flatten) and
+  reduce_state_in_graph deriving reductions off a MetricState;
+- label_results as the single stack→dict idiom, with the classwise wrapper
+  and group-fairness rates as degenerate tenant stacks (regression vs the
+  hand-rolled per-key loops they replaced).
+"""
+import pickle
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import torchmetrics_tpu.metric as M
+from torchmetrics_tpu import (
+    CatMetric,
+    MeanMetric,
+    Metric,
+    MetricCollection,
+    TenantStack,
+    label_results,
+)
+from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassF1Score
+from torchmetrics_tpu.debug import strict_mode
+from torchmetrics_tpu.parallel import SyncPolicy
+from torchmetrics_tpu.parallel.reduction import Reduction
+from torchmetrics_tpu.parallel.sync import FakeSync, reduce_state_in_graph
+from torchmetrics_tpu.state import MetricState
+from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
+
+WORLD = 2
+
+
+def _mcls():
+    return MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+
+
+# ---------------------------------------------------------------- parity
+def test_mean_stack_matches_sequential_loop_bitwise():
+    tenants = ["a", "b", "c"]
+    stack = TenantStack(MeanMetric(), tenants=tenants)
+    rng = np.random.RandomState(0)
+    fleet = {t: MeanMetric() for t in tenants}
+    for _ in range(3):
+        batch = jnp.asarray(rng.rand(stack.slots, 5).astype(np.float32))
+        stack.update(batch)
+        for i, t in enumerate(tenants):
+            fleet[t].update(batch[i])
+    res = stack.results()
+    for t in tenants:
+        assert float(res[t]) == float(fleet[t].compute())
+
+
+def test_classifier_stack_matches_sequential_loop_bitwise():
+    stack = TenantStack(_mcls(), tenants=list(range(4)))
+    fleet = [_mcls() for _ in range(4)]
+    rng = np.random.RandomState(1)
+    for _ in range(3):
+        preds = jnp.asarray(rng.randint(0, 4, (stack.slots, 6)).astype(np.int32))
+        target = jnp.asarray(rng.randint(0, 4, (stack.slots, 6)).astype(np.int32))
+        stack.update(preds, target)
+        for i, m in enumerate(fleet):
+            m.update(preds[i], target[i])
+    out = stack.compute()
+    for i, m in enumerate(fleet):
+        assert float(out[i]) == float(m.compute())
+
+
+def test_collection_template_parity():
+    def _mk():
+        return {
+            "acc": MulticlassAccuracy(num_classes=3, average="micro", validate_args=False),
+            "f1": MulticlassF1Score(num_classes=3, average="macro", validate_args=False),
+        }
+
+    stack = TenantStack(MetricCollection(_mk()), tenants=["x", "y"])
+    fleet = {"x": _mk(), "y": _mk()}
+    rng = np.random.RandomState(2)
+    for _ in range(2):
+        preds = jnp.asarray(rng.randint(0, 3, (stack.slots, 8)).astype(np.int32))
+        target = jnp.asarray(rng.randint(0, 3, (stack.slots, 8)).astype(np.int32))
+        stack.update(preds, target)
+        for i, t in enumerate(("x", "y")):
+            for m in fleet[t].values():
+                m.update(preds[i], target[i])
+    res = stack.results()
+    for t in ("x", "y"):
+        for name, m in fleet[t].items():
+            assert float(res[t][name]) == float(m.compute())
+
+
+def test_stacked_update_is_one_dispatch():
+    stack = TenantStack(MeanMetric(), tenants=list(range(8)))
+    rng = np.random.RandomState(3)
+    feed = [jnp.asarray(rng.rand(stack.slots, 4).astype(np.float32)) for _ in range(3)]
+    stack.update(feed[0])  # trace + compile
+    stack.update(feed[1])
+    before = M.executable_cache_stats()["dispatches"]
+    stack.update(feed[2])
+    assert M.executable_cache_stats()["dispatches"] - before == 1
+
+
+# ------------------------------------------------------------- sync routes
+def _mean_world(n_tenants=3, seed=5):
+    """WORLD stacked ranks + the per-tenant fleet twin, identically fed."""
+    rng = np.random.RandomState(seed)
+    ranks = [TenantStack(MeanMetric(), tenants=list(range(n_tenants))) for _ in range(WORLD)]
+    fleet = [[MeanMetric() for _ in range(n_tenants)] for _ in range(WORLD)]
+    for r in range(WORLD):
+        batch = jnp.asarray(rng.rand(ranks[r].slots, 4).astype(np.float32))
+        ranks[r].update(batch)
+        for i in range(n_tenants):
+            fleet[r][i].update(batch[i])
+    return ranks, fleet
+
+
+def test_eager_sync_parity_default_policy():
+    ranks, fleet = _mean_world()
+    ranks[0].sync(sync_backend=FakeSync([s.metric_state for s in ranks], 0))
+    synced = ranks[0].compute()
+    for i in range(3):
+        ms = [fleet[r][i] for r in range(WORLD)]
+        ms[0].sync(sync_backend=FakeSync([m.metric_state for m in ms], 0))
+        assert float(synced[i]) == float(ms[0].compute())
+
+
+def test_one_collective_per_bucket_regardless_of_n():
+    for n in (2, 8):
+        ranks, _ = _mean_world(n_tenants=n, seed=6)
+        before = M.executable_cache_stats()["collectives_issued"]
+        ranks[0].sync(sync_backend=FakeSync([s.metric_state for s in ranks], 0))
+        issued = M.executable_cache_stats()["collectives_issued"] - before
+        buckets = {
+            (str(ranks[0]._reductions[k]), str(getattr(ranks[0], k).dtype))
+            for k in ranks[0]._defaults
+        }
+        # MeanMetric stack: (SUM,f32)={value,weight}, (MAX,bool)={tenant_valid},
+        # (SUM,i32)={tenant_count} — 3 collectives, for 2 tenants or 8
+        assert issued == len(buckets) == 3
+
+
+@pytest.mark.parametrize(
+    "policy",
+    [
+        SyncPolicy(),
+        SyncPolicy(gather="all_gather"),
+        SyncPolicy(gather="all_gather", reduce_scatter_threshold=1),
+        SyncPolicy(gather="all_gather", quantize_bits=8, quantize_threshold=1, quantize_chunk=1),
+    ],
+    ids=["dense", "all_gather", "reduce_scatter", "quantized"],
+)
+def test_in_graph_sync_route_parity(policy):
+    """Stacked leaves through every SyncPolicy route == the per-tenant loop
+    through the same route, bitwise (quantize_chunk=1 makes the quantized
+    wire format element-local, so the layouts can't diverge)."""
+    n_tenants = 3
+    ranks, fleet = _mean_world(n_tenants=n_tenants, seed=7)
+    reds = dict(ranks[0]._reductions)
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *[dict(s.metric_state) for s in ranks]
+    )
+    out = jax.vmap(
+        lambda s: reduce_state_in_graph(s, reds, "dp", policy=policy), axis_name="dp"
+    )(stacked)
+    names = list(fleet[0][0]._defaults)
+    for i in range(n_tenants):
+        reds_i = dict(fleet[0][i]._reductions)
+        st_i = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *[dict(fleet[r][i].metric_state) for r in range(WORLD)]
+        )
+        ref = jax.vmap(
+            lambda s: reduce_state_in_graph(s, reds_i, "dp", policy=policy), axis_name="dp"
+        )(st_i)
+        for name in names:
+            np.testing.assert_array_equal(
+                np.asarray(out[name][0, i]), np.asarray(ref[name][0])
+            )
+
+
+def test_sketch_template_stacks_and_merges():
+    from torchmetrics_tpu import ApproxQuantile
+
+    def _mk():
+        return ApproxQuantile(q=0.5, compression=64)
+
+    rng = np.random.RandomState(11)
+    ranks = [TenantStack(_mk(), tenants=["p", "q"]) for _ in range(WORLD)]
+    fleet = [[_mk() for _ in range(2)] for _ in range(WORLD)]
+    for r in range(WORLD):
+        batch = jnp.asarray(rng.rand(ranks[r].slots, 200).astype(np.float32))
+        ranks[r].update(batch)
+        for i in range(2):
+            fleet[r][i].update(batch[i])
+    ranks[0].sync(sync_backend=FakeSync([s.metric_state for s in ranks], 0))
+    out = ranks[0].compute()
+    for i in range(2):
+        ms = [fleet[r][i] for r in range(WORLD)]
+        ms[0].sync(sync_backend=FakeSync([m.metric_state for m in ms], 0))
+        assert float(out[i]) == float(ms[0].compute())
+
+
+def test_windowed_and_decayed_templates_stack():
+    from torchmetrics_tpu import DecayedMean, WindowedMean
+
+    rng = np.random.RandomState(13)
+    for mk in (lambda: WindowedMean(horizon=8, slots=4), lambda: DecayedMean(halflife=8.0)):
+        stack = TenantStack(mk(), tenants=[0, 1])
+        fleet = [mk() for _ in range(2)]
+        for _ in range(5):
+            batch = jnp.asarray(rng.rand(stack.slots, 6).astype(np.float32))
+            stack.update(batch)
+            for i in range(2):
+                fleet[i].update(batch[i])
+        out = stack.compute()
+        for i in range(2):
+            assert float(out[i]) == float(fleet[i].compute())
+
+
+def test_buffered_stack_matches_eager():
+    eager = TenantStack(MeanMetric(), tenants=[0, 1, 2])
+    buffered = TenantStack(MeanMetric(), tenants=[0, 1, 2]).buffered(window=4)
+    rng = np.random.RandomState(19)
+    for _ in range(6):  # one scanned flush at 4 staged + 2 left pending
+        batch = jnp.asarray(rng.rand(4, 3).astype(np.float32))
+        eager.update(batch)
+        buffered.update(batch)
+    np.testing.assert_array_equal(
+        np.asarray(eager.compute()), np.asarray(buffered.compute())
+    )
+
+
+# ------------------------------------------------------------- slot churn
+def test_add_tenant_grows_at_pow2_and_preserves_state():
+    stack = TenantStack(MeanMetric(), tenants=["a", "b"])
+    assert stack.slots == 2
+    stack.update(jnp.full((2, 3), 2.0, jnp.float32))
+    stack.add_tenant("c")
+    assert stack.slots == 4 and stack.slot_of("c") == 2
+    res = stack.results()
+    assert float(res["a"]) == 2.0 and float(res["b"]) == 2.0
+    with pytest.raises(ValueError):
+        stack.update(jnp.full((2, 3), 4.0, jnp.float32))  # stale slot axis
+    stack.update(jnp.full((4, 3), 4.0, jnp.float32))
+    res = stack.results()
+    assert float(res["c"]) == 4.0
+    assert float(res["a"]) == 3.0  # (3·2 + 3·4) / 6
+
+
+def test_remove_tenant_resets_slot_and_frees_it():
+    stack = TenantStack(MeanMetric(), tenants=["a", "b"])
+    stack.update(jnp.ones((2, 3), jnp.float32))
+    slot = stack.remove_tenant("a")
+    assert slot == 0 and stack.tenant_ids == ("b",)
+    # the freed slot is back at the defaults — no ghost tenant in later syncs
+    assert float(stack.tenant_count[slot]) == 0
+    assert not bool(stack.tenant_valid[slot])
+    assert stack.add_tenant("z") == slot
+    assert float(stack.results()["b"]) == 1.0
+    with pytest.raises(TorchMetricsUserError):
+        stack.add_tenant("z")
+    with pytest.raises(TorchMetricsUserError):
+        stack.remove_tenant("never-there")
+
+
+def test_churn_within_capacity_zero_retraces_under_strict_mode():
+    stack = TenantStack(MeanMetric(), tenants=[0, 1, 2], capacity=4)
+    rng = np.random.RandomState(23)
+    feed = [jnp.asarray(rng.rand(stack.slots, 3).astype(np.float32)) for _ in range(2)]
+    stack.update(feed[0])  # warm the update executable
+    stack.add_tenant(3)  # warm both slot-kernel directions at this capacity
+    stack.remove_tenant(3)
+    before = M.executable_cache_stats()["retraces"]
+    with strict_mode(max_new_executables=0):
+        stack.add_tenant(3)
+        stack.update(feed[1])
+        stack.remove_tenant(0)
+        stack.update(feed[0])
+    assert M.executable_cache_stats()["retraces"] == before
+    assert stack.tenant_ids == (1, 2, 3)
+
+
+# ------------------------------------------- executable / profile identity
+def test_executable_key_tracks_slots_not_roster():
+    a = TenantStack(MeanMetric(), tenants=[0, 1])
+    b = TenantStack(MeanMetric(), tenants=["x", "y"])  # same config, other ids
+    c = TenantStack(MeanMetric(), tenants=[0, 1], capacity=4)
+    assert a._executable_cache_key() == b._executable_cache_key()
+    assert c._executable_cache_key() != a._executable_cache_key()
+
+    from torchmetrics_tpu.observability.autotune import (
+        ProfileCache,
+        metric_set_key,
+        topology_key,
+    )
+
+    topo = topology_key(world=1)
+    key = lambda m: ProfileCache.profile_key(topo, metric_set_key(m))  # noqa: E731
+    assert key(a) == key(b)
+    assert key(c) != key(a)
+
+
+def test_ledger_renders_stacked_executables():
+    from torchmetrics_tpu.observability.ledger import attribute_key, describe_key
+
+    stack = TenantStack(_mcls(), tenants=list(range(256)))
+    key = ("update", stack._executable_cache_key())
+    assert describe_key(key) == "update[TenantStack[MulticlassAccuracy]×256]"
+    attrs = attribute_key(key)
+    assert attrs["tenant_slots"] == 256
+    plain = ("update", MeanMetric()._executable_cache_key())
+    assert attribute_key(plain)["tenant_slots"] is None
+    assert describe_key(plain) == "update[MeanMetric]"
+
+
+# ------------------------------------------------------ checkpoint / chaos
+def test_stack_checkpoint_rejoin_under_chaos():
+    from torchmetrics_tpu.parallel import ChaosSchedule, ElasticSync, chaos_group
+    from torchmetrics_tpu.parallel.elastic import checkpoint_metric, rejoin_metric
+
+    tenants = ["a", "b", "c"]
+    rng = np.random.RandomState(17)
+
+    def _mk():
+        return TenantStack(MeanMetric(), tenants=tenants)
+
+    data = [jnp.asarray(rng.rand(_mk().slots, 4).astype(np.float32)) for _ in range(WORLD)]
+
+    ref = [_mk() for _ in range(WORLD)]
+    for r in range(WORLD):
+        ref[r].update(data[r])
+    ref[0].sync(sync_backend=FakeSync([m.metric_state for m in ref], 0))
+    fault_free = {t: float(v) for t, v in ref[0].results().items()}
+
+    ranks = [_mk() for _ in range(WORLD)]
+    for r in range(WORLD):
+        ranks[r].update(data[r])
+    revived = rejoin_metric(checkpoint_metric(ranks[1]))  # preempt + rehydrate
+    assert isinstance(revived, TenantStack)
+    assert revived.tenant_ids == tuple(tenants)
+
+    sched = ChaosSchedule({0: [("timeout", 1)]})  # rank 1 times out once
+    backs = chaos_group([ranks[0].metric_state, revived.metric_state], sched)
+    for r, m_ in enumerate((ranks[0], revived)):
+        m_._sync_backend = ElasticSync(backs[r], policy=SyncPolicy(retry_attempts=1))
+    backs[0].controller.advance()
+    got = {t: float(v) for t, v in ranks[0].results().items()}
+    assert got == fault_free  # one retry recovers the full-coverage result
+
+    revived.unsync()
+    revived.add_tenant("d")  # the rejoined stack keeps accepting churn
+    assert revived.slots == 4 and "d" in revived.tenant_ids
+
+
+def test_stack_pickle_roundtrip_keeps_roster_and_state():
+    stack = TenantStack(MeanMetric(), tenants=["a", "b"])
+    stack.update(jnp.ones((2, 3), jnp.float32))
+    clone = pickle.loads(pickle.dumps(stack))
+    assert clone.tenant_ids == ("a", "b")
+    assert float(clone.results()["a"]) == 1.0
+    clone.update(jnp.full((2, 3), 3.0, jnp.float32))
+    assert float(clone.results()["a"]) == 2.0
+
+
+# ------------------------------------------------------------- error paths
+def test_stack_rejects_bad_templates_and_inputs():
+    with pytest.raises(ValueError):
+        TenantStack(CatMetric(), tenants=[0])  # ragged cat/list state
+    primed = MeanMetric()
+    primed.update(jnp.asarray([1.0]))
+    with pytest.raises(ValueError):
+        TenantStack(primed, tenants=[0])  # accumulated state
+    with pytest.raises(ValueError):
+        TenantStack(MeanMetric(), tenants=[0, 0])  # duplicate ids
+    with pytest.raises(TypeError):
+        TenantStack(object(), tenants=[0])
+    stack = TenantStack(MeanMetric(), tenants=[0, 1])
+    with pytest.raises(ValueError):
+        stack.update(jnp.ones((3, 2), jnp.float32))  # wrong leading axis
+    with pytest.raises(ValueError):
+        stack.update(jnp.float32(1.0))  # scalar has no tenant axis
+
+
+def test_reserved_state_name_rejected():
+    class Weird(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("tenant_valid", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+        def update(self, x):
+            self.tenant_valid = self.tenant_valid + jnp.sum(x)
+
+        def compute(self):
+            return self.tenant_valid
+
+    with pytest.raises(ValueError):
+        TenantStack(Weird(), tenants=[0])
+
+
+# ----------------------------------------------------- MetricState pytree
+def test_metric_state_pytree_roundtrip_keeps_metadata():
+    st = MetricState()
+    st.register("a", Reduction.SUM)
+    st["a"] = jnp.ones((2,), jnp.float32)
+    st.register("b", Reduction.MAX)
+    st["b"] = jnp.zeros((3,), jnp.float32)
+    leaves, treedef = jax.tree_util.tree_flatten(st)
+    rebuilt = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert isinstance(rebuilt, MetricState)
+    assert rebuilt.reduction("a") is Reduction.SUM
+    doubled = jax.tree_util.tree_map(lambda x: 2.0 * x, st)
+    assert isinstance(doubled, MetricState)
+    assert float(doubled["a"][0]) == 2.0
+    assert doubled.reduction("b") is Reduction.MAX
+
+
+def test_reduce_state_in_graph_derives_reductions_from_metric_state():
+    per_rank = []
+    for r in range(WORLD):
+        m_ = MeanMetric()
+        m_.update(jnp.asarray([float(r + 1)]))
+        per_rank.append(m_.as_state())
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_rank)
+    out = jax.vmap(
+        lambda s: reduce_state_in_graph(s, axis_name="dp"), axis_name="dp"
+    )(stacked)
+    assert isinstance(out, MetricState)
+    assert float(out["value"][0]) == 3.0  # 1 + 2, summed across the world
+
+
+# --------------------------------------------- label_results + regressions
+def test_label_results_contract():
+    vals = jnp.asarray([1.0, 2.0, 3.0])
+    assert {k: float(v) for k, v in label_results(vals).items()} == {
+        "0": 1.0, "1": 2.0, "2": 3.0,
+    }
+    named = label_results(vals, labels=["a", "b", "c"], prefix="m_", postfix="!")
+    assert set(named) == {"m_a!", "m_b!", "m_c!"}
+    tree = label_results({"x": vals, "y": vals * 10}, labels=["p", "q", "r"])
+    assert float(tree["q"]["y"]) == 20.0
+    with pytest.raises(ValueError):
+        label_results(vals, labels=["only", "two"])
+    assert label_results({}) == {}
+
+
+def test_classwise_wrapper_matches_manual_loop():
+    from torchmetrics_tpu import ClasswiseWrapper
+
+    n_cls = 3
+    w = ClasswiseWrapper(MulticlassAccuracy(num_classes=n_cls, average="none"))
+    twin = MulticlassAccuracy(num_classes=n_cls, average="none")
+    rng = np.random.RandomState(29)
+    preds = jnp.asarray(rng.rand(12, n_cls).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, n_cls, 12).astype(np.int32))
+    w.update(preds, target)
+    twin.update(preds, target)
+    vals = twin.compute()
+    manual = {f"multiclassaccuracy_{i}": float(vals[i]) for i in range(n_cls)}
+    got = {k: float(v) for k, v in w.compute().items()}
+    assert got == manual  # the deleted per-key loop, reproduced bitwise
+
+
+def test_group_stat_rates_match_manual_loop():
+    from torchmetrics_tpu.functional.classification.group_fairness import (
+        binary_groups_stat_rates,
+    )
+
+    rng = np.random.RandomState(31)
+    preds_np = rng.rand(64).astype(np.float32)
+    target_np = rng.randint(0, 2, 64).astype(np.int32)
+    groups_np = rng.randint(0, 2, 64).astype(np.int32)
+    out = binary_groups_stat_rates(
+        jnp.asarray(preds_np), jnp.asarray(target_np), jnp.asarray(groups_np),
+        num_groups=2,
+    )
+    assert set(out) == {"group_0", "group_1"}
+    p_bin = (preds_np >= 0.5).astype(np.int64)
+    for g in range(2):
+        sel = groups_np == g
+        p, t = p_bin[sel], target_np[sel]
+        counts = np.asarray(
+            [
+                np.sum((p == 1) & (t == 1)),  # tp
+                np.sum((p == 1) & (t == 0)),  # fp
+                np.sum((p == 0) & (t == 0)),  # tn
+                np.sum((p == 0) & (t == 1)),  # fn
+            ],
+            dtype=np.float32,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out[f"group_{g}"]), counts / counts.sum(), rtol=1e-6
+        )

@@ -681,3 +681,57 @@ def test_tpu010_non_int_dict_passes(tmp_path):
             _CALIBRATION["nb"] = 2.2
     """)
     assert "TPU010" not in _rules(res)
+
+
+# --------------------------------------------------------------------- TPU011
+def test_tpu011_per_tenant_update_loop_flagged(tmp_path):
+    res = _lint_fixture(tmp_path, kernel_src="""
+        def _fleet_update(per_tenant_metrics, preds, target):
+            for tid, m in per_tenant_metrics.items():
+                m.update(preds[tid], target[tid])
+    """)
+    assert "TPU011" in _rules(res)
+
+
+def test_tpu011_cohort_compute_loop_flagged(tmp_path):
+    res = _lint_fixture(tmp_path, kernel_src="""
+        def _fleet_update(cohorts):
+            out = {}
+            for name, m in cohorts.items():
+                out[name] = m.compute()
+            return out
+    """)
+    assert "TPU011" in _rules(res)
+
+
+def test_tpu011_stacked_vmap_body_passes(tmp_path):
+    # the TenantStack rewrite: one vmapped update over the slot axis
+    res = _lint_fixture(tmp_path, kernel_src="""
+        import jax
+
+        def _fleet_update(stack, stacked_state, preds, target):
+            return jax.vmap(stack.pure_update)(stacked_state, preds, target)
+    """)
+    assert "TPU011" not in _rules(res)
+    assert not res.new_violations
+
+
+def test_tpu011_collection_member_loop_passes(tmp_path):
+    # iterating a MetricCollection's own members is the supported fused
+    # path, not a per-tenant fan-out — the name heuristic must not match
+    res = _lint_fixture(tmp_path, kernel_src="""
+        def _collection_update(metrics, preds, target):
+            for name, m in metrics.items():
+                m.update(preds, target)
+    """)
+    assert "TPU011" not in _rules(res)
+
+
+def test_tpu011_host_only_loop_passes(tmp_path):
+    # per-tenant loops outside any jit-reachable path are eager-layer code
+    res = _lint_fixture(tmp_path, metrics_src="""
+        def export_scrape(per_tenant_metrics):
+            for tid, m in per_tenant_metrics.items():
+                m.compute()
+    """, root_kinds=("update", "kernel"))
+    assert "TPU011" not in _rules(res)

@@ -19,6 +19,7 @@ directly; TPU005 scans all functions (donation misuse is an eager-layer bug).
 | TPU008 | no list-state concat in a traced path (use the padded layout)     |
 | TPU009 | no blocking host collective without a timeout/retry policy        |
 | TPU010 | no ad-hoc module-level counter dicts (use observability.registry) |
+| TPU011 | no per-tenant metric loop in a traced path (use TenantStack)      |
 """
 from __future__ import annotations
 
@@ -36,7 +37,7 @@ from .callgraph import (
 )
 from .corpus import ClassInfo, Corpus, FunctionInfo, ModuleInfo
 
-ALL_RULES = ("TPU000", "TPU001", "TPU002", "TPU003", "TPU004", "TPU005", "TPU006", "TPU007", "TPU008", "TPU009", "TPU010")
+ALL_RULES = ("TPU000", "TPU001", "TPU002", "TPU003", "TPU004", "TPU005", "TPU006", "TPU007", "TPU008", "TPU009", "TPU010", "TPU011")
 
 RULE_TITLES = {
     "TPU000": "malformed waiver",
@@ -50,6 +51,7 @@ RULE_TITLES = {
     "TPU008": "list-state concat in a traced path",
     "TPU009": "blocking host collective without timeout/retry policy",
     "TPU010": "ad-hoc module-level counter dict (use observability.registry)",
+    "TPU011": "per-tenant metric loop in a traced path (use TenantStack)",
 }
 
 
@@ -235,7 +237,45 @@ def check_traced_rules(fn: FunctionInfo, corpus: Corpus, roots: Set[str]) -> Lis
                                 " bucket (see reduce_state_in_graph)",
                             )
 
+        # ---- TPU011: per-tenant metric loop in a traced path ---------
+        if isinstance(node, ast.For) and _mentions_tenant_name(node.iter):
+            for stmt in node.body:
+                for sub in ast.walk(stmt):
+                    if (
+                        isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr in ("update", "forward", "compute")
+                    ):
+                        emit(
+                            "TPU011", sub,
+                            f"`.{sub.func.attr}()` dispatched per tenant inside a Python"
+                            " loop over a per-tenant/per-cohort metric table: N tenants"
+                            " pay N dispatches and N collectives per sync — stack the"
+                            " tenants along a leading slot axis and vmap the fused"
+                            " update body (see multitenant.TenantStack)",
+                        )
+
     return out
+
+
+# per-tenant table hints: deliberately does NOT match "metric" — a
+# MetricCollection iterating its own members eagerly is the supported
+# fused-dispatch path, not the per-tenant fan-out TPU011 flags
+_TENANT_HINTS = ("tenant", "cohort", "per_")
+
+
+def _mentions_tenant_name(expr: ast.expr) -> bool:
+    """Loop iterable ranging over a per-tenant metric table (name contains
+    'tenant'/'cohort'/'per_')."""
+    for sub in ast.walk(expr):
+        name = None
+        if isinstance(sub, ast.Name):
+            name = sub.id
+        elif isinstance(sub, ast.Attribute):
+            name = sub.attr
+        if name and any(h in name.lower() for h in _TENANT_HINTS):
+            return True
+    return False
 
 
 def _mentions_state_name(expr: ast.expr) -> bool:

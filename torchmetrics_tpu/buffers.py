@@ -50,6 +50,13 @@ def _capacity_for(rows: int) -> int:
     return max(_next_pow2(rows), MIN_CAPACITY)
 
 
+# public aliases: the pow2 shape-stability trick is shared infrastructure —
+# tenant slots (multitenant.py) pad to the same geometric capacities as cat
+# rows, so churn within capacity never changes a traced shape
+next_pow2 = _next_pow2
+capacity_for = _capacity_for
+
+
 def _row_form(inc: Any) -> Array:
     """Increment as (rows,) + trailing — scalars become a single row,
     matching ``dim_zero_cat``'s ``atleast_1d`` semantics."""

@@ -221,10 +221,10 @@ class MetricCollection:
             for name in members[1:]:
                 m = self._metrics[name]
                 if copy:
-                    object.__setattr__(m, "_state", deepcopy(rep.__dict__["_state"]))
+                    object.__setattr__(m, "_state", deepcopy(rep._state_view()))
                     m._update_count = rep._update_count
                 else:
-                    object.__setattr__(m, "_state", rep.__dict__["_state"])
+                    object.__setattr__(m, "_state", rep._state_view())
                     m._update_count = rep._update_count
         self._state_is_copy = copy
 
@@ -357,7 +357,7 @@ class MetricCollection:
             rep._update_count += 1
             rep._eager_validate(*args, **_filter_kwargs(rep._update_impl, **kwargs))
             st: Dict[str, Any] = {}
-            for k, v in rep.__dict__["_state"].items():
+            for k, v in rep._state_view().items():
                 if k in rep._list_states:
                     continue
                 if isinstance(v, jax.Array):
@@ -368,7 +368,7 @@ class MetricCollection:
             states[name] = st
         new_states, appends = fused_fn(states, args, kwargs)
         for name, rep in fused:
-            st = rep.__dict__["_state"]  # shared dict: members see it too
+            st = rep._state_view()  # shared MetricState: members see it too
             for k, v in new_states[name].items():
                 st[k] = v
             rep._extend_list_states(appends[name])
@@ -454,7 +454,7 @@ class MetricCollection:
         regroup = enable != self._enable_compute_groups or manual != self._manual_groups
         for m in self._metrics.values():
             if regroup:
-                m.__dict__["_state"] = {}  # un-share: discovery needs independent states
+                m._install_state({})  # un-share: discovery needs independent states
             m.reset()
         if regroup:
             self._enable_compute_groups = enable

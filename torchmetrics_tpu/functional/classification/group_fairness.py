@@ -36,9 +36,13 @@ def _groups_stat_update(
 
 
 def _groups_stat_scores_compute(group_stats: Array) -> Dict[str, Array]:
+    # groups are a degenerate tenant axis: rates carry groups along the
+    # leading stacked axis and labelling is the shared label_results idiom
+    from ...multitenant import label_results
+
     total = jnp.sum(group_stats, axis=1, keepdims=True)
     rates = _safe_divide(group_stats, total)
-    return {f"group_{i}": rates[i] for i in range(group_stats.shape[0])}
+    return label_results(rates, prefix="group_")
 
 
 def binary_groups_stat_rates(

@@ -39,7 +39,7 @@ import inspect
 import itertools
 import sys
 from contextlib import contextmanager
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -62,6 +62,7 @@ from .parallel.strategies import (
 )
 from .parallel.elastic import elastic_stats, reset_elastic_stats
 from .parallel.sync import NoSync, SyncBackend, default_sync_backend, reduce_state_in_graph
+from .state import MetricState
 from .utils.data import dim_zero_cat
 from .utils.exceptions import TorchMetricsUserError
 from .utils.prints import rank_zero_warn
@@ -178,6 +179,15 @@ _RUNTIME_ATTRS = frozenset(
         "compute_with_cache",
     }
 )
+
+
+def _runtime_attrs_for(cls: type) -> frozenset:
+    """Attributes excluded from executable-key scanning for ``cls``.
+
+    Subclasses with their own host-side bookkeeping (e.g. ``TenantStack``'s
+    tenant-id table) extend the base set via ``_extra_runtime_attrs``."""
+    extra = getattr(cls, "_extra_runtime_attrs", None)
+    return _RUNTIME_ATTRS | extra if extra else _RUNTIME_ATTRS
 
 
 class _Unkeyable(Exception):
@@ -386,6 +396,10 @@ class Metric:
 
     __jit_state_names__: Tuple[str, ...] = ()
 
+    # subclass hook: extra attribute names excluded from executable-key
+    # scanning (host-side bookkeeping that never changes the traced program)
+    _extra_runtime_attrs: frozenset = frozenset()
+
     is_differentiable: Optional[bool] = None
     higher_is_better: Optional[bool] = None
     full_state_update: Optional[bool] = False
@@ -437,9 +451,10 @@ class Metric:
             raise ValueError(f"Unexpected keyword arguments: {sorted(kwargs)}")
         if list_layout not in ("padded", "list"):
             raise ValueError(f"list_layout must be 'padded' or 'list', got {list_layout!r}")
-        # bypass __setattr__ guards during bootstrap
+        # bypass __setattr__ guards during bootstrap; state lives in ONE
+        # explicit MetricState pytree — the class below is a thin view on it
         object.__setattr__(self, "_defaults", {})
-        object.__setattr__(self, "_state", {})
+        object.__setattr__(self, "_state", MetricState())
         self._reductions: Dict[str, Union[Reduction, Callable]] = {}
         self._persistent: Dict[str, bool] = {}
         self._list_states: set = set()
@@ -513,7 +528,10 @@ class Metric:
         self._defaults[name] = [] if name in self._list_states else value
         self._reductions[name] = red
         self._persistent[name] = persistent
-        self._state[name] = [] if name in self._list_states else value
+        st = self.__dict__["_state"]
+        if isinstance(st, MetricState):
+            st.register(name, red, list_state=name in self._list_states)
+        st[name] = [] if name in self._list_states else value
         self._invalidate_executable_key()
 
     # attribute routing: registered states live in self._state
@@ -645,7 +663,7 @@ class Metric:
         self.update(*args, **kwargs)  # batch-only state
         with self.sync_context(should_sync=self.dist_sync_on_step):
             batch_val = _squeeze_if_scalar(self._compute_impl())
-        self._state = cache
+        self._install_state(cache)
         self._update_count = count
         self._computed = None
         return batch_val
@@ -885,8 +903,54 @@ class Metric:
         return out
 
     # ------------------------------------------------------------------
-    # eager state plumbing
+    # eager state plumbing — every read/write goes through ONE MetricState
     # ------------------------------------------------------------------
+    def _state_view(self) -> MetricState:
+        """The live :class:`MetricState`, without flushing staged updates.
+
+        Grouped collections and legacy pickles occasionally install a plain
+        dict as ``_state``; the view re-wraps it in place with this metric's
+        reduction/layout metadata so downstream layers (streaming, sync,
+        multitenant) always observe an explicit MetricState."""
+        st = self.__dict__["_state"]
+        if not isinstance(st, MetricState):
+            st = MetricState(
+                st, reductions=self._reductions, list_states=self._list_states
+            )
+            object.__setattr__(self, "_state", st)
+        return st
+
+    def _install_state(self, mapping: Mapping) -> None:
+        """Replace ``_state`` with a fresh MetricState over ``mapping``."""
+        object.__setattr__(
+            self,
+            "_state",
+            MetricState(
+                mapping, reductions=self._reductions, list_states=self._list_states
+            ),
+        )
+
+    def as_state(self) -> MetricState:
+        """Current state as an explicit :class:`MetricState` pytree.
+
+        Flushes staged streaming updates first, then returns the live state
+        (leaves are shared, not copied). The returned object is a registered
+        pytree: it can be passed to ``jit``/``vmap``/``shard_map`` directly
+        and to :func:`~torchmetrics_tpu.parallel.sync.reduce_state_in_graph`
+        without a separate reductions mapping."""
+        self._flush_pending()
+        return self._state_view()
+
+    def load_state(self, state: Mapping) -> None:
+        """Install leaf values from a mapping / MetricState (shared leaves)."""
+        self._flush_pending()
+        view = self._state_view()
+        for name, v in state.items():
+            if name not in self._defaults:
+                raise KeyError(f"Unexpected state {name!r} for {type(self).__name__}")
+            view[name] = v
+        self._computed = None
+
     def _tensor_state(self) -> StateDict:
         return {k: v for k, v in self._state.items() if k not in self._list_states}
 
@@ -1026,11 +1090,12 @@ class Metric:
         cached = self.__dict__.get("_exec_key_cache")
         if cached is not None:
             return cached
+        runtime = _runtime_attrs_for(type(self))
         try:
             cfg = tuple(
                 (k, _freeze_config_value(v))
                 for k, v in sorted(self.__dict__.items())
-                if k not in _RUNTIME_ATTRS
+                if k not in runtime
             )
             defaults = []
             for k in sorted(self._defaults):
@@ -1292,7 +1357,7 @@ class Metric:
             return
         if self._cache is None:
             raise TorchMetricsUserError("The Metric has no cache to restore from.")
-        self._state = dict(self._cache)
+        self._install_state(self._cache)
         self._cache = None
         self._is_synced = False
 
@@ -1445,6 +1510,8 @@ class Metric:
         ):
             if attr not in self.__dict__:
                 object.__setattr__(self, attr, factory())
+        # legacy pickles carry a plain state dict — normalize to MetricState
+        self._state_view()
 
     def _cat_state_digest(self, name: str, value: Any) -> bytes:
         """Incremental digest of a cat state's content.

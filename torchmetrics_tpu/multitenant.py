@@ -1,0 +1,441 @@
+"""Vectorized multi-tenant metric stacks: N cohorts, one executable.
+
+Production evaluation runs thousands of concurrent metric sets — one per
+user cohort, A/B arm, model variant, language slice. Updating them as N
+independent ``Metric``/``MetricCollection`` objects pays N dispatches per
+step and N collectives per sync even when every tenant runs the *same*
+metric configuration. :class:`TenantStack` removes that multiplier:
+
+- N homogeneous tenants' states are stacked along a leading tenant axis
+  into ONE :class:`~torchmetrics_tpu.state.MetricState`, so the whole
+  fleet travels through jit as one pytree;
+- the fused update body ``vmap``-s the template's pure update over the
+  tenant axis — ONE executable and ONE dispatch per step regardless of N;
+- sync sees stacked leaves as single leaves, so the bucketed gather in
+  ``parallel/sync.py`` still issues ONE collective per
+  ``(Reduction, dtype)`` bucket — not per tenant;
+- tenant churn (add/remove) flips a slot in a ``tenant_valid`` mask via a
+  pre-compiled slot kernel over power-of-two padded slots (the CatBuffer
+  shape-stability trick): no shape ever changes within a capacity, so
+  churn never retraces.
+
+``windowed()``/``decayed()``/sketch-backed templates stack for free: their
+states are fixed-shape arrays, and mergeable sketch reductions are lifted
+per-slot with :class:`~torchmetrics_tpu.state.StackedMerge`.
+
+``ClasswiseWrapper`` and the group-fairness metrics are degenerate tenant
+stacks (classes → tenant axis, groups → tenant axis): their per-key result
+labelling shares :func:`label_results` with :meth:`TenantStack.results`.
+"""
+from typing import Any, Callable, Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .buffers import next_pow2
+from .metric import Metric, _filter_kwargs
+from .parallel.reduction import Reduction
+from .state import StackedMerge
+from .utils.exceptions import TorchMetricsUserError
+
+Array = jax.Array
+
+__all__ = ["TenantStack", "label_results"]
+
+# slot axes pad to pow2 capacities like cat buffers do, but a stack of 2
+# tenants should not pay an 8-slot floor the way cat rows do
+_MIN_SLOTS = 2
+
+_RESERVED_STATE_NAMES = frozenset({"tenant_valid", "tenant_count", "slots", "template"})
+
+
+def _slot_capacity(n: int) -> int:
+    return max(next_pow2(max(int(n), 1)), _MIN_SLOTS)
+
+
+def label_results(
+    values: Any,
+    labels: Optional[Sequence[Any]] = None,
+    prefix: str = "",
+    postfix: str = "",
+) -> Dict[str, Any]:
+    """Label a leading stacked axis into a ``{name: value}`` dict.
+
+    The single labelling idiom for every "stack → per-key dict" surface:
+    tenant stacks (:meth:`TenantStack.results`), classwise wrappers
+    (classes → tenant axis), and group-fairness rates (groups → tenant
+    axis). ``values`` is an array (or pytree of arrays) whose leading axis
+    is the stacked axis; ``labels`` defaults to positional indices.
+    """
+    leaves = jax.tree_util.tree_leaves(values)
+    if not leaves:
+        return {}
+    n = leaves[0].shape[0]
+    keys = list(labels) if labels is not None else list(range(n))
+    if len(keys) != n:
+        raise ValueError(f"got {len(keys)} labels for a stacked axis of {n}")
+    return {
+        f"{prefix}{key}{postfix}": jax.tree_util.tree_map(lambda x: x[i], values)
+        for i, key in enumerate(keys)
+    }
+
+
+def _check_stackable(metric: Metric, what: str) -> None:
+    if not type(metric).jittable or not metric._use_jit:
+        raise ValueError(
+            f"cannot stack {what}: the fused tenant update vmaps the update "
+            "body in-graph, so it must be jittable."
+        )
+    if metric._list_states:
+        raise ValueError(
+            f"cannot stack {what}: cat/list states are ragged per tenant; "
+            "use a sketch-backed state (reservoir/tdigest/countmin) instead."
+        )
+    if metric.update_count:
+        raise ValueError(
+            f"cannot stack {what} with accumulated state; stack a fresh "
+            "template (or reset() it first) — every slot starts from the "
+            "state defaults."
+        )
+
+
+class _TemplateView:
+    """Uniform pure-functional adapter over a Metric or MetricCollection.
+
+    Flattens the template into ``members`` — ``(display_name, prefix,
+    metric)`` triples — with member state names disambiguated by prefix, so
+    the stack sees one flat ``{prefixed_name: default}`` namespace
+    regardless of template shape.
+    """
+
+    def __init__(self, template: Any) -> None:
+        from .collections import MetricCollection  # deferred: import cycle
+
+        if isinstance(template, MetricCollection):
+            self.is_collection = True
+            self.members: List[Tuple[str, str, Metric]] = [
+                (name, f"{name}__", m) for name, m in template._metrics.items()
+            ]
+            if not self.members:
+                raise ValueError("cannot stack an empty MetricCollection")
+        elif isinstance(template, Metric):
+            self.is_collection = False
+            self.members = [("", "", template)]
+        else:
+            raise TypeError(
+                f"TenantStack template must be a Metric or MetricCollection, "
+                f"got {type(template).__name__}"
+            )
+        for display, _, m in self.members:
+            _check_stackable(m, f"{type(m).__name__} ({display or 'template'})")
+        self.defaults: Dict[str, Array] = {}
+        self.reductions: Dict[str, Any] = {}
+        for _, prefix, m in self.members:
+            for name, default in m._defaults.items():
+                full = prefix + name
+                if full in _RESERVED_STATE_NAMES:
+                    raise ValueError(
+                        f"state name {full!r} collides with TenantStack internals"
+                    )
+                self.defaults[full] = jnp.asarray(default)
+                self.reductions[full] = m._reductions[name]
+
+    def pure_update(self, state: Mapping[str, Array], args: tuple, kwargs: dict) -> Dict[str, Array]:
+        """One tenant's update: template state in, template state out. Pure."""
+        out = dict(state)
+        for _, prefix, m in self.members:
+            sub = {name: state[prefix + name] for name in m._defaults}
+            kw = _filter_kwargs(m._update_impl, **kwargs)
+            new_sub, _appends = m._pure_update(sub, args, kw)
+            for name, v in new_sub.items():
+                out[prefix + name] = v
+        return out
+
+    def pure_compute(self, state: Mapping[str, Array]) -> Any:
+        """One tenant's compute over an explicit state. Pure."""
+        results: Dict[str, Any] = {}
+        for display, prefix, m in self.members:
+            sub = {name: state[prefix + name] for name in m._defaults}
+            value = m._pure_compute(sub, {})
+            if not self.is_collection:
+                return value
+            results[display] = value
+        return results
+
+
+class TenantStack(Metric):
+    """N homogeneous metric sets stacked along a leading tenant axis.
+
+    One ``TenantStack`` replaces N copies of a template metric (or
+    collection): every state leaf gains a leading ``(slots,)`` axis, the
+    update body is the template's pure update ``vmap``-ed over that axis,
+    and sync reduces the stacked leaves through the ordinary bucketed
+    collectives — so N tenants cost ONE dispatch per update and ONE
+    collective per ``(Reduction, dtype)`` bucket.
+
+    Slots are padded to the next power of two and gated by a
+    ``tenant_valid`` mask; :meth:`add_tenant`/:meth:`remove_tenant` flip
+    mask slots through one pre-compiled kernel, so tenant churn within a
+    capacity never changes a traced shape (zero retraces under
+    ``strict_mode``). Crossing a pow2 boundary doubles the slot axis — an
+    intentional, O(log N)-rare recompile.
+
+    Updates take the template's arguments with a leading ``(slots, ...)``
+    tenant axis (rows for invalid slots are ignored). Results come back
+    stacked from :meth:`compute`, or labelled per tenant from
+    :meth:`results`.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import MeanMetric, TenantStack
+        >>> stack = TenantStack(MeanMetric(), tenants=["en", "fr"])
+        >>> stack.update(jnp.asarray([[1.0], [10.0]]))  # (slots, batch)
+        >>> res = stack.results()
+        >>> float(res["en"]), float(res["fr"])
+        (1.0, 10.0)
+    """
+
+    full_state_update = True  # the vmapped body reads the state it advances
+    higher_is_better = None
+    is_differentiable = False
+    _extra_runtime_attrs = frozenset({"_view", "_tenant_ids", "_slot_of"})
+
+    def __init__(
+        self,
+        template: Any,
+        tenants: Iterable[Any] = (),
+        capacity: int = _MIN_SLOTS,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        view = _TemplateView(template)
+        tenant_list = list(tenants)
+        if len(set(tenant_list)) != len(tenant_list):
+            raise ValueError("duplicate tenant ids")
+        slots = _slot_capacity(max(len(tenant_list), int(capacity)))
+        object.__setattr__(self, "_view", view)
+        object.__setattr__(
+            self, "_tenant_ids", tenant_list + [None] * (slots - len(tenant_list))
+        )
+        object.__setattr__(
+            self, "_slot_of", {t: i for i, t in enumerate(tenant_list)}
+        )
+        self.template = template
+        self.slots = slots
+        for name, default in view.defaults.items():
+            red = view.reductions[name]
+            slot_red = StackedMerge(red) if getattr(red, "mergeable", False) else red
+            stacked = jnp.array(jnp.broadcast_to(default, (slots,) + jnp.shape(default)))
+            self.add_state(name, default=stacked, dist_reduce_fx=slot_red)
+        self.add_state(
+            "tenant_valid", default=jnp.zeros((slots,), bool), dist_reduce_fx="max"
+        )
+        self.add_state(
+            "tenant_count", default=jnp.zeros((slots,), jnp.int32), dist_reduce_fx="sum"
+        )
+        self._mark_valid_slots()
+
+    # ------------------------------------------------------------------
+    # tenant roster (host-side bookkeeping; device truth is tenant_valid)
+    # ------------------------------------------------------------------
+    @property
+    def tenant_ids(self) -> Tuple[Any, ...]:
+        """Active tenant ids, in slot order."""
+        return tuple(t for t in self._tenant_ids if t is not None)
+
+    @property
+    def n_tenants(self) -> int:
+        return len(self._slot_of)
+
+    def __len__(self) -> int:
+        return len(self._slot_of)
+
+    def slot_of(self, tenant_id: Any) -> int:
+        return self._slot_of[tenant_id]
+
+    def _mark_valid_slots(self) -> None:
+        valid = np.zeros((self.slots,), bool)
+        if self._slot_of:
+            valid[list(self._slot_of.values())] = True
+        self.tenant_valid = jnp.asarray(valid)
+
+    # ------------------------------------------------------------------
+    # slot kernel: ONE executable serves every add/remove at a capacity
+    # ------------------------------------------------------------------
+    def _slot_kernel(
+        self, state: Dict[str, Array], slot: Array, active: Array
+    ) -> Dict[str, Array]:
+        view = self._view
+        out = dict(state)
+        for name, default in view.defaults.items():
+            out[name] = state[name].at[slot].set(default)
+        out["tenant_valid"] = state["tenant_valid"].at[slot].set(active)
+        out["tenant_count"] = state["tenant_count"].at[slot].set(jnp.int32(0))
+        return out
+
+    def _apply_slot(self, slot: int, active: bool) -> None:
+        kernel = self._get_jitted("tenant_slot", self._slot_kernel)
+        state = {name: getattr(self, name) for name in self._defaults}
+        # explicit device_put of the two host scalars: strict_mode's
+        # transfer guard allows explicit transfers, and the traced kernel
+        # stays one executable across every slot index / direction
+        new = kernel(state, jax.device_put(np.int32(slot)), jax.device_put(np.bool_(active)))
+        for name, value in new.items():
+            setattr(self, name, value)
+
+    def warm_slot_kernel(self) -> None:
+        """Pre-compile the add/remove kernel (e.g. before ``strict_mode``).
+
+        Warms against a free slot (a semantic no-op: the slot stays
+        invalid and at its defaults). With no free slot the next add
+        grows to a new capacity — and a new kernel — anyway, so there is
+        nothing worth warming."""
+        if None in self._tenant_ids:
+            self._apply_slot(self._tenant_ids.index(None), False)
+
+    def add_tenant(self, tenant_id: Any) -> int:
+        """Activate a slot for ``tenant_id``; returns the slot index.
+
+        O(1) within capacity (one pre-compiled kernel dispatch); doubles
+        the slot axis when full (an intentional recompile at pow2
+        boundaries only).
+        """
+        self._flush_pending()
+        if tenant_id in self._slot_of:
+            raise TorchMetricsUserError(f"tenant {tenant_id!r} already present")
+        if None not in self._tenant_ids:
+            self._grow()
+        slot = self._tenant_ids.index(None)
+        self._apply_slot(slot, True)
+        self._tenant_ids[slot] = tenant_id
+        self._slot_of[tenant_id] = slot
+        self._computed = None
+        return slot
+
+    def remove_tenant(self, tenant_id: Any) -> int:
+        """Deactivate ``tenant_id``'s slot (state resets to the defaults so
+        later syncs never carry a ghost tenant); returns the freed slot."""
+        self._flush_pending()
+        if tenant_id not in self._slot_of:
+            raise TorchMetricsUserError(f"tenant {tenant_id!r} not present")
+        slot = self._slot_of.pop(tenant_id)
+        self._tenant_ids[slot] = None
+        self._apply_slot(slot, False)
+        self._computed = None
+        return slot
+
+    def _grow(self) -> None:
+        old, new = self.slots, self.slots * 2
+        view = self._view
+        for name, default in view.defaults.items():
+            tail = jnp.array(jnp.broadcast_to(default, (old,) + jnp.shape(default)))
+            self._state[name] = jnp.concatenate([getattr(self, name), tail], axis=0)
+            self._defaults[name] = jnp.concatenate(
+                [jnp.array(jnp.broadcast_to(default, (old,) + jnp.shape(default))), tail],
+                axis=0,
+            )
+        self._state["tenant_valid"] = jnp.concatenate(
+            [self.tenant_valid, jnp.zeros((old,), bool)]
+        )
+        self._state["tenant_count"] = jnp.concatenate(
+            [self.tenant_count, jnp.zeros((old,), jnp.int32)]
+        )
+        self._defaults["tenant_valid"] = jnp.zeros((new,), bool)
+        self._defaults["tenant_count"] = jnp.zeros((new,), jnp.int32)
+        self.slots = new
+        self._tenant_ids.extend([None] * old)
+        self._invalidate_executable_key()
+
+    # ------------------------------------------------------------------
+    # fused dispatch: vmap the template's pure update over the slot axis
+    # ------------------------------------------------------------------
+    def _eager_validate(self, *args: Any, **kwargs: Any) -> None:
+        for a in tuple(args) + tuple(kwargs.values()):
+            shape = jnp.shape(a) if hasattr(a, "shape") else None
+            if shape is not None and (len(shape) == 0 or shape[0] != self.slots):
+                raise ValueError(
+                    f"TenantStack inputs need a leading ({self.slots},) tenant-slot "
+                    f"axis, got shape {shape}; stack per-tenant batches with "
+                    "jnp.stack (rows for empty slots are ignored)."
+                )
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        view = self._view
+        stacked = {name: getattr(self, name) for name in view.defaults}
+        valid = self.tenant_valid
+
+        new_stacked = jax.vmap(
+            lambda state, a, kw: view.pure_update(state, a, kw)
+        )(stacked, tuple(args), dict(kwargs))
+
+        for name, old in stacked.items():
+            sel = valid.reshape((-1,) + (1,) * (old.ndim - 1))
+            self._state[name] = jnp.where(sel, new_stacked[name], old)
+        self.tenant_count = self.tenant_count + valid.astype(jnp.int32)
+
+    def compute(self) -> Any:
+        """Stacked results: each leaf has the ``(slots,)`` tenant axis.
+
+        Rows for invalid slots are computed from the slot defaults; use
+        :meth:`results` for the labelled, valid-only view.
+        """
+        view = self._view
+        stacked = {name: getattr(self, name) for name in view.defaults}
+        return jax.vmap(view.pure_compute)(stacked)
+
+    def results(self) -> Dict[Any, Any]:
+        """Per-tenant labelled results: ``{tenant_id: value}`` (valid slots
+        only — the mask applied to :meth:`compute`'s stacked output)."""
+        out = self.compute()
+        return {
+            tid: jax.tree_util.tree_map(lambda x, s=slot: x[s], out)
+            for slot, tid in enumerate(self._tenant_ids)
+            if tid is not None
+        }
+
+    # ------------------------------------------------------------------
+    # executable-cache identity
+    # ------------------------------------------------------------------
+    def _executable_cache_key(self) -> tuple:
+        """Stable config key: (slot count, template identity, reductions).
+
+        The base implementation would trip over the Metric-valued
+        ``template`` attribute and the stacked defaults (> the key-array
+        byte cap at large N) and fall back to a per-instance nonce —
+        useless for the cross-process ``ProfileCache``. The override keys
+        on the template members' own config keys plus the slot count, so
+        equal stacks share executables and autotune profiles, and the slot
+        count moving (pow2 growth) moves the key.
+        """
+        cached = self.__dict__.get("_exec_key_cache")
+        if cached is not None:
+            return cached
+        inner = tuple(m._executable_cache_key() for _, _, m in self._view.members)
+        key = (
+            "cfg",
+            type(self),
+            (("tenant_slots", self.slots), ("template", inner)),
+            tuple((k, str(self._reductions[k])) for k in sorted(self._defaults)),
+        )
+        object.__setattr__(self, "_exec_key_cache", key)
+        return key
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def reset(self) -> None:
+        super().reset()
+        # defaults say "no tenants"; the roster is host truth
+        self._mark_valid_slots()
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        super().__setstate__(state)
+        if "_view" not in self.__dict__:  # legacy / stripped checkpoints
+            object.__setattr__(self, "_view", _TemplateView(self.template))
+
+    def __repr__(self) -> str:
+        inner = ",".join(type(m).__name__ for _, _, m in self._view.members)
+        return (
+            f"TenantStack({inner}, tenants={self.n_tenants}, slots={self.slots})"
+        )

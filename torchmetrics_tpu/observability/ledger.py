@@ -159,13 +159,33 @@ def _find_op(key: Any) -> Optional[str]:
     return None
 
 
+def _find_tenant_slots(key: Any) -> Optional[int]:
+    """Tenant-slot count marker in a ``TenantStack`` config key: the
+    ``("tenant_slots", <int>)`` pair its ``_executable_cache_key`` embeds."""
+    if (
+        isinstance(key, tuple)
+        and len(key) == 2
+        and key[0] == "tenant_slots"
+        and isinstance(key[1], int)
+    ):
+        return key[1]
+    if isinstance(key, (tuple, list, frozenset)):
+        for item in key:
+            n = _find_tenant_slots(item)
+            if n is not None:
+                return n
+    return None
+
+
 def attribute_key(key: Any) -> Dict[str, Any]:
     """Human attribution for an executable-cache key.
 
-    Returns ``{"op", "metric", "donated"}`` where ``metric`` is the metric
-    class name embedded in the key (keys built by ``_executable_cache_key``
-    carry ``type(self)``) and ``op`` the leading op string. Works on any
-    key shape ``_global_jit`` sees, including the direct callers in
+    Returns ``{"op", "metric", "metrics", "donated", "tenant_slots"}``
+    where ``metric`` is the metric class name embedded in the key (keys
+    built by ``_executable_cache_key`` carry ``type(self)``), ``op`` the
+    leading op string, and ``tenant_slots`` the slot count for stacked
+    (``TenantStack``) executables. Works on any key shape ``_global_jit``
+    sees, including the direct callers in
     ``streaming``/``collections``/``buffers``.
     """
     donated = None
@@ -190,14 +210,25 @@ def attribute_key(key: Any) -> Dict[str, Any]:
         "metric": metrics[0] if metrics else None,
         "metrics": metrics,
         "donated": donated,
+        "tenant_slots": _find_tenant_slots(inner),
     }
 
 
 def describe_key(key: Any) -> str:
-    """Short human-readable rendering: ``"update[BinaryAccuracy]"``."""
+    """Short human-readable rendering: ``"update[BinaryAccuracy]"``.
+
+    Stacked executables render the stack and its slot count:
+    ``"update[TenantStack[MulticlassAccuracy]×256]"``.
+    """
     attr = attribute_key(key)
     op = attr["op"] or "?"
-    metric = ",".join(attr["metrics"]) if attr["metrics"] else "?"
+    names = attr["metrics"]
+    slots = attr["tenant_slots"]
+    if slots is not None and names:
+        inner = ",".join(names[1:]) or "?"
+        metric = f"{names[0]}[{inner}]×{slots}"
+    else:
+        metric = ",".join(names) if names else "?"
     out = f"{op}[{metric}]"
     if attr["donated"]:
         out += "+donate"

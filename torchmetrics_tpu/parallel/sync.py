@@ -217,11 +217,17 @@ class _GatherLeaf:
 
 def reduce_state_in_graph(
     state: StateDict,
-    reductions: Mapping[str, Union[Reduction, Callable]],
-    axis_name: str,
+    reductions: Optional[Mapping[str, Union[Reduction, Callable]]] = None,
+    axis_name: str = "",
     policy: Optional[SyncPolicy] = None,
 ) -> StateDict:
     """Sync a whole state dict across ``axis_name``. Pure & jittable.
+
+    ``state`` may be a plain dict (paired with an explicit ``reductions``
+    mapping) or a :class:`~torchmetrics_tpu.state.MetricState`, which carries
+    its own reduction metadata — pass ``reductions=None`` and the tags are
+    read off the state itself, and the result comes back as a MetricState
+    with the same metadata.
 
     Fixed-shape leaves with an elementwise reduction (sum/mean/max/min) are
     *bucketed*: every leaf sharing a ``(Reduction, dtype)`` pair is flattened
@@ -245,6 +251,15 @@ def reduce_state_in_graph(
     default. The default policy is exact and reproduces the dense collective
     schedule bitwise.
     """
+    if reductions is None:
+        reductions = getattr(state, "reductions", None)
+        if reductions is None:
+            raise TypeError(
+                "reduce_state_in_graph: pass an explicit `reductions` mapping "
+                "or a MetricState that carries its own reduction metadata"
+            )
+    if not axis_name:
+        raise TypeError("reduce_state_in_graph: `axis_name` is required")
     policy = policy or default_policy()
     begin_sync()
     out: StateDict = {}
@@ -317,6 +332,8 @@ def reduce_state_in_graph(
             out[name] = results[(spec[1], spec[2])]
         else:
             out[name] = spec[1](results[h] for h in spec[2])
+    if hasattr(state, "with_leaves"):  # MetricState in → MetricState out
+        return state.with_leaves(out)
     return out
 
 

@@ -1,0 +1,180 @@
+"""Explicit metric state: a registered pytree of leaves + static metadata.
+
+``MetricState`` decouples *what a metric has accumulated* from *the Metric
+object that accumulated it* — the same move "Automatic Cross-Replica
+Sharding of Weight Update" makes for optimizer state. The class is a
+``MutableMapping`` over the leaf dict (every historical ``self._state[...]``
+call site keeps working verbatim) plus static, hashable metadata: the
+per-leaf :class:`~torchmetrics_tpu.parallel.Reduction` tag and the set of
+list (``cat``) states. Registered as a JAX pytree, so a whole state travels
+through ``jit``/``vmap``/``shard_map`` as one argument with the metadata
+riding in the (hashable) treedef aux — equal metadata ⇒ equal treedefs ⇒ no
+retrace when only leaf values change.
+
+This is the seam the roadmap's sharded-cat work plugs into: a
+``NamedSharding`` layout for cat leaves changes only how ``MetricState``
+leaves are placed, not the Metric shell above or the sync bucketing below.
+
+``StackedMerge`` is the companion reduction adapter for states stacked along
+a leading axis (tenant slots, window slots): it wraps a mergeable sketch
+reduction so a gathered ``(n, stack, ...)`` pile merges element-by-element
+along the stack axis — the sync layers see just another mergeable callable.
+"""
+from collections.abc import MutableMapping
+from typing import Any, Callable, Dict, Iterator, Mapping, Optional, Union
+
+import jax
+
+from .parallel.reduction import Reduction
+
+Array = jax.Array
+
+__all__ = ["MetricState", "StackedMerge"]
+
+
+class StackedMerge:
+    """Per-element n-way merge for a leaf stacked along a leading axis.
+
+    Wraps a mergeable (sketch) reduction so a gathered ``(n, stack, ...)``
+    pile merges stack-element-by-stack-element (``vmap`` over axis 1). The
+    ``__str__`` participates in executable-cache keys; ``__reduce__`` keeps
+    checkpoints portable (the inner sketch reduction pickles by registry
+    kind).
+    """
+
+    mergeable = True
+
+    def __init__(self, inner: Any) -> None:
+        self.inner = inner
+
+    def __call__(self, stack: Array) -> Array:
+        return jax.vmap(self.inner, in_axes=1, out_axes=0)(stack)
+
+    def decay(self, x: Array, d: Array) -> Array:
+        # decayed() support rides through to the inner sketch per element
+        return jax.vmap(lambda e: self.inner.decay(e, d))(x)
+
+    @property
+    def supports_decay(self) -> bool:
+        return bool(getattr(self.inner, "supports_decay", False))
+
+    def __repr__(self) -> str:
+        return f"StackedMerge({self.inner!r})"
+
+    def __str__(self) -> str:
+        return f"stacked:{self.inner}"
+
+    def __reduce__(self):
+        return (StackedMerge, (self.inner,))
+
+
+@jax.tree_util.register_pytree_node_class
+class MetricState(MutableMapping):
+    """State leaves + static (reduction, layout) metadata, as one pytree.
+
+    Duck-types a plain dict of leaves — indexing, iteration, ``.items()``,
+    ``.update()`` all operate on the leaf dict — while carrying the static
+    metadata the sync/checkpoint layers need to interpret those leaves
+    without the owning :class:`~torchmetrics_tpu.metric.Metric`:
+
+    - ``reductions``: leaf name → :class:`Reduction` tag (or a mergeable
+      sketch callable),
+    - ``list_states``: names whose leaves are growing ``cat`` lists /
+      CatBuffers rather than fixed-shape arrays.
+
+    Pytree contract: children are the leaf values in insertion order; the
+    aux data is ``(names, reduction items, list-state set)`` — hashable, so
+    two states with equal leaf names and metadata share a treedef and a jit
+    cache line.
+    """
+
+    def __init__(
+        self,
+        leaves: Optional[Mapping[str, Any]] = None,
+        *,
+        reductions: Optional[Mapping[str, Union[Reduction, Callable]]] = None,
+        list_states: Any = (),
+    ) -> None:
+        self._leaves: Dict[str, Any] = dict(leaves) if leaves else {}
+        self._reductions: Dict[str, Union[Reduction, Callable]] = (
+            dict(reductions) if reductions else {}
+        )
+        self._list_states: frozenset = frozenset(list_states)
+
+    # -- mapping protocol over the leaf dict ---------------------------
+    def __getitem__(self, name: str) -> Any:
+        return self._leaves[name]
+
+    def __setitem__(self, name: str, value: Any) -> None:
+        self._leaves[name] = value
+
+    def __delitem__(self, name: str) -> None:
+        del self._leaves[name]
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._leaves)
+
+    def __len__(self) -> int:
+        return len(self._leaves)
+
+    def __repr__(self) -> str:
+        reds = {k: str(self._reductions.get(k, Reduction.NONE)) for k in self._leaves}
+        return f"MetricState({list(self._leaves)}, reductions={reds})"
+
+    # -- static metadata ------------------------------------------------
+    @property
+    def reductions(self) -> Dict[str, Union[Reduction, Callable]]:
+        """Leaf name → reduction tag (a copy; metadata is edit-by-register)."""
+        return dict(self._reductions)
+
+    @property
+    def list_states(self) -> frozenset:
+        return self._list_states
+
+    def reduction(self, name: str) -> Union[Reduction, Callable]:
+        return self._reductions.get(name, Reduction.NONE)
+
+    def register(
+        self,
+        name: str,
+        reduction: Union[Reduction, Callable],
+        list_state: bool = False,
+    ) -> None:
+        """Declare a leaf's static metadata (called by ``Metric.add_state``)."""
+        self._reductions[name] = reduction
+        if list_state:
+            self._list_states = self._list_states | {name}
+
+    # -- views ----------------------------------------------------------
+    def tensor_leaves(self) -> Dict[str, Any]:
+        """Fixed-shape leaves only (no list/cat states), as a plain dict."""
+        return {k: v for k, v in self._leaves.items() if k not in self._list_states}
+
+    def with_leaves(self, leaves: Mapping[str, Any]) -> "MetricState":
+        """Same metadata, new leaf values (the pure-update idiom)."""
+        return MetricState(
+            leaves, reductions=self._reductions, list_states=self._list_states
+        )
+
+    def copy(self) -> "MetricState":
+        return self.with_leaves(self._leaves)
+
+    # -- pytree protocol -------------------------------------------------
+    def tree_flatten(self):
+        names = tuple(self._leaves)
+        children = tuple(self._leaves[k] for k in names)
+        aux = (
+            names,
+            tuple((k, self._reductions[k]) for k in sorted(self._reductions)),
+            self._list_states,
+        )
+        return children, aux
+
+    @classmethod
+    def tree_unflatten(cls, aux, children) -> "MetricState":
+        names, reds, lists = aux
+        obj = cls.__new__(cls)
+        obj._leaves = dict(zip(names, children))
+        obj._reductions = dict(reds)
+        obj._list_states = lists
+        return obj

@@ -140,7 +140,7 @@ def _donation_safe_states(reps, seen: set) -> Dict[str, StateDict]:
     states: Dict[str, StateDict] = {}
     for name, rep in reps:
         st: StateDict = {}
-        for k, v in rep.__dict__["_state"].items():
+        for k, v in rep._state_view().items():
             if k in rep._list_states:
                 continue
             if isinstance(v, jax.Array):
@@ -290,7 +290,7 @@ class BufferedMetric:
             # that reached this flush point, so they are safe to gather while
             # the new window's scan is still executing on device
             pre_counts = (
-                {name: len(m.__dict__["_state"][name]) for name in self._ov_cat_names()}
+                {name: len(m._state_view()[name]) for name in self._ov_cat_names()}
                 if self.__dict__["_overlap"]
                 else None
             )
@@ -314,7 +314,7 @@ class BufferedMetric:
                         m._donation_safe_tensor_state(), valid_dev, steps
                     )
                     scan_sp.fence(new_tensors)
-            state = m.__dict__["_state"]
+            state = m._state_view()
             for k, v in new_tensors.items():
                 state[k] = v
             # appends leaves are (K, B, ...) scan stacks; rows >= valid are
@@ -372,7 +372,7 @@ class BufferedMetric:
             if stop < start:  # state shrank (reset/load) — resync from zero
                 start = 0
                 gathered.pop(name, None)
-            value = m.__dict__["_state"][name]
+            value = m._state_view()[name]
             if isinstance(value, CatBuffer):
                 # the padded layout indexes rows, not increments: the buffer
                 # slice IS the increment range (counts are row counts there)
@@ -425,7 +425,7 @@ class BufferedMetric:
                     contrib=int(m._update_count), policy=m._sync_policy
                 )
             self._ov_issue(
-                backend, {name: len(m.__dict__["_state"][name]) for name in cat_names}
+                backend, {name: len(m._state_view()[name]) for name in cat_names}
             )
             synced = m._gather_synced(backend, skip=frozenset(cat_names))
             for name in cat_names:
@@ -438,7 +438,7 @@ class BufferedMetric:
         finally:
             if _sp is not None:
                 _sp.end()
-        m.__dict__["_state"].update(synced)
+        m._state_view().update(synced)
         m._is_synced = True
 
     # -- observation (flush-first delegation) ---------------------------
@@ -655,7 +655,7 @@ class BufferedMetricCollection:
             states = _donation_safe_states(reps, set())
             new_states, appends = fn(states, jnp.asarray(valid, jnp.int32), steps)
             for name, rep in reps:
-                st = rep.__dict__["_state"]  # shared dict: group members see it
+                st = rep._state_view()  # shared dict: group members see it
                 for k, v in new_states[name].items():
                     st[k] = v
                 rep._extend_list_states_stacked(appends[name], valid)

@@ -1,12 +1,19 @@
 """ClasswiseWrapper — split per-class output into a labeled dict.
 
 Parity: reference ``src/torchmetrics/wrappers/classwise.py:31``.
+
+A classwise wrapper is a degenerate tenant stack (classes → tenant axis):
+the wrapped ``average="none"`` metric already computes one value per class
+along a leading stacked axis, so labelling is exactly
+:func:`~torchmetrics_tpu.multitenant.label_results` — not a bespoke
+per-key Python loop.
 """
 from typing import Any, Dict, List, Optional
 
 import jax
 
 from ..metric import Metric
+from ..multitenant import label_results
 from .abstract import WrapperMetric
 
 Array = jax.Array
@@ -45,9 +52,7 @@ class ClasswiseWrapper(WrapperMetric):
     def _convert(self, x: Array) -> Dict[str, Array]:
         name = self._prefix or f"{type(self.metric).__name__.lower()}_"
         postfix = self._postfix or ""
-        if self.labels is None:
-            return {f"{name}{i}{postfix}": val for i, val in enumerate(x)}
-        return {f"{name}{lab}{postfix}": val for lab, val in zip(self.labels, x)}
+        return label_results(x, labels=self.labels, prefix=name, postfix=postfix)
 
     def update(self, *args: Any, **kwargs: Any) -> None:
         self.metric.update(*args, **kwargs)
