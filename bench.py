@@ -647,15 +647,21 @@ def bench_smoke() -> dict:
     )
 
     # static gate: the corpus must lint clean against the committed baseline
+    # AND fast — the wall-time ceiling keeps the dataflow engine's summary
+    # cache honest as the corpus grows (a quadratic regression fails here
+    # long before it annoys anyone at commit time)
+    _TPULINT_WALL_BUDGET_S = 10.0
     repo_dir = os.path.dirname(os.path.abspath(__file__))
     try:
         from tools.tpulint import run_lint
 
         lint = run_lint([os.path.join(repo_dir, "torchmetrics_tpu")], root=repo_dir)
         tpulint_new = len(lint.new_violations)
+        tpulint_wall_s = lint.wall_s
     except Exception:
         tpulint_new = -1
-    tpulint_ok = tpulint_new == 0
+        tpulint_wall_s = -1.0
+    tpulint_ok = tpulint_new == 0 and 0.0 <= tpulint_wall_s < _TPULINT_WALL_BUDGET_S
 
     # bench-trajectory gate (tools/benchwatch): the committed BENCH_r*.json
     # series is a contract — the latest round of every config with enough
@@ -834,6 +840,8 @@ def bench_smoke() -> dict:
         "strict_mode_ok": strict_ok,
         "steady_state_retraces": steady_retraces,
         "tpulint_new_violations": tpulint_new,
+        "tpulint_wall_s": round(tpulint_wall_s, 3),
+        "tpulint_ok": tpulint_ok,
         "warmup_compile_s": compile_s,
         "update_s": update_s,
         "values": values,

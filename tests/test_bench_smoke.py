@@ -35,6 +35,10 @@ def test_bench_smoke_passes():
     assert result["strict_mode_ok"] is True, result
     assert result["steady_state_retraces"] == 0, result
     assert result["tpulint_new_violations"] == 0, result
+    # the static gate is also a perf gate: the dataflow engine must keep the
+    # full-corpus lint under its wall-time budget
+    assert result["tpulint_ok"] is True, result
+    assert 0.0 <= result["tpulint_wall_s"] < 10.0, result
     assert result["synced_accuracy"] == result["expected_synced_accuracy"], result
     # buffered streaming: 10 staged steps at window=4 auto-flush twice (at 4
     # and 8 staged), so 2 scanned dispatches cover 10 steps of metric work;

@@ -466,6 +466,24 @@ def test_sync_roots_detected(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# rule registry: every rule has a title and a severity tier
+# (lattice/branch/summary-cache tables and the TPU012–014 fixtures live in
+# tests/test_tpulint_dataflow.py alongside the engine they exercise)
+# ---------------------------------------------------------------------------
+
+
+def test_rule_registry_complete():
+    from tools.tpulint import ALL_RULES, RULE_SEVERITY, RULE_TITLES
+
+    assert {"TPU012", "TPU013", "TPU014"} <= set(ALL_RULES)
+    for rule in ALL_RULES:
+        assert rule in RULE_TITLES, f"{rule} missing a title"
+        assert RULE_SEVERITY.get(rule) in ("error", "warn"), f"{rule} missing a tier"
+    # the SPMD deadlock classes are error-tier: a hang is never just a warning
+    assert all(RULE_SEVERITY[r] == "error" for r in ("TPU012", "TPU013", "TPU014"))
+
+
+# ---------------------------------------------------------------------------
 # full-corpus gate + CLI
 # ---------------------------------------------------------------------------
 

@@ -12,6 +12,13 @@ timeout/retry policy (TPU009). Module-scoped TPU010 keeps process telemetry
 honest: counter state must live on ``observability.registry``, not in ad-hoc
 module-level dicts that escape reset/export/strict-mode budgets.
 
+On top of the syntactic rules, the abstract-interpretation engine in
+:mod:`.dataflow` propagates a HOST/TRACED/RANK-DEP/SHARDED/DONATED lattice
+interprocedurally and drives the SPMD rules: TPU012 (collective dominated by
+a rank-dependent branch), TPU013 (divergent collective sequences across
+paths through one root), TPU014 (sharding-spec producer/consumer mismatch)
+— plus the interprocedural halves of TPU003/TPU005.
+
 Programmatic entry point::
 
     from tools.tpulint import run_lint
@@ -20,22 +27,26 @@ Programmatic entry point::
 
 CLI::
 
-    python -m tools.tpulint torchmetrics_tpu/ [--update-baseline] [--json]
+    python -m tools.tpulint torchmetrics_tpu/ [--jobs N] [--sarif] [--json]
 """
 from __future__ import annotations
 
 import os
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from .baseline import BaselineKey, apply_baseline, load_baseline, save_baseline
 from .callgraph import find_roots, reach
 from .corpus import Corpus
+from .dataflow import DataflowEngine
 from .rules import (
     ALL_RULES,
+    RULE_SEVERITY,
     RULE_TITLES,
     Violation,
     check_counter_island,
+    check_dataflow_rules,
     check_state_contract,
     check_traced_rules,
     check_unguarded_host_collective,
@@ -53,6 +64,8 @@ class LintResult:
     n_files: int = 0
     n_roots: int = 0
     n_reachable: int = 0
+    wall_s: float = 0.0
+    jobs: int = 1
 
     @property
     def new_violations(self) -> List[Violation]:
@@ -73,24 +86,40 @@ class LintResult:
         return per_rule
 
 
-def run_lint(
-    paths: Sequence[str],
-    root: str = ".",
-    baseline_path: Optional[str] = DEFAULT_BASELINE,
-    root_kinds: Tuple[str, ...] = ("update", "kernel", "sync", "sketch"),
-) -> LintResult:
-    corpus = Corpus.build(list(paths), root=root)
-    roots = find_roots(corpus, kinds=root_kinds)
-    reachability = reach(corpus, roots)
+def _collect_violations(
+    corpus: Corpus,
+    roots,
+    reachability,
+    shard: int = 0,
+    n_shards: int = 1,
+) -> List[Violation]:
+    """All raw (pre-waiver, pre-baseline) violations for one shard.
 
+    Sharding is by sorted-index modulo across each check's own work list, so
+    the union over shards is exactly the single-process result and the merge
+    is order-independent (the caller re-sorts).
+    """
+    engine = DataflowEngine(corpus)
     violations: List[Violation] = []
-    for qn, fn in sorted(reachability.reachable.items()):
-        violations.extend(check_traced_rules(fn, corpus, reachability.roots_of.get(qn, set())))
-    for cinfo in sorted(corpus.classes.values(), key=lambda c: c.qualname):
-        if corpus.is_metric_subclass(cinfo):
+
+    def mine(idx: int) -> bool:
+        return idx % n_shards == shard
+
+    for idx, (qn, fn) in enumerate(sorted(reachability.reachable.items())):
+        if mine(idx):
+            violations.extend(check_traced_rules(fn, corpus, reachability.roots_of.get(qn, set()), engine))
+    metric_classes = [c for c in sorted(corpus.classes.values(), key=lambda c: c.qualname)
+                      if corpus.is_metric_subclass(c)]
+    for idx, cinfo in enumerate(metric_classes):
+        if mine(idx):
             violations.extend(check_state_contract(cinfo, corpus))
-    for fn in sorted(corpus.functions.values(), key=lambda f: f.qualname):
-        violations.extend(check_use_after_donation(fn))
+    for idx, fn in enumerate(sorted(corpus.functions.values(), key=lambda f: f.qualname)):
+        if not mine(idx):
+            continue
+        violations.extend(check_use_after_donation(fn, engine))
+        # the SPMD dataflow rules run over every function: in-graph collectives
+        # reach jit roots, elastic-round collectives live on eager paths
+        violations.extend(check_dataflow_rules(fn, engine))
         # TPU009 covers the jit-UNREACHABLE remainder: eager sync paths where
         # a blocking host collective is legal but must carry a timeout/retry
         # policy (traced paths are TPU001's jurisdiction)
@@ -98,8 +127,47 @@ def run_lint(
             violations.extend(check_unguarded_host_collective(fn))
     # TPU010 is module-scoped: ad-hoc counter islands live at module level,
     # outside any function body
-    for mod in sorted(corpus.modules.values(), key=lambda m: m.path):
-        violations.extend(check_counter_island(mod))
+    for idx, mod in enumerate(sorted(corpus.modules.values(), key=lambda m: m.path)):
+        if mine(idx):
+            violations.extend(check_counter_island(mod))
+    return violations
+
+
+def _lint_shard(args: Tuple[Sequence[str], str, Tuple[str, ...], int, int]) -> List[Violation]:
+    """Process-pool worker: parse the corpus and analyze one shard of it."""
+    paths, root, root_kinds, shard, n_shards = args
+    corpus = Corpus.build(list(paths), root=root)
+    roots = find_roots(corpus, kinds=root_kinds)
+    reachability = reach(corpus, roots)
+    return _collect_violations(corpus, roots, reachability, shard, n_shards)
+
+
+def run_lint(
+    paths: Sequence[str],
+    root: str = ".",
+    baseline_path: Optional[str] = DEFAULT_BASELINE,
+    root_kinds: Tuple[str, ...] = ("update", "kernel", "sync", "sketch"),
+    jobs: int = 1,
+) -> LintResult:
+    t0 = time.perf_counter()
+    corpus = Corpus.build(list(paths), root=root)
+    roots = find_roots(corpus, kinds=root_kinds)
+    reachability = reach(corpus, roots)
+
+    jobs = max(1, int(jobs))
+    if jobs > 1:
+        import concurrent.futures
+
+        work = [(tuple(paths), root, tuple(root_kinds), shard, jobs) for shard in range(jobs)]
+        try:
+            with concurrent.futures.ProcessPoolExecutor(max_workers=jobs) as pool:
+                shards = list(pool.map(_lint_shard, work))
+            violations = [v for shard in shards for v in shard]
+        except (OSError, ValueError):  # no fork/processes available: degrade
+            jobs = 1
+            violations = _collect_violations(corpus, roots, reachability)
+    else:
+        violations = _collect_violations(corpus, roots, reachability)
 
     waivers_by_path = {}
     for mod in corpus.modules.values():
@@ -108,7 +176,7 @@ def run_lint(
         violations.extend(w.malformed)
     apply_waivers(violations, waivers_by_path)
 
-    violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
     stale: List[BaselineKey] = []
     if baseline_path:
         stale = apply_baseline(violations, load_baseline(baseline_path))
@@ -119,11 +187,14 @@ def run_lint(
         n_files=len(corpus.modules),
         n_roots=len(roots),
         n_reachable=len(reachability.reachable),
+        wall_s=time.perf_counter() - t0,
+        jobs=jobs,
     )
 
 
 __all__ = [
     "ALL_RULES",
+    "RULE_SEVERITY",
     "RULE_TITLES",
     "DEFAULT_BASELINE",
     "LintResult",

@@ -1,7 +1,7 @@
 """CLI: ``python -m tools.tpulint [paths...]``.
 
-Exit codes: 0 = clean (no non-baselined violations), 1 = new violations
-found, 2 = usage error.
+Exit codes: 0 = clean (no non-baselined violations at or above the
+``--fail-on`` tier), 1 = new violations found, 2 = usage error.
 """
 from __future__ import annotations
 
@@ -9,7 +9,8 @@ import argparse
 import json
 import sys
 
-from . import DEFAULT_BASELINE, RULE_TITLES, run_lint, save_baseline
+from . import DEFAULT_BASELINE, RULE_SEVERITY, RULE_TITLES, run_lint, save_baseline
+from .sarif import to_sarif
 
 
 def main(argv=None) -> int:
@@ -27,7 +28,13 @@ def main(argv=None) -> int:
                     help="rewrite the baseline from this scan and exit 0")
     ap.add_argument("--roots", default="update,kernel,sync,sketch",
                     help="comma-separated root kinds: update,kernel,sync,sketch,compute")
+    ap.add_argument("--jobs", type=int, default=1, metavar="N",
+                    help="parse+analyze the corpus in an N-process pool (deterministic output)")
     ap.add_argument("--json", action="store_true", help="emit one JSON object instead of text")
+    ap.add_argument("--sarif", action="store_true", help="emit SARIF 2.1.0 instead of text")
+    ap.add_argument("--fail-on", choices=("error", "warn"), default="warn",
+                    help="exit 1 only for new violations at this tier or above "
+                         "(warn = any new violation fails, the default)")
     ap.add_argument("--show-waived", action="store_true", help="also list waived/baselined hits")
     args = ap.parse_args(argv)
 
@@ -35,11 +42,14 @@ def main(argv=None) -> int:
     root_kinds = tuple(k.strip() for k in args.roots.split(",") if k.strip())
     if not set(root_kinds) <= {"update", "kernel", "sync", "sketch", "compute"}:
         ap.error(f"unknown root kind in --roots={args.roots}")
+    if args.jobs < 1:
+        ap.error("--jobs must be >= 1")
 
     result = run_lint(
         paths,
         baseline_path=None if (args.no_baseline or args.update_baseline) else args.baseline,
         root_kinds=root_kinds,
+        jobs=args.jobs,
     )
 
     if args.update_baseline:
@@ -49,21 +59,29 @@ def main(argv=None) -> int:
         return 0
 
     new = result.new_violations
+    failing = new if args.fail_on == "warn" else [v for v in new if v.severity == "error"]
+
+    if args.sarif:
+        print(json.dumps(to_sarif(result), indent=2))
+        return 1 if failing else 0
+
     if args.json:
         print(json.dumps({
             "files": result.n_files,
             "roots": result.n_roots,
             "reachable": result.n_reachable,
-            "new": [v.__dict__ for v in new],
+            "new": [dict(v.__dict__, severity=v.severity) for v in new],
             "waived": len(result.waived),
             "baselined": len(result.baselined),
             "stale_baseline": [list(k) for k in result.stale_baseline],
             "summary": result.summary(),
+            "wall_s": round(result.wall_s, 3),
+            "jobs": result.jobs,
         }))
-        return 1 if new else 0
+        return 1 if failing else 0
 
     for v in new:
-        print(v.format())
+        print(f"{v.format()} [{v.severity}]")
     if args.show_waived:
         for v in result.waived:
             print(f"{v.format()}  (waived: {v.waive_reason})")
@@ -75,9 +93,10 @@ def main(argv=None) -> int:
     print(
         f"tpulint: {result.n_files} files, {result.n_roots} jit roots, "
         f"{result.n_reachable} reachable functions; new violations: {counts} "
-        f"({len(result.waived)} waived, {len(result.baselined)} baselined)"
+        f"({len(result.waived)} waived, {len(result.baselined)} baselined) "
+        f"in {result.wall_s:.2f}s with {result.jobs} job(s)"
     )
-    return 1 if new else 0
+    return 1 if failing else 0
 
 
 if __name__ == "__main__":

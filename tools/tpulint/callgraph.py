@@ -381,7 +381,7 @@ def reach(corpus: Corpus, roots: Dict[str, FunctionInfo]) -> Reachability:
         for sub in ast.walk(fn.node):
             if not isinstance(sub, ast.Call):
                 continue
-            callee = corpus.resolve_call(fn.module, sub.func, fn.cls)
+            callee = corpus.resolve_call(fn.module, sub.func, fn.cls, fn)
             if callee is not None:
                 edges.add(callee.qualname)
                 queue.append((callee, root))

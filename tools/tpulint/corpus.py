@@ -96,6 +96,8 @@ class Corpus:
         self.modules: Dict[str, ModuleInfo] = {}
         self.functions: Dict[str, FunctionInfo] = {}  # qualname -> info
         self.classes: Dict[str, ClassInfo] = {}  # qualname -> info
+        self._attr_class_cache: Dict[Tuple[str, str], Optional[ClassInfo]] = {}
+        self._local_alias_cache: Dict[str, Dict[str, str]] = {}  # fn qualname -> {local: attr}
 
     # -- construction ---------------------------------------------------
     @classmethod
@@ -210,8 +212,20 @@ class Corpus:
                 return c.methods[name]
         return None
 
-    def resolve_call(self, mod: ModuleInfo, func: ast.expr, cls: Optional[ClassInfo]) -> Optional[FunctionInfo]:
-        """Resolve a call expression to a corpus function, best effort."""
+    def resolve_call(
+        self,
+        mod: ModuleInfo,
+        func: ast.expr,
+        cls: Optional[ClassInfo],
+        fn: Optional[FunctionInfo] = None,
+    ) -> Optional[FunctionInfo]:
+        """Resolve a call expression to a corpus function, best effort.
+
+        With ``fn`` given, also resolves one hop of aliasing: method calls
+        through a single-assignment ``self.<attr>`` whose class is known
+        (``self._backend.gather(...)``) and through local aliases of such
+        attributes (``b = self._backend; b.gather(...)``).
+        """
         if isinstance(func, ast.Name):
             if func.id in mod.functions:
                 return mod.functions[func.id]
@@ -222,7 +236,26 @@ class Corpus:
         if isinstance(func, ast.Attribute):
             # self.method(...)
             if isinstance(func.value, ast.Name) and func.value.id == "self" and cls is not None:
-                return self.lookup_method(cls, func.attr)
+                hit = self.lookup_method(cls, func.attr)
+                if hit is not None:
+                    return hit
+            # self.<attr>.method(...) through a single-assignment attribute
+            if (
+                isinstance(func.value, ast.Attribute)
+                and isinstance(func.value.value, ast.Name)
+                and func.value.value.id == "self"
+                and cls is not None
+            ):
+                owner = self.attr_class(cls, func.value.attr)
+                if owner is not None:
+                    return self.lookup_method(owner, func.attr)
+            # local alias of self.<attr>: b = self._backend; b.method(...)
+            if isinstance(func.value, ast.Name) and cls is not None and fn is not None:
+                attr = self._local_aliases(fn).get(func.value.id)
+                if attr is not None:
+                    owner = self.attr_class(cls, attr)
+                    if owner is not None:
+                        return self.lookup_method(owner, func.attr)
             dotted = _dotted_name(func)
             if dotted:
                 head = dotted.split(".")[0]
@@ -230,6 +263,104 @@ class Corpus:
                 if target:
                     return self._function_by_dotted(target + dotted[len(head):])
         return None
+
+    def attr_class(self, cinfo: ClassInfo, attr: str) -> Optional[ClassInfo]:
+        """Corpus class an instance attribute is bound to, when every
+        ``self.<attr> = ...`` assignment in the MRO agrees on one — either a
+        direct constructor call (``self._backend = HostSync(...)``) or a
+        parameter whose annotation resolves (``backend: HostSync``)."""
+        key = (cinfo.qualname, attr)
+        if key in self._attr_class_cache:
+            return self._attr_class_cache[key]
+        resolved: Optional[ClassInfo] = None
+        consistent = True
+        for c in self.class_mro(cinfo):
+            for m in c.methods.values():
+                ann_by_param = {
+                    a.arg: a.annotation
+                    for a in list(m.node.args.posonlyargs) + list(m.node.args.args) + list(m.node.args.kwonlyargs)
+                    if a.annotation is not None
+                }
+                for node in ast.walk(m.node):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    for t in node.targets:
+                        if not (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                            and t.attr == attr
+                        ):
+                            continue
+                        cand = self._class_of_expr(c.module, node.value, ann_by_param)
+                        if cand is None:
+                            consistent = False
+                        elif resolved is None:
+                            resolved = cand
+                        elif resolved.qualname != cand.qualname:
+                            consistent = False
+        out = resolved if consistent else None
+        self._attr_class_cache[key] = out
+        return out
+
+    def _class_of_expr(
+        self, mod: ModuleInfo, expr: ast.expr, ann_by_param: Dict[str, Optional[ast.expr]]
+    ) -> Optional[ClassInfo]:
+        if isinstance(expr, ast.Call):
+            dotted = _dotted_name(expr.func)
+            if dotted:
+                head = dotted.split(".")[0]
+                target = mod.imports.get(head, head)
+                full = target + dotted[len(head):]
+                hit = self.resolve_class(full)
+                if hit is None and "." not in dotted and dotted in mod.classes:
+                    hit = mod.classes[dotted]
+                return hit
+        if isinstance(expr, ast.Name) and expr.id in ann_by_param:
+            ann = ann_by_param[expr.id]
+            if isinstance(ann, ast.Subscript):  # Optional[X] / X | None
+                ann = ann.slice
+            dotted = _dotted_name(ann) if isinstance(ann, (ast.Name, ast.Attribute)) else None
+            if dotted:
+                head = dotted.split(".")[0]
+                target = mod.imports.get(head, head)
+                hit = self.resolve_class(target + dotted[len(head):])
+                if hit is None and "." not in dotted and dotted in mod.classes:
+                    hit = mod.classes[dotted]
+                return hit
+        return None
+
+    def _local_aliases(self, fn: FunctionInfo) -> Dict[str, str]:
+        """Names assigned exactly once in ``fn``, from ``self.<attr>``."""
+        cached = self._local_alias_cache.get(fn.qualname)
+        if cached is not None:
+            return cached
+        assigned: Dict[str, int] = {}
+        aliases: Dict[str, str] = {}
+        for node in ast.walk(fn.node):
+            targets: List[ast.expr] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                targets = [node.target]
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                targets = [node.target]
+            for t in targets:
+                for sub in ast.walk(t):
+                    if isinstance(sub, ast.Name):
+                        assigned[sub.id] = assigned.get(sub.id, 0) + 1
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Attribute)
+                and isinstance(node.value.value, ast.Name)
+                and node.value.value.id == "self"
+            ):
+                aliases[node.targets[0].id] = node.value.attr
+        out = {name: attr for name, attr in aliases.items() if assigned.get(name, 0) == 1}
+        self._local_alias_cache[fn.qualname] = out
+        return out
 
     def _function_by_dotted(self, dotted: str) -> Optional[FunctionInfo]:
         parts = dotted.split(".")

@@ -1,0 +1,752 @@
+"""Abstract interpretation over the corpus: the tpulint dataflow engine.
+
+Propagates a per-variable lattice through every function body and — via
+per-function summaries — interprocedurally through the call graph:
+
+    BOTTOM < HOST < TRACED < RANK_DEP
+
+with two orthogonal facets carried alongside the kind:
+
+- ``spec``: the ``PartitionSpec`` a value was produced under
+  (``device_put(x, NamedSharding(mesh, P(...)))`` /
+  ``with_sharding_constraint``), consumed by TPU014;
+- ``deps``: which of the enclosing function's parameters the value is
+  derived from, so a caller can refine a callee summary with the kinds of
+  its actual arguments (one level of context sensitivity).
+
+The walk is branch-sensitive: ``if``/``while`` arms are analyzed under
+copies of the environment and joined afterwards; loop bodies are walked
+twice (join = widen — the lattice is finite and tiny, so two passes reach
+the fixpoint for realistic chains). Each function gets one cached
+:class:`Summary` keyed by ``(qualname, signature fingerprint)`` — editing a
+signature invalidates the entry; the full ~300-file corpus stays well under
+a second.
+
+Summaries record, besides the return value's abstract value:
+
+- ``collectives``: the ordered collective sequence the function issues,
+  with callee sequences inlined (TPU013 compares these across branch arms);
+- ``donates_params``: parameter indices the function forwards into a
+  donating jitted call (TPU005 interprocedural);
+- ``rank_branch_params``: parameters that, if rank-dependent at a call
+  site, put a collective under rank-divergent control flow (TPU012
+  interprocedural);
+- ``events``: the TPU012/TPU013/TPU014 findings inside the body itself.
+
+Limits (by design, documented in docs/static_analysis.md): lambdas and
+nested ``def`` bodies are opaque; ``for`` iteration order is not modeled;
+sequences longer than ``_SEQ_CAP`` are truncated; recursion yields the
+empty summary.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
+
+from .callgraph import _META_VALUE_ATTRS, _is_jnp_call, _terminates
+from .corpus import Corpus, FunctionInfo, _dotted_name
+
+# --- lattice ----------------------------------------------------------------
+
+BOTTOM = 0
+HOST = 1
+TRACED = 2
+RANK_DEP = 3
+
+KIND_NAMES = {BOTTOM: "BOTTOM", HOST: "HOST", TRACED: "TRACED", RANK_DEP: "RANK_DEP"}
+
+
+@dataclass(frozen=True)
+class AbstractValue:
+    """One lattice point: kind + sharding spec + parameter dependencies."""
+
+    kind: int = HOST
+    spec: Optional[str] = None  # normalized PartitionSpec text, e.g. "P('batch')"
+    deps: FrozenSet[int] = frozenset()
+
+    def __repr__(self) -> str:  # compact for test tables
+        extra = f", spec={self.spec}" if self.spec else ""
+        return f"AV({KIND_NAMES.get(self.kind, self.kind)}{extra})"
+
+
+V_HOST = AbstractValue(HOST)
+V_TRACED = AbstractValue(TRACED)
+V_RANK = AbstractValue(RANK_DEP)
+V_BOTTOM = AbstractValue(BOTTOM)
+
+
+def join(a: AbstractValue, b: AbstractValue) -> AbstractValue:
+    """Least upper bound; conflicting specs join to no-spec (unknown)."""
+    spec = a.spec if a.spec == b.spec else (a.spec or b.spec)
+    if a.spec and b.spec and a.spec != b.spec:
+        spec = None
+    return AbstractValue(max(a.kind, b.kind), spec, a.deps | b.deps)
+
+
+def join_env(a: Dict[str, AbstractValue], b: Dict[str, AbstractValue]) -> Dict[str, AbstractValue]:
+    out = dict(a)
+    for k, v in b.items():
+        out[k] = join(out[k], v) if k in out else v
+    return out
+
+
+# --- summaries --------------------------------------------------------------
+
+Event = Tuple[str, int, int, str]  # (rule, line, col, message)
+
+_SEQ_CAP = 32
+
+
+@dataclass(frozen=True)
+class Summary:
+    returns: AbstractValue = V_HOST
+    collectives: Tuple[str, ...] = ()
+    donates_params: Tuple[int, ...] = ()
+    rank_branch_params: Tuple[int, ...] = ()
+    events: Tuple[Event, ...] = ()
+
+
+EMPTY_SUMMARY = Summary()
+
+# in-graph collectives (jax.lax.*)
+COLLECTIVE_FNS = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "psum_scatter", "ppermute", "all_to_all",
+}
+# eager collective phases: elastic rounds + blocking multihost gathers — every
+# rank must reach these together or the pod deadlocks, same as in-graph psum
+ELASTIC_ROUND_FNS = {
+    "begin_round", "end_round", "recovery_barrier", "gather_contrib",
+    "sync_tensor", "sync_cat_padded", "all_gather_object",
+    "process_allgather", "sync_global_devices", "broadcast_one_to_all",
+}
+
+_RANK_PARAM_NAMES = {"rank", "world_rank", "local_rank", "rank_id", "process_index"}
+_RANK_ATTR_NAMES = {"rank", "_rank", "world_rank", "local_rank", "process_index"}
+_RANK_CALL_LEAFS = {"axis_index", "process_index"}
+_RESHARD_FNS = {"device_put", "with_sharding_constraint"}
+_SHARDED_CALLABLE_FNS = {"shard_map", "pjit"}
+_ARRAY_PARAM_NAMES = {"preds", "target"}
+_ARRAY_ANN_TOKENS = ("'Array'", "'ndarray'")
+
+
+def _resolved_dotted(imports: Dict[str, str], node: ast.expr) -> str:
+    dotted = _dotted_name(node)
+    if not dotted:
+        return ""
+    head = dotted.split(".")[0]
+    return imports.get(head, head) + dotted[len(head):]
+
+
+def _is_donating_jit(expr: ast.expr) -> bool:
+    """``jax.jit(..., donate_argnums=...)`` / ``*jit*(..., donate_state=True)``
+    / ``*jit*(..., donate=True)`` — any jit-minting helper with donation on."""
+    if not isinstance(expr, ast.Call):
+        return False
+    dotted = _dotted_name(expr.func) or ""
+    tail = dotted.split(".")[-1]
+    if tail == "jit":
+        return any(k.arg == "donate_argnums" and not _is_empty_tuple(k.value) for k in expr.keywords)
+    if "jit" in tail:
+        for k in expr.keywords:
+            if k.arg in ("donate_state", "donate") and isinstance(k.value, ast.Constant) and k.value.value is True:
+                return True
+        if tail in ("_get_jitted", "_global_jit"):
+            pos = 2
+            if len(expr.args) > pos and isinstance(expr.args[pos], ast.Constant) and expr.args[pos].value is True:
+                return True
+    return False
+
+
+def _is_empty_tuple(node: ast.expr) -> bool:
+    return isinstance(node, ast.Tuple) and not node.elts
+
+
+def _spec_text(node: ast.expr) -> Optional[str]:
+    """Normalized PartitionSpec text for ``P(...)``/``PartitionSpec(...)``
+    (possibly nested inside ``NamedSharding(mesh, ...)``)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            leaf = (_dotted_name(sub.func) or "").split(".")[-1]
+            if leaf in ("P", "PartitionSpec"):
+                try:
+                    args = ", ".join(ast.unparse(a) for a in sub.args)
+                except Exception:
+                    args = ""
+                return f"P({args})"
+    return None
+
+
+def _in_spec_list(call: ast.Call) -> Optional[List[Optional[str]]]:
+    """Declared per-positional-arg specs of a ``shard_map``/``pjit`` minting
+    call (``in_specs=`` / ``in_shardings=``), or None if it declares none."""
+    leaf = (_dotted_name(call.func) or "").split(".")[-1]
+    if leaf not in _SHARDED_CALLABLE_FNS and leaf != "jit":
+        return None
+    for kw in call.keywords:
+        if kw.arg in ("in_specs", "in_shardings"):
+            v = kw.value
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return [_spec_text(e) for e in v.elts]
+            s = _spec_text(v)
+            return [s] if s is not None else None
+    return None
+
+
+def _flat_params(fn_node: ast.AST) -> List[ast.arg]:
+    args = getattr(fn_node, "args", None)
+    if args is None:
+        return []
+    return list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+
+
+def _iter_calls(node: ast.AST, _root: bool = True) -> Iterator[ast.Call]:
+    """Call nodes under ``node`` in source-nesting order, skipping the bodies
+    of nested functions and lambdas (they execute elsewhere, if at all)."""
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)) and not _root:
+        return
+    if isinstance(node, ast.Call):
+        yield node
+    for child in ast.iter_child_nodes(node):
+        yield from _iter_calls(child, _root=False)
+
+
+def signature_fingerprint(fn: FunctionInfo) -> str:
+    """Cache key component: changes iff the function's signature changes."""
+    try:
+        return ast.dump(fn.node.args)
+    except Exception:
+        return ""
+
+
+# --- the engine -------------------------------------------------------------
+
+
+class DataflowEngine:
+    """Interprocedural abstract interpreter with a per-function summary cache."""
+
+    def __init__(self, corpus: Corpus) -> None:
+        self.corpus = corpus
+        self._cache: Dict[Tuple[str, str], Summary] = {}
+        self._active: Set[str] = set()
+        self.stats = {"hits": 0, "misses": 0}
+
+    def cache_key(self, fn: FunctionInfo) -> Tuple[str, str]:
+        return (fn.qualname, signature_fingerprint(fn))
+
+    def summarize(self, fn: FunctionInfo) -> Summary:
+        key = self.cache_key(fn)
+        hit = self._cache.get(key)
+        if hit is not None:
+            self.stats["hits"] += 1
+            return hit
+        if fn.qualname in self._active:  # recursion: neutral summary
+            return EMPTY_SUMMARY
+        self.stats["misses"] += 1
+        self._active.add(fn.qualname)
+        try:
+            summary = _Analyzer(self, fn).run()
+        finally:
+            self._active.discard(fn.qualname)
+        self._cache[key] = summary
+        return summary
+
+    # convenience used by the TPU003 interprocedural upgrade
+    def call_returns_traced(self, fn: FunctionInfo, call: ast.Call) -> bool:
+        callee = self.corpus.resolve_call(fn.module, call.func, fn.cls, fn)
+        if callee is None or callee.qualname == fn.qualname:
+            return False
+        return self.summarize(callee).returns.kind >= TRACED
+
+
+class _Analyzer:
+    """One branch-sensitive walk over a single function body."""
+
+    def __init__(self, engine: DataflowEngine, fn: FunctionInfo) -> None:
+        self.engine = engine
+        self.fn = fn
+        self.imports = fn.module.imports
+        self.events: List[Event] = []
+        self._event_keys: Set[Tuple[str, int, int]] = set()
+        self.seq: List[str] = []
+        self.ret = V_BOTTOM
+        self.donates: Set[int] = set()
+        self.rank_branch_params: Set[int] = set()
+        self.param_index: Dict[str, int] = {}
+        # stacks of enclosing branch conditions
+        self._rank_ctx: List[Tuple[int, str]] = []  # (line, condition text)
+        self._param_ctx: List[FrozenSet[int]] = []
+        # names bound to callables with known facts
+        self._donating_callables: Set[str] = set()
+        self._spec_callables: Dict[str, List[Optional[str]]] = {}
+
+    # -- entry ----------------------------------------------------------
+    def run(self) -> Summary:
+        env: Dict[str, AbstractValue] = {}
+        for i, a in enumerate(_flat_params(self.fn.node)):
+            self.param_index[a.arg] = i
+            env[a.arg] = AbstractValue(self._seed_kind(a), None, frozenset({i}))
+        self.walk_block(list(self.fn.node.body), env)
+        ret = self.ret if self.ret.kind != BOTTOM else V_HOST
+        return Summary(
+            returns=ret,
+            collectives=tuple(self.seq[:_SEQ_CAP]),
+            donates_params=tuple(sorted(self.donates)),
+            rank_branch_params=tuple(sorted(self.rank_branch_params)),
+            events=tuple(self.events),
+        )
+
+    def _seed_kind(self, a: ast.arg) -> int:
+        if a.arg in _RANK_PARAM_NAMES:
+            return RANK_DEP
+        ann = a.annotation
+        if ann is not None and any(tok in ast.dump(ann) for tok in _ARRAY_ANN_TOKENS):
+            return TRACED
+        if a.arg in _ARRAY_PARAM_NAMES:
+            return TRACED
+        return HOST
+
+    def _emit(self, rule: str, node: ast.AST, msg: str) -> None:
+        line = getattr(node, "lineno", self.fn.node.lineno)
+        col = getattr(node, "col_offset", 0)
+        key = (rule, line, col)
+        if key not in self._event_keys:
+            self._event_keys.add(key)
+            self.events.append((rule, line, col, msg))
+
+    # -- statement walk -------------------------------------------------
+    def walk_block(self, stmts: List[ast.stmt], env: Dict[str, AbstractValue]) -> bool:
+        """Walk statements, mutating ``env``; True if the block terminates."""
+        for i, stmt in enumerate(stmts):
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue  # nested defs are opaque
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                self._do_assign(stmt, env)
+            elif isinstance(stmt, ast.Expr):
+                self.eval(stmt.value, env)
+            elif isinstance(stmt, ast.Return):
+                if stmt.value is not None:
+                    self.ret = join(self.ret, self.eval(stmt.value, env))
+                else:
+                    self.ret = join(self.ret, V_HOST)
+                return True
+            elif isinstance(stmt, ast.Raise):
+                if stmt.exc is not None:
+                    self.eval(stmt.exc, env)
+                return True
+            elif isinstance(stmt, (ast.Continue, ast.Break)):
+                return True
+            elif isinstance(stmt, ast.If):
+                self._do_if(stmt, stmts[i + 1:], env)
+            elif isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+                self._do_loop(stmt, stmts[i + 1:], env)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self.eval(item.context_expr, env)
+                    if isinstance(item.optional_vars, ast.Name):
+                        env[item.optional_vars.id] = V_HOST
+                if self.walk_block(list(stmt.body), env):
+                    return True
+            elif isinstance(stmt, ast.Try):
+                body_env = dict(env)
+                self.walk_block(list(stmt.body), body_env)
+                merged = join_env(env, body_env)
+                for handler in stmt.handlers:
+                    h_env = dict(merged)
+                    self.walk_block(list(handler.body), h_env)
+                    merged = join_env(merged, h_env)
+                env.clear()
+                env.update(merged)
+                self.walk_block(list(stmt.orelse), env)
+                if self.walk_block(list(stmt.finalbody), env):
+                    return True
+            elif isinstance(stmt, (ast.Assert, ast.Delete, ast.Pass, ast.Global, ast.Nonlocal, ast.Import, ast.ImportFrom)):
+                if isinstance(stmt, ast.Assert):
+                    self.eval(stmt.test, env)
+        return False
+
+    def _do_assign(self, stmt: ast.stmt, env: Dict[str, AbstractValue]) -> None:
+        value = getattr(stmt, "value", None)
+        if value is None:
+            return
+        targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+        # callable-fact bindings: f = shard_map(g, ..., in_specs=...), f = jit(..., donate_argnums=...)
+        if isinstance(value, ast.Call):
+            specs = _in_spec_list(value)
+            donating = _is_donating_jit(value)
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    if specs is not None:
+                        self._spec_callables[t.id] = specs
+                    else:
+                        self._spec_callables.pop(t.id, None)
+                    if donating:
+                        self._donating_callables.add(t.id)
+                    else:
+                        self._donating_callables.discard(t.id)
+        val = self.eval(value, env)
+        if isinstance(stmt, ast.AugAssign) and isinstance(stmt.target, ast.Name):
+            val = join(val, env.get(stmt.target.id, V_HOST))
+        for t in targets:
+            self._bind(t, val, env)
+
+    def _bind(self, target: ast.expr, val: AbstractValue, env: Dict[str, AbstractValue]) -> None:
+        if isinstance(target, ast.Name):
+            env[target.id] = val
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._bind(e, val, env)
+        elif isinstance(target, ast.Attribute) and isinstance(target.value, ast.Name) and target.value.id == "self":
+            env[f"self.{target.attr}"] = val
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, val, env)
+
+    def _do_if(self, stmt: ast.If, rest: List[ast.stmt], env: Dict[str, AbstractValue]) -> None:
+        cond = self.eval(stmt.test, env)
+        rank_dep = cond.kind == RANK_DEP
+        if rank_dep:
+            try:
+                cond_text = ast.unparse(stmt.test)
+            except Exception:
+                cond_text = "<cond>"
+            self._rank_ctx.append((stmt.test.lineno, cond_text))
+            # TPU013: compare the collective sequence of each path through
+            # this divergence point, including the rest of the current block
+            # (an early-returning arm skips it)
+            seq_t, term_t = self._seq_of(list(stmt.body))
+            seq_f, term_f = self._seq_of(list(stmt.orelse))
+            seq_rest, _ = self._seq_of(rest)
+            path_t = seq_t + ((), seq_rest)[not term_t]
+            path_f = seq_f + ((), seq_rest)[not term_f]
+            if path_t != path_f:
+                self._emit(
+                    "TPU013", stmt,
+                    f"code paths diverging on rank-dependent `{cond_text}` issue different "
+                    f"collective sequences ({list(path_t) or 'none'} vs {list(path_f) or 'none'}): "
+                    "ranks taking different paths issue mismatched collectives and the "
+                    "program deadlocks or reduces garbage — hoist the collective out of the "
+                    "branch or make the condition rank-invariant",
+                )
+        elif cond.deps:
+            self._param_ctx.append(cond.deps)
+        env_t, env_f = dict(env), dict(env)
+        self.walk_block(list(stmt.body), env_t)
+        if rank_dep:
+            self._rank_ctx.pop()
+        self.walk_block(list(stmt.orelse), env_f)
+        if not rank_dep and cond.deps:
+            self._param_ctx.pop()
+        merged = join_env(env_t, env_f)
+        env.clear()
+        env.update(merged)
+
+    def _do_loop(self, stmt: ast.stmt, rest: List[ast.stmt], env: Dict[str, AbstractValue]) -> None:
+        rank_dep = False
+        if isinstance(stmt, ast.While):
+            cond = self.eval(stmt.test, env)
+            rank_dep = cond.kind == RANK_DEP
+            if rank_dep:
+                try:
+                    cond_text = ast.unparse(stmt.test)
+                except Exception:
+                    cond_text = "<cond>"
+                self._rank_ctx.append((stmt.test.lineno, cond_text))
+                seq_body, _ = self._seq_of(list(stmt.body))
+                if seq_body:
+                    self._emit(
+                        "TPU013", stmt,
+                        f"`while` on rank-dependent `{cond_text}` issues collectives "
+                        f"{list(seq_body)} a rank-dependent number of times — every rank "
+                        "must run the same collective sequence",
+                    )
+        else:  # For / AsyncFor
+            it = self.eval(stmt.iter, env)
+            self._bind(stmt.target, AbstractValue(it.kind, None, it.deps), env)
+        # two passes: the second sees loop-carried kinds (join == widen here —
+        # the lattice is finite so this reaches the fixpoint for real code)
+        body_env = dict(env)
+        self.walk_block(list(stmt.body), body_env)
+        merged = join_env(env, body_env)
+        body_env = dict(merged)
+        self.walk_block(list(stmt.body), body_env)
+        merged = join_env(merged, body_env)
+        env.clear()
+        env.update(merged)
+        if rank_dep:
+            self._rank_ctx.pop()
+        self.walk_block(list(getattr(stmt, "orelse", [])), env)
+
+    # -- sequence collection (pure, no event emission) -------------------
+    def _seq_of(self, stmts: List[ast.stmt]) -> Tuple[Tuple[str, ...], bool]:
+        """Collective sequence a block issues, and whether it terminates the
+        enclosing path (ends in return/raise/continue/break). Branch-insensitive
+        inside the block: arms are concatenated in source order."""
+        out: List[str] = []
+
+        def exprs_of(node: ast.AST) -> None:
+            for c in _iter_calls(node):
+                out.extend(self._collective_kinds(c))
+
+        def walk(block: List[ast.stmt]) -> bool:
+            for s in block:
+                if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                    continue
+                if isinstance(s, ast.If):
+                    exprs_of(s.test)
+                    t = walk(list(s.body))
+                    f = walk(list(s.orelse))
+                    if t and f:
+                        return True
+                elif isinstance(s, (ast.For, ast.AsyncFor, ast.While)):
+                    exprs_of(s.test if isinstance(s, ast.While) else s.iter)
+                    walk(list(s.body))
+                    walk(list(s.orelse))
+                elif isinstance(s, ast.Try):
+                    walk(list(s.body))
+                    for h in s.handlers:
+                        walk(list(h.body))
+                    walk(list(s.orelse))
+                    if walk(list(s.finalbody)):
+                        return True
+                elif isinstance(s, (ast.With, ast.AsyncWith)):
+                    for item in s.items:
+                        exprs_of(item.context_expr)
+                    if walk(list(s.body)):
+                        return True
+                else:
+                    exprs_of(s)
+                    if isinstance(s, (ast.Return, ast.Raise, ast.Continue, ast.Break)):
+                        return True
+            return False
+
+        term = walk(stmts)
+        return tuple(out[:_SEQ_CAP]), term
+
+    def _collective_kinds(self, call: ast.Call) -> List[str]:
+        """Collective sequence one call contributes (callee summaries inlined)."""
+        func = call.func
+        leaf = ""
+        if isinstance(func, ast.Attribute):
+            leaf = func.attr
+        elif isinstance(func, ast.Name):
+            leaf = func.id
+        dotted = _resolved_dotted(self.imports, func) if isinstance(func, (ast.Attribute, ast.Name)) else ""
+        if leaf in COLLECTIVE_FNS and ("jax" in dotted or dotted == leaf):
+            return [leaf]
+        if leaf in ELASTIC_ROUND_FNS:
+            return [leaf]
+        callee = self.engine.corpus.resolve_call(self.fn.module, func, self.fn.cls, self.fn)
+        if callee is not None and callee.qualname != self.fn.qualname:
+            return list(self.engine.summarize(callee).collectives)
+        return []
+
+    # -- expression evaluation ------------------------------------------
+    def eval(self, expr: ast.expr, env: Dict[str, AbstractValue]) -> AbstractValue:
+        if isinstance(expr, ast.Constant):
+            return V_HOST
+        if isinstance(expr, ast.Name):
+            return env.get(expr.id, V_HOST)
+        if isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name) and expr.value.id == "self":
+                hit = env.get(f"self.{expr.attr}")
+                if hit is not None:
+                    return hit
+            if expr.attr in _RANK_ATTR_NAMES:
+                return V_RANK
+            if expr.attr in _META_VALUE_ATTRS:
+                return V_HOST
+            base = self.eval(expr.value, env)
+            return AbstractValue(base.kind, None, base.deps)
+        if isinstance(expr, ast.Subscript):
+            base = self.eval(expr.value, env)
+            self.eval(expr.slice, env)
+            return base
+        if isinstance(expr, ast.BinOp):
+            lv, rv = self.eval(expr.left, env), self.eval(expr.right, env)
+            if lv.spec and rv.spec and lv.spec != rv.spec:
+                self._emit(
+                    "TPU014", expr,
+                    f"operands sharded as {lv.spec} and {rv.spec} mixed in one expression "
+                    "without a resharding op between — GSPMD inserts an implicit (and "
+                    "silent) reshard; make the transfer explicit with "
+                    "with_sharding_constraint/device_put",
+                )
+            return join(lv, rv)
+        if isinstance(expr, ast.BoolOp):
+            out = V_BOTTOM
+            for v in expr.values:
+                out = join(out, self.eval(v, env))
+            return AbstractValue(max(out.kind, HOST), None, out.deps)
+        if isinstance(expr, ast.UnaryOp):
+            return self.eval(expr.operand, env)
+        if isinstance(expr, ast.Compare):
+            out = self.eval(expr.left, env)
+            for c in expr.comparators:
+                out = join(out, self.eval(c, env))
+            return AbstractValue(max(out.kind, HOST), None, out.deps)
+        if isinstance(expr, ast.IfExp):
+            cond = self.eval(expr.test, env)
+            out = join(self.eval(expr.body, env), self.eval(expr.orelse, env))
+            return join(out, AbstractValue(cond.kind, None, cond.deps))
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            out = V_BOTTOM
+            for e in expr.elts:
+                out = join(out, self.eval(e, env))
+            return out if out.kind != BOTTOM else V_HOST
+        if isinstance(expr, ast.Dict):
+            out = V_BOTTOM
+            for v in expr.values:
+                if v is not None:
+                    out = join(out, self.eval(v, env))
+            return out if out.kind != BOTTOM else V_HOST
+        if isinstance(expr, ast.Starred):
+            return self.eval(expr.value, env)
+        if isinstance(expr, (ast.Lambda, ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)):
+            return V_HOST  # opaque
+        if isinstance(expr, ast.Call):
+            return self._eval_call(expr, env)
+        if isinstance(expr, ast.JoinedStr):
+            return V_HOST
+        return V_HOST
+
+    def _eval_call(self, call: ast.Call, env: Dict[str, AbstractValue]) -> AbstractValue:
+        func = call.func
+        arg_vals = [self.eval(a, env) for a in call.args]
+        for kw in call.keywords:
+            arg_vals.append(self.eval(kw.value, env))
+        args_joined = V_BOTTOM
+        for v in arg_vals:
+            args_joined = join(args_joined, v)
+
+        leaf = ""
+        if isinstance(func, ast.Attribute):
+            leaf = func.attr
+        elif isinstance(func, ast.Name):
+            leaf = func.id
+        dotted = _resolved_dotted(self.imports, func) if isinstance(func, (ast.Attribute, ast.Name)) else ""
+
+        # rank-dependence sources
+        if leaf in _RANK_CALL_LEAFS and (leaf == "axis_index" or "jax" in dotted or dotted == leaf):
+            return AbstractValue(RANK_DEP, None, args_joined.deps)
+        # a call on a rank-named receiver/method: self._rank() etc.
+        if isinstance(func, ast.Attribute) and func.attr in _RANK_ATTR_NAMES:
+            return AbstractValue(RANK_DEP, None, args_joined.deps)
+
+        # sharding spec constructors and resharding ops
+        if leaf in ("P", "PartitionSpec", "NamedSharding"):
+            return AbstractValue(HOST, _spec_text(call), frozenset())
+        if leaf in _RESHARD_FNS and call.args:
+            spec = None
+            if len(call.args) > 1:
+                spec = _spec_text(call.args[1]) or self.eval(call.args[1], env).spec
+            base = arg_vals[0]
+            return AbstractValue(max(base.kind, TRACED), spec, base.deps)
+
+        # immediate invocation of an annotated callable: shard_map(f, ...)(x)
+        if isinstance(func, ast.Call):
+            inner_specs = _in_spec_list(func)
+            if inner_specs is not None:
+                self._check_spec_consumption(call, arg_vals, inner_specs)
+            self.eval(func, env)
+        if isinstance(func, ast.Name) and func.id in self._spec_callables:
+            self._check_spec_consumption(call, arg_vals, self._spec_callables[func.id])
+
+        # collective?
+        kinds = self._collective_kinds(call)
+        if kinds:
+            self.seq.extend(kinds)
+            del self.seq[_SEQ_CAP:]
+            if self._rank_ctx:
+                line, cond_text = self._rank_ctx[-1]
+                self._emit(
+                    "TPU012", call,
+                    f"collective `{kinds[0]}` dominated by a branch on rank-dependent "
+                    f"`{cond_text}` (line {line}): ranks that skip the branch never join "
+                    "the collective and the program deadlocks — hoist it out of the "
+                    "branch or gate on a rank-invariant value",
+                )
+            elif self._param_ctx:
+                for deps in self._param_ctx:
+                    self.rank_branch_params.update(deps)
+
+        # donation through this call
+        self._check_donation(call, leaf)
+
+        # corpus callee: refine with the summary
+        callee = self.engine.corpus.resolve_call(self.fn.module, func, self.fn.cls, self.fn)
+        if callee is not None and callee.qualname != self.fn.qualname:
+            summary = self.engine.summarize(callee)
+            offset = 1 if _flat_params(callee.node) and _flat_params(callee.node)[0].arg == "self" else 0
+            # interprocedural TPU012: rank-dep actual hits a param the callee
+            # branches on before a collective
+            for p in summary.rank_branch_params:
+                ai = p - offset
+                if 0 <= ai < len(call.args) and arg_vals[ai].kind == RANK_DEP:
+                    self._emit(
+                        "TPU012", call,
+                        f"rank-dependent value passed to `{callee.name}` parameter "
+                        f"#{p}, which the callee branches on before issuing a collective "
+                        "— the divergence deadlocks inside the callee",
+                    )
+            out = summary.returns
+            # one level of context: callee return derived from params — join in
+            # the kinds of the matching actual args
+            kind = out.kind
+            for p in out.deps:
+                ai = p - offset
+                if 0 <= ai < len(call.args):
+                    kind = max(kind, arg_vals[ai].kind)
+            return AbstractValue(kind, out.spec, args_joined.deps)
+
+        # jax/jnp library call: returns a traced array; rank-dep args dominate
+        if _is_jnp_call(call, self.imports):
+            return AbstractValue(max(TRACED, args_joined.kind), args_joined.spec, args_joined.deps)
+        # method on an array-ish receiver propagates (x.astype(...), x.sum())
+        if isinstance(func, ast.Attribute):
+            recv = self.eval(func.value, env)
+            if recv.kind >= TRACED and leaf not in _META_VALUE_ATTRS:
+                return AbstractValue(max(recv.kind, args_joined.kind), recv.spec, recv.deps | args_joined.deps)
+        # unknown call: host result, but rank-dependence survives casts
+        kind = RANK_DEP if args_joined.kind == RANK_DEP else HOST
+        return AbstractValue(kind, None, args_joined.deps)
+
+    def _check_spec_consumption(self, call: ast.Call, arg_vals: List[AbstractValue], specs: List[Optional[str]]) -> None:
+        for i, a in enumerate(call.args):
+            if i >= len(specs) and len(specs) == 1:
+                expected = specs[0]
+            elif i < len(specs):
+                expected = specs[i]
+            else:
+                expected = None
+            have = arg_vals[i].spec if i < len(arg_vals) else None
+            if expected and have and expected != have:
+                self._emit(
+                    "TPU014", call,
+                    f"leaf produced under {have} consumed by a kernel annotated for "
+                    f"{expected} without a resharding op between — insert "
+                    "with_sharding_constraint/device_put (or fix the annotation)",
+                )
+
+    def _check_donation(self, call: ast.Call, leaf: str) -> None:
+        donating = _is_donating_jit(call.func) or (
+            isinstance(call.func, ast.Name) and call.func.id in self._donating_callables
+        )
+        if donating and call.args and isinstance(call.args[0], ast.Name):
+            name = call.args[0].id
+            if name in self.param_index:
+                self.donates.add(self.param_index[name])
+            return
+        # one level through a corpus helper that donates its params
+        callee = self.engine.corpus.resolve_call(self.fn.module, call.func, self.fn.cls, self.fn)
+        if callee is None or callee.qualname == self.fn.qualname:
+            return
+        summary = self.engine.summarize(callee)
+        if not summary.donates_params:
+            return
+        offset = 1 if _flat_params(callee.node) and _flat_params(callee.node)[0].arg == "self" else 0
+        for p in summary.donates_params:
+            ai = p - offset
+            if 0 <= ai < len(call.args) and isinstance(call.args[ai], ast.Name):
+                name = call.args[ai].id
+                if name in self.param_index:
+                    self.donates.add(self.param_index[name])

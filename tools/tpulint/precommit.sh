@@ -1,0 +1,24 @@
+#!/bin/sh
+# tpulint pre-commit hook: block a commit that introduces new tracer-hygiene
+# or SPMD (TPU012/013/014) violations into the corpus.
+#
+# Install (from the repo root):
+#     ln -sf ../../tools/tpulint/precommit.sh .git/hooks/pre-commit
+#
+# The full-corpus run stays cheap (the dataflow engine's summary cache keeps
+# it well under the 10 s smoke budget); pass TPULINT_JOBS=N to shard the
+# analysis across a process pool on multi-core machines.
+set -eu
+
+REPO_ROOT=$(git rev-parse --show-toplevel)
+cd "$REPO_ROOT"
+
+JOBS="${TPULINT_JOBS:-1}"
+
+if ! python -m tools.tpulint torchmetrics_tpu --jobs "$JOBS"; then
+    echo >&2 ""
+    echo >&2 "tpulint: commit blocked — fix the violations above, add an inline"
+    echo >&2 "waiver (# tpulint: disable=TPUxxx(reason)), or inspect with:"
+    echo >&2 "    python -m tools.tpulint torchmetrics_tpu --show-waived"
+    exit 1
+fi

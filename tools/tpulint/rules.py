@@ -20,6 +20,13 @@ directly; TPU005 scans all functions (donation misuse is an eager-layer bug).
 | TPU009 | no blocking host collective without a timeout/retry policy        |
 | TPU010 | no ad-hoc module-level counter dicts (use observability.registry) |
 | TPU011 | no per-tenant metric loop in a traced path (use TenantStack)      |
+| TPU012 | no collective dominated by a branch on a rank-dependent value     |
+| TPU013 | no divergent collective sequences across paths through one root   |
+| TPU014 | no sharding-spec mismatch between producer and consumer           |
+
+TPU012/TPU013/TPU014 (and the interprocedural halves of TPU003/TPU005) are
+driven by the abstract-interpretation engine in :mod:`.dataflow`; the rest
+are single-pass syntactic checks.
 """
 from __future__ import annotations
 
@@ -36,8 +43,12 @@ from .callgraph import (
     host_only_lines,
 )
 from .corpus import ClassInfo, Corpus, FunctionInfo, ModuleInfo
+from .dataflow import DataflowEngine, _is_donating_jit  # noqa: F401  (re-exported)
 
-ALL_RULES = ("TPU000", "TPU001", "TPU002", "TPU003", "TPU004", "TPU005", "TPU006", "TPU007", "TPU008", "TPU009", "TPU010", "TPU011")
+ALL_RULES = (
+    "TPU000", "TPU001", "TPU002", "TPU003", "TPU004", "TPU005", "TPU006",
+    "TPU007", "TPU008", "TPU009", "TPU010", "TPU011", "TPU012", "TPU013", "TPU014",
+)
 
 RULE_TITLES = {
     "TPU000": "malformed waiver",
@@ -52,6 +63,29 @@ RULE_TITLES = {
     "TPU009": "blocking host collective without timeout/retry policy",
     "TPU010": "ad-hoc module-level counter dict (use observability.registry)",
     "TPU011": "per-tenant metric loop in a traced path (use TenantStack)",
+    "TPU012": "collective divergence (rank-dependent branch dominates a collective)",
+    "TPU013": "collective-order mismatch across code paths",
+    "TPU014": "sharding-spec mismatch between producer and consumer",
+}
+
+# severity tiers: `error` = correctness/deadlock (wrong numbers, hung pods,
+# deleted buffers); `warn` = performance/hygiene (slow but right)
+RULE_SEVERITY = {
+    "TPU000": "warn",
+    "TPU001": "error",
+    "TPU002": "error",
+    "TPU003": "error",
+    "TPU004": "error",
+    "TPU005": "error",
+    "TPU006": "warn",
+    "TPU007": "warn",
+    "TPU008": "error",
+    "TPU009": "error",
+    "TPU010": "warn",
+    "TPU011": "warn",
+    "TPU012": "error",
+    "TPU013": "error",
+    "TPU014": "error",
 }
 
 
@@ -69,6 +103,10 @@ class Violation:
 
     def key(self) -> Tuple[str, str, str]:
         return (self.path, self.symbol, self.rule)
+
+    @property
+    def severity(self) -> str:
+        return RULE_SEVERITY.get(self.rule, "error")
 
     def format(self) -> str:
         return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message} [{self.symbol}]"
@@ -114,9 +152,10 @@ def _alias_targets(mod_imports: Dict[str, str], node: ast.expr) -> str:
 class _FunctionContext:
     """Shared per-function analysis state for the traced-path rules."""
 
-    def __init__(self, fn: FunctionInfo, corpus: Corpus) -> None:
+    def __init__(self, fn: FunctionInfo, corpus: Corpus, engine: Optional[DataflowEngine] = None) -> None:
         self.fn = fn
         self.corpus = corpus
+        self.engine = engine
         self.imports = fn.module.imports
         self.host_lines = host_only_lines(fn.node)
         self.taint: Taint = compute_taint(fn, self.imports)
@@ -125,9 +164,11 @@ class _FunctionContext:
         return getattr(node, "lineno", 0) not in self.host_lines
 
 
-def check_traced_rules(fn: FunctionInfo, corpus: Corpus, roots: Set[str]) -> List[Violation]:
+def check_traced_rules(
+    fn: FunctionInfo, corpus: Corpus, roots: Set[str], engine: Optional[DataflowEngine] = None
+) -> List[Violation]:
     """TPU001/TPU002/TPU003/TPU006 over one jit-reachable function."""
-    ctx = _FunctionContext(fn, corpus)
+    ctx = _FunctionContext(fn, corpus, engine)
     out: List[Violation] = []
     root_note = "" if fn.qualname in roots else f" (reachable from {sorted(roots)[0]})"
 
@@ -339,6 +380,11 @@ def _test_depends_on_array(test: ast.expr, ctx: _FunctionContext) -> bool:
         f = test.func
         if isinstance(f, ast.Attribute) and f.attr in ("any", "all") and ctx.taint.is_array_expr(f.value):
             return True
+        # interprocedural (one level of function return): branching on a
+        # corpus helper whose dataflow summary returns a traced array —
+        # `if _normalize(preds): ...` concretizes just like `if preds: ...`
+        if ctx.engine is not None and ctx.engine.call_returns_traced(ctx.fn, test):
+            return True
     if isinstance(test, ast.Attribute) or isinstance(test, ast.Subscript):
         return ctx.taint.is_array_expr(test)
     return False
@@ -483,12 +529,15 @@ def _default_is_integer(default: ast.expr, imports: Dict[str, str]) -> bool:
 # --- TPU005: use-after-donation --------------------------------------------
 
 
-def check_use_after_donation(fn: FunctionInfo) -> List[Violation]:
+def check_use_after_donation(fn: FunctionInfo, engine: Optional[DataflowEngine] = None) -> List[Violation]:
     """Flag reads of a variable after it was passed to a donating jit call.
 
     Donated buffers are deallocated by XLA on dispatch; a later host read
     raises ``RuntimeError: Array has been deleted`` only at runtime — and only
-    on backends that honor donation, so CPU tests never catch it.
+    on backends that honor donation, so CPU tests never catch it. With the
+    dataflow ``engine``, donation is also tracked one level through helper
+    calls: passing a buffer to a corpus function whose summary says it
+    forwards that parameter into a donating jit counts as donating it here.
     """
     out: List[Violation] = []
     donating: Set[str] = set()  # names bound to donating jitted callables
@@ -499,11 +548,6 @@ def check_use_after_donation(fn: FunctionInfo) -> List[Violation]:
                 if isinstance(t, ast.Name):
                     donating.add(t.id)
 
-    if not donating and not any(
-        isinstance(n, ast.Call) and _is_donating_jit(n.func) for n in ast.walk(fn.node)
-    ):
-        return out
-
     donated: Dict[str, int] = {}  # var name -> line of the donating call
     for node in ast.walk(fn.node):
         if isinstance(node, ast.Call):
@@ -512,6 +556,18 @@ def check_use_after_donation(fn: FunctionInfo) -> List[Violation]:
             ) or _is_donating_jit(node.func)
             if is_donating_call and node.args and isinstance(node.args[0], ast.Name):
                 donated.setdefault(node.args[0].id, node.lineno)
+            elif engine is not None:
+                # interprocedural: helper that donates the matching param
+                callee = engine.corpus.resolve_call(fn.module, node.func, fn.cls, fn)
+                if callee is not None and callee.qualname != fn.qualname:
+                    summary = engine.summarize(callee)
+                    if summary.donates_params:
+                        params = _callee_params(callee)
+                        offset = 1 if params and params[0] == "self" else 0
+                        for p in summary.donates_params:
+                            ai = p - offset
+                            if 0 <= ai < len(node.args) and isinstance(node.args[ai], ast.Name):
+                                donated.setdefault(node.args[ai].id, node.lineno)
 
     if not donated:
         return out
@@ -598,27 +654,23 @@ def check_unguarded_host_collective(fn: FunctionInfo) -> List[Violation]:
     return out
 
 
-def _is_donating_jit(expr: ast.expr) -> bool:
-    """``jax.jit(..., donate_argnums=...)`` / ``*._get_jitted(..., donate_state=True)``
-    / ``_global_jit(..., donate_state=True)``."""
-    if not isinstance(expr, ast.Call):
-        return False
-    dotted = _dotted_name(expr.func) or ""
-    tail = dotted.split(".")[-1]
-    if tail == "jit":
-        return any(k.arg == "donate_argnums" and not _is_empty_tuple(k.value) for k in expr.keywords)
-    if tail in ("_get_jitted", "_global_jit"):
-        for k in expr.keywords:
-            if k.arg == "donate_state" and isinstance(k.value, ast.Constant) and k.value.value is True:
-                return True
-        pos = 2 if tail == "_get_jitted" else 2
-        if len(expr.args) > pos and isinstance(expr.args[pos], ast.Constant) and expr.args[pos].value is True:
-            return True
-    return False
+def _callee_params(fn: FunctionInfo) -> List[str]:
+    args = fn.node.args
+    return [a.arg for a in list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)]
 
 
-def _is_empty_tuple(node: ast.expr) -> bool:
-    return isinstance(node, ast.Tuple) and not node.elts
+# --- TPU012/TPU013/TPU014: dataflow-engine rules ----------------------------
+
+
+def check_dataflow_rules(fn: FunctionInfo, engine: DataflowEngine) -> List[Violation]:
+    """Emit the TPU012/TPU013/TPU014 events the dataflow engine recorded for
+    one function (collective divergence, collective-order mismatch,
+    sharding-spec mismatch — see :mod:`.dataflow` for the analysis)."""
+    summary = engine.summarize(fn)
+    return [
+        Violation(rule, fn.path, line, col, msg, fn.qualname)
+        for rule, line, col, msg in summary.events
+    ]
 
 
 # ------------------------------------------------------------------ TPU010

@@ -1,0 +1,70 @@
+"""SARIF 2.1.0 export so CI and editors consume tpulint findings natively.
+
+One ``run`` per invocation; every rule in the catalog is declared on the
+driver with its severity tier as ``defaultConfiguration.level``; new
+violations become ``results``, waived/baselined ones are emitted as
+suppressed results (``suppressions``) so SARIF viewers show the full audit
+trail without failing the build on them.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+from .rules import ALL_RULES, RULE_SEVERITY, RULE_TITLES, Violation
+
+SARIF_SCHEMA = "https://docs.oasis-open.org/sarif/sarif/v2.1.0/errata01/os/schemas/sarif-schema-2.1.0.json"
+SARIF_VERSION = "2.1.0"
+
+_LEVELS = {"error": "error", "warn": "warning"}
+
+
+def _result(v: Violation) -> Dict:
+    out: Dict = {
+        "ruleId": v.rule,
+        "level": _LEVELS.get(RULE_SEVERITY.get(v.rule, "error"), "error"),
+        "message": {"text": f"{v.message} [{v.symbol}]"},
+        "locations": [
+            {
+                "physicalLocation": {
+                    "artifactLocation": {"uri": v.path.replace("\\", "/")},
+                    "region": {"startLine": max(1, v.line), "startColumn": max(1, v.col + 1)},
+                }
+            }
+        ],
+    }
+    if v.waived:
+        out["suppressions"] = [
+            {"kind": "inSource", "justification": v.waive_reason or "waived"}
+        ]
+    elif v.baselined:
+        out["suppressions"] = [{"kind": "external", "justification": "baselined"}]
+    return out
+
+
+def to_sarif(result) -> Dict:
+    """Convert a :class:`tools.tpulint.LintResult` to a SARIF 2.1.0 dict."""
+    rules: List[Dict] = [
+        {
+            "id": rule,
+            "name": rule,
+            "shortDescription": {"text": RULE_TITLES.get(rule, rule)},
+            "defaultConfiguration": {"level": _LEVELS.get(RULE_SEVERITY.get(rule, "error"), "error")},
+        }
+        for rule in ALL_RULES
+    ]
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "tpulint",
+                        "informationUri": "docs/static_analysis.md",
+                        "rules": rules,
+                    }
+                },
+                "results": [_result(v) for v in result.violations],
+            }
+        ],
+    }

@@ -196,7 +196,9 @@ class CatBuffer:
         return self.buffer[: self.count]
 
     def rows(self, start: int, stop: int) -> Array:
-        return self.buffer[start:stop]
+        """Rows ``[start, stop)`` of the valid region; ``stop`` is clamped to
+        ``count`` so capacity padding never leaks into a sync payload."""
+        return self.buffer[start : min(stop, self.count)]
 
     def snapshot(self) -> "CatBuffer":
         """Cheap O(1) copy sharing the device buffer; the next append on

@@ -351,13 +351,15 @@ class TenantStack(Metric):
     # fused dispatch: vmap the template's pure update over the slot axis
     # ------------------------------------------------------------------
     def _eager_validate(self, *args: Any, **kwargs: Any) -> None:
-        for a in tuple(args) + tuple(kwargs.values()):
+        labelled = [(f"args[{i}]", a) for i, a in enumerate(args)]
+        labelled += sorted(kwargs.items())  # deterministic check order
+        for label, a in labelled:
             shape = jnp.shape(a) if hasattr(a, "shape") else None
             if shape is not None and (len(shape) == 0 or shape[0] != self.slots):
                 raise ValueError(
-                    f"TenantStack inputs need a leading ({self.slots},) tenant-slot "
-                    f"axis, got shape {shape}; stack per-tenant batches with "
-                    "jnp.stack (rows for empty slots are ignored)."
+                    f"TenantStack input {label!r} needs a leading ({self.slots},) "
+                    f"tenant-slot axis, got shape {shape}; stack per-tenant "
+                    "batches with jnp.stack (rows for empty slots are ignored)."
                 )
 
     def update(self, *args: Any, **kwargs: Any) -> None:

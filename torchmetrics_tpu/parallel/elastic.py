@@ -605,7 +605,10 @@ class ElasticSync(SyncBackend):
             self._present -= self._suspects
         if hasattr(inner, "recovery_barrier"):
             try:
-                inner.recovery_barrier()
+                # the probe must not outlive the retry budget it runs inside:
+                # an unbounded barrier (inner default timeout may be None)
+                # would wedge the whole retry loop on one dead peer
+                inner.recovery_barrier(timeout_s=_BACKOFF_CAP_S)
             except TimeoutError:
                 # still wedged: the next attempt raises again and burns its
                 # share of the budget — bounded by retry_attempts

@@ -374,7 +374,14 @@ def _zeros_psum_gather(v: Array, axis_name: str, n: int) -> Array:
 
 
 def _stack_gather(v: Array, axis_name: str, n: int, policy: SyncPolicy) -> Array:
-    """(n, *v.shape) gather, policy-routed."""
+    """(n, *v.shape) gather, policy-routed.
+
+    The policy must be process-uniform: the branch below selects which
+    collective gets compiled, so processes disagreeing on
+    ``use_all_gather()`` issue mismatched collective sequences and hang
+    the mesh. Host config, not a rank-dependent value — tpulint TPU012/013
+    check the latter; uniformity of the former is this call's contract.
+    """
     if policy.use_all_gather():
         record_collective("all_gather", v.size * v.dtype.itemsize, n, dtype=v.dtype)
         return lax.all_gather(v, axis_name)

@@ -275,7 +275,10 @@ def reduce_state_in_graph(
         return (dt, len(lst) - 1)
 
     fallbacks: list = []  # (name, value, red) — per-leaf path (odd reductions)
-    for name, value in state.items():
+    # canonical name order: every process must issue the same collective
+    # sequence with the same bucket layout, even if its state dict was built
+    # in a different insertion order (TPU013 — divergent order hangs the mesh)
+    for name, value in sorted(state.items()):
         red = reductions.get(name, Reduction.NONE)
         gatherish = red in (Reduction.CAT, Reduction.NONE) or (
             not isinstance(red, Reduction) and callable(red)
