@@ -342,6 +342,196 @@ def _telemetry_smoke() -> dict:
     }
 
 
+def _sharded_cat_smoke() -> dict:
+    """Sharded cat-state gate (ISSUE 20), four invariants:
+
+    (a) residency: at n=1e6 the peak per-device resident bytes of a
+        ``ShardedCatBuffer`` must be <= 1/4 of the replicated ``CatBuffer``
+        (the layout pays ~1/world; the slack absorbs per-shard pow2
+        rounding on meshes the row count doesn't divide);
+    (b) parity: a ``BinaryPrecisionRecallCurve`` twin pair — sharded vs
+        replicated state, identical updates — must agree BITWISE. The
+        sharded read path is ``cat_compact``, whose stable compaction
+        reproduces shard-major materialization exactly, so this is an
+        equality gate, not a tolerance gate. The ``sharded_oracle()``
+        gather must also see the same multiset of rows;
+    (c) retraces: steady-state lockstep appends plus a fixed-shape
+        ``sharded_histogram`` reader run under ``strict_mode`` with zero
+        retraces and zero new executables;
+    (d) chaos: a ChaosSync preemption -> rejoin round on sharded state
+        degrades to the documented coverage fraction, then recovers the
+        preempted rank's checkpoint through the reshard plan
+        (``merge_on_rejoin(..., devices=...)``) with oracle parity.
+    """
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    import torchmetrics_tpu.metric as M
+    from torchmetrics_tpu.buffers import (
+        CatBuffer,
+        ShardedCatBuffer,
+        _capacity_for,
+        batch_sharding,
+        default_eval_mesh,
+    )
+    from torchmetrics_tpu.classification import BinaryPrecisionRecallCurve
+    from torchmetrics_tpu.debug import StrictModeViolation, strict_mode
+    from torchmetrics_tpu.parallel.elastic import (
+        ChaosSchedule,
+        ElasticSync,
+        chaos_group,
+        checkpoint_metric,
+        elastic_stats,
+    )
+    from torchmetrics_tpu.parallel.sharded_compute import sharded_histogram
+    from torchmetrics_tpu.parallel.strategies import SyncPolicy
+    from torchmetrics_tpu.regression import SpearmanCorrCoef
+    from torchmetrics_tpu.utils.data import dim_zero_cat, sharded_oracle
+
+    world = jax.device_count()
+    mesh = default_eval_mesh()
+    rng = np.random.RandomState(11)
+
+    # (a) residency at n=1e6 — one bulk append each, then drop the buffers
+    n_big = 1_000_000
+    big = jnp.zeros((n_big,), jnp.float32)
+    rep_big = CatBuffer.allocate(big)
+    sh_big = ShardedCatBuffer.allocate(big, mesh=mesh)
+    replicated_bytes = int(rep_big.buffer.size) * rep_big.buffer.dtype.itemsize
+    sharded_peak = max(int(v) for v in sh_big.per_device_nbytes().values())
+    bytes_ok = sharded_peak * 4 <= replicated_bytes
+    del rep_big, sh_big, big
+
+    # (b) bitwise PR-curve parity, sharded read path vs replicated oracle
+    msh = BinaryPrecisionRecallCurve(
+        list_layout="padded", cat_layout="sharded", validate_args=False
+    )
+    mrep = BinaryPrecisionRecallCurve(validate_args=False)
+    for _ in range(4):
+        p = jnp.asarray(rng.rand(512).astype(np.float32))
+        t = jnp.asarray(rng.randint(0, 2, 512).astype(np.int32))
+        msh.update(p, t)
+        mrep.update(p, t)
+    pr_bitwise = all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(msh.compute(), mrep.compute())
+    )
+    with sharded_oracle():
+        gathered = np.sort(np.asarray(dim_zero_cat(msh.preds)))
+    oracle_gather_ok = np.array_equal(
+        gathered, np.sort(np.asarray(dim_zero_cat(mrep.preds)))
+    )
+
+    # (c) zero steady-state retraces: pre-sized buffer (no grow in the
+    # window), lockstep appends + a count-invariant histogram read. The
+    # transfer guard stays off: an append's device-to-device scatter of the
+    # incoming increment onto the NamedSharding is the layout's designed
+    # ingest path, not a leak — the gate is retraces/new executables.
+    batch = 64
+    cap = _capacity_for(-(-(1024 + 8 * batch) // world))
+    sbuf = ShardedCatBuffer(
+        jax.device_put(jnp.zeros((world, cap), jnp.float32), batch_sharding(mesh)),
+        np.zeros(world, np.int32),
+        mesh=mesh,
+    )
+    incs = [jnp.asarray(rng.rand(batch).astype(np.float32)) for _ in range(8)]
+    sbuf.append(jnp.asarray(rng.rand(1024).astype(np.float32)))  # bulk warm
+    sbuf.append(incs[0])  # warms the steady append kernel + device counts
+    hist = sharded_histogram(sbuf, bins=256)
+    jax.block_until_ready(hist)
+    retrace_before = M.executable_cache_stats()["retraces"]
+    sharded_strict_ok = True
+    try:
+        with strict_mode(
+            transfer_guard=None, max_retraces=0, max_new_executables=0
+        ):
+            for inc in incs[1:]:
+                sbuf.append(inc)
+                hist = sharded_histogram(sbuf, bins=256)
+            jax.block_until_ready(hist)
+    except StrictModeViolation:
+        sharded_strict_ok = False
+    steady_retraces = M.executable_cache_stats()["retraces"] - retrace_before
+
+    # (d) preemption -> rejoin through the reshard plan
+    n_r = 48
+    sms = [
+        SpearmanCorrCoef(list_layout="padded", cat_layout="sharded") for _ in range(2)
+    ]
+    datas = []
+    for m_ in sms:
+        p = rng.rand(n_r).astype(np.float32)
+        t = (p * 2 + rng.rand(n_r).astype(np.float32) * 0.2).astype(np.float32)
+        m_.update(jnp.asarray(p), jnp.asarray(t))
+        datas.append((p, t))
+    orc = SpearmanCorrCoef(list_layout="padded")
+    orc.update(
+        jnp.asarray(np.concatenate([d[0] for d in datas])),
+        jnp.asarray(np.concatenate([d[1] for d in datas])),
+    )
+    expect = float(orc.compute())
+    blob = checkpoint_metric(sms[1])  # rank 1 checkpoints, then is preempted
+    cbacks = chaos_group(
+        [m_.metric_state for m_ in sms], ChaosSchedule({0: [("drop", 1)]})
+    )
+    sms[0]._sync_backend = ElasticSync(
+        cbacks[0], policy=SyncPolicy(retry_attempts=2, backoff_base_s=0.01)
+    )
+    cbacks[0].advance_round()
+    float(sms[0].compute())  # degraded round: rank 0's own partial
+    cov_drop = sms[0].coverage
+    rejoins_before = elastic_stats()["rejoins"]
+    recovered = sms[0]._sync_backend.merge_on_rejoin(
+        sms[0], blob, devices=jax.devices()
+    )
+    rejoins = elastic_stats()["rejoins"] - rejoins_before
+    still_sharded = isinstance(sms[0].preds, ShardedCatBuffer)
+    resharded_over_world = still_sharded and sms[0].preds.n_shards == world
+    sms[0]._sync_backend = None
+    sms[0]._computed = None
+    rejoined = float(sms[0].compute())
+    chaos_ok = (
+        cov_drop is not None
+        and cov_drop.ranks_present == 1
+        and cov_drop.ranks_expected == 2
+        and recovered == n_r
+        and rejoins == 1
+        and still_sharded
+        and resharded_over_world
+        and abs(rejoined - expect) < 1e-6
+    )
+
+    return {
+        "ok": (
+            bytes_ok
+            and pr_bitwise
+            and oracle_gather_ok
+            and sharded_strict_ok
+            and steady_retraces == 0
+            and chaos_ok
+        ),
+        "world": world,
+        "bytes_ok": bytes_ok,
+        "replicated_bytes_per_device": replicated_bytes,
+        "sharded_peak_bytes_per_device": sharded_peak,
+        "residency_ratio": round(sharded_peak / replicated_bytes, 4),
+        "pr_curve_bitwise": pr_bitwise,
+        "oracle_gather_ok": oracle_gather_ok,
+        "strict_ok": sharded_strict_ok,
+        "steady_retraces": steady_retraces,
+        "chaos_ok": chaos_ok,
+        "chaos": {
+            "drop_coverage": cov_drop.as_dict() if cov_drop is not None else None,
+            "recovered_samples": recovered,
+            "rejoins": rejoins,
+            "resharded_over_world": resharded_over_world,
+            "rejoined_matches_oracle": abs(rejoined - expect) < 1e-6,
+        },
+    }
+
+
 def bench_smoke() -> dict:
     """CPU-safe sanity pass: tiny shapes, one rep, no backend probe.
 
@@ -785,6 +975,13 @@ def bench_smoke() -> dict:
         and mt["ledger_key"] == "update[TenantStack[MulticlassAccuracy]×256]"
     )
 
+    # sharded cat-state gate (ISSUE 20): residency <= 1/4 replicated at
+    # n=1e6, bitwise PR-curve parity vs the replicated oracle, zero
+    # steady-state retraces under strict_mode, and a ChaosSync preemption ->
+    # rejoin round recovering through the reshard plan
+    shc = _sharded_cat_smoke()
+    sharded_cat_ok = bool(shc["ok"])
+
     telemetry = _telemetry_smoke()
     telemetry_ok = bool(telemetry["ok"])
 
@@ -834,6 +1031,7 @@ def bench_smoke() -> dict:
             and autotune_ok
             and ledger_ok
             and multi_tenant_ok
+            and sharded_cat_ok
         ),
         "dispatches_per_update": dispatches,
         "clone_new_compilations": clone_misses,
@@ -904,6 +1102,8 @@ def bench_smoke() -> dict:
         },
         "multi_tenant_ok": multi_tenant_ok,
         "multi_tenant": mt,
+        "sharded_cat_ok": sharded_cat_ok,
+        "sharded_cat": shc,
         "ledger_ok": ledger_ok,
         "ledger": {
             "entries": len(ledger_entries),
@@ -1609,6 +1809,148 @@ def bench_cat_append() -> dict:
     }
 
 
+def _sharded_cat_case(n_rows: int, batch: int = 64, measure: int = 20, reps: int = 3) -> dict:
+    """One sharded-vs-replicated cat-state comparison at ~``n_rows`` rows.
+
+    Three observables per size (the ISSUE 20 contract):
+
+    * residency — peak resident cat-state bytes on the busiest device. A
+      replicated layout pays the full pow2 buffer on EVERY device of a
+      data-parallel eval; the sharded layout pays ~1/world of it;
+    * append throughput — steady-state lockstep appends (preds + target,
+      one metric update's worth) through the cached donated per-shard
+      slab kernel, zero retraces;
+    * exact-AUROC compute latency — the sharded read path (bucketed
+      histogram, O(bins) psum, ε = O(1/bins)) vs gather-then-compute
+      (exact sort over the materialized rows), fresh host data per rep
+      (the remote layer memoizes identical dispatches, see
+      ``bench_auroc_exact``).
+    """
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    import torchmetrics_tpu.metric as M
+    from torchmetrics_tpu.buffers import (
+        CatBuffer,
+        ShardedCatBuffer,
+        _capacity_for,
+        batch_sharding,
+        default_eval_mesh,
+    )
+    from torchmetrics_tpu.functional.classification import _exact_jit as EJ
+    from torchmetrics_tpu.parallel.sharded_compute import histogram_auroc
+
+    world = jax.device_count()
+    mesh = default_eval_mesh()
+    rng = np.random.RandomState(29)
+    measure = min(measure, max(2, n_rows // (2 * batch)))
+    warm_rows = max(batch, n_rows - measure * batch)
+    preds_np = (rng.rand(warm_rows) + _SALT_BASE).astype(np.float32)
+    target_np = rng.randint(0, 2, warm_rows).astype(np.float32)
+
+    # pre-sized buffers: no grow inside the measured window on either side
+    cap = _capacity_for(-(-(warm_rows + (measure + 2) * batch) // world))
+
+    def _mk_sharded() -> ShardedCatBuffer:
+        return ShardedCatBuffer(
+            jax.device_put(jnp.zeros((world, cap), jnp.float32), batch_sharding(mesh)),
+            np.zeros(world, np.int32),
+            mesh=mesh,
+        )
+
+    sh_p, sh_t = _mk_sharded(), _mk_sharded()
+    sh_p.append(jnp.asarray(preds_np))
+    sh_t.append(jnp.asarray(target_np))
+    rep_cap = _capacity_for(warm_rows + (measure + 2) * batch)
+    rep_p = CatBuffer(jnp.zeros((rep_cap,), jnp.float32), 0)
+    rep_p.append(jnp.asarray(preds_np))
+
+    replicated_bytes = int(rep_p.buffer.size) * rep_p.buffer.dtype.itemsize
+    sharded_peak = max(int(v) for v in sh_p.per_device_nbytes().values())
+
+    incs_p = [
+        jnp.asarray((rng.rand(batch) + _SALT_BASE).astype(np.float32))
+        for _ in range(measure + 1)
+    ]
+    incs_t = [
+        jnp.asarray(rng.randint(0, 2, batch).astype(np.float32))
+        for _ in range(measure + 1)
+    ]
+    sh_p.append(incs_p[0])  # warms the steady batch-append kernel
+    sh_t.append(incs_t[0])
+    jax.block_until_ready((sh_p.buffer, sh_t.buffer))
+    before = M.executable_cache_stats()["retraces"]
+    t0 = time.perf_counter()
+    for i in range(1, measure + 1):
+        sh_p.append(incs_p[i])
+        sh_t.append(incs_t[i])
+    jax.block_until_ready((sh_p.buffer, sh_t.buffer))
+    append_s = time.perf_counter() - t0
+    steady_retraces = M.executable_cache_stats()["retraces"] - before
+
+    # AUROC latency: rep 0 is the untimed warmup (compiles both paths)
+    n_now = sh_p.count
+    tgt_full = rng.randint(0, 2, n_now).astype(np.float32)
+    hist_times, sort_times = [], []
+    for r in range(reps + 1):
+        fresh = (rng.rand(n_now) + _SALT_BASE).astype(np.float32)
+        fp = ShardedCatBuffer.allocate(jnp.asarray(fresh), mesh=mesh)
+        ft = ShardedCatBuffer.allocate(jnp.asarray(tgt_full), mesh=mesh)
+        jax.block_until_ready((fp.buffer, ft.buffer))
+        t0 = time.perf_counter()
+        float(histogram_auroc(fp, ft, bins=8192))
+        hist_dt = time.perf_counter() - t0
+        rp = jnp.asarray(fresh)
+        rt = jnp.asarray(tgt_full.astype(np.int32))
+        jax.block_until_ready((rp, rt))
+        t0 = time.perf_counter()
+        float(EJ.binary_auroc_exact(rp, rt))
+        sort_dt = time.perf_counter() - t0
+        if r:
+            hist_times.append(hist_dt)
+            sort_times.append(sort_dt)
+    hist_s = sorted(hist_times)[len(hist_times) // 2]
+    sort_s = sorted(sort_times)[len(sort_times) // 2]
+
+    return {
+        "n_rows": int(n_now),
+        "world": world,
+        "batch": batch,
+        "measured_ops": measure,
+        "replicated_bytes_per_device": replicated_bytes,
+        "sharded_peak_bytes_per_device": sharded_peak,
+        "residency_ratio": round(sharded_peak / replicated_bytes, 4),
+        "sharded_appends_per_s": round(measure / append_s, 1) if append_s > 0 else 0.0,
+        "steady_retraces": steady_retraces,
+        "hist_auroc_s": round(hist_s, 5),
+        "gather_sort_auroc_s": round(sort_s, 5),
+        "auroc_speedup_vs_gather": round(sort_s / hist_s, 2) if hist_s else None,
+    }
+
+
+def bench_cat_sharded() -> dict:
+    """Sharded cat state (ISSUE 20) vs replicated, n ∈ {1e4, 1e6}. The
+    headline value is steady-state lockstep appends/s at n=1e6; vs_baseline
+    is the exact-AUROC latency ratio of gather-then-compute over the
+    bucketed-histogram read path at the same size."""
+    cases = {f"n{n}": _sharded_cat_case(n) for n in (10_000, 1_000_000)}
+    big = cases["n1000000"]
+    return {
+        "value": big["sharded_appends_per_s"],
+        "unit": f"appends/s (sharded cat state, batch=64, n=1e6, world={big['world']})",
+        "vs_baseline": big["auroc_speedup_vs_gather"],
+        "note": (
+            "residency_ratio = peak per-device resident cat bytes "
+            "sharded/replicated (~1/world); AUROC comparison is the 8192-bin "
+            "histogram psum (eps = O(1/bins)) vs the exact sort over "
+            "gathered rows"
+        ),
+        "cases": cases,
+    }
+
+
 def bench_online_stream() -> dict:
     """Online evaluation stream: events/s through a buffered windowed +
     decayed + sketch metric stack (the serving-traffic shape of
@@ -1938,6 +2280,7 @@ _CONFIGS = {
     "bertscore_kernel": "bench_config5",
     "bootstrap_vmap": "bench_bootstrap",
     "cat_append": "bench_cat_append",
+    "cat_sharded": "bench_cat_sharded",
     "online_stream": "bench_online_stream",
     "multi_tenant": "bench_multi_tenant",
 }
@@ -2103,6 +2446,14 @@ def main() -> None:
     if len(sys.argv) > 1 and sys.argv[1] == "--smoke":
         # CPU-safe, probe-free: must work in CI / tier-1 without a TPU tunnel
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        # the sharded cat gate needs a mesh: force 8 virtual host devices.
+        # tests/conftest.py does this for pytest runs; a standalone --smoke
+        # must do it itself, before jax first initializes
+        _flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in _flags:
+            os.environ["XLA_FLAGS"] = (
+                _flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
         print(json.dumps(bench_smoke()))
         return
     if len(sys.argv) > 1 and sys.argv[1] == "--baseline":

@@ -90,6 +90,23 @@ def test_bench_smoke_passes():
     assert mt["slot_count_moves_key"] is True, result
     assert mt["replay_strict_ok"] is True and mt["replay_retraces"] == 0, result
     assert mt["ledger_key"] == "update[TenantStack[MulticlassAccuracy]×256]", result
+    # sharded cat-state gate: at n=1e6 the peak per-device resident bytes
+    # must be <= 1/4 of the replicated layout (actual ~1/world), the
+    # PR-curve read path bitwise-matches the replicated oracle, steady-state
+    # appends hold zero retraces under strict_mode, and a ChaosSync
+    # preemption -> rejoin round recovers through the reshard plan with
+    # correct coverage
+    assert result["sharded_cat_ok"] is True, result
+    shc = result["sharded_cat"]
+    assert shc["bytes_ok"] is True, result
+    assert shc["sharded_peak_bytes_per_device"] * 4 <= shc["replicated_bytes_per_device"], result
+    assert shc["pr_curve_bitwise"] is True, result
+    assert shc["oracle_gather_ok"] is True, result
+    assert shc["strict_ok"] is True and shc["steady_retraces"] == 0, result
+    assert shc["chaos_ok"] is True, result
+    assert shc["chaos"]["drop_coverage"]["fraction"] == 0.5, result
+    assert shc["chaos"]["resharded_over_world"] is True, result
+    assert shc["chaos"]["rejoined_matches_oracle"] is True, result
     # ledger gate: a complete device-truth entry (flops, bytes, compiled
     # footprint, donation set) for every executable the smoke run minted,
     # and a roofline row per entry derived from cost_analysis()

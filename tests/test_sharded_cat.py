@@ -1,0 +1,462 @@
+"""Sharded cat state: buffer layout, distributed kernels, reshard plan.
+
+Covers ISSUE 20: the resident ``NamedSharding`` :class:`ShardedCatBuffer`,
+the distributed read paths in ``parallel.sharded_compute`` (bitwise for
+sort-based consumers, documented ε for the bucketed-histogram backend), the
+refused-densify contract with the ``sharded_oracle()`` escape hatch, and the
+reshard plan under elastic preemption/rejoin (uneven counts, empty shards,
+larger mesh, double-preemption) with coverage accounting.
+
+Runs on 8 virtual CPU devices (conftest.py forces
+``--xla_force_host_platform_device_count=8``).
+"""
+import copy
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import KendallRankCorrCoef, SpearmanCorrCoef
+from torchmetrics_tpu.buffers import CatBuffer, ShardedCatBuffer, default_eval_mesh
+from torchmetrics_tpu.classification.auroc import BinaryAUROC
+from torchmetrics_tpu.classification.precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+)
+from torchmetrics_tpu.parallel import sharded_compute as sc
+from torchmetrics_tpu.parallel.elastic import (
+    ChaosSchedule,
+    ElasticSync,
+    chaos_group,
+    checkpoint_metric,
+    merge_checkpoint,
+    rejoin_metric,
+    reset_elastic_stats,
+)
+from torchmetrics_tpu.parallel.strategies import SyncPolicy
+from torchmetrics_tpu.parallel.sync import FakeSync
+from torchmetrics_tpu.retrieval import RetrievalMRR
+from torchmetrics_tpu.utils.data import dim_zero_cat, padded_cat, sharded_oracle
+
+WORLD = len(jax.devices())
+
+FAST = SyncPolicy(retry_attempts=2, backoff_base_s=0.001)
+
+
+def _rand(n, seed=0):
+    return np.random.RandomState(seed).rand(n).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# buffer layout
+# ---------------------------------------------------------------------------
+
+
+def test_allocate_shards_across_all_devices():
+    buf = ShardedCatBuffer.allocate(jnp.asarray(_rand(100)))
+    assert buf.n_shards == WORLD
+    assert buf.count == 100
+    per_dev = buf.per_device_nbytes()
+    assert len(per_dev) == WORLD
+    # balanced layout: every device holds the same resident bytes
+    assert len(set(per_dev.values())) == 1
+
+
+def test_append_grow_and_materialize_order_stable():
+    data = _rand(1000, seed=1)
+    buf = ShardedCatBuffer.allocate(jnp.asarray(data[:64]))
+    for i in range(64, 1000, 64):
+        buf.append(jnp.asarray(data[i : i + 64]))
+    assert buf.count == 1000
+    # shard-major materialization is a permutation of the appended rows
+    rows = np.sort(np.asarray(buf.materialize()))
+    np.testing.assert_array_equal(rows, np.sort(data))
+    # and cat_compact reproduces materialize() order bitwise
+    np.testing.assert_array_equal(
+        np.asarray(sc.cat_compact(buf)), np.asarray(buf.materialize())
+    )
+
+
+def test_uneven_counts_small_append():
+    # 3 rows over 8 shards: shards past the third stay empty
+    buf = ShardedCatBuffer.allocate(jnp.arange(3, dtype=jnp.float32))
+    assert buf.count == 3
+    assert int(np.sum(buf.counts == 0)) == WORLD - 3
+    np.testing.assert_array_equal(np.asarray(buf.materialize()), np.arange(3.0))
+
+
+def test_lockstep_appends_align_across_states():
+    # preds/target appended in lockstep share per-shard counts, so the
+    # shard-major permutation keeps rows aligned
+    p = _rand(123, seed=2)
+    t = _rand(123, seed=3)
+    pb = ShardedCatBuffer.allocate(jnp.asarray(p[:50]))
+    tb = ShardedCatBuffer.allocate(jnp.asarray(t[:50]))
+    pb.append(jnp.asarray(p[50:]))
+    tb.append(jnp.asarray(t[50:]))
+    np.testing.assert_array_equal(pb.counts, tb.counts)
+    pm, tm_ = np.asarray(pb.materialize()), np.asarray(tb.materialize())
+    pairs = {(round(float(a), 6), round(float(b), 6)) for a, b in zip(pm, tm_)}
+    expect = {(round(float(a), 6), round(float(b), 6)) for a, b in zip(p, t)}
+    assert pairs == expect
+
+
+def test_snapshot_is_copy_on_write():
+    data = _rand(32)
+    buf = ShardedCatBuffer.allocate(jnp.asarray(data))
+    snap = buf.snapshot()
+    before = np.asarray(snap.materialize()).copy()
+    buf.append(jnp.asarray(_rand(32, seed=9)))
+    assert snap.count == 32 and buf.count == 64
+    # the snapshot is insulated from the later append
+    np.testing.assert_array_equal(np.asarray(snap.materialize()), before)
+
+
+def test_pickle_roundtrip_rebalances():
+    data = _rand(77, seed=4)
+    buf = ShardedCatBuffer.allocate(jnp.asarray(data))
+    restored = pickle.loads(pickle.dumps(buf))
+    assert isinstance(restored, ShardedCatBuffer)
+    assert restored.count == 77
+    assert restored == buf
+    # balanced ceil-chunk restore
+    assert int(restored.counts.max()) - int(restored.counts.min()) <= 10
+
+
+def test_deepcopy_and_astype():
+    buf = ShardedCatBuffer.allocate(jnp.asarray(_rand(16)))
+    dup = copy.deepcopy(buf)
+    assert dup == buf and dup is not buf
+    as64 = buf.astype(jnp.int32)
+    assert str(as64.dtype) == "int32"
+
+
+# ---------------------------------------------------------------------------
+# refused densify (satellite: clear NotImplementedError naming the metric)
+# ---------------------------------------------------------------------------
+
+
+def test_dim_zero_cat_refuses_sharded_state():
+    m = SpearmanCorrCoef(list_layout="padded", cat_layout="sharded")
+    m.update(jnp.asarray(_rand(32)), jnp.asarray(_rand(32, seed=1)))
+    with pytest.raises(NotImplementedError, match="SpearmanCorrCoef.preds"):
+        dim_zero_cat(m.preds)
+    with pytest.raises(NotImplementedError, match="sharded_oracle"):
+        padded_cat(m.target)
+
+
+def test_sharded_oracle_context_allows_densify():
+    m = SpearmanCorrCoef(list_layout="padded", cat_layout="sharded")
+    m.update(jnp.asarray(_rand(32)), jnp.asarray(_rand(32, seed=1)))
+    with sharded_oracle():
+        vals, count = padded_cat(m.preds)
+    assert count == 32
+    # and the context unwinds: the guard re-arms afterwards
+    with pytest.raises(NotImplementedError):
+        dim_zero_cat(m.preds)
+
+
+# ---------------------------------------------------------------------------
+# metric integration + state metadata
+# ---------------------------------------------------------------------------
+
+
+def test_cat_layout_validation():
+    with pytest.raises(ValueError, match="replicated.*sharded|sharded.*replicated"):
+        SpearmanCorrCoef(cat_layout="bogus")
+    with pytest.raises(ValueError, match="padded"):
+        SpearmanCorrCoef(list_layout="list", cat_layout="sharded")
+
+
+def test_sharded_states_in_treedef_aux():
+    rep = SpearmanCorrCoef(list_layout="padded")
+    sh = SpearmanCorrCoef(list_layout="padded", cat_layout="sharded")
+    for m in (rep, sh):
+        m.update(jnp.asarray(_rand(8)), jnp.asarray(_rand(8, seed=1)))
+    assert sh._state_view().sharded_states == frozenset({"preds", "target"})
+    assert rep._state_view().sharded_states == frozenset()
+    # replicated/sharded twins must never share a treedef (or a jit cache line)
+    _, td_rep = jax.tree_util.tree_flatten(rep._state_view())
+    _, td_sh = jax.tree_util.tree_flatten(sh._state_view())
+    assert td_rep != td_sh
+
+
+def test_state_buffers_are_sharded_buffers():
+    m = BinaryPrecisionRecallCurve(list_layout="padded", cat_layout="sharded")
+    m.update(jnp.asarray(_rand(64)), jnp.asarray((_rand(64, seed=5) < 0.5).astype(np.int32)))
+    assert isinstance(m.preds, ShardedCatBuffer)
+    assert isinstance(m.target, ShardedCatBuffer)
+    assert m.preds.owner == "BinaryPrecisionRecallCurve.preds"
+
+
+# ---------------------------------------------------------------------------
+# compute parity vs the replicated oracle
+# ---------------------------------------------------------------------------
+
+
+def _twin_update(rep, sh, preds, target, chunks=4):
+    n = len(preds)
+    step = -(-n // chunks)
+    for i in range(0, n, step):
+        rep.update(jnp.asarray(preds[i : i + step]), jnp.asarray(target[i : i + step]))
+        sh.update(jnp.asarray(preds[i : i + step]), jnp.asarray(target[i : i + step]))
+
+
+def test_pr_curve_bitwise_parity():
+    preds = _rand(500, seed=6)
+    target = (_rand(500, seed=7) < 0.4).astype(np.int32)
+    rep = BinaryPrecisionRecallCurve(list_layout="padded")
+    sh = BinaryPrecisionRecallCurve(list_layout="padded", cat_layout="sharded")
+    _twin_update(rep, sh, preds, target)
+    for a, b in zip(rep.compute(), sh.compute()):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_auroc_bitwise_parity():
+    preds = _rand(500, seed=8)
+    target = (_rand(500, seed=9) < 0.4).astype(np.int32)
+    rep = BinaryAUROC(list_layout="padded")
+    sh = BinaryAUROC(list_layout="padded", cat_layout="sharded")
+    _twin_update(rep, sh, preds, target)
+    assert float(rep.compute()) == float(sh.compute())
+
+
+def test_auroc_ignore_index_parity():
+    preds = _rand(300, seed=10)
+    target = (_rand(300, seed=11) < 0.4).astype(np.int32)
+    target[::5] = -1
+    rep = BinaryAUROC(ignore_index=-1, list_layout="padded")
+    sh = BinaryAUROC(ignore_index=-1, list_layout="padded", cat_layout="sharded")
+    _twin_update(rep, sh, preds, target)
+    assert float(rep.compute()) == float(sh.compute())
+
+
+def test_histogram_auroc_epsilon():
+    preds = _rand(2000, seed=12)
+    target = (_rand(2000, seed=13) < 0.35).astype(np.int32)
+    exact = BinaryAUROC(list_layout="padded")
+    hist = BinaryAUROC(hist_bins=8192, list_layout="padded", cat_layout="sharded")
+    _twin_update(exact, hist, preds, target)
+    # ε = O(1/bins): for uniform scores, well inside 1e-3 at 8192 buckets
+    assert abs(float(exact.compute()) - float(hist.compute())) < 1e-3
+
+
+def test_hist_bins_requires_sharded_layout():
+    with pytest.raises(ValueError, match="sharded"):
+        BinaryAUROC(hist_bins=4096, list_layout="padded")
+
+
+def test_rank_correlation_parity():
+    preds = _rand(400, seed=14)
+    target = preds * 2 + _rand(400, seed=15) * 0.3
+    for cls in (SpearmanCorrCoef, KendallRankCorrCoef):
+        rep = cls(list_layout="padded")
+        sh = cls(list_layout="padded", cat_layout="sharded")
+        _twin_update(rep, sh, preds, target)
+        ra, rb = rep.compute(), sh.compute()
+        ra = ra[0] if isinstance(ra, tuple) else ra
+        rb = rb[0] if isinstance(rb, tuple) else rb
+        assert abs(float(ra) - float(rb)) < 1e-6
+
+
+def test_retrieval_parity():
+    n = 400
+    preds = _rand(n, seed=16)
+    target = (_rand(n, seed=17) < 0.3).astype(np.int32)
+    idx = np.random.RandomState(18).randint(0, 25, n)
+    rep = RetrievalMRR(list_layout="padded")
+    sh = RetrievalMRR(list_layout="padded", cat_layout="sharded")
+    step = 100
+    for i in range(0, n, step):
+        rep.update(jnp.asarray(preds[i : i + step]), jnp.asarray(target[i : i + step]),
+                   indexes=jnp.asarray(idx[i : i + step]))
+        sh.update(jnp.asarray(preds[i : i + step]), jnp.asarray(target[i : i + step]),
+                  indexes=jnp.asarray(idx[i : i + step]))
+    assert abs(float(rep.compute()) - float(sh.compute())) < 1e-7
+
+
+def test_sharded_topk_exact():
+    data = _rand(999, seed=19)
+    buf = ShardedCatBuffer.allocate(jnp.asarray(data))
+    got = np.sort(np.asarray(sc.sharded_topk(buf, 25)))[::-1]
+    np.testing.assert_allclose(got, np.sort(data)[::-1][:25])
+
+
+def test_sharded_moments_match_numpy():
+    data = _rand(777, seed=20)
+    buf = ShardedCatBuffer.allocate(jnp.asarray(data))
+    mean, var = sc.sharded_moments(buf)
+    assert abs(float(mean) - data.mean()) < 1e-5
+    assert abs(float(var) - data.var()) < 1e-5
+
+
+# ---------------------------------------------------------------------------
+# sync: wire stays layout-independent, residency stays sharded
+# ---------------------------------------------------------------------------
+
+
+def test_fake_sync_group_keeps_sharded_residency():
+    preds = _rand(200, seed=21)
+    target = preds * 3 + _rand(200, seed=22) * 0.1
+    # replicated twin group = the oracle
+    rep = [SpearmanCorrCoef(list_layout="padded") for _ in range(2)]
+    sh = [SpearmanCorrCoef(list_layout="padded", cat_layout="sharded") for _ in range(2)]
+    for r, (lo, hi) in enumerate(((0, 100), (100, 200))):
+        rep[r].update(jnp.asarray(preds[lo:hi]), jnp.asarray(target[lo:hi]))
+        sh[r].update(jnp.asarray(preds[lo:hi]), jnp.asarray(target[lo:hi]))
+    rep[0]._sync_backend = FakeSync([m.metric_state for m in rep], 0)
+    sh[0]._sync_backend = FakeSync([m.metric_state for m in sh], 0)
+    expect = float(rep[0].compute())
+    got = float(sh[0].compute())
+    assert abs(got - expect) < 1e-6
+    # post-sync state is re-sharded, not densified
+    with sh[0].sync_context():
+        assert isinstance(sh[0].preds, ShardedCatBuffer)
+
+
+# ---------------------------------------------------------------------------
+# reshard plan edge cases
+# ---------------------------------------------------------------------------
+
+
+def test_reshard_uneven_counts_parity():
+    data = _rand(137, seed=23)
+    buf = ShardedCatBuffer.allocate(jnp.asarray(data[:9]))
+    buf.append(jnp.asarray(data[9:]))
+    assert len(set(int(c) for c in buf.counts)) >= 1  # ragged per-shard fill
+    out = sc.reshard(buf, devices=jax.devices()[:3])
+    assert out.n_shards == 3 and out.count == 137
+    assert out == buf  # shard-major row stream preserved
+
+
+def test_reshard_empty_and_never_updated_shards():
+    # 2 rows over 8 shards: 6 shards never held data
+    buf = ShardedCatBuffer.allocate(jnp.asarray(_rand(2, seed=24)))
+    out = sc.reshard(buf, devices=jax.devices()[:5])
+    assert out.count == 2 and out == buf
+    # zero-count buffer roundtrip (all shards empty)
+    empty = ShardedCatBuffer.allocate(jnp.asarray(_rand(4, seed=25)))
+    empty2 = sc.reshard(empty, devices=jax.devices()[:2])
+    assert empty2.count == 4 and empty2 == empty
+
+
+def test_reshard_onto_larger_mesh():
+    small = sc.reshard(
+        ShardedCatBuffer.allocate(jnp.asarray(_rand(64, seed=26))),
+        devices=jax.devices()[:2],
+    )
+    assert small.n_shards == 2
+    big = sc.reshard(small)  # back onto the full default mesh
+    assert big.n_shards == WORLD and big == small
+    per_dev = big.per_device_nbytes()
+    assert len(per_dev) == WORLD
+
+
+def test_checkpoint_restore_is_reshard_plan():
+    m = SpearmanCorrCoef(list_layout="padded", cat_layout="sharded")
+    m.update(jnp.asarray(_rand(90, seed=27)), jnp.asarray(_rand(90, seed=28)))
+    blob = checkpoint_metric(m)
+    # restore onto a 4-device survivor mesh
+    r = rejoin_metric(blob, devices=jax.devices()[:4])
+    assert isinstance(r.preds, ShardedCatBuffer) and r.preds.n_shards == 4
+    assert abs(float(r.compute()) - float(m.compute())) < 1e-6
+
+
+def test_merge_checkpoint_reshards_onto_survivors():
+    a_p, a_t = _rand(70, seed=29), _rand(70, seed=30)
+    b_p, b_t = _rand(40, seed=31), _rand(40, seed=32)
+    oracle = SpearmanCorrCoef(list_layout="padded")
+    oracle.update(jnp.asarray(np.concatenate([a_p, b_p])),
+                  jnp.asarray(np.concatenate([a_t, b_t])))
+    expect = float(oracle.compute())
+
+    m1 = SpearmanCorrCoef(list_layout="padded", cat_layout="sharded")
+    m1.update(jnp.asarray(a_p), jnp.asarray(a_t))
+    m2 = SpearmanCorrCoef(list_layout="padded", cat_layout="sharded")
+    m2.update(jnp.asarray(b_p), jnp.asarray(b_t))
+    recovered = merge_checkpoint(m1, checkpoint_metric(m2), devices=jax.devices()[:6])
+    assert recovered == 40
+    assert isinstance(m1.preds, ShardedCatBuffer) and m1.preds.n_shards == 6
+    assert m1.preds.count == 110
+    assert abs(float(m1.compute()) - expect) < 1e-6
+
+
+# ---------------------------------------------------------------------------
+# elastic rounds: preemption → rejoin with coverage accounting
+# ---------------------------------------------------------------------------
+
+
+def _spearman_group(world, n=60):
+    ms = [SpearmanCorrCoef(list_layout="padded", cat_layout="sharded") for _ in range(world)]
+    datas = []
+    for r, m in enumerate(ms):
+        p = _rand(n, seed=40 + r)
+        t = p * 2 + _rand(n, seed=50 + r) * 0.2
+        m.update(jnp.asarray(p), jnp.asarray(t))
+        datas.append((p, t))
+    return ms, datas
+
+
+def test_preemption_rejoin_round_recovers_with_coverage():
+    world = 2
+    reset_elastic_stats()
+    ms, datas = _spearman_group(world)
+    oracle = SpearmanCorrCoef(list_layout="padded")
+    oracle.update(jnp.asarray(np.concatenate([d[0] for d in datas])),
+                  jnp.asarray(np.concatenate([d[1] for d in datas])))
+    expect = float(oracle.compute())
+
+    blob = checkpoint_metric(ms[1])  # rank 1 checkpoints, then is preempted
+    group = [m.metric_state for m in ms]
+    backs = chaos_group(group, ChaosSchedule({0: [("drop", 1)]}))
+    ms[0]._sync_backend = ElasticSync(backs[0], policy=FAST)
+    backs[0].advance_round()
+    got = float(ms[0].compute())
+    cov = ms[0].coverage
+    assert cov.ranks_present == 1 and cov.ranks_expected == 2
+    # degraded round: rank 0's own (still sharded) partial result
+    local = SpearmanCorrCoef(list_layout="padded")
+    local.update(jnp.asarray(datas[0][0]), jnp.asarray(datas[0][1]))
+    assert abs(got - float(local.compute())) < 1e-6
+
+    # rejoin: merge the preempted rank's checkpoint over the survivor mesh
+    recovered = ms[0]._sync_backend.merge_on_rejoin(ms[0], blob)
+    assert recovered == 60
+    assert isinstance(ms[0].preds, ShardedCatBuffer)
+    ms[0]._sync_backend = None
+    ms[0]._computed = None
+    assert abs(float(ms[0].compute()) - expect) < 1e-6
+
+
+def test_double_preemption_during_round():
+    world = 4
+    reset_elastic_stats()
+    ms, datas = _spearman_group(world, n=40)
+    blobs = [checkpoint_metric(ms[2]), checkpoint_metric(ms[3])]
+    group = [m.metric_state for m in ms]
+    backs = chaos_group(group, ChaosSchedule({0: [("drop", 2), ("drop", 3)]}))
+    ms[0]._sync_backend = ElasticSync(backs[0], policy=FAST)
+    backs[0].advance_round()
+    float(ms[0].compute())
+    cov = ms[0].coverage
+    assert cov.ranks_present == 2 and cov.ranks_expected == 4
+    assert cov.fraction == pytest.approx(0.5)
+
+    # both preempted ranks' checkpoints fold back in; the adopted samples are
+    # remembered for the next round's contribution
+    es = ms[0]._sync_backend
+    assert es.merge_on_rejoin(ms[0], blobs[0]) == 40
+    assert es.merge_on_rejoin(ms[0], blobs[1]) == 40
+    assert es._adopted_contrib == 80
+    # rank 0's own rows + both recovered checkpoints (the degraded sync
+    # round left rank 1's rows with rank 1 — they return when it rejoins)
+    assert ms[0].preds.count == 3 * 40
+
+    oracle = SpearmanCorrCoef(list_layout="padded")
+    keep = [datas[0], datas[2], datas[3]]
+    oracle.update(jnp.asarray(np.concatenate([d[0] for d in keep])),
+                  jnp.asarray(np.concatenate([d[1] for d in keep])))
+    ms[0]._sync_backend = None
+    ms[0]._computed = None
+    assert abs(float(ms[0].compute()) - float(oracle.compute())) < 1e-6

@@ -475,12 +475,14 @@ def test_sync_roots_detected(tmp_path):
 def test_rule_registry_complete():
     from tools.tpulint import ALL_RULES, RULE_SEVERITY, RULE_TITLES
 
-    assert {"TPU012", "TPU013", "TPU014"} <= set(ALL_RULES)
+    assert {"TPU012", "TPU013", "TPU014", "TPU015"} <= set(ALL_RULES)
     for rule in ALL_RULES:
         assert rule in RULE_TITLES, f"{rule} missing a title"
         assert RULE_SEVERITY.get(rule) in ("error", "warn"), f"{rule} missing a tier"
     # the SPMD deadlock classes are error-tier: a hang is never just a warning
     assert all(RULE_SEVERITY[r] == "error" for r in ("TPU012", "TPU013", "TPU014"))
+    # densifying sharded state silently undoes the layout — also error-tier
+    assert RULE_SEVERITY["TPU015"] == "error"
 
 
 # ---------------------------------------------------------------------------
@@ -753,3 +755,102 @@ def test_tpu011_host_only_loop_passes(tmp_path):
                 m.compute()
     """, root_kinds=("update", "kernel"))
     assert "TPU011" not in _rules(res)
+
+
+# ---------------------------------------------------------------------------
+# TPU015 — full-materialization read of sharded cat state in a traced path
+# ---------------------------------------------------------------------------
+
+
+def test_tpu015_padded_cat_of_sharded_state_flagged(tmp_path):
+    res = _lint_fixture(tmp_path, kernel_src="""
+        from torchmetrics_tpu.utils.data import padded_cat
+
+        def _auroc_update(sharded_preds, target):
+            values, count = padded_cat(sharded_preds)
+            return values
+    """)
+    assert "TPU015" in _rules(res)
+
+
+def test_tpu015_dim_zero_cat_of_sharded_state_flagged(tmp_path):
+    res = _lint_fixture(tmp_path, kernel_src="""
+        from torchmetrics_tpu.utils.data import dim_zero_cat
+
+        def _curve_update(self, preds, target):
+            rows = dim_zero_cat(self.sharded_valid)
+            return rows
+    """)
+    assert "TPU015" in _rules(res)
+
+
+def test_tpu015_concatenate_of_sharded_state_flagged(tmp_path):
+    res = _lint_fixture(tmp_path, kernel_src="""
+        import jax.numpy as jnp
+
+        def _merge_update(shard_bufs, other):
+            return jnp.concatenate(shard_bufs, axis=0)
+    """)
+    assert "TPU015" in _rules(res)
+
+
+def test_tpu015_buffer_slice_of_sharded_state_flagged(tmp_path):
+    res = _lint_fixture(tmp_path, kernel_src="""
+        def _read_update(sharded_state, count):
+            return sharded_state.buffer[:count]
+    """)
+    assert "TPU015" in _rules(res)
+
+
+def test_tpu015_materialize_of_sharded_state_flagged(tmp_path):
+    res = _lint_fixture(tmp_path, kernel_src="""
+        def _read_update(sharded_state):
+            return sharded_state.materialize()
+    """)
+    assert "TPU015" in _rules(res)
+
+
+def test_tpu015_oracle_context_passes(tmp_path):
+    # the sanctioned escape hatch: densification wrapped in sharded_oracle()
+    res = _lint_fixture(tmp_path, kernel_src="""
+        from torchmetrics_tpu.utils.data import padded_cat, sharded_oracle
+
+        def _parity_update(sharded_preds, target):
+            with sharded_oracle():
+                values, count = padded_cat(sharded_preds)
+            return values
+    """)
+    assert "TPU015" not in _rules(res)
+
+
+def test_tpu015_oracle_named_function_passes(tmp_path):
+    res = _lint_fixture(tmp_path, kernel_src="""
+        from torchmetrics_tpu.utils.data import dim_zero_cat
+
+        def _oracle_update(sharded_preds):
+            return dim_zero_cat(sharded_preds)
+    """)
+    assert "TPU015" not in _rules(res)
+
+
+def test_tpu015_distributed_kernel_read_passes(tmp_path):
+    # the sanctioned read path: cat_compact / histogram kernels, no densify
+    res = _lint_fixture(tmp_path, kernel_src="""
+        from torchmetrics_tpu.parallel.sharded_compute import cat_compact
+
+        def _compact_update(sharded_preds):
+            return cat_compact(sharded_preds)
+    """)
+    assert "TPU015" not in _rules(res)
+
+
+def test_tpu015_replicated_state_passes(tmp_path):
+    # densifying a replicated padded buffer is the normal read path
+    res = _lint_fixture(tmp_path, kernel_src="""
+        from torchmetrics_tpu.utils.data import padded_cat
+
+        def _exact_update(preds_buf, target):
+            values, count = padded_cat(preds_buf)
+            return values
+    """)
+    assert "TPU015" not in _rules(res)

@@ -23,6 +23,7 @@ directly; TPU005 scans all functions (donation misuse is an eager-layer bug).
 | TPU012 | no collective dominated by a branch on a rank-dependent value     |
 | TPU013 | no divergent collective sequences across paths through one root   |
 | TPU014 | no sharding-spec mismatch between producer and consumer           |
+| TPU015 | no full-materialization read of sharded cat state in a traced path|
 
 TPU012/TPU013/TPU014 (and the interprocedural halves of TPU003/TPU005) are
 driven by the abstract-interpretation engine in :mod:`.dataflow`; the rest
@@ -48,6 +49,7 @@ from .dataflow import DataflowEngine, _is_donating_jit  # noqa: F401  (re-export
 ALL_RULES = (
     "TPU000", "TPU001", "TPU002", "TPU003", "TPU004", "TPU005", "TPU006",
     "TPU007", "TPU008", "TPU009", "TPU010", "TPU011", "TPU012", "TPU013", "TPU014",
+    "TPU015",
 )
 
 RULE_TITLES = {
@@ -66,6 +68,7 @@ RULE_TITLES = {
     "TPU012": "collective divergence (rank-dependent branch dominates a collective)",
     "TPU013": "collective-order mismatch across code paths",
     "TPU014": "sharding-spec mismatch between producer and consumer",
+    "TPU015": "full-materialization read of sharded cat state in a traced path",
 }
 
 # severity tiers: `error` = correctness/deadlock (wrong numbers, hung pods,
@@ -86,6 +89,7 @@ RULE_SEVERITY = {
     "TPU012": "error",
     "TPU013": "error",
     "TPU014": "error",
+    "TPU015": "error",
 }
 
 
@@ -171,6 +175,10 @@ def check_traced_rules(
     ctx = _FunctionContext(fn, corpus, engine)
     out: List[Violation] = []
     root_note = "" if fn.qualname in roots else f" (reachable from {sorted(roots)[0]})"
+    # TPU015 exemptions: an explicitly-named oracle function, or statements
+    # inside a `with sharded_oracle():` block, acknowledge the densification
+    oracle_fn = "oracle" in fn.qualname.lower()
+    oracle_lines = _oracle_block_lines(fn.node)
 
     def emit(rule: str, node: ast.AST, msg: str) -> None:
         out.append(
@@ -278,6 +286,48 @@ def check_traced_rules(
                                 " bucket (see reduce_state_in_graph)",
                             )
 
+        # ---- TPU015: full-materialization read of sharded cat state --
+        if not oracle_fn and getattr(node, "lineno", 0) not in oracle_lines:
+            if isinstance(node, ast.Call):
+                densify = _densify_call_name(node, ctx.imports)
+                if densify and any(_mentions_sharded_name(a) for a in node.args):
+                    emit(
+                        "TPU015", node,
+                        f"`{densify}` over sharded cat state in a jit-reachable"
+                        " path: densifying replicates the full NamedSharding"
+                        " buffer onto one device (O(N) gather at compute time) —"
+                        " read it through parallel.sharded_compute (cat_compact,"
+                        " histogram_auroc, sharded_topk, ...) or wrap the oracle"
+                        " read in utils.data.sharded_oracle()",
+                    )
+                f15 = node.func
+                if (
+                    isinstance(f15, ast.Attribute)
+                    and f15.attr == "materialize"
+                    and _mentions_sharded_name(f15.value)
+                ):
+                    emit(
+                        "TPU015", node,
+                        "`.materialize()` on sharded cat state in a jit-reachable"
+                        " path gathers every shard onto one device — use the"
+                        " distributed kernels in parallel.sharded_compute, or"
+                        " wrap the oracle read in utils.data.sharded_oracle()",
+                    )
+            if isinstance(node, ast.Subscript):
+                v15 = node.value
+                if (
+                    isinstance(v15, ast.Attribute)
+                    and v15.attr == "buffer"
+                    and _mentions_sharded_name(v15.value)
+                ):
+                    emit(
+                        "TPU015", node,
+                        "slicing `.buffer[...]` of sharded cat state in a"
+                        " jit-reachable path materializes the raw sharded"
+                        " capacity on one device — read through"
+                        " parallel.sharded_compute instead",
+                    )
+
         # ---- TPU011: per-tenant metric loop in a traced path ---------
         if isinstance(node, ast.For) and _mentions_tenant_name(node.iter):
             for stmt in node.body:
@@ -317,6 +367,56 @@ def _mentions_tenant_name(expr: ast.expr) -> bool:
         if name and any(h in name.lower() for h in _TENANT_HINTS):
             return True
     return False
+
+
+# sharded-state hints (same contract style as _TENANT_HINTS): TPU015 keys on
+# value names that advertise the NamedSharding layout — `sharded_preds`,
+# `self.shard_buf`, a `ShardedCatBuffer`-typed local named accordingly
+def _mentions_sharded_name(expr: ast.expr) -> bool:
+    for sub in ast.walk(expr):
+        name = None
+        if isinstance(sub, ast.Name):
+            name = sub.id
+        elif isinstance(sub, ast.Attribute):
+            name = sub.attr
+        if name and "shard" in name.lower():
+            return True
+    return False
+
+
+def _densify_call_name(call: ast.Call, imports: Dict[str, str]) -> str:
+    """'' unless the call densifies a cat state onto one device:
+    ``padded_cat``/``dim_zero_cat``/``cat_state_or_empty`` or a jnp/np
+    ``concatenate``."""
+    f = call.func
+    if not isinstance(f, (ast.Attribute, ast.Name)):
+        return ""
+    dotted = _alias_targets(imports, f)
+    last = dotted.split(".")[-1]
+    if last in ("padded_cat", "dim_zero_cat", "cat_state_or_empty"):
+        return last
+    if dotted.startswith(("jax.numpy.", "numpy.")) and last == "concatenate":
+        return _dotted_name(f) or last
+    return ""
+
+
+def _oracle_block_lines(fn_node: ast.AST) -> Set[int]:
+    """Lines inside a ``with sharded_oracle():`` block (TPU015 exemption)."""
+    lines: Set[int] = set()
+    for sub in ast.walk(fn_node):
+        if not isinstance(sub, ast.With):
+            continue
+        for item in sub.items:
+            ce = item.context_expr
+            target = ce.func if isinstance(ce, ast.Call) else ce
+            name = _dotted_name(target) or ""
+            if "oracle" in name.lower():
+                for stmt in sub.body:
+                    for n2 in ast.walk(stmt):
+                        if hasattr(n2, "lineno"):
+                            lines.add(n2.lineno)
+                break
+    return lines
 
 
 def _mentions_state_name(expr: ast.expr) -> bool:

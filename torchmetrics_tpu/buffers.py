@@ -33,6 +33,20 @@ Array = jax.Array
 MIN_CAPACITY = 8
 
 
+def default_eval_mesh(devices: Optional[Sequence[Any]] = None) -> Any:
+    """The 1-D eval mesh sharded cat state lives on: every visible device on
+    one ``'batch'`` axis (SNIPPETS §1 pattern). Pass ``devices`` to build a
+    sub-mesh (elastic survivors, reshard targets)."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    return jax.sharding.Mesh(np.array(devs), ("batch",))
+
+
+def batch_sharding(mesh: Any) -> Any:
+    """``NamedSharding(mesh, P('batch'))`` — rows partitioned on the leading
+    axis, trailing dims replicated."""
+    return jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("batch"))
+
+
 class CatLayoutError(TypeError):
     """An increment is incompatible with the padded buffer's row layout.
 
@@ -273,6 +287,349 @@ class CatBuffer:
         new._count_dev = self._count_dev
         self._owns = False
         new._owns = False
+        memo[id(self)] = new
+        return new
+
+
+def _make_sharded_append(n_shards: int, chunk: int, rows: int, sharding: Any) -> Any:
+    """Donating append kernel for the sharded layout.
+
+    The increment is padded to ``n_shards * chunk`` rows, reshaped to one
+    ``chunk``-row slab per shard, and written at each shard's own valid
+    count with a vmapped ``dynamic_update_slice`` — under the sharding
+    constraint each device writes only the slab it owns. Rows past a
+    shard's valid share land past its count (the CatBuffer garbage
+    invariant), so uneven splits need no masking. ``chunk``/``rows`` are
+    static per executable key; the per-shard valid row counts derived from
+    them bake in as constants.
+    """
+    valid = np.clip(rows - np.arange(n_shards) * chunk, 0, chunk).astype(np.int32)
+
+    def sharded_append(buf: Array, inc: Array, counts: Array) -> Tuple[Array, Array]:
+        pad = n_shards * chunk - rows
+        if pad:
+            inc = jnp.concatenate(
+                [inc, jnp.zeros((pad,) + inc.shape[1:], inc.dtype)], axis=0
+            )
+        slabs = inc.reshape((n_shards, chunk) + inc.shape[1:])
+
+        def upd(buf_s: Array, slab: Array, cnt: Array) -> Array:
+            start = (cnt,) + (0,) * (slab.ndim - 1)
+            return lax.dynamic_update_slice(buf_s, slab, start)
+
+        new = jax.vmap(upd)(buf, slabs, counts)
+        new = lax.with_sharding_constraint(new, sharding)
+        return new, counts + jnp.asarray(valid)
+
+    return sharded_append
+
+
+def _make_sharded_grow_append(new_capacity: int, *args: Any) -> Any:
+    inner = _make_sharded_append(*args)
+
+    def grow_append(buf: Array, inc: Array, counts: Array) -> Tuple[Array, Array]:
+        pad = jnp.zeros(
+            (buf.shape[0], new_capacity - buf.shape[1]) + buf.shape[2:], buf.dtype
+        )
+        return inner(jnp.concatenate([buf, pad], axis=1), inc, counts)
+
+    return grow_append
+
+
+class ShardedCatBuffer(CatBuffer):
+    """Cat state resident under ``NamedSharding(P('batch'))`` on the eval mesh.
+
+    The buffer is ``(n_shards, capacity) + trailing`` with the shard axis
+    partitioned across the mesh — each device owns ``capacity`` rows of
+    padding-backed storage, so resident cat-state bytes per device scale as
+    ``total / n_shards`` instead of ``total``. Appends split each increment
+    into one slab per shard and write all slabs in a single donated kernel;
+    per-shard valid counts ride as an ordinary ``(n_shards,)`` int32 leaf
+    (host-mirrored, like ``CatBuffer.count``).
+
+    Reading: the valid rows are the per-shard prefixes in shard-major order
+    — NOT append order. Every exact consumer of cat state (AUROC, PR-curve,
+    rank correlations, retrieval grouping) is row-order-invariant, which is
+    what makes the layout sound. ``dim_zero_cat``/``padded_cat`` REFUSE to
+    densify this type outside :func:`sharded_oracle`
+    (``utils/data.py``); distributed reads go through
+    :mod:`torchmetrics_tpu.parallel.sharded_compute`.
+
+    Pickling stores the materialized valid rows only; ``__setstate__``
+    rebuilds balanced shards on the *current* default mesh — a checkpoint
+    taken on one mesh rejoins a differently-sized mesh resharded (see
+    ``sharded_compute.reshard`` for the in-memory plan).
+    """
+
+    __slots__ = ("counts", "_counts_dev", "mesh", "owner")
+
+    def __init__(
+        self,
+        buffer: Array,
+        counts: Any,
+        mesh: Any = None,
+        owns: bool = True,
+        owner: Optional[str] = None,
+    ) -> None:
+        counts = np.asarray(counts, np.int32)
+        super().__init__(buffer, int(counts.sum()), owns=owns)
+        self.counts = counts
+        self._counts_dev: Optional[Array] = None
+        self.mesh = mesh if mesh is not None else default_eval_mesh()
+        self.owner = owner
+
+    # ------------------------------------------------------------- creation
+
+    @classmethod
+    def allocate(
+        cls,
+        first_inc: Any,
+        mesh: Any = None,
+        owner: Optional[str] = None,
+    ) -> "ShardedCatBuffer":
+        inc = _row_form(first_inc)
+        mesh = mesh if mesh is not None else default_eval_mesh()
+        n_shards = mesh.devices.size
+        cap = _capacity_for(-(-max(inc.shape[0], 1) // n_shards))
+        buf = jax.device_put(
+            jnp.zeros((n_shards, cap) + inc.shape[1:], inc.dtype), batch_sharding(mesh)
+        )
+        out = cls(buf, np.zeros(n_shards, np.int32), mesh=mesh, owner=owner)
+        out.append(inc)
+        return out
+
+    @classmethod
+    def from_increments(
+        cls,
+        increments: Sequence[Any],
+        mesh: Any = None,
+        owner: Optional[str] = None,
+    ) -> "ShardedCatBuffer":
+        rows = [_row_form(e) for e in increments]
+        trailings = {r.shape[1:] for r in rows}
+        if len(trailings) > 1:
+            raise CatLayoutError(f"ragged increment trailing shapes {sorted(trailings)}")
+        first = rows[0] if len(rows) == 1 else jnp.concatenate(rows, axis=0)
+        return cls.allocate(first, mesh=mesh, owner=owner)
+
+    @classmethod
+    def from_rows(
+        cls,
+        rows: Any,
+        mesh: Any = None,
+        owner: Optional[str] = None,
+    ) -> "ShardedCatBuffer":
+        """Balanced sharded buffer over an already-dense rows array (sync
+        re-materialization, checkpoint restore)."""
+        return cls.allocate(_row_form(rows), mesh=mesh, owner=owner)
+
+    # ------------------------------------------------------------ properties
+
+    @property
+    def n_shards(self) -> int:
+        return self.buffer.shape[0]
+
+    @property
+    def capacity(self) -> int:
+        """Per-shard row capacity (the grow/garbage contract is per shard)."""
+        return self.buffer.shape[1]
+
+    @property
+    def trailing(self) -> Tuple[int, ...]:
+        return self.buffer.shape[2:]
+
+    def per_device_nbytes(self) -> dict:
+        """Resident buffer bytes per device (the HBM-scaling observable)."""
+        out: dict = {}
+        for shard in self.buffer.addressable_shards:
+            d = shard.device
+            out[d] = out.get(d, 0) + shard.data.size * shard.data.dtype.itemsize
+        return out
+
+    # -------------------------------------------------------------- mutation
+
+    def append(self, inc: Any) -> None:
+        inc = _row_form(inc)
+        if inc.shape[1:] != self.trailing:
+            raise CatLayoutError(
+                f"increment trailing shape {inc.shape[1:]} != buffer trailing {self.trailing}"
+            )
+        if inc.dtype != self.dtype:
+            promoted = jnp.promote_types(self.dtype, inc.dtype)
+            if promoted != self.dtype:
+                self.buffer = jax.device_put(
+                    self.buffer.astype(promoted), batch_sharding(self.mesh)
+                )
+                self._owns = True
+            if promoted != inc.dtype:
+                inc = inc.astype(promoted)
+        rows = inc.shape[0]
+        if rows == 0:
+            return
+        n = self.n_shards
+        chunk = -(-rows // n)  # ceil: shard s takes rows [s*chunk, (s+1)*chunk)
+        counts = self._counts_dev
+        if counts is None:
+            counts = jnp.asarray(self.counts)
+        sharding = batch_sharding(self.mesh)
+        mesh_key = tuple(d.id for d in self.mesh.devices.flat)
+        key_tail = (self.capacity, n, chunk, inc.shape, str(inc.dtype), mesh_key)
+        if int(self.counts.max()) + chunk > self.capacity:
+            new_cap = _capacity_for(int(self.counts.max()) + chunk)
+            fn = _jit(
+                ("sharded_catbuf_grow_append", new_cap) + key_tail,
+                _make_sharded_grow_append(new_cap, n, chunk, rows, sharding),
+            )
+            self.buffer, self._counts_dev = fn(self.buffer, inc, counts)
+        else:
+            if not self._owns:
+                self.buffer = jax.device_put(
+                    jnp.array(self.buffer, copy=True), sharding
+                )
+            fn = _jit(
+                ("sharded_catbuf_append",) + key_tail,
+                _make_sharded_append(n, chunk, rows, sharding),
+                donate=True,
+            )
+            self.buffer, self._counts_dev = fn(self.buffer, inc, counts)
+        self._owns = True
+        self.counts = self.counts + np.clip(
+            rows - np.arange(n) * chunk, 0, chunk
+        ).astype(np.int32)
+        self.count = int(self.counts.sum())
+
+    # --------------------------------------------------------------- reading
+
+    def materialize(self) -> Array:
+        """Densify to the valid rows in shard-major order.
+
+        This is the ORACLE/wire read: it replicates the full state onto one
+        device. API-level densify (``dim_zero_cat``/``padded_cat``) refuses
+        sharded buffers outside :func:`~torchmetrics_tpu.utils.data.sharded_oracle`;
+        compute paths go through ``parallel.sharded_compute`` instead.
+        """
+        if self.count == 0:
+            return jnp.zeros((0,) + self.trailing, self.dtype)
+        parts = [
+            self.buffer[s, : int(c)] for s, c in enumerate(self.counts) if int(c)
+        ]
+        return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+
+    def rows(self, start: int, stop: int) -> Array:
+        return self.materialize()[start : min(stop, self.count)]
+
+    def padded_wire(self) -> Tuple[Array, int]:
+        """Dense pow2-padded ``(buffer, count)`` view for the DCN sync wire
+        (``sync_cat_padded``): a host gather materializes bytes regardless
+        of layout, so the wire format stays layout-independent."""
+        rows = self.materialize()
+        cap = _capacity_for(max(self.count, 1))
+        pad = jnp.zeros((cap - rows.shape[0],) + self.trailing, self.dtype)
+        return jnp.concatenate([rows, pad], axis=0), self.count
+
+    def snapshot(self) -> "ShardedCatBuffer":
+        self._owns = False
+        out = ShardedCatBuffer(
+            self.buffer, self.counts.copy(), mesh=self.mesh, owns=False, owner=self.owner
+        )
+        out._counts_dev = self._counts_dev  # device arrays are immutable
+        return out
+
+    def astype(self, dtype: Any) -> "ShardedCatBuffer":
+        buf = jax.device_put(self.buffer.astype(dtype), batch_sharding(self.mesh))
+        return ShardedCatBuffer(buf, self.counts.copy(), mesh=self.mesh, owner=self.owner)
+
+    def to_device(self, device: Any) -> "ShardedCatBuffer":
+        # placement IS the mesh for this layout; a single-device move would
+        # silently un-shard the state, so it is a no-op by contract
+        return self
+
+    # ------------------------------------------------------------- protocols
+
+    def __eq__(self, other: Any) -> Any:
+        if other is self:
+            return True
+        if isinstance(other, ShardedCatBuffer):
+            if self.count != other.count or self.trailing != other.trailing:
+                return False
+            if self.count == 0:
+                return True
+            # host-side compare: the two buffers may live on different meshes
+            # (e.g. before/after reshard), and jnp refuses mixed device sets.
+            # reshard() preserves the shard-major row stream, so elementwise
+            # equality is the right check even across meshes.
+            return bool(
+                np.array_equal(
+                    np.asarray(self.materialize()), np.asarray(other.materialize())
+                )
+            )
+        if isinstance(other, (CatBuffer, list, tuple)):
+            # cross-layout comparison is row-ORDER-INSENSITIVE: shard-major
+            # materialization permutes append order, and every sharded
+            # consumer is order-invariant by contract
+            if isinstance(other, CatBuffer):
+                cat = other.materialize()
+            else:
+                if len(other) == 0:
+                    return self.count == 0
+                try:
+                    cat = jnp.concatenate([_row_form(e) for e in other], axis=0)
+                except Exception:
+                    return NotImplemented
+            mine = self.materialize()
+            if cat.shape != mine.shape:
+                return False
+            if self.count == 0:
+                return True
+            flat_a = np.asarray(mine).reshape(self.count, -1)
+            flat_b = np.asarray(cat).reshape(self.count, -1)
+            order_a = np.lexsort(flat_a.T[::-1])
+            order_b = np.lexsort(flat_b.T[::-1])
+            return bool(np.array_equal(flat_a[order_a], flat_b[order_b]))
+        return NotImplemented
+
+    __hash__ = object.__hash__
+
+    def __repr__(self) -> str:
+        return (
+            f"ShardedCatBuffer(count={self.count}, shards={self.n_shards}, "
+            f"capacity/shard={self.capacity}, trailing={self.trailing}, "
+            f"dtype={self.dtype})"
+        )
+
+    # ------------------------------------------------- pickle / deepcopy
+
+    def __getstate__(self) -> Tuple[Any, int, Optional[str]]:
+        return np.asarray(self.materialize()), self.count, self.owner
+
+    def __setstate__(self, state: Tuple[Any, ...]) -> None:
+        valid, count, owner = state
+        mesh = default_eval_mesh()
+        n = mesh.devices.size
+        chunk = -(-max(int(count), 1) // n)
+        cap = _capacity_for(chunk)
+        arr = np.zeros((n, cap) + valid.shape[1:], valid.dtype)
+        counts = np.clip(int(count) - np.arange(n) * chunk, 0, chunk).astype(np.int32)
+        # balanced ceil-chunk per shard, shard-major: restore IS the reshard
+        # plan for a checkpoint crossing onto a differently-sized mesh
+        for s in range(n):
+            lo = s * chunk
+            arr[s, : counts[s]] = valid[lo : lo + counts[s]]
+        self.buffer = jax.device_put(jnp.asarray(arr), batch_sharding(mesh))
+        self.counts = counts
+        self.count = int(count)
+        self._count_dev = None
+        self._counts_dev = None
+        self._owns = True
+        self.mesh = mesh
+        self.owner = owner
+
+    def __deepcopy__(self, memo: dict) -> "ShardedCatBuffer":
+        new = ShardedCatBuffer(
+            self.buffer, self.counts.copy(), mesh=self.mesh, owns=False, owner=self.owner
+        )
+        new._counts_dev = self._counts_dev
+        self._owns = False
         memo[id(self)] = new
         return new
 

@@ -38,14 +38,38 @@ class BinaryAUROC(BinaryPrecisionRecallCurve):
     plot_upper_bound = 1.0
 
     def __init__(self, max_fpr: Optional[float] = None, thresholds: Thresholds = None,
-                 ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
+                 ignore_index: Optional[int] = None, validate_args: bool = True,
+                 hist_bins: Optional[int] = None, **kwargs: Any) -> None:
         super().__init__(thresholds, ignore_index, validate_args, **kwargs)
         if validate_args and max_fpr is not None and not (isinstance(max_fpr, float) and 0 < max_fpr <= 1):
             raise ValueError(f"Argument `max_fpr` should be a float in range (0, 1], but got: {max_fpr}")
+        if validate_args and hist_bins is not None:
+            if not (isinstance(hist_bins, int) and hist_bins >= 2):
+                raise ValueError(f"Argument `hist_bins` should be an int >= 2, but got: {hist_bins}")
+            if self._cat_layout != "sharded":
+                raise ValueError(
+                    "Argument `hist_bins` selects the bucketed-histogram AUROC "
+                    "backend, which only applies to cat_layout='sharded' state"
+                )
+            if max_fpr is not None:
+                raise ValueError("`hist_bins` and `max_fpr` are mutually exclusive")
         self.max_fpr = max_fpr
+        self.hist_bins = hist_bins
 
     def compute(self) -> Array:
         if self.thresholds is None:
+            from ..buffers import ShardedCatBuffer
+
+            if self.hist_bins is not None and isinstance(self.preds, ShardedCatBuffer):
+                # O(bins) bucketed-histogram backend: per-shard scatter-add
+                # partials + one small psum instead of a full gather+sort.
+                # ε = O(1/hist_bins) vs the exact sort-based value (ties
+                # within a bucket share one threshold) — see
+                # docs/parallelism.md "Sharded cat state".
+                from ..parallel.sharded_compute import histogram_auroc
+
+                return histogram_auroc(self.preds, self.target, bins=self.hist_bins,
+                                       valid=getattr(self, "valid", None))
             if self.max_fpr is None and self._use_jit:
                 # fixed epoch-end shape → traced filled-curve compute (one
                 # XLA program instead of an eager op-by-op host round-trip);

@@ -25,7 +25,7 @@ from ..functional.classification.precision_recall_curve import (
     _multilabel_precision_recall_curve_update,
 )
 from ..metric import Metric
-from ..utils.data import dim_zero_cat, padded_cat
+from ..parallel.sharded_compute import cat_compact, padded_or_sharded_cat
 from ..utils.enums import ClassificationTask
 from .base import _ClassificationTaskWrapper
 
@@ -77,14 +77,17 @@ class BinaryPrecisionRecallCurve(Metric):
             self.confmat = self.confmat + _binary_precision_recall_curve_update(p, t, self.thresholds, mask)
 
     def _exact_state(self) -> Tuple[Array, Array]:
-        # padded layout: the state is a (buffer, count) pair; padded_cat
-        # slices off the invalid tail before the exact-length kernel sees it
-        preds, _ = padded_cat(self.preds)
-        target, _ = padded_cat(self.target)
+        # padded layout: the state is a (buffer, count) pair; the cat read
+        # slices off the invalid tail before the exact-length kernel sees it.
+        # Sharded layout reads through cat_compact (shard-major compaction on
+        # the mesh) — same row order as the replicated materialization, so the
+        # downstream sort-based curve is bitwise-identical either way.
+        preds, _ = padded_or_sharded_cat(self.preds)
+        target, _ = padded_or_sharded_cat(self.target)
         if self.ignore_index is not None:
             # astype(bool): sync transports may return the mask as 0/1 ints,
             # and integer `preds[keep]` would gather rows instead of masking
-            keep = dim_zero_cat(self.valid).astype(bool)
+            keep = cat_compact(self.valid).astype(bool)
             preds, target = preds[keep], target[keep]
         return preds, target
 
@@ -142,10 +145,10 @@ class MulticlassPrecisionRecallCurve(Metric):
             )
 
     def _exact_state(self) -> Tuple[Array, Array]:
-        preds, _ = padded_cat(self.preds)
-        target, _ = padded_cat(self.target)
+        preds, _ = padded_or_sharded_cat(self.preds)
+        target, _ = padded_or_sharded_cat(self.target)
         if self.ignore_index is not None:
-            keep = dim_zero_cat(self.valid).astype(bool)
+            keep = cat_compact(self.valid).astype(bool)
             preds, target = preds[keep], target[keep]
         return preds, target
 
@@ -195,7 +198,7 @@ class MultilabelPrecisionRecallCurve(Metric):
             )
 
     def _exact_state(self) -> Tuple[Array, Array]:
-        return padded_cat(self.preds)[0], padded_cat(self.target)[0]
+        return padded_or_sharded_cat(self.preds)[0], padded_or_sharded_cat(self.target)[0]
 
     def compute(self):
         if self.thresholds is None:

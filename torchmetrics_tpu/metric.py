@@ -45,7 +45,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .buffers import CatBuffer, CatLayoutError
+from .buffers import CatBuffer, CatLayoutError, ShardedCatBuffer
 from .observability import ledger as _ledger
 from .observability import spans as _spans
 from .observability.registry import REGISTRY as _REGISTRY
@@ -164,6 +164,7 @@ _RUNTIME_ATTRS = frozenset(
         "_sync_policy",
         "_sync_residuals",
         "_list_layout",
+        "_cat_layout",
         "_cat_meta",
         "_layout_fallback",
         "_hash_digests",
@@ -375,6 +376,16 @@ class Metric:
             O(log n) executables); ``"list"`` keeps the legacy
             one-array-per-update Python list (the equivalence oracle,
             bitwise-identical results).
+        cat_layout: residency for padded ``cat`` states — ``"replicated"``
+            (default) keeps each :class:`CatBuffer` whole on one device;
+            ``"sharded"`` partitions the ``(buffer, count)`` pair across the
+            eval mesh under ``NamedSharding(P('batch'))``
+            (:class:`~torchmetrics_tpu.buffers.ShardedCatBuffer`), so
+            resident cat-state bytes per device scale with the pod.
+            Compute reads then go through the distributed kernels in
+            :mod:`~torchmetrics_tpu.parallel.sharded_compute`; densifying
+            via ``dim_zero_cat``/``padded_cat`` raises unless wrapped in
+            ``sharded_oracle()``.
 
     Example (defining a custom metric):
         >>> import jax.numpy as jnp
@@ -445,12 +456,19 @@ class Metric:
         sync_policy: Optional[SyncPolicy] = None,
         jit: bool = True,
         list_layout: str = "padded",
+        cat_layout: str = "replicated",
         **kwargs: Any,
     ) -> None:
         if kwargs:
             raise ValueError(f"Unexpected keyword arguments: {sorted(kwargs)}")
         if list_layout not in ("padded", "list"):
             raise ValueError(f"list_layout must be 'padded' or 'list', got {list_layout!r}")
+        if cat_layout not in ("replicated", "sharded"):
+            raise ValueError(
+                f"cat_layout must be 'replicated' or 'sharded', got {cat_layout!r}"
+            )
+        if cat_layout == "sharded" and list_layout != "padded":
+            raise ValueError("cat_layout='sharded' requires list_layout='padded'")
         # bypass __setattr__ guards during bootstrap; state lives in ONE
         # explicit MetricState pytree — the class below is a thin view on it
         object.__setattr__(self, "_defaults", {})
@@ -459,6 +477,7 @@ class Metric:
         self._persistent: Dict[str, bool] = {}
         self._list_states: set = set()
         self._list_layout = list_layout
+        self._cat_layout = cat_layout
         self._cat_meta: Dict[str, tuple] = {}  # name -> (np.dtype | None, trailing | None)
         self._layout_fallback: set = set()  # cat states degraded to the list layout
         self._hash_digests: Dict[str, list] = {}  # name -> [state obj, covered, hasher]
@@ -530,7 +549,12 @@ class Metric:
         self._persistent[name] = persistent
         st = self.__dict__["_state"]
         if isinstance(st, MetricState):
-            st.register(name, red, list_state=name in self._list_states)
+            st.register(
+                name,
+                red,
+                list_state=name in self._list_states,
+                sharded=self._uses_sharded(name),
+            )
         st[name] = [] if name in self._list_states else value
         self._invalidate_executable_key()
 
@@ -915,10 +939,16 @@ class Metric:
         st = self.__dict__["_state"]
         if not isinstance(st, MetricState):
             st = MetricState(
-                st, reductions=self._reductions, list_states=self._list_states
+                st,
+                reductions=self._reductions,
+                list_states=self._list_states,
+                sharded_states=self._sharded_state_names(),
             )
             object.__setattr__(self, "_state", st)
         return st
+
+    def _sharded_state_names(self) -> frozenset:
+        return frozenset(n for n in self._list_states if self._uses_sharded(n))
 
     def _install_state(self, mapping: Mapping) -> None:
         """Replace ``_state`` with a fresh MetricState over ``mapping``."""
@@ -926,7 +956,10 @@ class Metric:
             self,
             "_state",
             MetricState(
-                mapping, reductions=self._reductions, list_states=self._list_states
+                mapping,
+                reductions=self._reductions,
+                list_states=self._list_states,
+                sharded_states=self._sharded_state_names(),
             ),
         )
 
@@ -976,6 +1009,20 @@ class Metric:
             and self._reductions.get(name) == Reduction.CAT
         )
 
+    def _uses_sharded(self, name: str) -> bool:
+        return self._cat_layout == "sharded" and self._uses_padded(name)
+
+    def _new_cat_buffer(self, name: str, increments: Any, single: bool) -> CatBuffer:
+        """Allocate the layout-appropriate buffer for one cat state; sharded
+        buffers carry the owning ``Metric.state`` name so a refused densify
+        can say which metric to re-wire (utils/data.py)."""
+        if self._uses_sharded(name):
+            owner = f"{type(self).__name__}.{name}"
+            if single:
+                return ShardedCatBuffer.allocate(increments, owner=owner)
+            return ShardedCatBuffer.from_increments(increments, owner=owner)
+        return CatBuffer.allocate(increments) if single else CatBuffer.from_increments(increments)
+
     def _record_cat_meta(self, name: str, inc: Any) -> None:
         arr = inc if isinstance(inc, (jax.Array, np.ndarray)) else jnp.asarray(inc)
         self._cat_meta[name] = (np.dtype(arr.dtype), arr.shape[1:] if arr.ndim else ())
@@ -999,9 +1046,11 @@ class Metric:
                 if isinstance(target, list):
                     # lazy: the empty state stays a plain [] until the first
                     # append; loaded legacy increments fold in on the fly
-                    buf = CatBuffer.from_increments(target) if target else CatBuffer.allocate(inc)
                     if target:
+                        buf = self._new_cat_buffer(name, target, single=False)
                         buf.append(inc)
+                    else:
+                        buf = self._new_cat_buffer(name, inc, single=True)
                     self._state[name] = buf
                     return
             except CatLayoutError:
@@ -1024,7 +1073,7 @@ class Metric:
             if isinstance(v, list) and v and self._uses_padded(k):
                 self._record_cat_meta(k, v[-1])
                 try:
-                    self._state[k] = CatBuffer.from_increments(v)
+                    self._state[k] = self._new_cat_buffer(k, v, single=False)
                 except CatLayoutError:
                     self._layout_fallback.add(k)
 
@@ -1287,7 +1336,17 @@ class Metric:
                 if addressed:
                     backend.set_current(name)
                 value = self._state[name]
-                if isinstance(value, CatBuffer):
+                if isinstance(value, ShardedCatBuffer):
+                    # the DCN wire is layout-independent (a host gather
+                    # materializes bytes either way); the gathered rows are
+                    # immediately re-sharded so residency stays distributed
+                    # through compute and the next round's appends
+                    wire, cnt = value.padded_wire()
+                    gathered = backend.sync_cat_padded(wire, cnt)
+                    synced[name] = ShardedCatBuffer.from_rows(
+                        gathered, mesh=value.mesh, owner=value.owner
+                    )
+                elif isinstance(value, CatBuffer):
                     synced[name] = backend.sync_cat_padded(value.buffer, value.count)
                 else:
                     probe = self._precat(name)
@@ -1507,6 +1566,7 @@ class Metric:
             ("_cat_meta", dict),
             ("_layout_fallback", set),
             ("_list_layout", lambda: "padded"),
+            ("_cat_layout", lambda: "replicated"),
         ):
             if attr not in self.__dict__:
                 object.__setattr__(self, attr, factory())
@@ -1523,7 +1583,14 @@ class Metric:
         """
         rec = self._hash_digests.get(name)
         n = len(value)
-        if rec is None or rec[0] is not value or rec[1] > n:
+        if (
+            rec is None
+            or rec[0] is not value
+            or rec[1] > n
+            # sharded buffers append per shard: the global shard-major prefix
+            # is NOT append-stable, so growth rehashes from row 0
+            or (rec[1] < n and isinstance(value, ShardedCatBuffer))
+        ):
             rec = [value, 0, hashlib.blake2b(digest_size=16)]
             self._hash_digests[name] = rec
         if rec[1] < n:

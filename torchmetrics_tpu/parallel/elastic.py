@@ -197,17 +197,63 @@ def checkpoint_metric(metric: Any) -> bytes:
     Padded cat buffers pickle as their materialized valid prefix plus count
     (``CatBuffer.__getstate__``), so the checkpoint is layout-independent: a
     rank restored on different hardware, or merged into a peer, reads the
-    same rows it accumulated.
+    same rows it accumulated. Sharded cat buffers additionally carry their
+    owner tag; unpickling rebuilds balanced shards on the *current* process
+    mesh, so restore doubles as the reshard plan for a mesh change.
     """
     return pickle.dumps(metric)
 
 
-def rejoin_metric(blob: bytes) -> Any:
-    """Rehydrate a checkpointed metric on the rejoining rank."""
-    return pickle.loads(blob)
+def _reshard_metric_states(metric: Any, devices: Any, mesh: Any) -> None:
+    """Re-shard every ``ShardedCatBuffer`` state of ``metric`` onto the
+    given mesh (or a default mesh over ``devices``) via the chunked
+    redistribution plan in ``parallel.sharded_compute.reshard``."""
+    from ..buffers import ShardedCatBuffer
+    from .sharded_compute import reshard
+
+    for k in getattr(metric, "_list_states", ()):
+        v = getattr(metric, k)
+        if isinstance(v, ShardedCatBuffer):
+            setattr(metric, k, reshard(v, devices=devices, mesh=mesh))
 
 
-def merge_checkpoint(metric: Any, blob: bytes) -> None:
+def _checkpoint_samples(metric: Any) -> int:
+    """Sample rows a checkpointed metric carries (max over its cat states) —
+    the contribution the rejoin hands back to coverage accounting."""
+    from ..buffers import CatBuffer
+
+    rows = 0
+    state = metric.metric_state
+    for k in getattr(metric, "_list_states", ()):
+        v = state.get(k)
+        if isinstance(v, CatBuffer):
+            rows = max(rows, len(v))
+        elif isinstance(v, (list, tuple)):
+            total = 0
+            for e in v:
+                arr = jnp.asarray(e)
+                total += int(arr.shape[0]) if arr.ndim else 1
+            rows = max(rows, total)
+    return rows
+
+
+def rejoin_metric(blob: bytes, devices: Any = None, mesh: Any = None) -> Any:
+    """Rehydrate a checkpointed metric on the rejoining rank.
+
+    For sharded cat state, unpickling already rebuilds balanced shards on
+    the default process mesh; pass ``devices``/``mesh`` to place the state
+    on a *different* mesh instead (e.g. the survivors after a preemption, or
+    a larger mesh on scale-up) via the chunked reshard plan.
+    """
+    metric = pickle.loads(blob)
+    if devices is not None or mesh is not None:
+        _reshard_metric_states(metric, devices, mesh)
+    return metric
+
+
+def merge_checkpoint(
+    metric: Any, blob: bytes, devices: Any = None, mesh: Any = None
+) -> int:
     """Merge a checkpointed peer's partial state into ``metric`` in place.
 
     The rejoin-merge contract: both states are mergeable reductions
@@ -215,11 +261,26 @@ def merge_checkpoint(metric: Any, blob: bytes) -> None:
     states merge via the metric's own ``merge_states``), so a rank that was
     absent for E epochs folds back in with one call and the next round
     reports 100% coverage again.
+
+    Cat states re-adopt into the metric's declared layout after the merge:
+    under ``cat_layout='sharded'`` the merged rows land back in a balanced
+    :class:`~torchmetrics_tpu.buffers.ShardedCatBuffer` (optionally on the
+    ``devices``/``mesh`` given — the survivors' mesh after a preemption).
+    Returns the number of sample rows recovered from the checkpoint so the
+    caller can fold them into its next ``begin_round(contrib=...)``.
     """
     peer = pickle.loads(blob)
+    recovered = _checkpoint_samples(peer)
     merged = metric.merge_states([metric.metric_state, peer.metric_state])
     for k, v in merged.items():
         setattr(metric, k, list(v) if isinstance(v, tuple) else v)
+    if hasattr(metric, "_adopt_padded_lists"):
+        # fold merged row lists back into the declared cat layout (padded
+        # buffer, or sharded buffer under cat_layout='sharded')
+        metric._adopt_padded_lists()
+    if devices is not None or mesh is not None:
+        _reshard_metric_states(metric, devices, mesh)
+    return recovered
 
 
 # ---------------------------------------------------------------------------
@@ -493,6 +554,9 @@ class ElasticSync(SyncBackend):
         self._last_contrib: Dict[int, int] = {}
         self._suspects: Set[int] = set()
         self._round_degraded = False
+        # samples recovered via merge_on_rejoin, folded into the next
+        # round's contribution so coverage counts the adopted rows
+        self._adopted_contrib = 0
         self.epoch = 0
         self.last_coverage: Optional[Coverage] = None
 
@@ -628,6 +692,9 @@ class ElasticSync(SyncBackend):
         self._round_policy = policy
         self._round_degraded = False
         self._suspects = set()
+        if self._adopted_contrib:
+            contrib = int(contrib) + self._adopted_contrib
+            self._adopted_contrib = 0
         self._present = set(range(self._expected)) - set(
             getattr(getattr(self._inner, "controller", None), "down", ())
         )
@@ -724,6 +791,28 @@ class ElasticSync(SyncBackend):
                 "the partial result."
             )
         return cov
+
+    def merge_on_rejoin(
+        self, metric: Any, blob: bytes, devices: Any = None, mesh: Any = None
+    ) -> int:
+        """Fold a preempted peer's checkpoint into ``metric`` over the
+        surviving mesh.
+
+        The merge re-adopts the recovered rows into the metric's declared
+        cat layout; sharded cat state re-shards onto ``devices``/``mesh``
+        (the survivors) via the chunked redistribution plan, so the
+        preempted owner's shard never materializes whole on one device. The
+        recovered sample count is returned AND remembered: the next
+        ``begin_round`` adds it to this rank's contribution, so sample
+        coverage accounts for the recovered rows instead of reporting them
+        lost with the departed rank.
+        """
+        recovered = merge_checkpoint(metric, blob, devices=devices, mesh=mesh)
+        self._adopted_contrib += recovered
+        _ELASTIC["rejoins"] += 1
+        if _spans.ENABLED:
+            _spans.instant("elastic.merge_on_rejoin", samples=recovered)
+        return recovered
 
     # -- guarded collectives ---------------------------------------------
     def sync_tensor(self, value: Array, reduction) -> Array:

@@ -10,7 +10,7 @@ import jax.numpy as jnp
 from ..functional.regression.kendall import kendall_rank_corrcoef
 from ..functional.regression.spearman import _spearman_corrcoef_compute
 from ..metric import Metric
-from ..utils.data import padded_cat
+from ..parallel.sharded_compute import padded_or_sharded_cat
 
 Array = jax.Array
 
@@ -43,8 +43,13 @@ class SpearmanCorrCoef(Metric):
         self.target.append(target.astype(jnp.float32))
 
     def compute(self) -> Array:
-        # padded layout: mask each (buffer, count) state to its valid prefix
-        return _spearman_corrcoef_compute(padded_cat(self.preds)[0], padded_cat(self.target)[0])
+        # padded layout: mask each (buffer, count) state to its valid prefix;
+        # sharded layout compacts shard-major on the mesh (rank correlation
+        # is row-order-invariant, and preds/target compact under the same
+        # permutation because they append in lockstep)
+        return _spearman_corrcoef_compute(
+            padded_or_sharded_cat(self.preds)[0], padded_or_sharded_cat(self.target)[0]
+        )
 
 
 class KendallRankCorrCoef(Metric):
@@ -86,5 +91,6 @@ class KendallRankCorrCoef(Metric):
 
     def compute(self):
         return kendall_rank_corrcoef(
-            padded_cat(self.preds)[0], padded_cat(self.target)[0], self.variant, self.t_test, self.alternative
+            padded_or_sharded_cat(self.preds)[0], padded_or_sharded_cat(self.target)[0],
+            self.variant, self.t_test, self.alternative
         )

@@ -17,8 +17,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..metric import Metric
+from ..parallel.sharded_compute import cat_compact
 from ..utils.checks import is_tracing
-from ..utils.data import dim_zero_cat, padded_cat
 
 Array = jax.Array
 
@@ -166,12 +166,16 @@ class RetrievalMetric(Metric, ABC):
         return jnp.sum(target.astype(jnp.float32) * mask, axis=-1) == 0
 
     def compute(self) -> Array:
-        # padded layout: slice each (buffer, count) state to its valid prefix
-        indexes = np.asarray(padded_cat(self.indexes)[0])
-        preds = np.asarray(padded_cat(self.preds)[0])
-        target = np.asarray(padded_cat(self.target)[0])
+        # padded layout: slice each (buffer, count) state to its valid prefix.
+        # Sharded layout compacts on the mesh first (cat_compact) — grouping
+        # by query index is row-order-invariant, so the shard-major order is
+        # as good as append order, and the O(N) densification happens exactly
+        # once here at the epoch boundary rather than inside the jit graph.
+        indexes = np.asarray(cat_compact(self.indexes))
+        preds = np.asarray(cat_compact(self.preds))
+        target = np.asarray(cat_compact(self.target))
         ignore = (
-            np.asarray(dim_zero_cat(self.ignore)).astype(bool)
+            np.asarray(cat_compact(self.ignore)).astype(bool)
             if self.ignore_index is not None
             else None
         )

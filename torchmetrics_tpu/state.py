@@ -80,12 +80,18 @@ class MetricState(MutableMapping):
     - ``reductions``: leaf name → :class:`Reduction` tag (or a mergeable
       sketch callable),
     - ``list_states``: names whose leaves are growing ``cat`` lists /
-      CatBuffers rather than fixed-shape arrays.
+      CatBuffers rather than fixed-shape arrays,
+    - ``sharded_states``: the subset of cat states resident as
+      :class:`~torchmetrics_tpu.buffers.ShardedCatBuffer` under
+      ``NamedSharding(P('batch'))`` — carried in the aux so fused dispatch,
+      scan flushes and every SyncPolicy route see the layout without
+      per-metric code, and so replicated/sharded twins never share a
+      treedef (or an executable-cache line).
 
     Pytree contract: children are the leaf values in insertion order; the
-    aux data is ``(names, reduction items, list-state set)`` — hashable, so
-    two states with equal leaf names and metadata share a treedef and a jit
-    cache line.
+    aux data is ``(names, reduction items, list-state set, sharded set)`` —
+    hashable, so two states with equal leaf names and metadata share a
+    treedef and a jit cache line.
     """
 
     def __init__(
@@ -94,12 +100,14 @@ class MetricState(MutableMapping):
         *,
         reductions: Optional[Mapping[str, Union[Reduction, Callable]]] = None,
         list_states: Any = (),
+        sharded_states: Any = (),
     ) -> None:
         self._leaves: Dict[str, Any] = dict(leaves) if leaves else {}
         self._reductions: Dict[str, Union[Reduction, Callable]] = (
             dict(reductions) if reductions else {}
         )
         self._list_states: frozenset = frozenset(list_states)
+        self._sharded_states: frozenset = frozenset(sharded_states)
 
     # -- mapping protocol over the leaf dict ---------------------------
     def __getitem__(self, name: str) -> Any:
@@ -131,6 +139,10 @@ class MetricState(MutableMapping):
     def list_states(self) -> frozenset:
         return self._list_states
 
+    @property
+    def sharded_states(self) -> frozenset:
+        return self._sharded_states
+
     def reduction(self, name: str) -> Union[Reduction, Callable]:
         return self._reductions.get(name, Reduction.NONE)
 
@@ -139,11 +151,14 @@ class MetricState(MutableMapping):
         name: str,
         reduction: Union[Reduction, Callable],
         list_state: bool = False,
+        sharded: bool = False,
     ) -> None:
         """Declare a leaf's static metadata (called by ``Metric.add_state``)."""
         self._reductions[name] = reduction
         if list_state:
             self._list_states = self._list_states | {name}
+        if sharded:
+            self._sharded_states = self._sharded_states | {name}
 
     # -- views ----------------------------------------------------------
     def tensor_leaves(self) -> Dict[str, Any]:
@@ -153,7 +168,10 @@ class MetricState(MutableMapping):
     def with_leaves(self, leaves: Mapping[str, Any]) -> "MetricState":
         """Same metadata, new leaf values (the pure-update idiom)."""
         return MetricState(
-            leaves, reductions=self._reductions, list_states=self._list_states
+            leaves,
+            reductions=self._reductions,
+            list_states=self._list_states,
+            sharded_states=self._sharded_states,
         )
 
     def copy(self) -> "MetricState":
@@ -167,14 +185,17 @@ class MetricState(MutableMapping):
             names,
             tuple((k, self._reductions[k]) for k in sorted(self._reductions)),
             self._list_states,
+            self._sharded_states,
         )
         return children, aux
 
     @classmethod
     def tree_unflatten(cls, aux, children) -> "MetricState":
-        names, reds, lists = aux
+        # pre-sharded-layout treedefs carry a 3-tuple aux
+        names, reds, lists = aux[:3]
         obj = cls.__new__(cls)
         obj._leaves = dict(zip(names, children))
         obj._reductions = dict(reds)
         obj._list_states = lists
+        obj._sharded_states = aux[3] if len(aux) > 3 else frozenset()
         return obj

@@ -6,18 +6,53 @@ TPU-first differences: ``_bincount`` is implemented as a one-hot matmul-friendly
 segment sum with a *static* ``minlength`` (XLA requires static shapes) and the
 CUDA-determinism fallbacks disappear (TPU is deterministic by default).
 """
+import contextlib
 from typing import List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 
-from ..buffers import CatBuffer
+from ..buffers import CatBuffer, ShardedCatBuffer
 
 Array = jax.Array
+
+# depth > 0 ⇔ inside sharded_oracle(): densifying a sharded buffer is an
+# explicit opt-in, never an accident (ISSUE 20 satellite)
+_ORACLE_DEPTH = [0]
+
+
+@contextlib.contextmanager
+def sharded_oracle():
+    """Allow ``dim_zero_cat``/``padded_cat`` to densify sharded cat state.
+
+    The gather-then-compute path survives only as a bitwise/ε oracle for the
+    distributed kernels in ``parallel.sharded_compute``; wrap oracle reads in
+    this context to acknowledge the full replication onto one device.
+    """
+    _ORACLE_DEPTH[0] += 1
+    try:
+        yield
+    finally:
+        _ORACLE_DEPTH[0] -= 1
+
+
+def _refuse_sharded_densify(x: ShardedCatBuffer) -> None:
+    owner = x.owner or "<unowned sharded cat state>"
+    raise NotImplementedError(
+        f"refusing to densify sharded cat state {owner!r}: dim_zero_cat/"
+        "padded_cat would replicate the full buffer onto one device, undoing "
+        "the NamedSharding layout. Read it through the distributed kernels "
+        "in torchmetrics_tpu.parallel.sharded_compute (cat_compact, "
+        "histogram_auroc, sharded_topk, ...), or wrap the call in "
+        "torchmetrics_tpu.utils.data.sharded_oracle() to opt into the "
+        "gather-then-compute oracle explicitly."
+    )
 
 
 def dim_zero_cat(x: Union[Array, List[Array], tuple, CatBuffer]) -> Array:
     """Concatenate a (possibly list-valued or padded-buffer) state along dim 0."""
+    if isinstance(x, ShardedCatBuffer) and not _ORACLE_DEPTH[0]:
+        _refuse_sharded_densify(x)
     if isinstance(x, CatBuffer):
         return x.materialize()
     if isinstance(x, (jnp.ndarray, jax.Array)) and not isinstance(x, (list, tuple)):
@@ -50,6 +85,8 @@ def cat_state_or_empty(x: Union[Array, List[Array], tuple, CatBuffer], dtype=jnp
     list's truthiness must handle both forms. Empty lists yield an empty
     array instead of raising.
     """
+    if isinstance(x, ShardedCatBuffer) and not _ORACLE_DEPTH[0]:
+        _refuse_sharded_densify(x)
     if isinstance(x, CatBuffer):
         return x.materialize()
     if not isinstance(x, (list, tuple)):
